@@ -1,0 +1,14 @@
+"""dcf_tpu — TPU-native two-party Distributed Comparison Function framework.
+
+A ground-up reimplementation of the capabilities of the reference Rust crate
+xymeng16/dcf (GGM-tree DCF keygen, XOR-output-group batch evaluation,
+AES-256 Hirose PRG, key serialization), redesigned for TPU:
+
+- ``dcf_tpu.spec`` — pure-Python bit-exact golden model (see the package
+  modules' own docstrings for the full map as they land: keys, gen, backends,
+  ops, parallel).
+"""
+
+from dcf_tpu.spec import Bound, CmpFn  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,339 @@
+"""Pure-Python golden model of the DCF scheme (the "spec").
+
+This module is the slow, obviously-correct executable specification of the
+two-party Distributed Comparison Function implemented by the reference crate
+(xymeng16/dcf).  Every other backend in this framework — the vectorized numpy
+backend, the C++ host core, and the JAX/Pallas TPU backend — is validated
+bit-for-bit against this model.
+
+Semantics honored here (see SURVEY.md §0, §2.1, §3):
+
+* ``f_{alpha,beta}(x) = beta if x < alpha else 0`` for the ``LT_BETA`` bound
+  (strict: ``f(alpha) = 0``), ``x > alpha`` for ``GT_BETA``.  Reference:
+  ``/root/reference/src/lib.rs:62`` and the test vectors at ``src/lib.rs:363-370``.
+* Comparison order is unsigned big-endian lexicographic over the ``n_bytes``
+  input bytes; the GGM tree is walked MSB-first (``src/lib.rs:106, 181``).
+* The output group is XOR (byte-wise), not additive — reconstruction is
+  ``y0 ^ y1`` (``src/lib.rs:390-392``).
+* The PRG is the Hirose double-block-length construction over AES-256 with
+  its exact loop-truncation quirk (``src/prg.rs:42-73``, SURVEY.md §2.1):
+  only ``min(2, lam // 16)`` block positions are ever encrypted, the t-bits
+  are taken from the two *left-child* buffers before masking, and the LSB of
+  the last byte of all four outputs is cleared (effective output ``8*lam - 1``
+  bits).
+
+Everything here operates on ``bytes`` and Python ints; no numpy, no JAX.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+from typing import Sequence
+
+__all__ = [
+    "AES_SBOX",
+    "aes256_expand_key",
+    "aes256_encrypt_block",
+    "HirosePrgSpec",
+    "Bound",
+    "CmpFn",
+    "Cw",
+    "Share",
+    "gen",
+    "eval_point",
+    "eval_batch",
+    "xor_bytes",
+]
+
+
+# ---------------------------------------------------------------------------
+# AES-256 (FIPS-197), minimal encrypt-only implementation.
+# ---------------------------------------------------------------------------
+
+def _build_sbox() -> bytes:
+    """Generate the AES S-box from first principles (GF(2^8) inverse + affine).
+
+    Generated rather than transcribed so a typo is impossible; validated in
+    tests against the `cryptography` package and the reference PRG vectors.
+    """
+    # GF(2^8) exp/log tables using generator 3.
+    exp = [0] * 512
+    log = [0] * 256
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        # multiply x by 3 = x ^ (x<<1) with reduction by 0x11b
+        x ^= (x << 1) ^ (0x11B if x & 0x80 else 0)
+        x &= 0xFF
+    for i in range(255, 512):
+        exp[i] = exp[i - 255]
+
+    def inv(a: int) -> int:
+        return 0 if a == 0 else exp[255 - log[a]]
+
+    sbox = bytearray(256)
+    for a in range(256):
+        b = inv(a)
+        r = 0x63
+        for shift in (0, 1, 2, 3, 4):
+            r ^= ((b << shift) | (b >> (8 - shift))) & 0xFF
+        sbox[a] = r
+    return bytes(sbox)
+
+
+AES_SBOX = _build_sbox()
+
+_RCON = [0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36, 0x6C]
+
+
+def aes256_expand_key(key: bytes) -> list[bytes]:
+    """Expand a 32-byte AES-256 key into 15 round keys of 16 bytes each."""
+    if len(key) != 32:
+        raise ValueError("AES-256 key must be 32 bytes")
+    nk, nr = 8, 14
+    w = [key[4 * i : 4 * i + 4] for i in range(nk)]
+    for i in range(nk, 4 * (nr + 1)):
+        temp = w[i - 1]
+        if i % nk == 0:
+            rot = temp[1:] + temp[:1]
+            temp = bytes(AES_SBOX[b] for b in rot)
+            temp = bytes([temp[0] ^ _RCON[i // nk - 1], temp[1], temp[2], temp[3]])
+        elif i % nk == 4:
+            temp = bytes(AES_SBOX[b] for b in temp)
+        w.append(bytes(a ^ b for a, b in zip(w[i - nk], temp)))
+    return [b"".join(w[4 * r : 4 * r + 4]) for r in range(nr + 1)]
+
+
+def _xtime(a: int) -> int:
+    return ((a << 1) ^ (0x1B if a & 0x80 else 0)) & 0xFF
+
+
+_SHIFT_ROWS = [0, 5, 10, 15, 4, 9, 14, 3, 8, 13, 2, 7, 12, 1, 6, 11]
+
+
+def aes256_encrypt_block(round_keys: Sequence[bytes], block: bytes) -> bytes:
+    """Encrypt one 16-byte block with pre-expanded AES-256 round keys."""
+    s = bytes(a ^ b for a, b in zip(block, round_keys[0]))
+    for rnd in range(1, 14):
+        s = bytes(AES_SBOX[b] for b in s)
+        s = bytes(s[i] for i in _SHIFT_ROWS)
+        out = bytearray(16)
+        for c in range(4):
+            a0, a1, a2, a3 = s[4 * c : 4 * c + 4]
+            out[4 * c + 0] = _xtime(a0) ^ _xtime(a1) ^ a1 ^ a2 ^ a3
+            out[4 * c + 1] = a0 ^ _xtime(a1) ^ _xtime(a2) ^ a2 ^ a3
+            out[4 * c + 2] = a0 ^ a1 ^ _xtime(a2) ^ _xtime(a3) ^ a3
+            out[4 * c + 3] = _xtime(a0) ^ a0 ^ a1 ^ a2 ^ _xtime(a3)
+        s = bytes(a ^ b for a, b in zip(out, round_keys[rnd]))
+    s = bytes(AES_SBOX[b] for b in s)
+    s = bytes(s[i] for i in _SHIFT_ROWS)
+    return bytes(a ^ b for a, b in zip(s, round_keys[14]))
+
+
+def xor_bytes(*parts: bytes) -> bytes:
+    """Byte-wise XOR of equal-length byte strings (utils::xor analog)."""
+    out = bytearray(parts[0])
+    for p in parts[1:]:
+        for i, b in enumerate(p):
+            out[i] ^= b
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# Hirose PRG (reference src/prg.rs:22-74), with its exact quirks.
+# ---------------------------------------------------------------------------
+
+
+class HirosePrgSpec:
+    """Bit-exact model of ``Aes256HirosePrg<LAMBDA, N_KEYS>``.
+
+    ``keys`` are the caller-supplied 32-byte AES-256 keys; the reference
+    requires ``lam % 16 == 0`` and ``len(keys) == 2 * (lam // 16)``, but only
+    ciphers ``0`` and (when ``lam >= 32``) ``17`` are ever used, because the
+    encryption loop ``(0..2).zip(0..lam/16)`` truncates to
+    ``min(2, lam // 16)`` iterations with ``i == j`` (src/prg.rs:48-56).
+
+    Reference-executable ``lam`` values are ``16`` and multiples of 16 that
+    are ``>= 144``: for ``32 <= lam < 144`` the reference's own key-count
+    contract gives ``2 * (lam // 16) <= 17`` ciphers, so indexing
+    ``ciphers[17]`` panics (src/prg.rs:51).  This framework still supports
+    those shapes (e.g. the BASELINE.json lam=128 metric) as an extension,
+    provided ``keys`` covers index 17; the divergence is documented here
+    because no reference behavior exists to diverge from.
+    """
+
+    def __init__(self, lam: int, keys: Sequence[bytes]):
+        if lam % 16 != 0:
+            raise ValueError("lam must be a multiple of 16 bytes")
+        self.lam = lam
+        used = [17 * k for k in range(min(2, lam // 16))]
+        if used and used[-1] >= len(keys):
+            raise ValueError(
+                f"lam={lam} uses cipher indices {used}; got {len(keys)} keys"
+            )
+        # Only indices 17*k are ever used — skip expanding the rest (the
+        # reference contract supplies 2*(lam/16) keys, 2046 unused at lam=16384).
+        self.round_keys = {i: aes256_expand_key(keys[i]) for i in used}
+
+    def gen(self, seed: bytes) -> list[tuple[bytes, bytes, bool]]:
+        lam = self.lam
+        assert len(seed) == lam
+        seed_p = bytes(b ^ 0xFF for b in seed)  # seed ^ c, c = 0xff.. (prg.rs:36-38,44)
+        buf0 = [bytearray(lam), bytearray(lam)]
+        buf1 = [bytearray(lam), bytearray(lam)]
+        # zip truncation: iterations (k, k) for k in 0..min(2, lam/16);
+        # cipher index is i*16 + j = 17*k (src/prg.rs:48-51).
+        for k in range(min(2, lam // 16)):
+            rk = self.round_keys[17 * k]
+            lo, hi = 16 * k, 16 * (k + 1)
+            buf0[k][lo:hi] = aes256_encrypt_block(rk, seed[lo:hi])
+            buf1[k][lo:hi] = aes256_encrypt_block(rk, seed_p[lo:hi])
+        # Miyaguchi-style feed-forward into BOTH halves (src/prg.rs:57-62);
+        # never-encrypted halves become literal copies of seed / seed_p.
+        for k in range(2):
+            buf0[k] = bytearray(a ^ b for a, b in zip(buf0[k], seed))
+            buf1[k] = bytearray(a ^ b for a, b in zip(buf1[k], seed_p))
+        # t-bits from the two buffers of half 0, BEFORE masking (src/prg.rs:63-64).
+        bit0 = bool(buf0[0][0] & 1)
+        bit1 = bool(buf1[0][0] & 1)
+        # Clear LSB of last byte of all four outputs (src/prg.rs:65-68).
+        for buf in (buf0[0], buf0[1], buf1[0], buf1[1]):
+            buf[lam - 1] &= 0xFE
+        return [
+            (bytes(buf0[0]), bytes(buf1[0]), bit0),
+            (bytes(buf0[1]), bytes(buf1[1]), bit1),
+        ]
+
+
+# ---------------------------------------------------------------------------
+# DCF gen / eval (reference src/lib.rs:86-204).
+# ---------------------------------------------------------------------------
+
+
+class Bound(Enum):
+    """BoundState (src/lib.rs:342-349)."""
+
+    LT_BETA = "lt"  # f(x) = beta iff x < alpha (paper's preference)
+    GT_BETA = "gt"  # f(x) = beta iff x > alpha
+
+
+@dataclass(frozen=True)
+class CmpFn:
+    """Comparison function description (src/lib.rs:41-46)."""
+
+    alpha: bytes
+    beta: bytes
+
+
+@dataclass(frozen=True)
+class Cw:
+    """Correction word (src/lib.rs:209-214)."""
+
+    s: bytes
+    v: bytes
+    tl: bool
+    tr: bool
+
+
+@dataclass(frozen=True)
+class Share:
+    """DCF key (src/lib.rs:275-283).
+
+    ``s0s`` has length 2 out of ``gen`` and length 1 as input to ``eval``
+    (only ``s0s[0]`` is read). ``cws``/``cw_np1`` are identical for both
+    parties; only the starting seed differs.
+    """
+
+    s0s: tuple[bytes, ...]
+    cws: tuple[Cw, ...]
+    cw_np1: bytes
+
+    def for_party(self, b: int) -> "Share":
+        return Share(s0s=(self.s0s[b],), cws=self.cws, cw_np1=self.cw_np1)
+
+
+def _bit_msb(data: bytes, i: int) -> bool:
+    """Bit i of ``data`` in MSB-first order (bitvec Msb0 view)."""
+    return bool((data[i // 8] >> (7 - i % 8)) & 1)
+
+
+def gen(
+    prg: HirosePrgSpec,
+    f: CmpFn,
+    s0s: Sequence[bytes],
+    bound: Bound,
+) -> Share:
+    """GGM-tree key generation (src/lib.rs:86-161)."""
+    n_bytes, lam = len(f.alpha), len(f.beta)
+    n = 8 * n_bytes
+    zero = bytes(lam)
+    v_alpha = zero
+    ss = [(bytes(s0s[0]), bytes(s0s[1]))]
+    ts = [(False, True)]
+    cws: list[Cw] = []
+    for i in range(1, n + 1):
+        (s0l, v0l, t0l), (s0r, v0r, t0r) = prg.gen(ss[i - 1][0])
+        (s1l, v1l, t1l), (s1r, v1r, t1r) = prg.gen(ss[i - 1][1])
+        alpha_i = _bit_msb(f.alpha, i - 1)
+        keep, lose = (1, 0) if alpha_i else (0, 1)  # 0 = L, 1 = R
+        s_cw = xor_bytes([s0l, s0r][lose], [s1l, s1r][lose])
+        v_cw = xor_bytes([v0l, v0r][lose], [v1l, v1r][lose], v_alpha)
+        if bound is Bound.LT_BETA:
+            if lose == 0:
+                v_cw = xor_bytes(v_cw, f.beta)
+        else:
+            if lose == 1:
+                v_cw = xor_bytes(v_cw, f.beta)
+        v_alpha = xor_bytes(v_alpha, [v0l, v0r][keep], [v1l, v1r][keep], v_cw)
+        tl_cw = t0l ^ t1l ^ alpha_i ^ True
+        tr_cw = t0r ^ t1r ^ alpha_i
+        cws.append(Cw(s=s_cw, v=v_cw, tl=tl_cw, tr=tr_cw))
+        ss.append(
+            (
+                xor_bytes([s0l, s0r][keep], s_cw if ts[i - 1][0] else zero),
+                xor_bytes([s1l, s1r][keep], s_cw if ts[i - 1][1] else zero),
+            )
+        )
+        ts.append(
+            (
+                [t0l, t0r][keep] ^ (ts[i - 1][0] & [tl_cw, tr_cw][keep]),
+                [t1l, t1r][keep] ^ (ts[i - 1][1] & [tl_cw, tr_cw][keep]),
+            )
+        )
+    cw_np1 = xor_bytes(ss[n][0], ss[n][1], v_alpha)
+    return Share(s0s=(bytes(s0s[0]), bytes(s0s[1])), cws=tuple(cws), cw_np1=cw_np1)
+
+
+def eval_point(prg: HirosePrgSpec, b: bool, k: Share, x: bytes) -> bytes:
+    """Single-point evaluation (src/lib.rs:163-193)."""
+    n = len(k.cws)
+    lam = len(k.cw_np1)
+    assert n == 8 * len(x)
+    zero = bytes(lam)
+    s = k.s0s[0]
+    t = bool(b)
+    v = zero
+    for i in range(1, n + 1):
+        cw = k.cws[i - 1]
+        (sl, vl_hat, tl), (sr, vr_hat, tr) = prg.gen(s)
+        if t:
+            sl = xor_bytes(sl, cw.s)
+            sr = xor_bytes(sr, cw.s)
+        tl ^= t & cw.tl
+        tr ^= t & cw.tr
+        if _bit_msb(x, i - 1):
+            v = xor_bytes(v, vr_hat, cw.v if t else zero)
+            s, t = sr, tr
+        else:
+            v = xor_bytes(v, vl_hat, cw.v if t else zero)
+            s, t = sl, tl
+    return xor_bytes(v, s, k.cw_np1 if t else zero)
+
+
+def eval_batch(
+    prg: HirosePrgSpec, b: bool, k: Share, xs: Sequence[bytes]
+) -> list[bytes]:
+    """Batch evaluation: a pure map over points (src/lib.rs:194-203)."""
+    return [eval_point(prg, b, k, x) for x in xs]

@@ -1,0 +1,212 @@
+"""Spec-model tests: ports of the reference's four unit tests plus AES checks.
+
+Reference tests ported (SURVEY.md §4):
+- test_dcf_gen_then_eval_ok            (src/lib.rs:372-395)
+- test_dcf_gen_gt_beta_then_eval_ok    (src/lib.rs:397-420)
+- test_dcf_gen_then_eval_not_zeros     (src/lib.rs:422-442)
+- test_prg_gen_not_zeros               (src/prg.rs:86-96)
+"""
+
+import random
+
+import pytest
+
+from dcf_tpu import spec
+from tests.vectors import ALPHAS, BETA, KEYS, PRG_SEED
+
+
+def rand_bytes(rng: random.Random, n: int) -> bytes:
+    return bytes(rng.getrandbits(8) for _ in range(n))
+
+
+# ---------------------------------------------------------------------------
+# AES-256 primitives
+# ---------------------------------------------------------------------------
+
+
+def test_aes_sbox_known_entries():
+    # FIPS-197 figure 7 spot checks.
+    assert spec.AES_SBOX[0x00] == 0x63
+    assert spec.AES_SBOX[0x01] == 0x7C
+    assert spec.AES_SBOX[0x53] == 0xED
+    assert spec.AES_SBOX[0xFF] == 0x16
+
+
+def test_aes256_fips197_vector():
+    # FIPS-197 appendix C.3: AES-256 of 00112233..ff under key 000102..1f.
+    key = bytes(range(32))
+    block = bytes.fromhex("00112233445566778899aabbccddeeff")
+    rk = spec.aes256_expand_key(key)
+    out = spec.aes256_encrypt_block(rk, block)
+    assert out == bytes.fromhex("8ea2b7ca516745bfeafc49904b496089")
+
+
+def test_aes256_matches_cryptography_lib():
+    cryptography = pytest.importorskip("cryptography")  # noqa: F841
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    rng = random.Random(7)
+    for _ in range(8):
+        key = rand_bytes(rng, 32)
+        block = rand_bytes(rng, 16)
+        enc = Cipher(algorithms.AES(key), modes.ECB()).encryptor()
+        expect = enc.update(block) + enc.finalize()
+        got = spec.aes256_encrypt_block(spec.aes256_expand_key(key), block)
+        assert got == expect
+
+
+# ---------------------------------------------------------------------------
+# PRG
+# ---------------------------------------------------------------------------
+
+
+def test_prg_gen_not_zeros():
+    prg = spec.HirosePrgSpec(16, KEYS)
+    out = prg.gen(PRG_SEED)
+    zero = bytes(16)
+    for s, v, _t in out:
+        assert s != zero
+        assert v != zero
+        assert spec.xor_bytes(s, PRG_SEED) != zero
+        assert spec.xor_bytes(v, PRG_SEED) != zero
+
+
+def test_prg_right_child_is_seed_copy():
+    # The zip-truncation quirk (SURVEY.md §2.1): for lam=16 the right child's
+    # s is the (masked) seed and its v is the (masked) seed ^ 0xff...
+    prg = spec.HirosePrgSpec(16, KEYS)
+    (s_l, v_l, t_l), (s_r, v_r, t_r) = prg.gen(PRG_SEED)
+    seed_p = bytes(b ^ 0xFF for b in PRG_SEED)
+    mask = PRG_SEED[:15] + bytes([PRG_SEED[15] & 0xFE])
+    mask_p = seed_p[:15] + bytes([seed_p[15] & 0xFE])
+    assert s_r == mask
+    assert v_r == mask_p
+
+
+def test_prg_t_bit_sourcing():
+    # Both t-bits come from byte 0 of the *half-0* buffers (src/prg.rs:63-64):
+    # t_l from buf0[0] (= s_l) and t_r from buf1[0] (= v_l) — NOT from the
+    # right child's buffers.  Byte 0 is untouched by the last-byte masking,
+    # so the returned s_l/v_l expose the exact source bits.
+    prg = spec.HirosePrgSpec(16, KEYS)
+    rng = random.Random(9)
+    for _ in range(32):
+        seed = rand_bytes(rng, 16)
+        (s_l, v_l, t_l), (_s_r, _v_r, t_r) = prg.gen(seed)
+        assert t_l == bool(s_l[0] & 1)
+        assert t_r == bool(v_l[0] & 1)
+
+
+def test_prg_key_count_contract():
+    # lam=32 under the reference's own key-count contract (2*(lam/16) = 4
+    # keys) would index ciphers[17] and panic; the framework refuses it.
+    rng = random.Random(10)
+    with pytest.raises(ValueError):
+        spec.HirosePrgSpec(32, [rand_bytes(rng, 32) for _ in range(4)])
+
+
+def test_prg_last_bit_cleared():
+    prg = spec.HirosePrgSpec(16, KEYS)
+    rng = random.Random(1)
+    for _ in range(4):
+        seed = rand_bytes(rng, 16)
+        for s, v, _t in prg.gen(seed):
+            assert s[15] & 1 == 0
+            assert v[15] & 1 == 0
+
+
+def test_prg_large_lambda_shape():
+    # lam=32 exercises both loop iterations (ciphers 0 and 17).
+    rng = random.Random(2)
+    keys = [rand_bytes(rng, 32) for _ in range(4 * 16 + 2)]
+    prg = spec.HirosePrgSpec(32, keys)
+    seed = rand_bytes(rng, 32)
+    (s_l, v_l, _), (s_r, v_r, _) = prg.gen(seed)
+    seed_p = bytes(b ^ 0xFF for b in seed)
+    # Half 0 block 0 encrypted, block 1 of half 0 is seed copy (feed-forward of
+    # zeros); half 1 block 1 encrypted, block 0 is seed copy.
+    assert s_l[:16] != seed[:16]
+    assert s_l[16:] == seed[16:31] + bytes([seed[31] & 0xFE])
+    assert s_r[:16] == seed[:16]
+    assert v_l[16:] == seed_p[16:31] + bytes([seed_p[31] & 0xFE])
+    assert v_r[:16] == seed_p[:16]
+
+
+# ---------------------------------------------------------------------------
+# DCF end-to-end (ported reference tests)
+# ---------------------------------------------------------------------------
+
+
+def _keypair(bound: spec.Bound, seed: int = 42):
+    rng = random.Random(seed)
+    prg = spec.HirosePrgSpec(16, KEYS)
+    s0s = [rand_bytes(rng, 16), rand_bytes(rng, 16)]
+    f = spec.CmpFn(alpha=ALPHAS[2], beta=BETA)
+    k = spec.gen(prg, f, s0s, bound)
+    return prg, k.for_party(0), k.for_party(1)
+
+
+def test_dcf_gen_then_eval_ok():
+    prg, k0, k1 = _keypair(spec.Bound.LT_BETA)
+    ys0 = spec.eval_batch(prg, False, k0, ALPHAS)
+    ys1 = spec.eval_batch(prg, True, k1, ALPHAS)
+    recon = [spec.xor_bytes(a, b) for a, b in zip(ys0, ys1)]
+    assert recon == [BETA, BETA, bytes(16), bytes(16), bytes(16)]
+
+
+def test_dcf_gen_gt_beta_then_eval_ok():
+    prg, k0, k1 = _keypair(spec.Bound.GT_BETA)
+    ys0 = spec.eval_batch(prg, False, k0, ALPHAS)
+    ys1 = spec.eval_batch(prg, True, k1, ALPHAS)
+    recon = [spec.xor_bytes(a, b) for a, b in zip(ys0, ys1)]
+    assert recon == [bytes(16), bytes(16), bytes(16), BETA, BETA]
+
+
+def test_dcf_gen_then_eval_not_zeros():
+    prg, k0, k1 = _keypair(spec.Bound.LT_BETA)
+    y0 = spec.eval_point(prg, False, k0, ALPHAS[2])
+    y1 = spec.eval_point(prg, True, k1, ALPHAS[2])
+    assert y0 != bytes(16)
+    assert y1 != bytes(16)
+
+
+def test_dcf_full_domain_small_n():
+    # Full-domain eval at n_bytes=1 (256 points): output must be exactly
+    # [beta]*alpha + [0]*(256-alpha) for LT, and the complement (minus x=alpha)
+    # for GT.
+    rng = random.Random(3)
+    keys = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    prg = spec.HirosePrgSpec(16, keys)
+    alpha = bytes([0x5A])
+    beta = rand_bytes(rng, 16)
+    s0s = [rand_bytes(rng, 16), rand_bytes(rng, 16)]
+    k = spec.gen(prg, spec.CmpFn(alpha, beta), s0s, spec.Bound.LT_BETA)
+    xs = [bytes([i]) for i in range(256)]
+    ys0 = spec.eval_batch(prg, False, k.for_party(0), xs)
+    ys1 = spec.eval_batch(prg, True, k.for_party(1), xs)
+    for i, (y0, y1) in enumerate(zip(ys0, ys1)):
+        expect = beta if i < 0x5A else bytes(16)
+        assert spec.xor_bytes(y0, y1) == expect, f"x={i}"
+
+
+def test_dcf_random_property():
+    # Property test: XOR of party evals equals f(x) for random alpha/beta/x.
+    rng = random.Random(4)
+    for trial in range(3):
+        keys = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+        prg = spec.HirosePrgSpec(16, keys)
+        n_bytes = 2
+        alpha = rand_bytes(rng, n_bytes)
+        beta = rand_bytes(rng, 16)
+        s0s = [rand_bytes(rng, 16), rand_bytes(rng, 16)]
+        for bound in (spec.Bound.LT_BETA, spec.Bound.GT_BETA):
+            k = spec.gen(prg, spec.CmpFn(alpha, beta), s0s, bound)
+            xs = [rand_bytes(rng, n_bytes) for _ in range(16)] + [alpha]
+            ys0 = spec.eval_batch(prg, False, k.for_party(0), xs)
+            ys1 = spec.eval_batch(prg, True, k.for_party(1), xs)
+            for x, y0, y1 in zip(xs, ys0, ys1):
+                if bound is spec.Bound.LT_BETA:
+                    expect = beta if x < alpha else bytes(16)
+                else:
+                    expect = beta if x > alpha else bytes(16)
+                assert spec.xor_bytes(y0, y1) == expect

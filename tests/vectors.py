@@ -1,0 +1,28 @@
+"""Shared test vectors, byte-identical to the reference crate's.
+
+These are the hardcoded constants from /root/reference/src/lib.rs:359-370 and
+/root/reference/src/prg.rs:79-84 (data, not code): two AES-256 keys, five
+alpha values straddling ALPHAS[2] (the last three differ only in the final
+byte, 0x55 < 0x56 < 0x57), a fixed beta, and the PRG test seed.
+"""
+
+KEYS = [
+    b"j9\x1b_\xb3X\xf33\xacW\x15\x1b\x0812K\xb3I\xb9\x90r\x1cN\xb5\xee9W\xd3\xbb@\xc6d",
+    b"\x9b\x15\xc8\x0f\xb7\xbc!q\x9e\x89\xb8\xf7\x0e\xa0S\x9dN\xfa\x0c;\x16\xe4\x98\x82b\xfcdy\xb5\x8c{\xc2",
+]
+
+ALPHAS = [
+    b"K\xa9W\xf5\xdd\x05\xe9\xfc?\x04\xf6\xfbUo\xa8C",
+    b"\xc2GK\xda\xc6\xbb\x99\x98Fq\"f\xb7\x8csU",
+    b"\xc2GK\xda\xc6\xbb\x99\x98Fq\"f\xb7\x8csV",
+    b"\xc2GK\xda\xc6\xbb\x99\x98Fq\"f\xb7\x8csW",
+    b"\xef\x96\x97\xd7\x8f\x8a\xa4AP\n\xb35\xb5k\xff\x97",
+]
+
+BETA = b"\x03\x11\x97\x12C\x8a\xe9#\x81\xa8\xde\xa8\x8f \xc0\xbb"
+
+PRG_SEED = b"*L\x8f%y\x12Z\x94*E\x8f$+NH\x19"
+
+assert all(len(k) == 32 for k in KEYS)
+assert all(len(a) == 16 for a in ALPHAS)
+assert len(BETA) == 16 and len(PRG_SEED) == 16
