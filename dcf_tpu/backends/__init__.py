@@ -1,0 +1,12 @@
+"""Evaluation backends.
+
+All backends compute the same function bit-for-bit — the per-party DCF
+evaluation y_b = Eval(b, k, x) (reference src/lib.rs:163-204) — over batches:
+
+- ``numpy_backend`` — vectorized host oracle (the layout blueprint)
+- ``native`` (dcf_tpu.native) — C++ host core, serial + threaded
+- ``jax_backend`` — lax.scan/vmap TPU path (single chip)
+- ``dcf_tpu.parallel`` — the JAX path sharded over a device mesh
+"""
+
+from dcf_tpu.backends.numpy_backend import eval_batch_np  # noqa: F401

@@ -1,0 +1,102 @@
+"""Host-side batched key generation.
+
+Vectorized numpy port of the GGM keygen (reference src/lib.rs:86-161) over a
+key axis: K comparison functions are processed level-by-level with one batched
+PRG call per party per level (2K AES-256 block pairs), instead of the
+reference's one-key-at-a-time loop.  Keygen is inherently sequential across
+the n = 8*n_bytes levels (level i consumes level i-1's seeds), so it stays on
+the host; keys are generated once and shipped to HBM for evaluation.
+
+A C++ fast path with the same output lives in ``dcf_tpu.native``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.spec import Bound
+
+__all__ = ["gen_batch", "random_s0s"]
+
+
+def random_s0s(num_keys: int, lam: int, rng: np.random.Generator) -> np.ndarray:
+    """Sample the two random starting seeds per key: uint8 [K, 2, lam]."""
+    return rng.integers(0, 256, size=(num_keys, 2, lam), dtype=np.uint8)
+
+
+def _sel(left: np.ndarray, right: np.ndarray, take_right: np.ndarray) -> np.ndarray:
+    """Per-key child selection; take_right is uint8 [K] broadcast over trailing dims."""
+    cond = take_right.astype(bool).reshape(-1, *([1] * (left.ndim - 1)))
+    return np.where(cond, right, left)
+
+
+def gen_batch(
+    prg: HirosePrgNp,
+    alphas: np.ndarray,
+    betas: np.ndarray,
+    s0s: np.ndarray,
+    bound: Bound,
+) -> KeyBundle:
+    """Generate K DCF keys at once.
+
+    alphas: uint8 [K, n_bytes]; betas: uint8 [K, lam]; s0s: uint8 [K, 2, lam].
+    Returns a two-party KeyBundle (s0s retained with P=2).
+    """
+    k_num, n_bytes = alphas.shape
+    lam = prg.lam
+    if betas.shape != (k_num, lam) or s0s.shape != (k_num, 2, lam):
+        raise ValueError("alphas/betas/s0s shape mismatch")
+    n = 8 * n_bytes
+    # MSB-first bit planes of alpha: uint8 [K, n] (np.unpackbits is MSB-first,
+    # matching the reference's Msb0 bit view at src/lib.rs:106).
+    alpha_bits = np.unpackbits(alphas, axis=1)
+
+    s_a = s0s[:, 0, :].copy()  # party 0 seeds [K, lam]
+    s_b = s0s[:, 1, :].copy()  # party 1 seeds
+    t_a = np.zeros(k_num, dtype=np.uint8)  # t^(0)_0 = 0
+    t_b = np.ones(k_num, dtype=np.uint8)  # t^(0)_1 = 1
+    v_alpha = np.zeros((k_num, lam), dtype=np.uint8)
+
+    cw_s = np.zeros((k_num, n, lam), dtype=np.uint8)
+    cw_v = np.zeros((k_num, n, lam), dtype=np.uint8)
+    cw_t = np.zeros((k_num, n, 2), dtype=np.uint8)
+
+    for i in range(n):
+        p0 = prg.gen(s_a)
+        p1 = prg.gen(s_b)
+        a_i = alpha_bits[:, i]  # 1 -> keep R / lose L
+        # lose side: R when a_i == 0, L when a_i == 1.
+        lose_is_r = (a_i ^ 1).astype(np.uint8)
+        s_cw = _sel(p0.s_l, p0.s_r, lose_is_r) ^ _sel(p1.s_l, p1.s_r, lose_is_r)
+        v_cw = (
+            _sel(p0.v_l, p0.v_r, lose_is_r)
+            ^ _sel(p1.v_l, p1.v_r, lose_is_r)
+            ^ v_alpha
+        )
+        # beta folds into v_cw when the lose side matches the bound
+        # (src/lib.rs:114-125): LT_BETA on lose==L (a_i==1), GT_BETA on
+        # lose==R (a_i==0).
+        beta_gate = a_i if bound is Bound.LT_BETA else (a_i ^ 1)
+        v_cw ^= betas * beta_gate[:, None]
+        v_alpha ^= _sel(p0.v_l, p0.v_r, a_i) ^ _sel(p1.v_l, p1.v_r, a_i) ^ v_cw
+        tl_cw = p0.t_l ^ p1.t_l ^ a_i ^ 1
+        tr_cw = p0.t_r ^ p1.t_r ^ a_i
+        cw_s[:, i] = s_cw
+        cw_v[:, i] = v_cw
+        cw_t[:, i, 0] = tl_cw
+        cw_t[:, i, 1] = tr_cw
+        t_cw_keep = _sel(tl_cw, tr_cw, a_i)
+        new_s_a = _sel(p0.s_l, p0.s_r, a_i) ^ s_cw * t_a[:, None]
+        new_s_b = _sel(p1.s_l, p1.s_r, a_i) ^ s_cw * t_b[:, None]
+        new_t_a = _sel(p0.t_l, p0.t_r, a_i) ^ (t_a & t_cw_keep)
+        new_t_b = _sel(p1.t_l, p1.t_r, a_i) ^ (t_b & t_cw_keep)
+        s_a, s_b, t_a, t_b = new_s_a, new_s_b, new_t_a, new_t_b
+
+    cw_np1 = s_a ^ s_b ^ v_alpha
+    return KeyBundle(
+        s0s=s0s.copy(), cw_s=cw_s, cw_v=cw_v, cw_t=cw_t, cw_np1=cw_np1
+    )

@@ -1,0 +1,202 @@
+"""Key material: structure-of-arrays bundles and serialization.
+
+The reference keeps one ``Share`` per function (array-of-structs,
+src/lib.rs:275-283) with hand-written positional serde.  On TPU the natural
+layout is structure-of-arrays stacked over a key axis — the same arrays are
+the HBM upload image for the eval kernels:
+
+    s0s     uint8 [K, P, lam]   starting seeds (P = 2 from gen, 1 per party)
+    cw_s    uint8 [K, n, lam]   correction-word seeds
+    cw_v    uint8 [K, n, lam]   correction-word values
+    cw_t    uint8 [K, n, 2]     (tl, tr) bits
+    cw_np1  uint8 [K, lam]      final correction word
+
+``cws``/``cw_np1`` are shared by both parties; only the starting seed differs
+(src/lib.rs:269-272).  Two codecs are provided: ``.npz`` (convenience) and a
+flat framed binary (``DCFK`` magic) that is the documented wire format the
+reference's unused bincode/serde deps gesture at (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+from dcf_tpu import spec
+
+__all__ = ["KeyBundle"]
+
+_MAGIC = b"DCFK"
+_VERSION = 1
+
+
+@dataclass(frozen=True)
+class KeyBundle:
+    """K stacked DCF keys in structure-of-arrays layout."""
+
+    s0s: np.ndarray  # uint8 [K, P, lam], P in {1, 2}
+    cw_s: np.ndarray  # uint8 [K, n, lam]
+    cw_v: np.ndarray  # uint8 [K, n, lam]
+    cw_t: np.ndarray  # uint8 [K, n, 2]
+    cw_np1: np.ndarray  # uint8 [K, lam]
+
+    def __post_init__(self):
+        k, n, lam = self.cw_s.shape
+        if self.s0s.shape[0] != k or self.s0s.shape[2] != lam:
+            raise ValueError("s0s shape mismatch")
+        if self.s0s.shape[1] not in (1, 2):
+            raise ValueError("s0s party dimension must be 1 or 2")
+        if self.cw_v.shape != (k, n, lam) or self.cw_t.shape != (k, n, 2):
+            raise ValueError("cw shape mismatch")
+        if self.cw_np1.shape != (k, lam):
+            raise ValueError("cw_np1 shape mismatch")
+        if n % 8 != 0:
+            raise ValueError("n must be a multiple of 8 bits")
+        for a in (self.s0s, self.cw_s, self.cw_v, self.cw_t, self.cw_np1):
+            if a.dtype != np.uint8:
+                raise ValueError("all bundle arrays must be uint8")
+
+    @property
+    def num_keys(self) -> int:
+        return self.cw_s.shape[0]
+
+    @property
+    def n_bits(self) -> int:
+        return self.cw_s.shape[1]
+
+    @property
+    def n_bytes(self) -> int:
+        return self.cw_s.shape[1] // 8
+
+    @property
+    def lam(self) -> int:
+        return self.cw_s.shape[2]
+
+    def for_party(self, b: int) -> "KeyBundle":
+        """Restrict to party ``b``'s starting seed (s0s[:, b:b+1])."""
+        if self.s0s.shape[1] != 2:
+            raise ValueError("bundle already restricted to one party")
+        if b not in (0, 1):
+            raise ValueError(f"party must be 0 or 1, got {b}")
+        return KeyBundle(
+            s0s=self.s0s[:, b : b + 1].copy(),
+            cw_s=self.cw_s,
+            cw_v=self.cw_v,
+            cw_t=self.cw_t,
+            cw_np1=self.cw_np1,
+        )
+
+    # -- spec interop -------------------------------------------------------
+
+    @classmethod
+    def from_shares(cls, shares: list[spec.Share]) -> "KeyBundle":
+        k = len(shares)
+        n = len(shares[0].cws)
+        lam = len(shares[0].cw_np1)
+        p = len(shares[0].s0s)
+        s0s = np.zeros((k, p, lam), dtype=np.uint8)
+        cw_s = np.zeros((k, n, lam), dtype=np.uint8)
+        cw_v = np.zeros((k, n, lam), dtype=np.uint8)
+        cw_t = np.zeros((k, n, 2), dtype=np.uint8)
+        cw_np1 = np.zeros((k, lam), dtype=np.uint8)
+        for i, sh in enumerate(shares):
+            for j, s0 in enumerate(sh.s0s):
+                s0s[i, j] = np.frombuffer(s0, dtype=np.uint8)
+            for j, cw in enumerate(sh.cws):
+                cw_s[i, j] = np.frombuffer(cw.s, dtype=np.uint8)
+                cw_v[i, j] = np.frombuffer(cw.v, dtype=np.uint8)
+                cw_t[i, j] = (cw.tl, cw.tr)
+            cw_np1[i] = np.frombuffer(sh.cw_np1, dtype=np.uint8)
+        return cls(s0s, cw_s, cw_v, cw_t, cw_np1)
+
+    def to_shares(self) -> list[spec.Share]:
+        out = []
+        for i in range(self.num_keys):
+            cws = tuple(
+                spec.Cw(
+                    s=self.cw_s[i, j].tobytes(),
+                    v=self.cw_v[i, j].tobytes(),
+                    tl=bool(self.cw_t[i, j, 0]),
+                    tr=bool(self.cw_t[i, j, 1]),
+                )
+                for j in range(self.n_bits)
+            )
+            out.append(
+                spec.Share(
+                    s0s=tuple(s.tobytes() for s in self.s0s[i]),
+                    cws=cws,
+                    cw_np1=self.cw_np1[i].tobytes(),
+                )
+            )
+        return out
+
+    # -- codecs -------------------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        """Flat framed binary: header + raw SoA arrays in a fixed order."""
+        k, p = self.s0s.shape[0], self.s0s.shape[1]
+        header = _MAGIC + struct.pack(
+            "<HHIIH", _VERSION, p, k, self.n_bits, self.lam
+        )
+        return b"".join(
+            [
+                header,
+                self.s0s.tobytes(),
+                self.cw_s.tobytes(),
+                self.cw_v.tobytes(),
+                self.cw_t.tobytes(),
+                self.cw_np1.tobytes(),
+            ]
+        )
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "KeyBundle":
+        if data[:4] != _MAGIC:
+            raise ValueError("not a DCFK key bundle")
+        try:
+            version, p, k, n, lam = struct.unpack_from("<HHIIH", data, 4)
+        except struct.error as e:
+            raise ValueError(f"truncated key bundle header: {e}") from e
+        if version != _VERSION:
+            raise ValueError(f"unsupported key bundle version {version}")
+        off = 4 + struct.calcsize("<HHIIH")
+
+        def take(shape):
+            nonlocal off
+            size = int(np.prod(shape))
+            arr = np.frombuffer(data, dtype=np.uint8, count=size, offset=off)
+            off += size
+            return arr.reshape(shape).copy()
+
+        s0s = take((k, p, lam))
+        cw_s = take((k, n, lam))
+        cw_v = take((k, n, lam))
+        cw_t = take((k, n, 2))
+        cw_np1 = take((k, lam))
+        if off != len(data):
+            raise ValueError("trailing bytes in key bundle")
+        return cls(s0s, cw_s, cw_v, cw_t, cw_np1)
+
+    def save(self, path: str) -> None:
+        if path.endswith(".npz"):
+            np.savez(
+                path,
+                s0s=self.s0s,
+                cw_s=self.cw_s,
+                cw_v=self.cw_v,
+                cw_t=self.cw_t,
+                cw_np1=self.cw_np1,
+            )
+        else:
+            with open(path, "wb") as fh:
+                fh.write(self.to_bytes())
+
+    @classmethod
+    def load(cls, path: str) -> "KeyBundle":
+        if path.endswith(".npz"):
+            z = np.load(path)
+            return cls(z["s0s"], z["cw_s"], z["cw_v"], z["cw_t"], z["cw_np1"])
+        with open(path, "rb") as fh:
+            return cls.from_bytes(fh.read())

@@ -1,0 +1,6 @@
+"""Crypto primitives: AES-256 and the Hirose PRG, vectorized.
+
+- ``dcf_tpu.ops.aes`` — numpy batch AES-256 (host)
+- ``dcf_tpu.ops.prg`` — numpy batch Hirose PRG (host)
+- ``dcf_tpu.ops.aes_jax`` — JAX AES-256 for the TPU eval path
+"""

@@ -33,7 +33,9 @@ from typing import Sequence
 
 __all__ = [
     "AES_SBOX",
+    "SHIFT_ROWS",
     "aes256_expand_key",
+    "hirose_used_cipher_indices",
     "aes256_encrypt_block",
     "HirosePrgSpec",
     "Bound",
@@ -110,7 +112,7 @@ def _xtime(a: int) -> int:
     return ((a << 1) ^ (0x1B if a & 0x80 else 0)) & 0xFF
 
 
-_SHIFT_ROWS = [0, 5, 10, 15, 4, 9, 14, 3, 8, 13, 2, 7, 12, 1, 6, 11]
+SHIFT_ROWS = [0, 5, 10, 15, 4, 9, 14, 3, 8, 13, 2, 7, 12, 1, 6, 11]
 
 
 def aes256_encrypt_block(round_keys: Sequence[bytes], block: bytes) -> bytes:
@@ -118,7 +120,7 @@ def aes256_encrypt_block(round_keys: Sequence[bytes], block: bytes) -> bytes:
     s = bytes(a ^ b for a, b in zip(block, round_keys[0]))
     for rnd in range(1, 14):
         s = bytes(AES_SBOX[b] for b in s)
-        s = bytes(s[i] for i in _SHIFT_ROWS)
+        s = bytes(s[i] for i in SHIFT_ROWS)
         out = bytearray(16)
         for c in range(4):
             a0, a1, a2, a3 = s[4 * c : 4 * c + 4]
@@ -128,7 +130,7 @@ def aes256_encrypt_block(round_keys: Sequence[bytes], block: bytes) -> bytes:
             out[4 * c + 3] = _xtime(a0) ^ a0 ^ a1 ^ a2 ^ _xtime(a3)
         s = bytes(a ^ b for a, b in zip(out, round_keys[rnd]))
     s = bytes(AES_SBOX[b] for b in s)
-    s = bytes(s[i] for i in _SHIFT_ROWS)
+    s = bytes(s[i] for i in SHIFT_ROWS)
     return bytes(a ^ b for a, b in zip(s, round_keys[14]))
 
 
@@ -144,6 +146,22 @@ def xor_bytes(*parts: bytes) -> bytes:
 # ---------------------------------------------------------------------------
 # Hirose PRG (reference src/prg.rs:22-74), with its exact quirks.
 # ---------------------------------------------------------------------------
+
+
+def hirose_used_cipher_indices(lam: int, num_keys: int) -> list[int]:
+    """Validate a Hirose PRG shape and return the cipher indices it uses.
+
+    The used indices are ``17*k for k < min(2, lam // 16)`` — a consequence of
+    the reference's truncating encryption loop (src/prg.rs:48-51).  Shared by
+    every PRG implementation in this framework so the parity-critical key-count
+    contract cannot desynchronize between backends.
+    """
+    if lam % 16 != 0:
+        raise ValueError("lam must be a multiple of 16 bytes")
+    used = [17 * k for k in range(min(2, lam // 16))]
+    if used and used[-1] >= num_keys:
+        raise ValueError(f"lam={lam} uses cipher indices {used}; got {num_keys} keys")
+    return used
 
 
 class HirosePrgSpec:
@@ -165,16 +183,10 @@ class HirosePrgSpec:
     """
 
     def __init__(self, lam: int, keys: Sequence[bytes]):
-        if lam % 16 != 0:
-            raise ValueError("lam must be a multiple of 16 bytes")
         self.lam = lam
-        used = [17 * k for k in range(min(2, lam // 16))]
-        if used and used[-1] >= len(keys):
-            raise ValueError(
-                f"lam={lam} uses cipher indices {used}; got {len(keys)} keys"
-            )
         # Only indices 17*k are ever used — skip expanding the rest (the
         # reference contract supplies 2*(lam/16) keys, 2046 unused at lam=16384).
+        used = hirose_used_cipher_indices(lam, len(keys))
         self.round_keys = {i: aes256_expand_key(keys[i]) for i in used}
 
     def gen(self, seed: bytes) -> list[tuple[bytes, bytes, bool]]:

@@ -1,0 +1,139 @@
+"""Parity: numpy keygen/eval/PRG vs the pure-Python spec model."""
+
+import random
+
+import numpy as np
+import pytest
+
+from dcf_tpu import spec
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.gen import gen_batch, random_s0s
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.ops.aes import aes256_encrypt_np, expand_key_np
+from dcf_tpu.ops.prg import HirosePrgNp
+from tests.vectors import ALPHAS, BETA, KEYS
+
+
+def rand_bytes(rng: random.Random, n: int) -> bytes:
+    return bytes(rng.getrandbits(8) for _ in range(n))
+
+
+def test_aes_np_matches_spec():
+    rng = random.Random(11)
+    key = rand_bytes(rng, 32)
+    rk_np = expand_key_np(key)
+    rk = spec.aes256_expand_key(key)
+    blocks = np.random.default_rng(0).integers(0, 256, (33, 16), dtype=np.uint8)
+    out = aes256_encrypt_np(rk_np, blocks)
+    for i in range(blocks.shape[0]):
+        assert out[i].tobytes() == spec.aes256_encrypt_block(rk, blocks[i].tobytes())
+
+
+@pytest.mark.parametrize("lam,nkeys", [(16, 2), (32, 18), (144, 18)])
+def test_prg_np_matches_spec(lam, nkeys):
+    rng = random.Random(12)
+    keys = [rand_bytes(rng, 32) for _ in range(nkeys)]
+    prg_spec = spec.HirosePrgSpec(lam, keys)
+    prg_np = HirosePrgNp(lam, keys)
+    seeds = np.random.default_rng(1).integers(0, 256, (7, lam), dtype=np.uint8)
+    out = prg_np.gen(seeds)
+    for i in range(seeds.shape[0]):
+        (s_l, v_l, t_l), (s_r, v_r, t_r) = prg_spec.gen(seeds[i].tobytes())
+        assert out.s_l[i].tobytes() == s_l
+        assert out.v_l[i].tobytes() == v_l
+        assert out.s_r[i].tobytes() == s_r
+        assert out.v_r[i].tobytes() == v_r
+        assert bool(out.t_l[i]) == t_l
+        assert bool(out.t_r[i]) == t_r
+
+
+@pytest.mark.parametrize("bound", [spec.Bound.LT_BETA, spec.Bound.GT_BETA])
+def test_gen_batch_matches_spec(bound):
+    rng = random.Random(13)
+    prg_spec = spec.HirosePrgSpec(16, KEYS)
+    prg_np = HirosePrgNp(16, KEYS)
+    k_num, n_bytes, lam = 3, 2, 16
+    nprng = np.random.default_rng(2)
+    alphas = nprng.integers(0, 256, (k_num, n_bytes), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k_num, lam), dtype=np.uint8)
+    s0s = random_s0s(k_num, lam, nprng)
+    bundle = gen_batch(prg_np, alphas, betas, s0s, bound)
+    for i in range(k_num):
+        share = spec.gen(
+            prg_spec,
+            spec.CmpFn(alphas[i].tobytes(), betas[i].tobytes()),
+            [s0s[i, 0].tobytes(), s0s[i, 1].tobytes()],
+            bound,
+        )
+        got = bundle.to_shares()[i]
+        assert got.s0s == share.s0s
+        assert got.cws == share.cws
+        assert got.cw_np1 == share.cw_np1
+
+
+@pytest.mark.parametrize("bound", [spec.Bound.LT_BETA, spec.Bound.GT_BETA])
+def test_eval_np_matches_spec_and_reconstructs(bound):
+    rng = random.Random(14)
+    prg_spec = spec.HirosePrgSpec(16, KEYS)
+    prg_np = HirosePrgNp(16, KEYS)
+    k_num, n_bytes, lam, m = 2, 2, 16, 9
+    nprng = np.random.default_rng(3)
+    alphas = nprng.integers(0, 256, (k_num, n_bytes), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k_num, lam), dtype=np.uint8)
+    s0s = random_s0s(k_num, lam, nprng)
+    bundle = gen_batch(prg_np, alphas, betas, s0s, bound)
+    xs = nprng.integers(0, 256, (m, n_bytes), dtype=np.uint8)
+    xs[0] = alphas[0]  # include the boundary point
+    y0 = eval_batch_np(prg_np, 0, bundle.for_party(0), xs)
+    y1 = eval_batch_np(prg_np, 1, bundle.for_party(1), xs)
+    for i in range(k_num):
+        k0 = bundle.to_shares()[i].for_party(0)
+        for j in range(m):
+            expect = spec.eval_point(prg_spec, False, k0, xs[j].tobytes())
+            assert y0[i, j].tobytes() == expect
+    # Reconstruction against the plain comparison function.
+    recon = y0 ^ y1
+    for i in range(k_num):
+        a = alphas[i].tobytes()
+        for j in range(m):
+            x = xs[j].tobytes()
+            lt = x < a if bound is spec.Bound.LT_BETA else x > a
+            expect = betas[i].tobytes() if lt else bytes(lam)
+            assert recon[i, j].tobytes() == expect
+
+
+def test_eval_np_reference_vectors():
+    # The reference's own end-to-end vectors through the numpy path.
+    prg_np = HirosePrgNp(16, KEYS)
+    nprng = np.random.default_rng(4)
+    alphas = np.frombuffer(ALPHAS[2], dtype=np.uint8)[None, :]
+    betas = np.frombuffer(BETA, dtype=np.uint8)[None, :]
+    s0s = random_s0s(1, 16, nprng)
+    bundle = gen_batch(prg_np, alphas, betas, s0s, spec.Bound.LT_BETA)
+    xs = np.stack([np.frombuffer(a, dtype=np.uint8) for a in ALPHAS])
+    y0 = eval_batch_np(prg_np, 0, bundle.for_party(0), xs)
+    y1 = eval_batch_np(prg_np, 1, bundle.for_party(1), xs)
+    recon = y0 ^ y1
+    expect = [BETA, BETA, bytes(16), bytes(16), bytes(16)]
+    assert [recon[0, j].tobytes() for j in range(5)] == expect
+
+
+def test_keybundle_codec_roundtrip(tmp_path):
+    prg_np = HirosePrgNp(16, KEYS)
+    nprng = np.random.default_rng(5)
+    alphas = nprng.integers(0, 256, (4, 2), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (4, 16), dtype=np.uint8)
+    bundle = gen_batch(prg_np, alphas, betas, random_s0s(4, 16, nprng), spec.Bound.LT_BETA)
+    # flat binary
+    rt = KeyBundle.from_bytes(bundle.to_bytes())
+    for name in ("s0s", "cw_s", "cw_v", "cw_t", "cw_np1"):
+        assert np.array_equal(getattr(rt, name), getattr(bundle, name))
+    # file codecs
+    for fname in ("b.dcfk", "b.npz"):
+        p = str(tmp_path / fname)
+        bundle.save(p)
+        loaded = KeyBundle.load(p)
+        assert np.array_equal(loaded.cw_s, bundle.cw_s)
+    # corrupt magic
+    with pytest.raises(ValueError):
+        KeyBundle.from_bytes(b"XXXX" + bundle.to_bytes()[4:])
