@@ -1,0 +1,176 @@
+"""JAX evaluation backend — the TPU hot path (single device).
+
+Design (SURVEY.md §3.2, §7): the reference walks each point's GGM path with a
+per-point Python-equivalent loop and rayon across points (src/lib.rs:163-204).
+Here the n = 8*n_bytes levels become a ``lax.scan`` whose carry is only the
+live walk state (s, t, v) for every (key, point) pair — O(lam) per pair, not
+the reference's O(n*lam) retained path — and the per-level correction-word
+application plus Hirose PRG run vectorized over the whole (K, M) batch on the
+VPU.  Keys live in HBM as the KeyBundle SoA arrays, shipped once; per-level
+slices are fed to the scan pre-transposed to level-major layout.
+
+The same jitted function is what ``dcf_tpu.parallel`` shards over a device
+mesh (keys/points axes over ICI), and ``__graft_entry__`` compile-checks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.ops.aes import expand_key_np
+from dcf_tpu.ops.aes_jax import aes256_encrypt_jax
+from dcf_tpu.spec import hirose_used_cipher_indices
+
+__all__ = ["JaxBackend", "prg_gen_jax", "eval_core", "eval_scan"]
+
+
+def prg_gen_jax(
+    round_keys: Sequence[jnp.ndarray], lam: int, seeds: jnp.ndarray
+):
+    """Batched Hirose PRG on device (bit-exact with HirosePrgNp.gen).
+
+    round_keys: one [15, 16] uint8 array per used cipher (index 17*k).
+    seeds: uint8 [..., lam].  Returns (s_l, v_l, t_l, s_r, v_r, t_r).
+    """
+    seed_p = seeds ^ jnp.uint8(0xFF)
+    batch = seeds.shape[:-1]
+    n_enc = min(2, lam // 16)
+    halves0 = []
+    halves1 = []
+    for k in range(n_enc):
+        lo = 16 * k
+        # Encrypt seed and seed^c blocks in one batched call (same cipher).
+        both = aes256_encrypt_jax(
+            round_keys[k],
+            jnp.stack([seeds[..., lo : lo + 16], seed_p[..., lo : lo + 16]]),
+        )
+        halves0.append(both[0])
+        halves1.append(both[1])
+
+    def assemble(half_blocks, which):
+        # Place encrypted block k at byte range [16k, 16k+16) of output half
+        # `which`; all other bytes are zero (the truncated-loop quirk).
+        out = jnp.zeros((*batch, lam), dtype=jnp.uint8)
+        if which < n_enc:
+            out = out.at[..., 16 * which : 16 * which + 16].set(half_blocks[which])
+        return out
+
+    buf0 = [assemble(halves0, 0), assemble(halves0, 1)]
+    buf1 = [assemble(halves1, 0), assemble(halves1, 1)]
+    buf0 = [b ^ seeds for b in buf0]
+    buf1 = [b ^ seed_p for b in buf1]
+    t_l = buf0[0][..., 0] & jnp.uint8(1)
+    t_r = buf1[0][..., 0] & jnp.uint8(1)
+    mask = jnp.full((lam,), 0xFF, dtype=jnp.uint8).at[lam - 1].set(0xFE)
+    buf0 = [b & mask for b in buf0]
+    buf1 = [b & mask for b in buf1]
+    return buf0[0], buf1[0], t_l, buf0[1], buf1[1], t_r
+
+
+def eval_core(
+    round_keys: tuple[jnp.ndarray, ...],
+    s0: jnp.ndarray,  # uint8 [K, lam]
+    cw_s: jnp.ndarray,  # uint8 [n, K, lam]  (level-major)
+    cw_v: jnp.ndarray,  # uint8 [n, K, lam]
+    cw_t: jnp.ndarray,  # uint8 [n, K, 2]
+    cw_np1: jnp.ndarray,  # uint8 [K, lam]
+    xs: jnp.ndarray,  # uint8 [K, M, n_bytes] or [M, n_bytes] (shared by keys)
+    b: int,
+    lam: int,
+) -> jnp.ndarray:
+    """Evaluate party ``b`` on all (key, point) pairs -> uint8 [K, M, lam].
+
+    Unjitted core so ``dcf_tpu.parallel`` can wrap it in ``shard_map``; use
+    ``eval_scan`` (the jitted wrapper) for single-device calls.  A 2D ``xs``
+    is broadcast across keys on device (free in XLA — avoids materializing K
+    copies on the host).
+    """
+    k_num = s0.shape[0]
+    if xs.ndim == 2:
+        xs = jnp.broadcast_to(xs[None], (k_num, *xs.shape))
+    m = xs.shape[1]
+    n = cw_s.shape[0]
+    # MSB-first bit planes computed on device: [K, M, n_bytes, 8] -> [n, K, M].
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    x_bits = ((xs[..., None] >> shifts) & jnp.uint8(1)).reshape(k_num, m, n)
+    x_bits = jnp.moveaxis(x_bits, -1, 0)
+
+    s = jnp.broadcast_to(s0[:, None, :], (k_num, m, lam)).astype(jnp.uint8)
+    t = jnp.full((k_num, m), b, dtype=jnp.uint8)
+    v = jnp.zeros((k_num, m, lam), dtype=jnp.uint8)
+
+    def body(carry, level):
+        s, t, v = carry
+        cw_s_i, cw_v_i, cw_t_i, xbit = level
+        s_l, v_l, t_l, s_r, v_r, t_r = prg_gen_jax(round_keys, lam, s)
+        t_mask = t[..., None]
+        cs = cw_s_i[:, None, :] * t_mask  # [K,1,lam] gated per (key,point)
+        s_l = s_l ^ cs
+        s_r = s_r ^ cs
+        t_l = t_l ^ (t & cw_t_i[:, None, 0])
+        t_r = t_r ^ (t & cw_t_i[:, None, 1])
+        xb = xbit[..., None].astype(bool)
+        v = v ^ jnp.where(xb, v_r, v_l) ^ cw_v_i[:, None, :] * t_mask
+        s = jnp.where(xb, s_r, s_l)
+        t = jnp.where(xbit.astype(bool), t_r, t_l)
+        return (s, t, v), None
+
+    (s, t, v), _ = jax.lax.scan(body, (s, t, v), (cw_s, cw_v, cw_t, x_bits))
+    return v ^ s ^ cw_np1[:, None, :] * t[..., None]
+
+
+eval_scan = partial(jax.jit, static_argnames=("b", "lam"))(eval_core)
+
+
+class JaxBackend:
+    """Device-resident DCF evaluator.
+
+    Holds the expanded cipher round keys and (optionally) a key bundle on
+    device so repeated evals pay the host->HBM key transfer once.
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes]):
+        used = hirose_used_cipher_indices(lam, len(cipher_keys))
+        self.lam = lam
+        self.round_keys = tuple(
+            jnp.asarray(expand_key_np(cipher_keys[i])) for i in used
+        )
+        self._bundle_dev = None
+
+    def put_bundle(self, bundle: KeyBundle) -> None:
+        """Ship a (party-restricted) key bundle to device, level-major."""
+        if bundle.lam != self.lam:
+            raise ValueError("bundle lam mismatch")
+        self._bundle_dev = {
+            k: jnp.asarray(v) for k, v in bundle.level_major().items()
+        }
+
+    def eval(self, b: int, xs: np.ndarray, bundle: KeyBundle | None = None) -> np.ndarray:
+        """Evaluate party ``b``; xs uint8 [M, n_bytes] or [K, M, n_bytes].
+
+        Returns uint8 [K, M, lam].  Uses the bundle shipped via
+        ``put_bundle`` unless one is passed explicitly.
+        """
+        if bundle is not None:
+            self.put_bundle(bundle)
+        if self._bundle_dev is None:
+            raise ValueError("no key bundle on device; call put_bundle first")
+        dev = self._bundle_dev
+        y = eval_scan(
+            self.round_keys,
+            dev["s0"],
+            dev["cw_s"],
+            dev["cw_v"],
+            dev["cw_t"],
+            dev["cw_np1"],
+            jnp.asarray(xs),
+            b=int(b),
+            lam=self.lam,
+        )
+        return np.asarray(y)

@@ -88,6 +88,24 @@ class KeyBundle:
             cw_np1=self.cw_np1,
         )
 
+    def level_major(self) -> dict[str, np.ndarray]:
+        """Arrays in the layout the eval scan consumes (level axis leading).
+
+        Returns contiguous ``s0`` [K, lam] (party-restricted bundles only),
+        ``cw_s``/``cw_v`` [n, K, lam], ``cw_t`` [n, K, 2], ``cw_np1`` [K, lam].
+        This is the single definition of the device layout — every backend
+        ships these arrays as-is.
+        """
+        if self.s0s.shape[1] != 1:
+            raise ValueError("level_major requires a party-restricted bundle")
+        return dict(
+            s0=np.ascontiguousarray(self.s0s[:, 0, :]),
+            cw_s=np.ascontiguousarray(self.cw_s.transpose(1, 0, 2)),
+            cw_v=np.ascontiguousarray(self.cw_v.transpose(1, 0, 2)),
+            cw_t=np.ascontiguousarray(self.cw_t.transpose(1, 0, 2)),
+            cw_np1=np.ascontiguousarray(self.cw_np1),
+        )
+
     # -- spec interop -------------------------------------------------------
 
     @classmethod

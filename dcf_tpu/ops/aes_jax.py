@@ -1,0 +1,49 @@
+"""AES-256 (encrypt-only) in JAX, bit-exact with the spec/numpy versions.
+
+``aes256_encrypt_jax`` uses a table S-box via ``jnp.take`` (one 256-entry
+gather per round) — simple, and the parity anchor for any faster variant.
+
+All arithmetic is uint8; XLA maps it onto the VPU.  Round keys are expanded
+on the host (``dcf_tpu.ops.aes.expand_key_np``) and passed in as a [15, 16]
+uint8 array — the per-level key schedule never runs on device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from dcf_tpu.ops.aes import SBOX_NP, SHIFT_ROWS_NP
+
+__all__ = ["aes256_encrypt_jax"]
+
+_SBOX_J = jnp.asarray(SBOX_NP)
+_SHIFT_J = jnp.asarray(SHIFT_ROWS_NP)
+
+
+def _xtime(a: jnp.ndarray) -> jnp.ndarray:
+    # uint8 left-shift wraps mod 256, which is exactly (a << 1) & 0xFF.
+    return (a << 1) ^ ((a >> 7) * jnp.uint8(0x1B))
+
+
+def aes256_encrypt_jax(round_keys: jnp.ndarray, blocks: jnp.ndarray) -> jnp.ndarray:
+    """Encrypt uint8 blocks [..., 16] under round_keys uint8 [15, 16]."""
+    s = blocks ^ round_keys[0]
+    for rnd in range(1, 14):
+        s = jnp.take(_SBOX_J, s)
+        s = s[..., _SHIFT_J]
+        a = s.reshape(*s.shape[:-1], 4, 4)
+        a0, a1, a2, a3 = a[..., 0], a[..., 1], a[..., 2], a[..., 3]
+        mixed = jnp.stack(
+            [
+                _xtime(a0) ^ _xtime(a1) ^ a1 ^ a2 ^ a3,
+                a0 ^ _xtime(a1) ^ _xtime(a2) ^ a2 ^ a3,
+                a0 ^ a1 ^ _xtime(a2) ^ _xtime(a3) ^ a3,
+                _xtime(a0) ^ a0 ^ a1 ^ a2 ^ _xtime(a3),
+            ],
+            axis=-1,
+        )
+        s = mixed.reshape(*blocks.shape) ^ round_keys[rnd]
+    s = jnp.take(_SBOX_J, s)
+    s = s[..., _SHIFT_J]
+    return s ^ round_keys[14]

@@ -1,0 +1,17 @@
+"""Multi-chip scale-out: batch eval sharded over a TPU device mesh.
+
+The reference's only parallelism is rayon threads across points
+(src/lib.rs:194-199) — zero inter-task communication.  The TPU-native
+equivalent (SURVEY.md §2.2) is a 2D ``jax.sharding.Mesh`` with axes
+
+    ("keys", "points")
+
+and the eval ``shard_map``'d so each chip walks its (key-shard, point-shard)
+block locally; collectives ride ICI only for input/result redistribution, and
+no communication happens during the walk itself (the eval is a pure map).
+Keys stream host->HBM sharded over the "keys" axis, which is what makes the
+10^6-keys secure-ReLU workload (BASELINE config 5) fit: each of 8 chips
+holds 1/8 of the ~4.4 GB key image.
+"""
+
+from dcf_tpu.parallel.mesh import ShardedJaxBackend, make_mesh  # noqa: F401

@@ -1,15 +1,21 @@
 """Test configuration.
 
 Tests run on CPU with 8 virtual XLA devices so the multi-chip sharding tests
-can run without TPU hardware.  This must happen before the first `import jax`
-anywhere in the test process.
+can run without TPU hardware.  In this environment a sitecustomize module
+imports jax at interpreter start with JAX_PLATFORMS=axon (the TPU tunnel), so
+setting env vars here is too late for jax's config defaults — we override the
+live config instead, before any backend initializes.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,108 @@
+"""Parity: JAX backend vs numpy backend/spec (runs on CPU JAX, 8 virt devices)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from dcf_tpu import spec
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.gen import gen_batch, random_s0s
+from dcf_tpu.ops.prg import HirosePrgNp
+from tests.vectors import ALPHAS, BETA, KEYS
+
+
+def rand_bytes(rng: random.Random, n: int) -> bytes:
+    return bytes(rng.getrandbits(8) for _ in range(n))
+
+
+def test_aes_jax_matches_np():
+    from dcf_tpu.ops.aes import aes256_encrypt_np, expand_key_np
+    from dcf_tpu.ops.aes_jax import aes256_encrypt_jax
+    import jax.numpy as jnp
+
+    rng = random.Random(21)
+    key = rand_bytes(rng, 32)
+    rk = expand_key_np(key)
+    blocks = np.random.default_rng(0).integers(0, 256, (5, 7, 16), dtype=np.uint8)
+    out_np = aes256_encrypt_np(rk, blocks)
+    out_j = np.asarray(aes256_encrypt_jax(jnp.asarray(rk), jnp.asarray(blocks)))
+    assert np.array_equal(out_np, out_j)
+
+
+@pytest.mark.parametrize("lam,nkeys", [(16, 2), (32, 18)])
+def test_prg_jax_matches_np(lam, nkeys):
+    import jax.numpy as jnp
+    from dcf_tpu.backends.jax_backend import prg_gen_jax
+    from dcf_tpu.ops.aes import expand_key_np
+    from dcf_tpu.spec import hirose_used_cipher_indices
+
+    rng = random.Random(22)
+    keys = [rand_bytes(rng, 32) for _ in range(nkeys)]
+    prg_np = HirosePrgNp(lam, keys)
+    used = hirose_used_cipher_indices(lam, len(keys))
+    rks = tuple(jnp.asarray(expand_key_np(keys[i])) for i in used)
+    seeds = np.random.default_rng(1).integers(0, 256, (11, lam), dtype=np.uint8)
+    got = prg_gen_jax(rks, lam, jnp.asarray(seeds))
+    want = prg_np.gen(seeds)
+    for g, w in zip(got, (want.s_l, want.v_l, want.t_l, want.s_r, want.v_r, want.t_r)):
+        assert np.array_equal(np.asarray(g), w)
+
+
+@pytest.mark.parametrize("bound", [spec.Bound.LT_BETA, spec.Bound.GT_BETA])
+def test_jax_eval_matches_numpy(bound):
+    from dcf_tpu.backends.jax_backend import JaxBackend
+
+    rng = random.Random(23)
+    cipher_keys = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    prg_np = HirosePrgNp(16, cipher_keys)
+    nprng = np.random.default_rng(2)
+    k_num, n_bytes, m = 3, 2, 33
+    alphas = nprng.integers(0, 256, (k_num, n_bytes), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k_num, 16), dtype=np.uint8)
+    bundle = gen_batch(prg_np, alphas, betas, random_s0s(k_num, 16, nprng), bound)
+    xs = nprng.integers(0, 256, (m, n_bytes), dtype=np.uint8)
+    xs[:k_num] = alphas
+
+    backend = JaxBackend(16, cipher_keys)
+    for b in (0, 1):
+        want = eval_batch_np(prg_np, b, bundle.for_party(b), xs)
+        got = backend.eval(b, xs, bundle=bundle.for_party(b))
+        assert np.array_equal(got, want), f"party {b} mismatch"
+
+
+def test_jax_eval_reference_vectors_and_reconstruction():
+    from dcf_tpu.backends.jax_backend import JaxBackend
+
+    nprng = np.random.default_rng(3)
+    alphas = np.frombuffer(ALPHAS[2], dtype=np.uint8)[None, :]
+    betas = np.frombuffer(BETA, dtype=np.uint8)[None, :]
+    prg_np = HirosePrgNp(16, KEYS)
+    bundle = gen_batch(prg_np, alphas, betas, random_s0s(1, 16, nprng), spec.Bound.LT_BETA)
+    xs = np.stack([np.frombuffer(a, dtype=np.uint8) for a in ALPHAS])
+    backend = JaxBackend(16, KEYS)
+    y0 = backend.eval(0, xs, bundle=bundle.for_party(0))
+    y1 = backend.eval(1, xs, bundle=bundle.for_party(1))
+    recon = y0 ^ y1
+    expect = [BETA, BETA, bytes(16), bytes(16), bytes(16)]
+    assert [recon[0, j].tobytes() for j in range(5)] == expect
+
+
+def test_jax_eval_large_lambda_extension():
+    # lam=144 is the smallest reference-executable multi-block shape.
+    from dcf_tpu.backends.jax_backend import JaxBackend
+
+    rng = random.Random(24)
+    lam = 144
+    cipher_keys = [rand_bytes(rng, 32) for _ in range(2 * (lam // 16))]
+    prg_np = HirosePrgNp(lam, cipher_keys)
+    nprng = np.random.default_rng(4)
+    alphas = nprng.integers(0, 256, (1, 1), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (1, lam), dtype=np.uint8)
+    bundle = gen_batch(prg_np, alphas, betas, random_s0s(1, lam, nprng), spec.Bound.LT_BETA)
+    xs = nprng.integers(0, 256, (9, 1), dtype=np.uint8)
+    backend = JaxBackend(lam, cipher_keys)
+    for b in (0, 1):
+        want = eval_batch_np(prg_np, b, bundle.for_party(b), xs)
+        got = backend.eval(b, xs, bundle=bundle.for_party(b))
+        assert np.array_equal(got, want)
