@@ -1,0 +1,209 @@
+"""ctypes bindings for the C++ host core (libdcf.so).
+
+The native core is the role-equivalent of the reference Rust crate itself:
+host keygen, and a CPU eval path that serves as (a) the parity oracle and
+(b) the single-core/multi-core baseline anchoring the TPU speedup claims.
+Built on demand with ``make`` (g++; AES-NI when available, portable S-box
+fallback otherwise — bit-exact either way).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Sequence
+
+import numpy as np
+
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.spec import Bound, hirose_used_cipher_indices
+
+__all__ = ["NativeDcf", "build", "load"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB = None
+
+
+def build(portable: bool = False) -> str:
+    """Compile the shared library if needed; returns its path."""
+    target = "libdcf_portable.so" if portable else "libdcf.so"
+    path = os.path.join(_DIR, target)
+    src = os.path.join(_DIR, "dcf_core.cpp")
+    if not os.path.exists(path) or os.path.getmtime(path) < os.path.getmtime(src):
+        proc = subprocess.run(
+            ["make", "-C", _DIR, target], capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build failed (exit {proc.returncode}):\n{proc.stderr}"
+            )
+    return path
+
+
+def load(portable: bool = False) -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None or portable:
+        lib = ctypes.CDLL(build(portable))
+        lib.dcf_prg_sizeof.restype = ctypes.c_uint32
+        lib.dcf_has_aesni.restype = ctypes.c_int
+        lib.dcf_prg_init.restype = ctypes.c_int
+        if portable:
+            return lib
+        _LIB = lib
+    return _LIB
+
+
+def _ptr(a: np.ndarray):
+    """Pointer to a's buffer.  CAUTION: holds no reference — the array must
+    stay alive (bound to a local) until the foreign call returns."""
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+class NativeDcf:
+    """DCF gen/eval backed by the C++ core.
+
+    API mirrors the numpy layer: same SoA KeyBundle in, same [K, M, lam]
+    arrays out, bit-exact with every other backend.
+    """
+
+    def __init__(
+        self,
+        lam: int,
+        cipher_keys: Sequence[bytes],
+        num_threads: int | None = None,
+        portable: bool = False,
+    ):
+        hirose_used_cipher_indices(lam, len(cipher_keys))
+        if any(len(k) != 32 for k in cipher_keys):
+            raise ValueError("all cipher keys must be 32 bytes (AES-256)")
+        self.lam = lam
+        self.num_threads = num_threads or (os.cpu_count() or 1)
+        self._lib = load(portable)
+        self._prg = ctypes.create_string_buffer(self._lib.dcf_prg_sizeof())
+        keys_arr = np.frombuffer(b"".join(cipher_keys), dtype=np.uint8).copy()
+        rc = self._lib.dcf_prg_init(
+            self._prg, ctypes.c_uint32(lam), _ptr(keys_arr), len(cipher_keys)
+        )
+        if rc != 0:
+            raise ValueError(f"dcf_prg_init failed with code {rc}")
+
+    @property
+    def has_aesni(self) -> bool:
+        return bool(self._lib.dcf_has_aesni())
+
+    def prg_gen(self, seeds: np.ndarray):
+        """Batched PRG; returns the same tuple layout as HirosePrgNp.gen."""
+        lam = self.lam
+        assert seeds.dtype == np.uint8 and seeds.shape[-1] == lam
+        batch = int(np.prod(seeds.shape[:-1]))
+        flat = np.ascontiguousarray(seeds).reshape(batch, lam)
+        outs = [np.empty((batch, lam), dtype=np.uint8) for _ in range(4)]
+        ts = [np.empty(batch, dtype=np.uint8) for _ in range(2)]
+        self._lib.dcf_prg_gen_batch(
+            self._prg,
+            ctypes.c_uint64(batch),
+            _ptr(flat),
+            _ptr(outs[0]),
+            _ptr(outs[1]),
+            _ptr(ts[0]),
+            _ptr(outs[2]),
+            _ptr(outs[3]),
+            _ptr(ts[1]),
+        )
+        shape = seeds.shape[:-1]
+        return (
+            outs[0].reshape(*shape, lam),
+            outs[1].reshape(*shape, lam),
+            ts[0].reshape(shape),
+            outs[2].reshape(*shape, lam),
+            outs[3].reshape(*shape, lam),
+            ts[1].reshape(shape),
+        )
+
+    def gen_batch(
+        self,
+        alphas: np.ndarray,
+        betas: np.ndarray,
+        s0s: np.ndarray,
+        bound: Bound,
+        num_threads: int | None = None,
+    ) -> KeyBundle:
+        """Batched keygen; same contract as dcf_tpu.gen.gen_batch."""
+        k_num, n_bytes = alphas.shape
+        lam = self.lam
+        if betas.shape != (k_num, lam) or s0s.shape != (k_num, 2, lam):
+            raise ValueError("alphas/betas/s0s shape mismatch")
+        if any(a.dtype != np.uint8 for a in (alphas, betas, s0s)):
+            raise ValueError("alphas/betas/s0s must be uint8")
+        n = 8 * n_bytes
+        cw_s = np.empty((k_num, n, lam), dtype=np.uint8)
+        cw_v = np.empty((k_num, n, lam), dtype=np.uint8)
+        cw_t = np.empty((k_num, n, 2), dtype=np.uint8)
+        cw_np1 = np.empty((k_num, lam), dtype=np.uint8)
+        # Keep contiguous copies alive across the foreign call (see _ptr).
+        alphas_c = np.ascontiguousarray(alphas)
+        betas_c = np.ascontiguousarray(betas)
+        s0s_c = np.ascontiguousarray(s0s)
+        self._lib.dcf_gen_batch(
+            self._prg,
+            ctypes.c_uint32(k_num),
+            ctypes.c_uint32(n_bytes),
+            _ptr(alphas_c),
+            _ptr(betas_c),
+            _ptr(s0s_c),
+            ctypes.c_int(1 if bound is Bound.GT_BETA else 0),
+            _ptr(cw_s),
+            _ptr(cw_v),
+            _ptr(cw_t),
+            _ptr(cw_np1),
+            ctypes.c_int(num_threads or self.num_threads),
+        )
+        return KeyBundle(
+            s0s=s0s_c.copy(), cw_s=cw_s, cw_v=cw_v, cw_t=cw_t, cw_np1=cw_np1
+        )
+
+    def eval(
+        self,
+        b: int,
+        bundle: KeyBundle,
+        xs: np.ndarray,
+        num_threads: int | None = None,
+    ) -> np.ndarray:
+        """Batched eval; same contract as eval_batch_np (xs 2D = shared)."""
+        k_num, n, lam = bundle.cw_s.shape
+        if lam != self.lam:
+            raise ValueError("bundle lam mismatch")
+        if xs.dtype != np.uint8:
+            raise ValueError("xs must be uint8")
+        shared = xs.ndim == 2
+        m = xs.shape[0] if shared else xs.shape[1]
+        if (shared and xs.shape[1] * 8 != n) or (
+            not shared and (xs.shape[0] != k_num or xs.shape[2] * 8 != n)
+        ):
+            raise ValueError("xs shape mismatch with bundle")
+        ys = np.empty((k_num, m, lam), dtype=np.uint8)
+        # Keep contiguous copies alive across the foreign call (see _ptr).
+        s0_c = np.ascontiguousarray(bundle.s0s[:, 0, :])
+        cw_s_c = np.ascontiguousarray(bundle.cw_s)
+        cw_v_c = np.ascontiguousarray(bundle.cw_v)
+        cw_t_c = np.ascontiguousarray(bundle.cw_t)
+        cw_np1_c = np.ascontiguousarray(bundle.cw_np1)
+        xs_c = np.ascontiguousarray(xs)
+        self._lib.dcf_eval_batch(
+            self._prg,
+            ctypes.c_int(b),
+            ctypes.c_uint32(k_num),
+            ctypes.c_uint32(n // 8),
+            ctypes.c_uint64(m),
+            _ptr(s0_c),
+            _ptr(cw_s_c),
+            _ptr(cw_v_c),
+            _ptr(cw_t_c),
+            _ptr(cw_np1_c),
+            _ptr(xs_c),
+            ctypes.c_int(1 if shared else 0),
+            _ptr(ys),
+            ctypes.c_int(num_threads or self.num_threads),
+        )
+        return ys

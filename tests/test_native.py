@@ -1,0 +1,95 @@
+"""Parity: C++ native core vs the numpy layer (and hence the spec).
+
+Covers both compiled paths — AES-NI and the portable S-box fallback — and
+both the serial and threaded eval, mirroring the reference's CI feature
+matrix (multithread on/off, SURVEY.md §4).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from dcf_tpu import spec
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.gen import gen_batch, random_s0s
+from dcf_tpu.native import NativeDcf
+from dcf_tpu.ops.prg import HirosePrgNp
+
+
+def rand_bytes(rng: random.Random, n: int) -> bytes:
+    return bytes(rng.getrandbits(8) for _ in range(n))
+
+
+@pytest.fixture(scope="module", params=[False, True], ids=["aesni", "portable"])
+def native(request):
+    rng = random.Random(41)
+    keys = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    return keys, NativeDcf(16, keys, portable=request.param)
+
+
+def test_native_prg_matches_np(native):
+    keys, d = native
+    prg_np = HirosePrgNp(16, keys)
+    seeds = np.random.default_rng(1).integers(0, 256, (13, 16), dtype=np.uint8)
+    got = d.prg_gen(seeds)
+    want = prg_np.gen(seeds)
+    for g, w in zip(got, (want.s_l, want.v_l, want.t_l, want.s_r, want.v_r, want.t_r)):
+        assert np.array_equal(g, w)
+
+
+@pytest.mark.parametrize("bound", [spec.Bound.LT_BETA, spec.Bound.GT_BETA])
+def test_native_gen_matches_np(native, bound):
+    keys, d = native
+    prg_np = HirosePrgNp(16, keys)
+    nprng = np.random.default_rng(2)
+    alphas = nprng.integers(0, 256, (5, 2), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (5, 16), dtype=np.uint8)
+    s0s = random_s0s(5, 16, nprng)
+    want = gen_batch(prg_np, alphas, betas, s0s, bound)
+    got = d.gen_batch(alphas, betas, s0s, bound)
+    for name in ("s0s", "cw_s", "cw_v", "cw_t", "cw_np1"):
+        assert np.array_equal(getattr(got, name), getattr(want, name)), name
+
+
+@pytest.mark.parametrize("threads", [1, 4])
+def test_native_eval_matches_np(native, threads):
+    keys, d = native
+    prg_np = HirosePrgNp(16, keys)
+    nprng = np.random.default_rng(3)
+    alphas = nprng.integers(0, 256, (3, 2), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (3, 16), dtype=np.uint8)
+    bundle = d.gen_batch(alphas, betas, random_s0s(3, 16, nprng), spec.Bound.LT_BETA)
+    xs = nprng.integers(0, 256, (17, 2), dtype=np.uint8)
+    xs[:3] = alphas
+    for b in (0, 1):
+        want = eval_batch_np(prg_np, b, bundle.for_party(b), xs)
+        got = d.eval(b, bundle.for_party(b), xs, num_threads=threads)
+        assert np.array_equal(got, want)
+    # per-key xs layout
+    xs3 = nprng.integers(0, 256, (3, 6, 2), dtype=np.uint8)
+    for b in (0, 1):
+        want = eval_batch_np(prg_np, b, bundle.for_party(b), xs3)
+        got = d.eval(b, bundle.for_party(b), xs3, num_threads=threads)
+        assert np.array_equal(got, want)
+
+
+def test_native_large_lambda(native):
+    # lam=144: both cipher indices (0, 17) exercised.
+    rng = random.Random(42)
+    lam = 144
+    keys = [rand_bytes(rng, 32) for _ in range(18)]
+    use_portable = not native[1].has_aesni
+    d = NativeDcf(lam, keys, portable=use_portable)
+    prg_np = HirosePrgNp(lam, keys)
+    seeds = np.random.default_rng(4).integers(0, 256, (5, lam), dtype=np.uint8)
+    got = d.prg_gen(seeds)
+    want = prg_np.gen(seeds)
+    for g, w in zip(got, (want.s_l, want.v_l, want.t_l, want.s_r, want.v_r, want.t_r)):
+        assert np.array_equal(g, w)
+
+
+def test_native_bad_config():
+    rng = random.Random(43)
+    with pytest.raises(ValueError):
+        NativeDcf(32, [rand_bytes(rng, 32)] * 4)  # key-count contract violation
