@@ -1,0 +1,419 @@
+"""Fully bitsliced JAX eval backend — the TPU throughput path.
+
+The entire GGM walk stays in bit-plane form: the scan carry is
+
+    s  uint32 [8*lam, K, W]   seed planes (W = points/32 packed words)
+    t  uint32 [K, W]          control bits, one per (key, point) lane
+    v  uint32 [8*lam, K, W]   output accumulator planes
+
+and every level is pure XOR/AND plane algebra: the Hirose PRG runs the
+bitsliced AES (ops.aes_bitsliced) on the seed planes directly — seed^c is a
+plane-wise NOT — correction words enter as per-key masks broadcast across
+lanes, and the left/right child select is a lane-mask mux.  Nothing is ever
+packed or unpacked inside the scan; bytes<->planes conversion happens once at
+the edges on the host (utils.bits).
+
+This layout keeps keys on the broadcast axis ("mode A": points packed in
+lanes) — right for few-keys x many-points workloads like the flagship
+100k-point bench.  The many-keys x few-points regime (secure-ReLU) packs
+keys into lanes instead; see ``dcf_tpu.workloads``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.ops.aes_bitsliced import aes256_encrypt_planes, round_key_masks
+from dcf_tpu.spec import hirose_used_cipher_indices
+from dcf_tpu.utils.bits import byte_bits_lsb, expand_bits_to_masks, pack_lanes
+
+__all__ = [
+    "BitslicedBackend",
+    "KeyLanesBackend",
+    "eval_core_bitsliced",
+    "eval_core_keylanes",
+    "prg_planes",
+]
+
+_ONES = np.uint32(0xFFFFFFFF)
+
+
+def prg_planes(rk_masks, last_bit_mask, lam: int, seed, ones):
+    """Bitsliced Hirose PRG: seed planes [8*lam, *rest] -> six outputs.
+
+    Shape-agnostic over the trailing dims; used by both lane layouts
+    (points-in-lanes and keys-in-lanes).  Returns (s_l, v_l, t_l, s_r, v_r,
+    t_r) where s/v are [8*lam, *rest] planes and t are [*rest] lane masks.
+    """
+    n_blocks = lam // 16
+    n_enc = min(2, n_blocks)
+    rest = seed.shape[1:]
+    lbm = last_bit_mask.reshape(8 * lam, *([1] * len(rest)))
+    seed_p = seed ^ ones
+    enc0: list = [None, None]
+    enc1: list = [None, None]
+    for k in range(n_enc):
+        blk = slice(128 * k, 128 * (k + 1))
+        both = aes256_encrypt_planes(
+            jnp,
+            rk_masks[k],
+            jnp.stack([seed[blk], seed_p[blk]], axis=1),  # [128, 2, *rest]
+            ones,
+        )
+        enc0[k] = both[:, 0]
+        enc1[k] = both[:, 1]
+
+    zeros128 = jnp.zeros((128, *rest), dtype=jnp.uint32)
+
+    def half(enc, h):
+        parts = [
+            enc[h] if (j == h and h < n_enc) else zeros128 for j in range(n_blocks)
+        ]
+        return parts[0] if n_blocks == 1 else jnp.concatenate(parts, axis=0)
+
+    buf0 = [half(enc0, 0) ^ seed, half(enc0, 1) ^ seed]
+    buf1 = [half(enc1, 0) ^ seed_p, half(enc1, 1) ^ seed_p]
+    t_l = buf0[0][0]
+    t_r = buf1[0][0]
+    return (
+        buf0[0] & lbm,
+        buf1[0] & lbm,
+        t_l,
+        buf0[1] & lbm,
+        buf1[1] & lbm,
+        t_r,
+    )
+
+
+def eval_core_bitsliced(
+    rk_masks: tuple[jnp.ndarray, ...],  # per used cipher: uint32 [15, 128]
+    last_bit_mask: jnp.ndarray,  # uint32 [8*lam] (clears plane (lam-1)*8)
+    s0_pl: jnp.ndarray,  # uint32 [8*lam, K]
+    cw_s_pl: jnp.ndarray,  # uint32 [n, 8*lam, K]
+    cw_v_pl: jnp.ndarray,  # uint32 [n, 8*lam, K]
+    cw_tl: jnp.ndarray,  # uint32 [n, K]
+    cw_tr: jnp.ndarray,  # uint32 [n, K]
+    cw_np1_pl: jnp.ndarray,  # uint32 [8*lam, K]
+    x_mask: jnp.ndarray,  # uint32 [n, Kx, W] (Kx = K or 1 for shared points)
+    b: int,
+    lam: int,
+) -> jnp.ndarray:
+    """Party ``b`` eval, all planes; returns y planes uint32 [8*lam, K, W]."""
+    ones = jnp.uint32(0xFFFFFFFF)
+    k_num = s0_pl.shape[1]
+    w = x_mask.shape[2]
+    p = 8 * lam
+
+    s = jnp.broadcast_to(s0_pl[:, :, None], (p, k_num, w))
+    t = jnp.full((k_num, w), ones if b else jnp.uint32(0), dtype=jnp.uint32)
+    v = jnp.zeros((p, k_num, w), dtype=jnp.uint32)
+
+    def body(carry, level):
+        s, t, v = carry
+        cs, cv, ctl, ctr, xm = level
+        s_l, v_l, t_l, s_r, v_r, t_r = prg_planes(
+            rk_masks, last_bit_mask, lam, s, ones
+        )
+        gate = t[None, :, :]
+        s_l = s_l ^ (cs[:, :, None] & gate)
+        s_r = s_r ^ (cs[:, :, None] & gate)
+        t_l = t_l ^ (t & ctl[:, None])
+        t_r = t_r ^ (t & ctr[:, None])
+        xm_e = xm[None, :, :]  # broadcasts over planes and (if shared) keys
+        v = v ^ (v_r & xm_e) ^ (v_l & (xm_e ^ ones)) ^ (cv[:, :, None] & gate)
+        s = (s_r & xm_e) | (s_l & (xm_e ^ ones))
+        t = (t_r & xm) | (t_l & (xm ^ ones))
+        return (s, t, v), None
+
+    (s, t, v), _ = jax.lax.scan(
+        body, (s, t, v), (cw_s_pl, cw_v_pl, cw_tl, cw_tr, x_mask)
+    )
+    return v ^ s ^ (cw_np1_pl[:, :, None] & t[None, :, :])
+
+
+def eval_core_keylanes(
+    rk_masks: tuple[jnp.ndarray, ...],
+    last_bit_mask: jnp.ndarray,  # uint32 [8*lam]
+    s0_pl: jnp.ndarray,  # uint32 [8*lam, Wk]  (keys packed in lanes)
+    cw_s_pl: jnp.ndarray,  # uint32 [n, 8*lam, Wk]
+    cw_v_pl: jnp.ndarray,  # uint32 [n, 8*lam, Wk]
+    cw_tl: jnp.ndarray,  # uint32 [n, Wk]
+    cw_tr: jnp.ndarray,  # uint32 [n, Wk]
+    cw_np1_pl: jnp.ndarray,  # uint32 [8*lam, Wk]
+    x_mask: jnp.ndarray,  # uint32 [n, M, 1] (0/~0 per point, shared by keys)
+    b: int,
+    lam: int,
+) -> jnp.ndarray:
+    """Keys-in-lanes eval (many-keys regime): y planes uint32 [8*lam, M, Wk].
+
+    The dual of ``eval_core_bitsliced``: keys are packed 32-per-word so the
+    per-key correction words are packed data (no 32x broadcast blow-up),
+    while the shared evaluation points ride the explicit axis as full/zero
+    masks.  This is what makes the 10^6-key secure-ReLU shape fit in HBM:
+    the key image stays at its byte size (n*lam bytes per key).
+    """
+    ones = jnp.uint32(0xFFFFFFFF)
+    m = x_mask.shape[1]
+    wk = s0_pl.shape[1]
+    p = 8 * lam
+
+    s = jnp.broadcast_to(s0_pl[:, None, :], (p, m, wk))
+    t = jnp.full((m, wk), ones if b else jnp.uint32(0), dtype=jnp.uint32)
+    v = jnp.zeros((p, m, wk), dtype=jnp.uint32)
+
+    def body(carry, level):
+        s, t, v = carry
+        cs, cv, ctl, ctr, xm = level
+        s_l, v_l, t_l, s_r, v_r, t_r = prg_planes(
+            rk_masks, last_bit_mask, lam, s, ones
+        )
+        gate = t[None, :, :]
+        s_l = s_l ^ (cs[:, None, :] & gate)
+        s_r = s_r ^ (cs[:, None, :] & gate)
+        t_l = t_l ^ (t & ctl[None, :])
+        t_r = t_r ^ (t & ctr[None, :])
+        xm_e = xm[None, :, :]
+        v = v ^ (v_r & xm_e) ^ (v_l & (xm_e ^ ones)) ^ (cv[:, None, :] & gate)
+        s = (s_r & xm_e) | (s_l & (xm_e ^ ones))
+        t = (t_r & xm) | (t_l & (xm ^ ones))
+        return (s, t, v), None
+
+    (s, t, v), _ = jax.lax.scan(
+        body, (s, t, v), (cw_s_pl, cw_v_pl, cw_tl, cw_tr, x_mask)
+    )
+    return v ^ s ^ (cw_np1_pl[:, None, :] & t[None, :, :])
+
+
+# ---------------------------------------------------------------------------
+# Device-side bytes<->planes conversion.  The byte<->plane transposes cost
+# real bandwidth at 10^6+ point batches; doing them on host (single CPU core)
+# was the bottleneck, so they live inside the jitted program: the host ships
+# raw bytes and receives raw bytes.
+# ---------------------------------------------------------------------------
+
+
+def _pack_lanes_dev(bits):
+    """{0,1} [..., B] -> uint32 [..., B/32] (B % 32 == 0).  Disjoint-bit sum
+    == bitwise or, and uint32 addition cannot carry across them."""
+    b = bits.shape[-1]
+    w = bits.astype(jnp.uint32).reshape(*bits.shape[:-1], b // 32, 32)
+    return jnp.sum(w << jnp.arange(32, dtype=jnp.uint32), axis=-1, dtype=jnp.uint32)
+
+
+def _xs_to_mask_dev(xs):
+    """uint8 [Kx, M, n_bytes] -> walk-order lane masks uint32 [n, Kx, M/32]."""
+    kx, m, nb = xs.shape
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (xs[..., None] >> shifts) & jnp.uint8(1)  # [Kx, M, nb, 8] MSB-first
+    bits = jnp.moveaxis(bits.reshape(kx, m, nb * 8), 2, 0)  # [n, Kx, M]
+    return _pack_lanes_dev(bits)
+
+
+def _planes_to_bytes_dev(planes, lam: int):
+    """uint32 [8*lam, K, W] -> uint8 [K, W*32, lam]."""
+    p, k, w = planes.shape
+    bits = (planes[..., None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+    bits = bits.astype(jnp.uint8).reshape(p, k, w * 32)
+    bits = bits.transpose(1, 2, 0).reshape(k, w * 32, lam, 8)
+    return jnp.sum(bits << jnp.arange(8, dtype=jnp.uint8), axis=-1, dtype=jnp.uint8)
+
+
+def _eval_bytes(
+    rk_masks, last_bit_mask, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
+    xs, b: int, lam: int,
+):
+    """End-to-end device program: xs bytes in, y bytes out (points-in-lanes)."""
+    y_planes = eval_core_bitsliced(
+        rk_masks, last_bit_mask, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr,
+        cw_np1_pl, _xs_to_mask_dev(xs), b, lam,
+    )
+    return _planes_to_bytes_dev(y_planes, lam)
+
+
+def _eval_keylanes_bytes(
+    rk_masks, last_bit_mask, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
+    xs, b: int, lam: int,
+):
+    """Device program for the keys-in-lanes layout: returns uint8 [M, K_pad, lam]."""
+    m, nb = xs.shape
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = ((xs[..., None] >> shifts) & jnp.uint8(1)).reshape(m, nb * 8)
+    x_mask = (bits.T.astype(jnp.uint32) * jnp.uint32(0xFFFFFFFF))[:, :, None]
+    y_planes = eval_core_keylanes(
+        rk_masks, last_bit_mask, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr,
+        cw_np1_pl, x_mask, b, lam,
+    )
+    return _planes_to_bytes_dev(y_planes, lam)
+
+
+_eval_jit = partial(jax.jit, static_argnames=("b", "lam"))(_eval_bytes)
+_eval_keylanes_jit = partial(jax.jit, static_argnames=("b", "lam"))(
+    _eval_keylanes_bytes
+)
+
+
+class _BitslicedBase:
+    """Shared cipher/mask setup for the two lane layouts."""
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes]):
+        used = hirose_used_cipher_indices(lam, len(cipher_keys))
+        self.lam = lam
+        self.rk_masks = tuple(
+            jnp.asarray(round_key_masks(cipher_keys[i])) for i in used
+        )
+        lbm = np.full(8 * lam, _ONES, dtype=np.uint32)
+        lbm[(lam - 1) * 8] = 0  # clears the PRG's 8*lam-1 masked bit plane
+        self._last_bit_mask = jnp.asarray(lbm)
+        self._bundle_dev = None
+
+
+class BitslicedBackend(_BitslicedBase):
+    """Device-resident bitsliced DCF evaluator (API-compatible with JaxBackend)."""
+
+    def put_bundle(self, bundle: KeyBundle) -> None:
+        """Ship a party-restricted bundle to device as plane masks."""
+        if bundle.lam != self.lam:
+            raise ValueError("bundle lam mismatch")
+        if bundle.s0s.shape[1] != 1:
+            raise ValueError("put_bundle requires a party-restricted bundle")
+        # [K, n, lam] u8 -> bits [K, n, 8lam] -> [n, 8lam, K] masks.
+        def cw_planes(a):
+            bits = byte_bits_lsb(a)  # [K, n, 8lam]
+            return jnp.asarray(
+                expand_bits_to_masks(np.ascontiguousarray(bits.transpose(1, 2, 0)))
+            )
+
+        s0_bits = byte_bits_lsb(bundle.s0s[:, 0, :])  # [K, 8lam]
+        self._bundle_dev = dict(
+            s0=jnp.asarray(expand_bits_to_masks(s0_bits.T)),
+            cw_s=cw_planes(bundle.cw_s),
+            cw_v=cw_planes(bundle.cw_v),
+            cw_tl=jnp.asarray(expand_bits_to_masks(bundle.cw_t[:, :, 0].T)),
+            cw_tr=jnp.asarray(expand_bits_to_masks(bundle.cw_t[:, :, 1].T)),
+            cw_np1=jnp.asarray(
+                expand_bits_to_masks(byte_bits_lsb(bundle.cw_np1).T)
+            ),
+        )
+
+    def eval(
+        self, b: int, xs: np.ndarray, bundle: KeyBundle | None = None
+    ) -> np.ndarray:
+        """Evaluate party ``b``; xs uint8 [M, n_bytes] or [K, M, n_bytes].
+
+        Returns uint8 [K, M, lam].  Points are padded to a multiple of 32
+        internally (the pad lanes are computed and discarded).
+        """
+        if bundle is not None:
+            self.put_bundle(bundle)
+        if self._bundle_dev is None:
+            raise ValueError("no key bundle on device; call put_bundle first")
+        dev = self._bundle_dev
+        k_num = dev["s0"].shape[1]
+        n = dev["cw_s"].shape[0]
+        shared = xs.ndim == 2
+        m = xs.shape[0] if shared else xs.shape[1]
+        if xs.shape[-1] * 8 != n:
+            raise ValueError("xs width mismatch with bundle")
+        if not shared and xs.shape[0] != k_num:
+            raise ValueError(
+                f"xs has {xs.shape[0]} key rows but bundle has {k_num} keys"
+            )
+        m_pad = (m + 31) // 32 * 32
+        if m_pad != m:
+            pad = [(0, m_pad - m), (0, 0)] if shared else [(0, 0), (0, m_pad - m), (0, 0)]
+            xs = np.pad(xs, pad)
+        if shared:
+            xs = xs[None]
+        y = _eval_jit(
+            self.rk_masks,
+            self._last_bit_mask,
+            dev["s0"],
+            dev["cw_s"],
+            dev["cw_v"],
+            dev["cw_tl"],
+            dev["cw_tr"],
+            dev["cw_np1"],
+            jnp.asarray(np.ascontiguousarray(xs)),
+            b=int(b),
+            lam=self.lam,
+        )  # uint8 [K, m_pad, lam]
+        return np.asarray(y[:, :m, :])
+
+
+class KeyLanesBackend(_BitslicedBase):
+    """Many-keys bitsliced evaluator (keys packed in lanes, shared points).
+
+    Use when K >> M (e.g. the 10^6-keys x 10^3-points secure-ReLU shape):
+    the device-resident key image stays at its natural byte size instead of
+    the 32x mask blow-up of the points-in-lanes layout.
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes]):
+        super().__init__(lam, cipher_keys)
+        self._num_keys = 0
+
+    def put_bundle(self, bundle: KeyBundle) -> None:
+        """Ship a party-restricted bundle, keys packed 32-per-lane-word."""
+        if bundle.lam != self.lam:
+            raise ValueError("bundle lam mismatch")
+        if bundle.s0s.shape[1] != 1:
+            raise ValueError("put_bundle requires a party-restricted bundle")
+        k = bundle.num_keys
+        k_pad = (k + 31) // 32 * 32
+        self._num_keys = k
+
+        def pad_keys(a):
+            return np.pad(a, [(0, k_pad - k)] + [(0, 0)] * (a.ndim - 1))
+
+        def packed(bits_k_last):
+            # [..., K] {0,1} -> uint32 [..., K/32]
+            return jnp.asarray(pack_lanes(np.ascontiguousarray(bits_k_last)))
+
+        cw_s_bits = byte_bits_lsb(pad_keys(bundle.cw_s))  # [K, n, 8lam]
+        cw_v_bits = byte_bits_lsb(pad_keys(bundle.cw_v))
+        self._bundle_dev = dict(
+            s0=packed(byte_bits_lsb(pad_keys(bundle.s0s[:, 0, :])).T),
+            cw_s=packed(cw_s_bits.transpose(1, 2, 0)),
+            cw_v=packed(cw_v_bits.transpose(1, 2, 0)),
+            cw_tl=packed(pad_keys(bundle.cw_t[:, :, 0]).T),
+            cw_tr=packed(pad_keys(bundle.cw_t[:, :, 1]).T),
+            cw_np1=packed(byte_bits_lsb(pad_keys(bundle.cw_np1)).T),
+        )
+
+    def eval(
+        self, b: int, xs: np.ndarray, bundle: KeyBundle | None = None
+    ) -> np.ndarray:
+        """Evaluate party ``b`` on shared points xs uint8 [M, n_bytes].
+
+        Returns uint8 [K, M, lam].
+        """
+        if bundle is not None:
+            self.put_bundle(bundle)
+        if self._bundle_dev is None:
+            raise ValueError("no key bundle on device; call put_bundle first")
+        if xs.ndim != 2:
+            raise ValueError("KeyLanesBackend requires shared points [M, n_bytes]")
+        dev = self._bundle_dev
+        n = dev["cw_s"].shape[0]
+        if xs.shape[1] * 8 != n:
+            raise ValueError("xs width mismatch with bundle")
+        y = _eval_keylanes_jit(
+            self.rk_masks,
+            self._last_bit_mask,
+            dev["s0"],
+            dev["cw_s"],
+            dev["cw_v"],
+            dev["cw_tl"],
+            dev["cw_tr"],
+            dev["cw_np1"],
+            jnp.asarray(np.ascontiguousarray(xs)),
+            b=int(b),
+            lam=self.lam,
+        )  # uint8 [M, K_pad, lam]
+        return np.asarray(y).transpose(1, 0, 2)[: self._num_keys]

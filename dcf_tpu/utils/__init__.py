@@ -1,0 +1,1 @@
+"""Shared utilities (bit packing for the bitsliced TPU path)."""

@@ -1,0 +1,74 @@
+"""Bit-plane packing for the bitsliced eval path.
+
+Layout conventions (used consistently by ops.aes_bitsliced and
+backends.jax_bitsliced):
+
+* A byte axis of size nbytes expands to 8*nbytes planes: plane index
+  p = byte*8 + bit, bits LSB-first within each byte.
+* A batch axis of size B is packed 32 elements per uint32 word (B must be a
+  multiple of 32): word w holds elements w*32 .. w*32+31, element j in bit j.
+
+All host-side prep is numpy; the packed arrays go to device as-is.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "pack_lanes",
+    "unpack_lanes",
+    "byte_bits_lsb",
+    "byte_bits_msb",
+    "planes_to_bytes",
+    "expand_bits_to_masks",
+]
+
+_SHIFTS32 = np.arange(32, dtype=np.uint32)
+_SHIFTS8 = np.arange(8, dtype=np.uint8)
+
+
+def pack_lanes(bits: np.ndarray) -> np.ndarray:
+    """Pack a trailing batch axis of {0,1} values into uint32 words.
+
+    [..., B] (B % 32 == 0) -> uint32 [..., B//32].
+    """
+    b = bits.shape[-1]
+    if b % 32 != 0:
+        raise ValueError(f"batch {b} not a multiple of 32")
+    w = bits.astype(np.uint32).reshape(*bits.shape[:-1], b // 32, 32)
+    return np.bitwise_or.reduce(w << _SHIFTS32, axis=-1)
+
+
+def unpack_lanes(words: np.ndarray) -> np.ndarray:
+    """Inverse of pack_lanes: uint32 [..., W] -> uint8 {0,1} [..., W*32]."""
+    bits = (words[..., None] >> _SHIFTS32) & np.uint32(1)
+    return bits.reshape(*words.shape[:-1], words.shape[-1] * 32).astype(np.uint8)
+
+
+def byte_bits_lsb(arr: np.ndarray) -> np.ndarray:
+    """uint8 [..., nbytes] -> {0,1} [..., 8*nbytes], plane order byte*8+bit."""
+    bits = (arr[..., None] >> _SHIFTS8) & np.uint8(1)
+    return bits.reshape(*arr.shape[:-1], arr.shape[-1] * 8)
+
+
+def byte_bits_msb(arr: np.ndarray) -> np.ndarray:
+    """uint8 [..., nbytes] -> {0,1} [..., 8*nbytes] in MSB-first walk order
+    (bit i = the i-th bit consumed by the GGM tree walk)."""
+    bits = (arr[..., None] >> _SHIFTS8[::-1]) & np.uint8(1)
+    return bits.reshape(*arr.shape[:-1], arr.shape[-1] * 8)
+
+
+def planes_to_bytes(planes: np.ndarray, nbytes: int) -> np.ndarray:
+    """Packed planes [8*nbytes, ..., W] -> uint8 [..., W*32, nbytes]."""
+    if planes.shape[0] != 8 * nbytes:
+        raise ValueError("plane count does not match nbytes")
+    bits = unpack_lanes(planes)  # [8n, ..., B]
+    bits = np.moveaxis(bits, 0, -1)  # [..., B, 8n]
+    bits = bits.reshape(*bits.shape[:-1], nbytes, 8)
+    return np.bitwise_or.reduce(bits << _SHIFTS8, axis=-1).astype(np.uint8)
+
+
+def expand_bits_to_masks(bits: np.ndarray) -> np.ndarray:
+    """{0,1} array -> uint32 masks (0 or 0xFFFFFFFF), same shape."""
+    return (bits.astype(np.uint32) * np.uint32(0xFFFFFFFF)).astype(np.uint32)

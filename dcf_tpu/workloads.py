@@ -1,0 +1,93 @@
+"""Reference workloads (BASELINE.json configs) as runnable functions.
+
+- ``domain_points`` / ``full_domain_check`` — config 3: full-domain
+  evaluation at n bits with two-party XOR reconstruction verified against
+  the plain comparison function, streamed in chunks so n=24 (16.7M points)
+  runs in bounded memory.
+- ``secure_relu_eval`` — config 5: the many-keys x few-points shape
+  (10^6 keys x 10^3 points).  In FSS-based secure inference a ReLU/MSB
+  gate consumes one DCF evaluation per wire per input; the workload is
+  exactly a huge batch of independent DCF evals, which is why it scales as
+  a pure map over (keys x points).  Uses the keys-in-lanes backend.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from dcf_tpu.keys import KeyBundle
+
+__all__ = ["domain_points", "full_domain_check", "secure_relu_eval"]
+
+
+def domain_points(n_bytes: int, start: int, count: int) -> np.ndarray:
+    """Points start..start+count-1 as big-endian uint8 [count, n_bytes]."""
+    idx = np.arange(start, start + count, dtype=np.uint64)
+    shifts = (8 * np.arange(n_bytes - 1, -1, -1)).astype(np.uint64)
+    return ((idx[:, None] >> shifts) & np.uint64(0xFF)).astype(np.uint8)
+
+
+def full_domain_check(
+    eval0: Callable[[np.ndarray], np.ndarray],
+    eval1: Callable[[np.ndarray], np.ndarray],
+    alpha: int,
+    beta: bytes,
+    n_bits: int,
+    gt: bool = False,
+    chunk: int = 1 << 18,
+) -> int:
+    """Evaluate both parties over the whole 2^n_bits domain in chunks and
+    verify XOR reconstruction equals the comparison function everywhere.
+
+    eval_b(xs uint8 [M, n_bytes]) -> uint8 [1, M, lam] (or [K, M, lam]; key 0
+    is checked).  Returns the number of mismatching points (0 = pass).
+    """
+    n_bytes = n_bits // 8
+    lam = len(beta)
+    beta_arr = np.frombuffer(beta, dtype=np.uint8)
+    zero = np.zeros(lam, dtype=np.uint8)
+    total = 1 << n_bits
+    mismatches = 0
+    for start in range(0, total, chunk):
+        count = min(chunk, total - start)
+        xs = domain_points(n_bytes, start, count)
+        recon = (eval0(xs)[0] ^ eval1(xs)[0]).astype(np.uint8)  # [count, lam]
+        idx = np.arange(start, start + count)
+        inside = (idx > alpha) if gt else (idx < alpha)
+        expect = np.where(inside[:, None], beta_arr[None, :], zero[None, :])
+        mismatches += int(np.count_nonzero(np.any(recon != expect, axis=1)))
+    return mismatches
+
+
+def secure_relu_eval(
+    backend0,
+    backend1,
+    bundle: KeyBundle,
+    xs: np.ndarray,
+    key_chunk: int = 1 << 16,
+) -> np.ndarray:
+    """Config 5: evaluate K keys on M shared points, both parties, and
+    return the XOR reconstruction uint8 [K, M, lam], streaming over keys.
+
+    backend0/backend1: KeyLanesBackend-compatible evaluators (put_bundle +
+    eval).  Keys stream through the device in ``key_chunk`` slices — the
+    full 10^6-key image does not need to be HBM-resident at once.
+    """
+    k = bundle.num_keys
+    m, lam = xs.shape[0], bundle.lam
+    out = np.empty((k, m, lam), dtype=np.uint8)
+    for lo in range(0, k, key_chunk):
+        hi = min(k, lo + key_chunk)
+        sub = KeyBundle(
+            s0s=bundle.s0s[lo:hi],
+            cw_s=bundle.cw_s[lo:hi],
+            cw_v=bundle.cw_v[lo:hi],
+            cw_t=bundle.cw_t[lo:hi],
+            cw_np1=bundle.cw_np1[lo:hi],
+        )
+        y0 = backend0.eval(0, xs, bundle=sub.for_party(0))
+        y1 = backend1.eval(1, xs, bundle=sub.for_party(1))
+        out[lo:hi] = y0 ^ y1
+    return out
