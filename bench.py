@@ -1,0 +1,103 @@
+"""Driver benchmark: flagship DCF batch-eval throughput on the local chip.
+
+Workload: the reference's headline bench (`/root/reference/benches/
+dcf_batch_eval.rs:17-39`) scaled up — one DCF key, N=16-byte domain
+(n=128 scan levels), lam=16-byte range, a large batch of random points,
+party-0 evaluation.  Metric: DCF evals/sec/chip on the accelerator
+backend, with bit-exact parity checked against the C++ host core.
+
+Baseline: the single-core C++ eval rate measured in-process (the stand-in
+for single-core Rust per BASELINE.md — same AES-NI instruction path the
+`aes` crate uses).  `vs_baseline` is the speedup over it; the north-star
+target is >= 100x.
+
+Prints exactly ONE line of JSON to stdout; progress goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+LAM = 16
+N_BYTES = 16
+M_TPU = 1 << 20  # accelerator batch (points)
+M_CPU = 1 << 13  # single-core baseline batch (scaled up to a rate)
+M_PARITY = 4096  # bit-exact check subset
+TIMED_REPS = 3
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    from dcf_tpu.backends.jax_bitsliced import BitslicedBackend
+    from dcf_tpu.gen import random_s0s
+    from dcf_tpu.native import NativeDcf
+    from dcf_tpu.spec import Bound
+
+    rng = np.random.default_rng(2026)
+    cipher_keys = [rng.bytes(32), rng.bytes(32)]
+    native = NativeDcf(LAM, cipher_keys)
+    log(f"native core: AES-NI={native.has_aesni}")
+
+    alphas = rng.integers(0, 256, (1, N_BYTES), dtype=np.uint8)
+    betas = rng.integers(0, 256, (1, LAM), dtype=np.uint8)
+    bundle = native.gen_batch(alphas, betas, random_s0s(1, LAM, rng), Bound.LT_BETA)
+    xs = rng.integers(0, 256, (M_TPU, N_BYTES), dtype=np.uint8)
+
+    # --- single-core CPU baseline (Rust stand-in) ---
+    t0 = time.perf_counter()
+    y_cpu = native.eval(0, bundle, xs[:M_CPU], num_threads=1)
+    cpu_s = time.perf_counter() - t0
+    cpu_rate = M_CPU / cpu_s
+    log(f"cpu single-core: {M_CPU} pts in {cpu_s:.3f}s = {cpu_rate:,.0f} evals/s")
+
+    # --- accelerator backend ---
+    import jax
+
+    dev = jax.devices()[0]
+    log(f"jax device: {dev.platform} {getattr(dev, 'device_kind', '')}")
+    backend = BitslicedBackend(LAM, cipher_keys)
+    backend.put_bundle(bundle.for_party(0))
+
+    t0 = time.perf_counter()
+    y_dev = backend.eval(0, xs)  # compile + run (np.asarray syncs)
+    warm_s = time.perf_counter() - t0
+    log(f"warmup (compile + first run): {warm_s:.1f}s")
+
+    best_s = float("inf")
+    for i in range(TIMED_REPS):
+        t0 = time.perf_counter()
+        y_dev = backend.eval(0, xs)
+        dt = time.perf_counter() - t0
+        best_s = min(best_s, dt)
+        log(f"rep {i}: {M_TPU} pts in {dt:.3f}s = {M_TPU / dt:,.0f} evals/s")
+    dev_rate = M_TPU / best_s
+
+    # --- bit-exact parity vs the host core ---
+    parity_ok = bool(np.array_equal(y_dev[0, :M_PARITY], y_cpu[0, :M_PARITY]))
+    log(f"parity (first {M_PARITY} pts): {'OK' if parity_ok else 'MISMATCH'}")
+    if not parity_ok:
+        raise SystemExit("bit-exact parity check failed")
+
+    print(
+        json.dumps(
+            {
+                "metric": "dcf_batch_eval_evals_per_sec_per_chip",
+                "value": round(dev_rate, 1),
+                "unit": "evals/s (n=128, lam=16B, 1 key x 2^20 points, party 0)",
+                "vs_baseline": round(dev_rate / cpu_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    main()

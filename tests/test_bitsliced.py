@@ -49,6 +49,18 @@ def test_byte_bits_orders():
     assert list(msb[0, 8:]) == [0, 0, 0, 0, 0, 0, 1, 0]
 
 
+def test_xs_mask_dev_matches_host_msb():
+    """The device-side walk-order mask equals host byte_bits_msb + pack."""
+    from dcf_tpu.backends.jax_bitsliced import _xs_to_mask_dev
+
+    rng = np.random.default_rng(7)
+    xs = rng.integers(0, 256, (3, 64, 2), dtype=np.uint8)  # [Kx, M, n_bytes]
+    got = np.asarray(_xs_to_mask_dev(xs))  # [n, Kx, M/32]
+    bits = byte_bits_msb(xs.reshape(-1, 2)).reshape(3, 64, 16)  # [Kx, M, n]
+    want = pack_lanes(np.ascontiguousarray(bits.transpose(2, 0, 1)))
+    assert np.array_equal(got, want)
+
+
 def test_bitsliced_aes_matches_table():
     from dcf_tpu.ops.aes import aes256_encrypt_np, expand_key_np
     from dcf_tpu.ops.aes_bitsliced import aes256_encrypt_planes, round_key_masks
