@@ -1,0 +1,132 @@
+"""PallasBackend — device-resident DCF evaluator on the Pallas kernel.
+
+API-compatible with BitslicedBackend (put_bundle / eval), lam = 16 only
+(the kernel is specialized to one AES block per seed; other lam values use
+the XLA bitsliced path).  Key material is shipped once as bit-major plane
+masks; xs->bit-mask and plane->byte conversions run on device inside the
+same jitted program as the kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcf_tpu.backends.jax_bitsliced import _planes_to_bytes_dev, _xs_to_mask_dev
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.ops.aes_bitsliced import round_key_masks_bitmajor
+from dcf_tpu.ops.pallas_eval import DEFAULT_TILE_WORDS, dcf_eval_pallas
+from dcf_tpu.spec import hirose_used_cipher_indices
+from dcf_tpu.utils.bits import bitmajor_perm, byte_bits_lsb, expand_bits_to_masks
+
+__all__ = ["PallasBackend"]
+
+_PERM = bitmajor_perm(16)
+_INV_PERM = np.argsort(_PERM)
+
+
+@partial(jax.jit, static_argnames=("b", "tile_words", "interpret"))
+def _eval_bytes(rk, s0_t, cw_s_t, cw_v_t, cw_np1_t, cw_t, xs, inv_perm,
+                b: int, tile_words: int, interpret: bool):
+    # Shared bytes<->planes helpers from the XLA bitsliced backend; this
+    # kernel just wants (keys, level) leading and int32 lanes.
+    x_mask = jax.lax.bitcast_convert_type(
+        _xs_to_mask_dev(xs).transpose(1, 0, 2), jnp.int32
+    )[:, :, None, :]
+    y_bm = dcf_eval_pallas(
+        rk, s0_t, cw_s_t, cw_v_t, cw_np1_t, cw_t, x_mask,
+        b=b, tile_words=tile_words, interpret=interpret,
+    )
+    y = jax.lax.bitcast_convert_type(y_bm, jnp.uint32)
+    y = jnp.take(y, inv_perm, axis=1).transpose(1, 0, 2)  # [8lam, K, W]
+    return _planes_to_bytes_dev(y, 16)
+
+
+class PallasBackend:
+    """DCF evaluator running the fused Pallas walk kernel (lam = 16)."""
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes],
+                 tile_words: int = DEFAULT_TILE_WORDS,
+                 interpret: bool = False):
+        if lam != 16:
+            raise ValueError(
+                f"PallasBackend supports lam=16 only (got {lam}); "
+                "use BitslicedBackend for other lam"
+            )
+        used = hirose_used_cipher_indices(lam, len(cipher_keys))
+        self.lam = lam
+        self.tile_words = tile_words
+        self.interpret = interpret
+        self.rk = jnp.asarray(round_key_masks_bitmajor(cipher_keys[used[0]]))
+        self._inv_perm = jnp.asarray(_INV_PERM)
+        self._bundle_dev = None
+
+    def put_bundle(self, bundle: KeyBundle) -> None:
+        """Ship a party-restricted bundle as bit-major plane masks."""
+        if bundle.lam != self.lam:
+            raise ValueError("bundle lam mismatch")
+        if bundle.s0s.shape[1] != 1:
+            raise ValueError("put_bundle requires a party-restricted bundle")
+
+        def plane_masks(a):  # uint8 [..., lam] -> int32 masks [..., 128]
+            bits = byte_bits_lsb(a)[..., _PERM]
+            return expand_bits_to_masks(bits).view(np.int32)
+
+        def keyed(a):  # [K, lam] -> [K, 128, 1]
+            return jnp.asarray(plane_masks(a)[:, :, None])
+
+        def leveled(a):  # [K, n, lam] -> [K, n, 128, 1]
+            return jnp.asarray(plane_masks(a)[:, :, :, None])
+
+        self._bundle_dev = dict(
+            s0=keyed(bundle.s0s[:, 0, :]),
+            cw_s=leveled(bundle.cw_s),
+            cw_v=leveled(bundle.cw_v),
+            cw_np1=keyed(bundle.cw_np1),
+            cw_t=jnp.asarray(bundle.cw_t.astype(np.int32) * -1),
+        )
+
+    def eval(self, b: int, xs: np.ndarray,
+             bundle: KeyBundle | None = None) -> np.ndarray:
+        """Evaluate party ``b``; xs uint8 [M, n_bytes] or [K, M, n_bytes].
+
+        Returns uint8 [K, M, lam].  Points are padded internally to a
+        multiple of 32*tile_words (pad lanes computed and discarded).
+        """
+        if bundle is not None:
+            self.put_bundle(bundle)
+        if self._bundle_dev is None:
+            raise ValueError("no key bundle on device; call put_bundle first")
+        dev = self._bundle_dev
+        k_num = dev["s0"].shape[0]
+        n = dev["cw_s"].shape[1]
+        shared = xs.ndim == 2
+        m = xs.shape[0] if shared else xs.shape[1]
+        if xs.shape[-1] * 8 != n:
+            raise ValueError("xs width mismatch with bundle")
+        if not shared and xs.shape[0] != k_num:
+            raise ValueError(
+                f"xs has {xs.shape[0]} key rows but bundle has {k_num} keys"
+            )
+        if m == 0:
+            return np.zeros((k_num, 0, self.lam), dtype=np.uint8)
+        quantum = 32 * min(self.tile_words, max(1, (m + 31) // 32))
+        m_pad = (m + quantum - 1) // quantum * quantum
+        if m_pad != m:
+            pad = ([(0, m_pad - m), (0, 0)] if shared
+                   else [(0, 0), (0, m_pad - m), (0, 0)])
+            xs = np.pad(xs, pad)
+        if shared:
+            xs = xs[None]
+        y = _eval_bytes(
+            self.rk, dev["s0"], dev["cw_s"], dev["cw_v"], dev["cw_np1"],
+            dev["cw_t"], jnp.asarray(np.ascontiguousarray(xs)),
+            self._inv_perm, b=int(b),
+            tile_words=min(self.tile_words, m_pad // 32),
+            interpret=self.interpret,
+        )
+        return np.asarray(y[:, :m, :])

@@ -23,11 +23,16 @@ from __future__ import annotations
 import numpy as np
 
 from dcf_tpu.ops.aes import expand_key_np
-from dcf_tpu.ops.sbox_circuit import sbox_planes
+from dcf_tpu.ops.sbox_circuit import sbox_planes_bp113 as sbox_planes
 from dcf_tpu.spec import SHIFT_ROWS
 from dcf_tpu.utils.bits import byte_bits_lsb, expand_bits_to_masks
 
-__all__ = ["round_key_masks", "aes256_encrypt_planes"]
+__all__ = [
+    "round_key_masks",
+    "round_key_masks_bitmajor",
+    "aes256_encrypt_planes",
+    "aes256_encrypt_planes_bitmajor",
+]
 
 
 def round_key_masks(key: bytes) -> np.ndarray:
@@ -96,3 +101,63 @@ def aes256_encrypt_planes(xp, rk_masks, planes, ones):
     for rnd in range(1, 14):
         s = ark(mix(sub_shift(s)), rnd)
     return ark(sub_shift(s).reshape(128, *rest), 14)
+
+
+# ---------------------------------------------------------------------------
+# Bit-major variant (the Pallas kernel layout).
+#
+# Plane order within one 128-plane block: p' = bit*16 + byte (utils.bits.
+# bitmajor_perm), so the 8 S-box input planes are CONTIGUOUS 16-row sublane
+# slices of the state — no strided sublane gathers inside the kernel, which
+# is what Mosaic lowers well.  Semantics identical to the byte-major path.
+# ---------------------------------------------------------------------------
+
+
+def round_key_masks_bitmajor(key: bytes):
+    """32-byte key -> int32 [15, 128, 1] bit-major plane masks (0 / -1)."""
+    from dcf_tpu.utils.bits import bitmajor_perm
+
+    masks = round_key_masks(key)[:, bitmajor_perm(16)]  # [15, 128] uint32
+    return masks.view(np.int32)[:, :, None].copy()
+
+
+def aes256_encrypt_planes_bitmajor(xp, rk_all, state, ones):
+    """Encrypt blocks in bit-major plane representation.
+
+    rk_all: [15, 128, 1] plane masks (round_key_masks_bitmajor).  state:
+    [128, L] packed planes, bit-major order.  ones: all-ones scalar of the
+    state dtype.  Returns [128, L].  Works for numpy and jnp (including
+    inside a Pallas kernel, where every op below is sublane-contiguous).
+    """
+    l = state.shape[-1]
+
+    def sub(s):
+        s3 = s.reshape(8, 16, l)
+        return xp.stack(sbox_planes([s3[i] for i in range(8)], ones))
+
+    def shift(sb):
+        # [8, 16, L] -> [8, 4c, 4r, L]; dest (c, r) <- src ((c+r)%4, r),
+        # i.e. row r of the AES state rotates left by r columns.
+        a = sb.reshape(8, 4, 4, l)
+        rows = [a[:, :, 0, :]]
+        for r in range(1, 4):
+            x = a[:, :, r, :]
+            rows.append(xp.concatenate([x[:, r:], x[:, :r]], axis=1))
+        return xp.stack(rows, axis=2)
+
+    def xt(a):
+        # GF(2^8) doubling on the bit axis (axis 0) of [8, 4c, 4r, L].
+        return xp.stack(
+            [a[7], a[0] ^ a[7], a[1], a[2] ^ a[7], a[3] ^ a[7], a[4], a[5], a[6]]
+        )
+
+    def mix(a):
+        r1 = xp.concatenate([a[:, :, 1:], a[:, :, :1]], axis=2)
+        r2 = xp.concatenate([a[:, :, 2:], a[:, :, :2]], axis=2)
+        r3 = xp.concatenate([a[:, :, 3:], a[:, :, :3]], axis=2)
+        return xt(a) ^ xt(r1) ^ r1 ^ r2 ^ r3
+
+    s = state ^ rk_all[0]
+    for rnd in range(1, 14):
+        s = mix(shift(sub(s))).reshape(128, l) ^ rk_all[rnd]
+    return shift(sub(s)).reshape(128, l) ^ rk_all[14]

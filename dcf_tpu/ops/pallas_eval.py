@@ -1,0 +1,156 @@
+"""Pallas TPU kernel for the full DCF evaluation walk.
+
+The XLA bitsliced path (backends.jax_bitsliced) is HBM-bound: every level of
+the 8N-bit GGM walk materializes multi-MB plane intermediates between fused
+ops, so the chip streams ~TBs per batch.  This kernel keeps the ENTIRE
+walk — the bitsliced AES-256 Hirose PRG, correction-word application, and
+the left/right mux (reference semantics: /root/reference/src/lib.rs:163-204,
+/root/reference/src/prg.rs:42-73) — in VMEM: the (s, t, v) carry lives in
+VMEM scratch that persists across grid steps, so HBM traffic is only the
+per-level correction words + input-bit masks in and the output planes out.
+
+Layouts (lam = 16 only — one AES block per seed, one Hirose cipher; larger
+lam falls back to the XLA path):
+
+    planes   int32, bit-major order p' = bit*16 + byte
+             (utils.bits.bitmajor_perm) so S-box inputs are contiguous
+             16-row sublane slices
+    lanes    points packed 32-per-word; a grid step owns WT words
+             (32*WT points)
+    grid     (K, W // WT, n): keys x point tiles x walk levels, levels
+             innermost.  Level i's correction words arrive as a [128, 1]
+             block (pipelined DMA — Mosaic forbids dynamic lane slicing,
+             so the grid does the indexing); (tl, tr) are 0/-1 SMEM scalars.
+             The carry resets at i == 0 and the output block (revisited
+             across levels, flushed once) is written at i == n-1.
+
+Everything is int32 (identical bit patterns to uint32 for XOR/AND/OR; SMEM
+scalars want int32).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dcf_tpu.ops.aes_bitsliced import aes256_encrypt_planes_bitmajor
+
+__all__ = ["dcf_eval_pallas", "DEFAULT_TILE_WORDS"]
+
+DEFAULT_TILE_WORDS = 512  # 16384 points per grid step; ~6 MB VMEM live set
+
+
+def _kernel(rk_ref, s0_ref, cw_s_ref, cw_v_ref, cw_np1_ref, cw_t_ref, xm_ref,
+            y_ref, s_scr, t_scr, v_scr, *, b: int, n: int):
+    i = pl.program_id(2)
+    wt = xm_ref.shape[3]
+    ones = jnp.int32(-1)
+
+    @pl.when(i == 0)
+    def _():
+        # (broadcast via ^0: jnp.broadcast_to doesn't lower in Mosaic)
+        s_scr[:] = s0_ref[0] ^ jnp.zeros((128, wt), jnp.int32)
+        t_scr[:] = jnp.full((1, wt), ones if b else jnp.int32(0), jnp.int32)
+        v_scr[:] = jnp.zeros((128, wt), jnp.int32)
+
+    s = s_scr[:]
+    t = t_scr[:]
+    v = v_scr[:]
+
+    # PRG mask: output bit 8*lam-1 is cleared (reference src/prg.rs:65-68);
+    # for lam=16 that is byte 15 bit 0 -> bit-major plane 15.
+    plane_idx = jax.lax.broadcasted_iota(jnp.int32, (128, 1), 0)
+    lbm = jnp.where(plane_idx == 15, jnp.int32(0), ones)
+
+    sp = s ^ ones
+    # One Hirose PRG call = AES-256 over (seed, seed^c) side by side.
+    enc = aes256_encrypt_planes_bitmajor(
+        jnp, rk_ref[:], jnp.concatenate([s, sp], axis=1), ones
+    )
+    sl_raw = enc[:, :wt] ^ s   # left child seed planes (pre-mask)
+    vl_raw = enc[:, wt:] ^ sp  # left child value planes (pre-mask)
+    # t bits come from the pre-mask planes (src/prg.rs:63-64); the right
+    # half is the never-encrypted Miyaguchi copy: s_r = seed, v_r = seed^c.
+    t_l = sl_raw[0:1, :]
+    t_r = vl_raw[0:1, :]
+    s_l = sl_raw & lbm
+    v_l = vl_raw & lbm
+    s_r = s & lbm
+    v_r = sp & lbm
+
+    cs = cw_s_ref[0, 0]  # [128, 1]
+    cv = cw_v_ref[0, 0]
+    ctl = cw_t_ref[0, i, 0]
+    ctr = cw_t_ref[0, i, 1]
+    gate = t  # [1, wt], broadcasts over planes
+    s_l = s_l ^ (cs & gate)
+    s_r = s_r ^ (cs & gate)
+    t_l = t_l ^ (t & ctl)
+    t_r = t_r ^ (t & ctr)
+
+    xm = xm_ref[0, 0]  # [1, wt] input-bit lane masks for this level
+    nxm = xm ^ ones
+    v = v ^ (v_r & xm) ^ (v_l & nxm) ^ (cv & gate)
+    s = (s_r & xm) | (s_l & nxm)
+    t = (t_r & xm) | (t_l & nxm)
+
+    s_scr[:] = s
+    t_scr[:] = t
+    v_scr[:] = v
+
+    @pl.when(i == n - 1)
+    def _():
+        y_ref[0] = v ^ s ^ (cw_np1_ref[0] & t)
+
+
+def dcf_eval_pallas(
+    rk,        # int32 [15, 128, 1]    bit-major round-key masks (one cipher)
+    s0_t,      # int32 [K, 128, 1]     party seed planes
+    cw_s_t,    # int32 [K, n, 128, 1]  CW seed planes, one block per level
+    cw_v_t,    # int32 [K, n, 128, 1]  CW value planes
+    cw_np1_t,  # int32 [K, 128, 1]     final CW planes
+    cw_t,      # int32 [K, n, 2]       (tl, tr) as 0/-1 scalars
+    x_mask,    # int32 [Kx, n, 1, W]   per-level input-bit lane masks
+    *,
+    b: int,
+    tile_words: int = DEFAULT_TILE_WORDS,
+    interpret: bool = False,
+):
+    """Party ``b`` DCF eval; returns y planes int32 [K, 128, W] (bit-major)."""
+    k_num = s0_t.shape[0]
+    n = cw_s_t.shape[1]
+    kx, _, _, w = x_mask.shape
+    wt = min(tile_words, w)
+    if w % wt != 0:
+        raise ValueError(f"point words {w} not a multiple of tile {wt}")
+    shared = kx == 1
+
+    grid = (k_num, w // wt, n)
+    return pl.pallas_call(
+        partial(_kernel, b=b, n=n),
+        out_shape=jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((15, 128, 1), lambda k, j, i: (0, 0, 0)),
+            pl.BlockSpec((1, 128, 1), lambda k, j, i: (k, 0, 0)),
+            pl.BlockSpec((1, 1, 128, 1), lambda k, j, i: (k, i, 0, 0)),
+            pl.BlockSpec((1, 1, 128, 1), lambda k, j, i: (k, i, 0, 0)),
+            pl.BlockSpec((1, 128, 1), lambda k, j, i: (k, 0, 0)),
+            pl.BlockSpec((1, n, 2), lambda k, j, i: (k, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, 1, wt),
+                         (lambda k, j, i: (0, i, 0, j)) if shared
+                         else (lambda k, j, i: (k, i, 0, j))),
+        ],
+        out_specs=pl.BlockSpec((1, 128, wt), lambda k, j, i: (k, 0, j)),
+        scratch_shapes=[
+            pltpu.VMEM((128, wt), jnp.int32),
+            pltpu.VMEM((1, wt), jnp.int32),
+            pltpu.VMEM((128, wt), jnp.int32),
+        ],
+        interpret=interpret,
+    )(rk, s0_t, cw_s_t, cw_v_t, cw_np1_t, cw_t, x_mask)

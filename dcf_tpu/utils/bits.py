@@ -22,6 +22,7 @@ __all__ = [
     "byte_bits_msb",
     "planes_to_bytes",
     "expand_bits_to_masks",
+    "bitmajor_perm",
 ]
 
 _SHIFTS32 = np.arange(32, dtype=np.uint32)
@@ -72,3 +73,20 @@ def planes_to_bytes(planes: np.ndarray, nbytes: int) -> np.ndarray:
 def expand_bits_to_masks(bits: np.ndarray) -> np.ndarray:
     """{0,1} array -> uint32 masks (0 or 0xFFFFFFFF), same shape."""
     return (bits.astype(np.uint32) * np.uint32(0xFFFFFFFF)).astype(np.uint32)
+
+
+def bitmajor_perm(lam: int) -> np.ndarray:
+    """Permutation taking byte-major planes to bit-major-within-block order.
+
+    Byte-major plane index is p = byte*8 + bit (byte_bits_lsb).  The Pallas
+    kernel wants planes grouped so that all 16 byte positions of one AES
+    block sit contiguously for each bit: within 128-plane block ``blk``,
+    p' = 128*blk + bit*16 + byte_in_block.  Returns ``perm`` (len 8*lam)
+    such that ``planes_bm = planes[perm]``; ``np.argsort(perm)`` inverts.
+    """
+    perm = np.empty(8 * lam, dtype=np.int32)
+    for p_new in range(8 * lam):
+        blk, rem = divmod(p_new, 128)
+        bit, byte_in_blk = divmod(rem, 16)
+        perm[p_new] = (16 * blk + byte_in_blk) * 8 + bit
+    return perm

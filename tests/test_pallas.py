@@ -1,0 +1,138 @@
+"""Pallas kernel path: bit-major AES, full eval parity (interpret mode).
+
+On CPU the kernel runs via the Pallas interpreter; on TPU the same code is
+the fused VMEM walk kernel.  Parity target: the numpy oracle, which is
+itself pinned to the reference's vectors (tests/test_spec.py).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from dcf_tpu import spec
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.gen import gen_batch, random_s0s
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.utils.bits import (
+    bitmajor_perm,
+    byte_bits_lsb,
+    pack_lanes,
+    planes_to_bytes,
+)
+
+
+def rand_bytes(rng: random.Random, n: int) -> bytes:
+    return bytes(rng.getrandbits(8) for _ in range(n))
+
+
+def test_bitmajor_perm_roundtrip():
+    perm = bitmajor_perm(16)
+    assert sorted(perm) == list(range(128))
+    # plane 0 stays (byte 0, bit 0); bit-major plane 15 is byte 15, bit 0 —
+    # the plane the PRG's 8*lam-1 masking clears.
+    assert perm[0] == 0
+    assert perm[15] == 15 * 8
+
+
+def test_bitmajor_aes_matches_bytemajor():
+    from dcf_tpu.ops.aes_bitsliced import (
+        aes256_encrypt_planes,
+        aes256_encrypt_planes_bitmajor,
+        round_key_masks,
+        round_key_masks_bitmajor,
+    )
+
+    rng = random.Random(61)
+    key = rand_bytes(rng, 32)
+    blocks = np.random.default_rng(5).integers(0, 256, (64, 16), dtype=np.uint8)
+    planes = pack_lanes(np.ascontiguousarray(byte_bits_lsb(blocks).T))
+    want = aes256_encrypt_planes(
+        np, round_key_masks(key), planes, np.uint32(0xFFFFFFFF)
+    )
+    perm = bitmajor_perm(16)
+    got_bm = aes256_encrypt_planes_bitmajor(
+        np, round_key_masks_bitmajor(key), planes[perm].view(np.int32),
+        np.int32(-1),
+    )
+    got = got_bm.view(np.uint32)[np.argsort(perm)]
+    assert np.array_equal(got, want)
+    assert np.array_equal(planes_to_bytes(got, 16),
+                          planes_to_bytes(want, 16))
+
+
+@pytest.mark.parametrize("bound", [spec.Bound.LT_BETA, spec.Bound.GT_BETA])
+def test_pallas_eval_matches_numpy(bound):
+    from dcf_tpu.backends.pallas_backend import PallasBackend
+
+    rng = random.Random(62)
+    ck = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    prg = HirosePrgNp(16, ck)
+    nprng = np.random.default_rng(6)
+    k_num, n_bytes, m = 2, 2, 45  # m forces lane padding
+    alphas = nprng.integers(0, 256, (k_num, n_bytes), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k_num, 16), dtype=np.uint8)
+    bundle = gen_batch(prg, alphas, betas, random_s0s(k_num, 16, nprng), bound)
+    xs = nprng.integers(0, 256, (m, n_bytes), dtype=np.uint8)
+    xs[:k_num] = alphas
+    be = PallasBackend(16, ck, interpret=True)
+    for b in (0, 1):
+        want = eval_batch_np(prg, b, bundle.for_party(b), xs)
+        got = be.eval(b, xs, bundle=bundle.for_party(b))
+        assert np.array_equal(got, want), f"party {b}"
+
+
+def test_pallas_eval_per_key_points_multi_tile():
+    from dcf_tpu.backends.pallas_backend import PallasBackend
+
+    rng = random.Random(63)
+    ck = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    prg = HirosePrgNp(16, ck)
+    nprng = np.random.default_rng(7)
+    k_num, n_bytes, m = 2, 2, 128  # tile_words=2 -> two grid steps per key
+    bundle = gen_batch(
+        prg,
+        nprng.integers(0, 256, (k_num, n_bytes), dtype=np.uint8),
+        nprng.integers(0, 256, (k_num, 16), dtype=np.uint8),
+        random_s0s(k_num, 16, nprng),
+        spec.Bound.LT_BETA,
+    )
+    xs3 = nprng.integers(0, 256, (k_num, m, n_bytes), dtype=np.uint8)
+    be = PallasBackend(16, ck, tile_words=2, interpret=True)
+    for b in (0, 1):
+        want = eval_batch_np(prg, b, bundle.for_party(b), xs3)
+        got = be.eval(b, xs3, bundle=bundle.for_party(b))
+        assert np.array_equal(got, want)
+
+
+def test_pallas_two_party_reconstruction():
+    from dcf_tpu.backends.pallas_backend import PallasBackend
+
+    rng = random.Random(64)
+    ck = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    prg = HirosePrgNp(16, ck)
+    nprng = np.random.default_rng(8)
+    alpha = np.array([[0x41, 0x7F]], dtype=np.uint8)
+    beta = nprng.integers(0, 256, (1, 16), dtype=np.uint8)
+    bundle = gen_batch(prg, alpha, beta, random_s0s(1, 16, nprng),
+                       spec.Bound.LT_BETA)
+    xs = np.array(
+        [[0x41, 0x7E], [0x41, 0x7F], [0x41, 0x80], [0x00, 0x00], [0xFF, 0xFF]],
+        dtype=np.uint8,
+    )
+    be = PallasBackend(16, ck, interpret=True)
+    y0 = be.eval(0, xs, bundle=bundle.for_party(0))
+    y1 = be.eval(1, xs, bundle=bundle.for_party(1))
+    recon = y0[0] ^ y1[0]
+    want = np.stack(
+        [beta[0], np.zeros(16, np.uint8), np.zeros(16, np.uint8),
+         beta[0], np.zeros(16, np.uint8)]
+    )
+    assert np.array_equal(recon, want)
+
+
+def test_pallas_rejects_other_lambda():
+    from dcf_tpu.backends.pallas_backend import PallasBackend
+
+    with pytest.raises(ValueError, match="lam=16"):
+        PallasBackend(144, [b"\0" * 32] * 18)
