@@ -1,0 +1,37 @@
+"""Shared batch-shape validation/padding for the device eval backends.
+
+Every backend accepts xs as uint8 [M, n_bytes] (points shared by all keys)
+or [K, M, n_bytes] (per-key points) and returns uint8 [K, M, lam]; the
+checks and the pad-and-promote step are identical across backends and live
+here so a fix lands everywhere at once.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["validate_xs", "pad_xs"]
+
+
+def validate_xs(xs: np.ndarray, k_num: int, n_bits: int) -> tuple[bool, int]:
+    """Check xs against the on-device bundle; returns (shared, num_points)."""
+    if xs.ndim not in (2, 3):
+        raise ValueError(f"xs must be 2D or 3D, got {xs.ndim}D")
+    shared = xs.ndim == 2
+    m = xs.shape[0] if shared else xs.shape[1]
+    if xs.shape[-1] * 8 != n_bits:
+        raise ValueError("xs width mismatch with bundle")
+    if not shared and xs.shape[0] != k_num:
+        raise ValueError(
+            f"xs has {xs.shape[0]} key rows but bundle has {k_num} keys"
+        )
+    return shared, m
+
+
+def pad_xs(xs: np.ndarray, shared: bool, m: int, m_pad: int) -> np.ndarray:
+    """Zero-pad the point axis to m_pad and promote shared xs to [1, M, nb]."""
+    if m_pad != m:
+        pad = ([(0, m_pad - m), (0, 0)] if shared
+               else [(0, 0), (0, m_pad - m), (0, 0)])
+        xs = np.pad(xs, pad)
+    return xs[None] if shared else xs

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dcf_tpu.backends._common import pad_xs, validate_xs
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes_bitsliced import aes256_encrypt_planes, round_key_masks
 from dcf_tpu.spec import hirose_used_cipher_indices
@@ -316,20 +317,8 @@ class BitslicedBackend(_BitslicedBase):
         dev = self._bundle_dev
         k_num = dev["s0"].shape[1]
         n = dev["cw_s"].shape[0]
-        shared = xs.ndim == 2
-        m = xs.shape[0] if shared else xs.shape[1]
-        if xs.shape[-1] * 8 != n:
-            raise ValueError("xs width mismatch with bundle")
-        if not shared and xs.shape[0] != k_num:
-            raise ValueError(
-                f"xs has {xs.shape[0]} key rows but bundle has {k_num} keys"
-            )
-        m_pad = (m + 31) // 32 * 32
-        if m_pad != m:
-            pad = [(0, m_pad - m), (0, 0)] if shared else [(0, 0), (0, m_pad - m), (0, 0)]
-            xs = np.pad(xs, pad)
-        if shared:
-            xs = xs[None]
+        shared, m = validate_xs(xs, k_num, n)
+        xs = pad_xs(xs, shared, m, (m + 31) // 32 * 32)
         y = _eval_jit(
             self.rk_masks,
             self._last_bit_mask,

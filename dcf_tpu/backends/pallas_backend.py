@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dcf_tpu.backends._common import pad_xs, validate_xs
 from dcf_tpu.backends.jax_bitsliced import _planes_to_bytes_dev, _xs_to_mask_dev
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes_bitsliced import round_key_masks_bitmajor
@@ -57,6 +58,8 @@ class PallasBackend:
                 f"PallasBackend supports lam=16 only (got {lam}); "
                 "use BitslicedBackend for other lam"
             )
+        if tile_words < 1:
+            raise ValueError(f"tile_words must be >= 1, got {tile_words}")
         used = hirose_used_cipher_indices(lam, len(cipher_keys))
         self.lam = lam
         self.tile_words = tile_words
@@ -90,12 +93,31 @@ class PallasBackend:
             cw_t=jnp.asarray(bundle.cw_t.astype(np.int32) * -1),
         )
 
+    def _plan_tiles(self, m: int) -> tuple[int, int]:
+        """Pick (tile words, padded total words) for an m-point batch.
+
+        Small batches run as one exact tile (pad <= 31 points).  Larger ones
+        balance the tile count first, then round the tile up to the 128-lane
+        granule Mosaic requires, so padding waste stays a tile-rounding
+        sliver instead of up to a whole tile.
+        """
+        words = (m + 31) // 32
+        tw = self.tile_words
+        if words <= tw:
+            return words, words
+        n_tiles = -(-words // tw)
+        if tw >= 128:
+            wt = 128 * (-(-words // (128 * n_tiles)))
+        else:  # tiny tiles (tests / interpret mode): keep the exact size
+            wt = tw
+        return wt, wt * n_tiles
+
     def eval(self, b: int, xs: np.ndarray,
              bundle: KeyBundle | None = None) -> np.ndarray:
         """Evaluate party ``b``; xs uint8 [M, n_bytes] or [K, M, n_bytes].
 
-        Returns uint8 [K, M, lam].  Points are padded internally to a
-        multiple of 32*tile_words (pad lanes computed and discarded).
+        Returns uint8 [K, M, lam].  Points are padded internally to whole
+        lane-tiles (pad lanes computed and discarded).
         """
         if bundle is not None:
             self.put_bundle(bundle)
@@ -104,29 +126,15 @@ class PallasBackend:
         dev = self._bundle_dev
         k_num = dev["s0"].shape[0]
         n = dev["cw_s"].shape[1]
-        shared = xs.ndim == 2
-        m = xs.shape[0] if shared else xs.shape[1]
-        if xs.shape[-1] * 8 != n:
-            raise ValueError("xs width mismatch with bundle")
-        if not shared and xs.shape[0] != k_num:
-            raise ValueError(
-                f"xs has {xs.shape[0]} key rows but bundle has {k_num} keys"
-            )
+        shared, m = validate_xs(xs, k_num, n)
         if m == 0:
             return np.zeros((k_num, 0, self.lam), dtype=np.uint8)
-        quantum = 32 * min(self.tile_words, max(1, (m + 31) // 32))
-        m_pad = (m + quantum - 1) // quantum * quantum
-        if m_pad != m:
-            pad = ([(0, m_pad - m), (0, 0)] if shared
-                   else [(0, 0), (0, m_pad - m), (0, 0)])
-            xs = np.pad(xs, pad)
-        if shared:
-            xs = xs[None]
+        wt, w_pad = self._plan_tiles(m)
+        xs = pad_xs(xs, shared, m, 32 * w_pad)
         y = _eval_bytes(
             self.rk, dev["s0"], dev["cw_s"], dev["cw_v"], dev["cw_np1"],
             dev["cw_t"], jnp.asarray(np.ascontiguousarray(xs)),
-            self._inv_perm, b=int(b),
-            tile_words=min(self.tile_words, m_pad // 32),
+            self._inv_perm, b=int(b), tile_words=wt,
             interpret=self.interpret,
         )
         return np.asarray(y[:, :m, :])

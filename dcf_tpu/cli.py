@@ -1,0 +1,251 @@
+"""Benchmark CLI mirroring the reference's three criterion benches.
+
+Reference harness (no published numbers, SURVEY.md §6):
+
+- ``dcf``             — single ``gen`` + single-point ``eval``, N=16, lam=16
+                        (/root/reference/benches/dcf.rs:7-43)
+- ``dcf_batch_eval``  — 100 000-point batch eval, N=16, lam=16
+                        (/root/reference/benches/dcf_batch_eval.rs:17-39)
+- ``dcf_large_lambda``— lam=16384 (2048 AES keys), 10 000 points
+                        (/root/reference/benches/dcf_large_lambda.rs:8-43)
+
+plus ``secure_relu`` — the BASELINE.json config-5 many-keys workload.
+
+Usage::
+
+    python -m dcf_tpu.cli dcf_batch_eval --backend=pallas --points=1048576
+    python -m dcf_tpu.cli all --backend=cpu
+
+Backends: ``cpu`` (C++ core, all threads), ``cpu1`` (C++ single thread —
+the stand-in for the reference's serial feature matrix), ``numpy``,
+``jax`` (XLA scan/vmap), ``bitsliced`` (XLA bit-planes), ``pallas``
+(fused TPU kernel, lam=16 only).  Each bench prints one human line and one
+JSON line; gen always runs on the C++ host core (keys ship to the device
+once, SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+from dcf_tpu.gen import random_s0s
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.spec import Bound
+
+BACKENDS = ("cpu", "cpu1", "numpy", "jax", "bitsliced", "pallas")
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _cipher_keys(lam: int, rng) -> list[bytes]:
+    n_keys = max(2, 2 * (lam // 16))
+    return [rng.bytes(32) for _ in range(n_keys)]
+
+
+def _make_evaluator(backend: str, lam: int, cipher_keys, native):
+    """Returns eval_fn(b, bundle_party, xs) -> uint8 [K, M, lam]."""
+    if backend in ("cpu", "cpu1"):
+        threads = 1 if backend == "cpu1" else None
+
+        def run(b, bundle, xs):
+            return native.eval(b, bundle, xs, num_threads=threads)
+
+        return run
+    if backend == "numpy":
+        from dcf_tpu.backends.numpy_backend import eval_batch_np
+        from dcf_tpu.ops.prg import HirosePrgNp
+
+        prg = HirosePrgNp(lam, cipher_keys)
+        return lambda b, bundle, xs: eval_batch_np(prg, b, bundle, xs)
+    if backend == "jax":
+        from dcf_tpu.backends.jax_backend import JaxBackend
+
+        be = JaxBackend(lam, cipher_keys)
+    elif backend == "bitsliced":
+        from dcf_tpu.backends.jax_bitsliced import BitslicedBackend
+
+        be = BitslicedBackend(lam, cipher_keys)
+    elif backend == "pallas":
+        from dcf_tpu.backends.pallas_backend import PallasBackend
+
+        be = PallasBackend(lam, cipher_keys)
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
+    return lambda b, bundle, xs: be.eval(b, xs, bundle=bundle)
+
+
+def _timed(fn, reps: int):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _emit(name: str, backend: str, metric: str, value: float, unit: str):
+    log(f"{name}[{backend}]: {value:,.1f} {unit}")
+    print(
+        json.dumps(
+            {"bench": name, "backend": backend, "metric": metric,
+             "value": round(value, 1), "unit": unit}
+        ),
+        flush=True,
+    )
+
+
+def bench_dcf(args) -> None:
+    """Single gen + single-point eval latency (benches/dcf.rs analog)."""
+    from dcf_tpu.native import NativeDcf
+
+    lam, nb = 16, 16
+    rng = np.random.default_rng(args.seed)
+    ck = _cipher_keys(lam, rng)
+    native = NativeDcf(lam, ck)
+    alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
+    betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
+    s0s = random_s0s(1, lam, rng)
+
+    gen_s = _timed(
+        lambda: native.gen_batch(alphas, betas, s0s, Bound.LT_BETA), args.reps
+    )
+    _emit("dcf_gen", "cpu", "gen_latency_us", gen_s * 1e6, "us")
+
+    bundle = native.gen_batch(alphas, betas, s0s, Bound.LT_BETA)
+    run = _make_evaluator(args.backend, lam, ck, native)
+    xs = rng.integers(0, 256, (1, nb), dtype=np.uint8)
+    k0 = bundle.for_party(0)
+    run(0, k0, xs)  # warmup / compile
+    ev_s = _timed(lambda: run(0, k0, xs), args.reps)
+    _emit("dcf_eval_1pt", args.backend, "eval_latency_us", ev_s * 1e6, "us")
+
+
+def bench_batch(args) -> None:
+    """Batch eval throughput (benches/dcf_batch_eval.rs analog)."""
+    from dcf_tpu.native import NativeDcf
+
+    lam, nb = 16, 16
+    m = args.points or 100_000
+    rng = np.random.default_rng(args.seed)
+    ck = _cipher_keys(lam, rng)
+    native = NativeDcf(lam, ck)
+    bundle = native.gen_batch(
+        rng.integers(0, 256, (1, nb), dtype=np.uint8),
+        rng.integers(0, 256, (1, lam), dtype=np.uint8),
+        random_s0s(1, lam, rng),
+        Bound.LT_BETA,
+    )
+    xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
+    run = _make_evaluator(args.backend, lam, ck, native)
+    k0 = bundle.for_party(0)
+    y = run(0, k0, xs)  # warmup / compile
+    if args.check:
+        want = native.eval(0, bundle, xs[:2048])
+        assert np.array_equal(y[0, :2048], want[0]), "parity mismatch vs C++"
+        log("parity vs C++ core: OK (first 2048 pts)")
+    dt = _timed(lambda: run(0, k0, xs), args.reps)
+    _emit("dcf_batch_eval", args.backend, "evals_per_sec", m / dt, "evals/s")
+
+
+def bench_large_lambda(args) -> None:
+    """Large-range eval, lam=16384 (benches/dcf_large_lambda.rs analog)."""
+    from dcf_tpu.native import NativeDcf
+
+    lam, nb = 16384, 16
+    m = args.points or 10_000
+    if args.backend == "pallas":
+        raise SystemExit("pallas backend is lam=16 only; use bitsliced/jax/cpu")
+    rng = np.random.default_rng(args.seed)
+    ck = _cipher_keys(lam, rng)
+    native = NativeDcf(lam, ck)
+    log(f"gen (lam=16384, {2 * (lam // 16)} ciphers) ...")
+    bundle = native.gen_batch(
+        rng.integers(0, 256, (1, nb), dtype=np.uint8),
+        rng.integers(0, 256, (1, lam), dtype=np.uint8),
+        random_s0s(1, lam, rng),
+        Bound.LT_BETA,
+    )
+    xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
+    run = _make_evaluator(args.backend, lam, ck, native)
+    k0 = bundle.for_party(0)
+    y = run(0, k0, xs)  # warmup / compile
+    if args.check:
+        want = native.eval(0, bundle, xs[:64])
+        assert np.array_equal(y[0, :64], want[0]), "parity mismatch vs C++"
+        log("parity vs C++ core: OK (first 64 pts)")
+    dt = _timed(lambda: run(0, k0, xs), args.reps)
+    _emit("dcf_large_lambda", args.backend, "evals_per_sec", m / dt, "evals/s")
+
+
+def bench_secure_relu(args) -> None:
+    """Many-keys x few-points workload (BASELINE.json config 5, scaled)."""
+    from dcf_tpu.backends.jax_bitsliced import KeyLanesBackend
+    from dcf_tpu.native import NativeDcf
+    from dcf_tpu.workloads import secure_relu_eval
+
+    lam, nb = 16, 16
+    k = args.keys or 65_536
+    m = args.points or 1_024
+    rng = np.random.default_rng(args.seed)
+    ck = _cipher_keys(lam, rng)
+    native = NativeDcf(lam, ck)
+    log(f"gen {k} keys ...")
+    bundle = native.gen_batch(
+        rng.integers(0, 256, (k, nb), dtype=np.uint8),
+        rng.integers(0, 256, (k, lam), dtype=np.uint8),
+        random_s0s(k, lam, rng),
+        Bound.LT_BETA,
+    )
+    xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
+    be0 = KeyLanesBackend(lam, ck)
+    be1 = KeyLanesBackend(lam, ck)
+    secure_relu_eval(be0, be1, bundle, xs)  # warmup / compile
+    t0 = time.perf_counter()
+    secure_relu_eval(be0, be1, bundle, xs)
+    dt = time.perf_counter() - t0
+    # Two parties evaluated -> 2*K*M DCF evals.
+    _emit("secure_relu", "bitsliced-keylanes", "evals_per_sec",
+          2 * k * m / dt, "evals/s")
+
+
+BENCHES = {
+    "dcf": bench_dcf,
+    "dcf_batch_eval": bench_batch,
+    "dcf_large_lambda": bench_large_lambda,
+    "secure_relu": bench_secure_relu,
+}
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        prog="python -m dcf_tpu.cli",
+        description="DCF benchmark CLI (reference criterion-bench analogs)",
+    )
+    p.add_argument("bench", choices=(*BENCHES, "all"))
+    p.add_argument("--backend", default="cpu", choices=BACKENDS)
+    p.add_argument("--points", type=int, default=0,
+                   help="batch size (0 = bench default)")
+    p.add_argument("--keys", type=int, default=0,
+                   help="key count for secure_relu (0 = default)")
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--seed", type=int, default=2026)
+    p.add_argument("--check", action="store_true",
+                   help="verify parity vs the C++ core before timing")
+    args = p.parse_args(argv)
+    for name in BENCHES if args.bench == "all" else [args.bench]:
+        if args.bench == "all" and name == "dcf_large_lambda" and \
+                args.backend == "pallas":
+            log("skipping dcf_large_lambda (pallas is lam=16 only)")
+            continue
+        BENCHES[name](args)
+
+
+if __name__ == "__main__":
+    main()

@@ -78,7 +78,16 @@ class NativeDcf:
         if any(len(k) != 32 for k in cipher_keys):
             raise ValueError("all cipher keys must be 32 bytes (AES-256)")
         self.lam = lam
-        self.num_threads = num_threads or (os.cpu_count() or 1)
+        # Env overrides = the CI feature matrix (serial vs threaded eval,
+        # AES-NI vs portable cipher), mirroring the reference's with/without
+        # `multithread` cargo matrix.
+        env_threads = os.environ.get("DCF_NATIVE_THREADS", "")
+        self.num_threads = (
+            num_threads
+            or (int(env_threads) if env_threads.isdigit() else 0)
+            or (os.cpu_count() or 1)
+        )
+        portable = portable or os.environ.get("DCF_NATIVE_PORTABLE") == "1"
         self._lib = load(portable)
         self._prg = ctypes.create_string_buffer(self._lib.dcf_prg_sizeof())
         keys_arr = np.frombuffer(b"".join(cipher_keys), dtype=np.uint8).copy()

@@ -25,7 +25,7 @@ import numpy as np
 from dcf_tpu.ops.aes import expand_key_np
 from dcf_tpu.ops.sbox_circuit import sbox_planes_bp113 as sbox_planes
 from dcf_tpu.spec import SHIFT_ROWS
-from dcf_tpu.utils.bits import byte_bits_lsb, expand_bits_to_masks
+from dcf_tpu.utils.bits import bitmajor_perm, byte_bits_lsb, expand_bits_to_masks
 
 __all__ = [
     "round_key_masks",
@@ -115,8 +115,6 @@ def aes256_encrypt_planes(xp, rk_masks, planes, ones):
 
 def round_key_masks_bitmajor(key: bytes):
     """32-byte key -> int32 [15, 128, 1] bit-major plane masks (0 / -1)."""
-    from dcf_tpu.utils.bits import bitmajor_perm
-
     masks = round_key_masks(key)[:, bitmajor_perm(16)]  # [15, 128] uint32
     return masks.view(np.int32)[:, :, None].copy()
 

@@ -1,0 +1,36 @@
+"""Bench CLI: runs, emits valid JSON, parity gate passes."""
+
+import json
+
+import pytest
+
+
+def run_cli(capsys, argv):
+    from dcf_tpu import cli
+
+    cli.main(argv)
+    out = capsys.readouterr().out.strip().splitlines()
+    return [json.loads(line) for line in out]
+
+
+def test_cli_dcf_latency(capsys):
+    recs = run_cli(capsys, ["dcf", "--backend=cpu1", "--reps=1"])
+    assert [r["bench"] for r in recs] == ["dcf_gen", "dcf_eval_1pt"]
+    assert all(r["value"] > 0 for r in recs)
+
+
+def test_cli_batch_eval_numpy_with_check(capsys):
+    recs = run_cli(
+        capsys,
+        ["dcf_batch_eval", "--backend=numpy", "--points=64", "--reps=1",
+         "--check"],
+    )
+    assert recs[0]["metric"] == "evals_per_sec"
+    assert recs[0]["backend"] == "numpy"
+
+
+def test_cli_rejects_pallas_large_lambda():
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="lam=16"):
+        cli.main(["dcf_large_lambda", "--backend=pallas"])
