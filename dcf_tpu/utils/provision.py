@@ -1,0 +1,28 @@
+"""CPU-platform provisioning for multi-device runs without real chips.
+
+The multi-chip sharding path (dcf_tpu.parallel) is validated on N virtual
+XLA CPU devices — the TPU-native analog of the reference's thread-count-
+independent rayon parallelism (/root/reference/src/lib.rs:194-203).  Both
+tests/conftest.py and __graft_entry__.dryrun_multichip need the same env
+recipe, applied *before* the JAX backend initializes; keep it in one place.
+
+This module must stay importable without importing jax.
+"""
+
+from __future__ import annotations
+
+from typing import MutableMapping
+
+__all__ = ["force_cpu_devices"]
+
+_COUNT_FLAG = "xla_force_host_platform_device_count"
+
+
+def force_cpu_devices(env: MutableMapping[str, str], n_devices: int) -> None:
+    """Mutate ``env`` so a JAX process started with it sees ``n_devices``
+    virtual CPU devices (replacing any prior device-count flag)."""
+    flags = [f for f in env.get("XLA_FLAGS", "").split() if _COUNT_FLAG not in f]
+    flags.append(f"--{_COUNT_FLAG}={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_PLATFORM_NAME"] = "cpu"
