@@ -257,6 +257,13 @@ _eval_jit = partial(jax.jit, static_argnames=("b", "lam"))(_eval_bytes)
 _eval_keylanes_jit = partial(jax.jit, static_argnames=("b", "lam"))(
     _eval_keylanes_bytes
 )
+_stage_xs_jit = jax.jit(_xs_to_mask_dev)
+_planes_to_bytes_jit = partial(jax.jit, static_argnames=("lam",))(
+    _planes_to_bytes_dev
+)
+_eval_core_jit = partial(jax.jit, static_argnames=("b", "lam"))(
+    eval_core_bitsliced
+)
 
 
 class _BitslicedBase:
@@ -301,6 +308,40 @@ class BitslicedBackend(_BitslicedBase):
                 expand_bits_to_masks(byte_bits_lsb(bundle.cw_np1).T)
             ),
         )
+
+    def stage(self, xs: np.ndarray) -> dict:
+        """Ship xs to device as walk-order lane masks (criterion-setup analog).
+
+        Same protocol as ``PallasBackend.stage``: conversion + transfer happen
+        here, outside any timed region.
+        """
+        if self._bundle_dev is None:
+            raise ValueError("no key bundle on device; call put_bundle first")
+        k_num = self._bundle_dev["s0"].shape[1]
+        n = self._bundle_dev["cw_s"].shape[0]
+        shared, m = validate_xs(xs, k_num, n)
+        if m == 0:
+            raise ValueError("cannot stage an empty batch")
+        xs = pad_xs(xs, shared, m, (m + 31) // 32 * 32)
+        x_mask = _stage_xs_jit(jnp.asarray(np.ascontiguousarray(xs)))
+        return {"x_mask": x_mask, "m": m}
+
+    def eval_staged(self, b: int, staged: dict) -> jax.Array:
+        """Party ``b`` eval on staged points; returns DEVICE-resident y planes
+        (uint32 [8*lam, K, W]).  Dispatch is async — force completion with a
+        fetch.  Use ``eval`` for the bytes-in/bytes-out path."""
+        dev = self._bundle_dev
+        return _eval_core_jit(
+            self.rk_masks, self._last_bit_mask, dev["s0"], dev["cw_s"],
+            dev["cw_v"], dev["cw_tl"], dev["cw_tr"], dev["cw_np1"],
+            staged["x_mask"], b=int(b), lam=self.lam,
+        )
+
+    def staged_to_bytes(self, y_planes: jax.Array, m: int) -> np.ndarray:
+        """Convert ``eval_staged`` output to uint8 [K, M, lam] on host."""
+        return np.asarray(
+            _planes_to_bytes_jit(y_planes, lam=self.lam)
+        )[:, :m, :]
 
     def eval(
         self, b: int, xs: np.ndarray, bundle: KeyBundle | None = None

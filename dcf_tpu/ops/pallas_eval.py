@@ -5,9 +5,7 @@ the 8N-bit GGM walk materializes multi-MB plane intermediates between fused
 ops, so the chip streams ~TBs per batch.  This kernel keeps the ENTIRE
 walk — the bitsliced AES-256 Hirose PRG, correction-word application, and
 the left/right mux (reference semantics: /root/reference/src/lib.rs:163-204,
-/root/reference/src/prg.rs:42-73) — in VMEM: the (s, t, v) carry lives in
-VMEM scratch that persists across grid steps, so HBM traffic is only the
-per-level correction words + input-bit masks in and the output planes out.
+/root/reference/src/prg.rs:42-73) — in VMEM.
 
 Layouts (lam = 16 only — one AES block per seed, one Hirose cipher; larger
 lam falls back to the XLA path):
@@ -17,12 +15,13 @@ lam falls back to the XLA path):
              16-row sublane slices
     lanes    points packed 32-per-word; a grid step owns WT words
              (32*WT points)
-    grid     (K, W // WT, n): keys x point tiles x walk levels, levels
-             innermost.  Level i's correction words arrive as a [128, 1]
-             block (pipelined DMA — Mosaic forbids dynamic lane slicing,
-             so the grid does the indexing); (tl, tr) are 0/-1 SMEM scalars.
-             The carry resets at i == 0 and the output block (revisited
-             across levels, flushed once) is written at i == n-1.
+    grid     (K, W // WT): keys x point tiles.  The n-level walk runs as a
+             fori_loop INSIDE the kernel with the (s, t, v) carry live in
+             vregs/VMEM — one grid step per point tile, not per level, so
+             there is no per-level grid/DMA overhead (the per-level variant
+             measured ~44us/step of overhead vs ~9us of compute).  All n
+             correction words for the key ride in the step's VMEM block
+             (n=128: 2 x 64 KB) and are indexed dynamically by the loop.
 
 Everything is int32 (identical bit patterns to uint32 for XOR/AND/OR; SMEM
 scalars want int32).
@@ -41,70 +40,66 @@ from dcf_tpu.ops.aes_bitsliced import aes256_encrypt_planes_bitmajor
 
 __all__ = ["dcf_eval_pallas", "DEFAULT_TILE_WORDS"]
 
-DEFAULT_TILE_WORDS = 512  # 16384 points per grid step; ~6 MB VMEM live set
+# 4096 points per grid step.  128 is the Mosaic lane-granule minimum and
+# measured fastest on v5e (224 ms vs 311/339/354 ms for 256/512/1024 at 2^20
+# points): smaller tiles mean fewer vregs per gate op in the 113-gate S-box
+# chain, which schedules better, and a smaller VMEM live set.
+DEFAULT_TILE_WORDS = 128
 
 
 def _kernel(rk_ref, s0_ref, cw_s_ref, cw_v_ref, cw_np1_ref, cw_t_ref, xm_ref,
-            y_ref, s_scr, t_scr, v_scr, *, b: int, n: int):
-    i = pl.program_id(2)
+            y_ref, *, b: int, n: int):
     wt = xm_ref.shape[3]
     ones = jnp.int32(-1)
-
-    @pl.when(i == 0)
-    def _():
-        # (broadcast via ^0: jnp.broadcast_to doesn't lower in Mosaic)
-        s_scr[:] = s0_ref[0] ^ jnp.zeros((128, wt), jnp.int32)
-        t_scr[:] = jnp.full((1, wt), ones if b else jnp.int32(0), jnp.int32)
-        v_scr[:] = jnp.zeros((128, wt), jnp.int32)
-
-    s = s_scr[:]
-    t = t_scr[:]
-    v = v_scr[:]
+    rk = rk_ref[:]
 
     # PRG mask: output bit 8*lam-1 is cleared (reference src/prg.rs:65-68);
     # for lam=16 that is byte 15 bit 0 -> bit-major plane 15.
     plane_idx = jax.lax.broadcasted_iota(jnp.int32, (128, 1), 0)
     lbm = jnp.where(plane_idx == 15, jnp.int32(0), ones)
 
-    sp = s ^ ones
-    # One Hirose PRG call = AES-256 over (seed, seed^c) side by side.
-    enc = aes256_encrypt_planes_bitmajor(
-        jnp, rk_ref[:], jnp.concatenate([s, sp], axis=1), ones
-    )
-    sl_raw = enc[:, :wt] ^ s   # left child seed planes (pre-mask)
-    vl_raw = enc[:, wt:] ^ sp  # left child value planes (pre-mask)
-    # t bits come from the pre-mask planes (src/prg.rs:63-64); the right
-    # half is the never-encrypted Miyaguchi copy: s_r = seed, v_r = seed^c.
-    t_l = sl_raw[0:1, :]
-    t_r = vl_raw[0:1, :]
-    s_l = sl_raw & lbm
-    v_l = vl_raw & lbm
-    s_r = s & lbm
-    v_r = sp & lbm
+    # (broadcast via ^0: jnp.broadcast_to doesn't lower in Mosaic)
+    s0 = s0_ref[0] ^ jnp.zeros((128, wt), jnp.int32)
+    t0 = jnp.full((1, wt), ones if b else jnp.int32(0), jnp.int32)
+    v0 = jnp.zeros((128, wt), jnp.int32)
 
-    cs = cw_s_ref[0, 0]  # [128, 1]
-    cv = cw_v_ref[0, 0]
-    ctl = cw_t_ref[0, i, 0]
-    ctr = cw_t_ref[0, i, 1]
-    gate = t  # [1, wt], broadcasts over planes
-    s_l = s_l ^ (cs & gate)
-    s_r = s_r ^ (cs & gate)
-    t_l = t_l ^ (t & ctl)
-    t_r = t_r ^ (t & ctr)
+    def level(i, carry):
+        s, t, v = carry
+        sp = s ^ ones
+        # One Hirose PRG call = AES-256 over (seed, seed^c) side by side.
+        enc = aes256_encrypt_planes_bitmajor(
+            jnp, rk, jnp.concatenate([s, sp], axis=1), ones
+        )
+        sl_raw = enc[:, :wt] ^ s   # left child seed planes (pre-mask)
+        vl_raw = enc[:, wt:] ^ sp  # left child value planes (pre-mask)
+        # t bits come from the pre-mask planes (src/prg.rs:63-64); the right
+        # half is the never-encrypted Miyaguchi copy: s_r = seed, v_r = seed^c.
+        t_l = sl_raw[0:1, :]
+        t_r = vl_raw[0:1, :]
+        s_l = sl_raw & lbm
+        v_l = vl_raw & lbm
+        s_r = s & lbm
+        v_r = sp & lbm
 
-    xm = xm_ref[0, 0]  # [1, wt] input-bit lane masks for this level
-    nxm = xm ^ ones
-    v = v ^ (v_r & xm) ^ (v_l & nxm) ^ (cv & gate)
-    s = (s_r & xm) | (s_l & nxm)
-    t = (t_r & xm) | (t_l & nxm)
+        cs = cw_s_ref[0, i]  # [128, 1]
+        cv = cw_v_ref[0, i]
+        ctl = cw_t_ref[0, i, 0]
+        ctr = cw_t_ref[0, i, 1]
+        gate = t  # [1, wt], broadcasts over planes
+        s_l = s_l ^ (cs & gate)
+        s_r = s_r ^ (cs & gate)
+        t_l = t_l ^ (t & ctl)
+        t_r = t_r ^ (t & ctr)
 
-    s_scr[:] = s
-    t_scr[:] = t
-    v_scr[:] = v
+        xm = xm_ref[0, i]  # [1, wt] input-bit lane masks for this level
+        nxm = xm ^ ones
+        v = v ^ (v_r & xm) ^ (v_l & nxm) ^ (cv & gate)
+        s = (s_r & xm) | (s_l & nxm)
+        t = (t_r & xm) | (t_l & nxm)
+        return (s, t, v)
 
-    @pl.when(i == n - 1)
-    def _():
-        y_ref[0] = v ^ s ^ (cw_np1_ref[0] & t)
+    s, t, v = jax.lax.fori_loop(0, n, level, (s0, t0, v0))
+    y_ref[0] = v ^ s ^ (cw_np1_ref[0] & t)
 
 
 def dcf_eval_pallas(
@@ -129,28 +124,23 @@ def dcf_eval_pallas(
         raise ValueError(f"point words {w} not a multiple of tile {wt}")
     shared = kx == 1
 
-    grid = (k_num, w // wt, n)
+    grid = (k_num, w // wt)
     return pl.pallas_call(
         partial(_kernel, b=b, n=n),
         out_shape=jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((15, 128, 1), lambda k, j, i: (0, 0, 0)),
-            pl.BlockSpec((1, 128, 1), lambda k, j, i: (k, 0, 0)),
-            pl.BlockSpec((1, 1, 128, 1), lambda k, j, i: (k, i, 0, 0)),
-            pl.BlockSpec((1, 1, 128, 1), lambda k, j, i: (k, i, 0, 0)),
-            pl.BlockSpec((1, 128, 1), lambda k, j, i: (k, 0, 0)),
-            pl.BlockSpec((1, n, 2), lambda k, j, i: (k, 0, 0),
+            pl.BlockSpec((15, 128, 1), lambda k, j: (0, 0, 0)),
+            pl.BlockSpec((1, 128, 1), lambda k, j: (k, 0, 0)),
+            pl.BlockSpec((1, n, 128, 1), lambda k, j: (k, 0, 0, 0)),
+            pl.BlockSpec((1, n, 128, 1), lambda k, j: (k, 0, 0, 0)),
+            pl.BlockSpec((1, 128, 1), lambda k, j: (k, 0, 0)),
+            pl.BlockSpec((1, n, 2), lambda k, j: (k, 0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, 1, wt),
-                         (lambda k, j, i: (0, i, 0, j)) if shared
-                         else (lambda k, j, i: (k, i, 0, j))),
+            pl.BlockSpec((1, n, 1, wt),
+                         (lambda k, j: (0, 0, 0, j)) if shared
+                         else (lambda k, j: (k, 0, 0, j))),
         ],
-        out_specs=pl.BlockSpec((1, 128, wt), lambda k, j, i: (k, 0, j)),
-        scratch_shapes=[
-            pltpu.VMEM((128, wt), jnp.int32),
-            pltpu.VMEM((1, wt), jnp.int32),
-            pltpu.VMEM((128, wt), jnp.int32),
-        ],
+        out_specs=pl.BlockSpec((1, 128, wt), lambda k, j: (k, 0, j)),
         interpret=interpret,
     )(rk, s0_t, cw_s_t, cw_v_t, cw_np1_t, cw_t, x_mask)
