@@ -1,0 +1,171 @@
+"""VPU microbenchmarks: what is the achievable i32 gate-op rate on this chip?
+
+Anchors the roofline for the DCF walk kernel (ops/pallas_eval.py).  The walk
+is pure VPU work — XOR/AND planes, no MXU, no HBM pressure — so its ceiling
+is the rate at which Mosaic-compiled elementwise i32 ops retire.  Probes,
+all single-grid-step Pallas kernels looping in VMEM:
+
+  chain[k]   k independent add/and/xor dependency chains on [16, L] tiles:
+             measures issue throughput vs latency (ILP sweep).
+  sbox       the Boyar-Peralta 113-gate S-box applied back-to-back:
+             the walk spends ~2/3 of its ops here.
+  aes        full bitsliced AES-256 (14 rounds: sbox + shift + mix + ark):
+             everything but the DCF-level logic.
+
+Timing notes: on the tunneled dev device, ``block_until_ready`` does not
+block, so completion is forced by fetching a small digest (same trick as
+bench.py).  Each probe is timed at two loop counts and the rate is taken
+from the SLOPE, cancelling the fixed ~85ms dispatch+sync round-trip.
+
+Usage: python -m benchmarks.micro_vpu [--lanes 256] [--iters N]
+Prints one JSON line per probe: {probe, word_ops, seconds, tera_ops}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from dcf_tpu.ops.aes_bitsliced import (
+    aes256_encrypt_planes_bitmajor,
+    aes256_encrypt_planes_bitmajor_v2,
+    round_key_masks_bitmajor,
+)
+from dcf_tpu.ops.sbox_circuit import sbox_planes_bp113
+
+
+def _chain_kernel(x_ref, y_ref, *, iters: int, k: int):
+    c = x_ref[0]
+    r = x_ref[1 % x_ref.shape[0]]
+    states = tuple(x_ref[i % x_ref.shape[0]] ^ jnp.int32(i) for i in range(k))
+
+    def body(i, ss):
+        # 3 dependent ops per chain (add, and, xor); chains independent.
+        # Non-idempotent (the add) so the compiler cannot collapse the loop.
+        return tuple((s + c) ^ (s & r) for s in ss)
+
+    out = jax.lax.fori_loop(0, iters, body, states)
+    acc = out[0]
+    for s in out[1:]:
+        acc = acc ^ s
+    y_ref[:] = acc
+
+
+def _sbox_kernel(x_ref, y_ref, *, iters: int):
+    ones = jnp.int32(-1)
+    planes = tuple(x_ref[i] for i in range(8))
+
+    def body(i, ps):
+        return tuple(sbox_planes_bp113(list(ps), ones))
+
+    out = jax.lax.fori_loop(0, iters, body, planes)
+    acc = out[0]
+    for p in out[1:]:
+        acc = acc ^ p
+    y_ref[0] = acc
+
+
+def _aes_kernel(rk_ref, x_ref, y_ref, *, iters: int, enc):
+    ones = jnp.int32(-1)
+    rk = rk_ref[:]
+
+    def body(i, s):
+        return enc(jnp, rk, s, ones)
+
+    y_ref[:] = jax.lax.fori_loop(0, iters, body, x_ref[:])
+
+
+def _sync(y) -> None:
+    np.asarray(jnp.max(y.reshape(-1)[-8:]))
+
+
+def _time_one(fn_builder, args, out_shape, iters: int, reps: int = 3) -> float:
+    f = jax.jit(lambda *a: pl.pallas_call(
+        fn_builder(iters), out_shape=out_shape)(*a))
+    _sync(f(*args))  # compile + warmup
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(f(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _slope(fn_builder, args, out_shape, iters: int):
+    """Seconds per `iters` loop iterations, fixed overhead cancelled."""
+    t1 = _time_one(fn_builder, args, out_shape, iters)
+    t2 = _time_one(fn_builder, args, out_shape, 2 * iters)
+    return max(t2 - t1, 1e-9), t1
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lanes", type=int, default=256,
+                    help="lane width L of the [16, L] tiles (walk uses 2*wt)")
+    ap.add_argument("--iters", type=int, default=40000)
+    args = ap.parse_args()
+    lanes, iters = args.lanes, args.iters
+    rng = np.random.default_rng(0)
+
+    tile_words = 16 * lanes
+
+    for k in (1, 2, 4, 8):
+        x = jnp.asarray(
+            rng.integers(-(2**31), 2**31, (max(k, 2), 16, lanes), dtype=np.int64
+                         ).astype(np.int32))
+        sec, t1 = _slope(
+            lambda it: partial(_chain_kernel, iters=it, k=k), (x,),
+            jax.ShapeDtypeStruct((16, lanes), jnp.int32), iters)
+        word_ops = 3 * k * tile_words * iters
+        print(json.dumps({
+            "probe": f"chain[{k}]", "word_ops": word_ops, "seconds": sec,
+            "tera_ops": round(word_ops / sec / 1e12, 3),
+            "t_single": round(t1, 4)}))
+
+    x = jnp.asarray(
+        rng.integers(-(2**31), 2**31, (8, 16, lanes), dtype=np.int64
+                     ).astype(np.int32))
+    sbox_iters = max(1, iters // 8)
+    sec, t1 = _slope(lambda it: partial(_sbox_kernel, iters=it), (x,),
+                     jax.ShapeDtypeStruct((1, 16, lanes), jnp.int32),
+                     sbox_iters)
+    word_ops = 113 * tile_words * sbox_iters
+    print(json.dumps({
+        "probe": "sbox", "word_ops": word_ops, "seconds": sec,
+        "tera_ops": round(word_ops / sec / 1e12, 3),
+        "t_single": round(t1, 4)}))
+
+    rk = jnp.asarray(round_key_masks_bitmajor(bytes(range(32))))
+    st = jnp.asarray(
+        rng.integers(-(2**31), 2**31, (128, lanes), dtype=np.int64
+                     ).astype(np.int32))
+    aes_iters = max(1, iters // 100)
+    # Gate-op accounting per encryption (see ROOFLINE.md): 14 sbox layers,
+    # 13 mix layers (4-term xor tree over 128 planes + 2 xtime tap sets),
+    # 15 ARK xors over 128 planes.  tile_words = 16*lanes; 128 planes = 8*tw.
+    sbox_ops = 14 * 113 * tile_words
+    ark_ops = 15 * 8 * tile_words
+    mix_ops = 13 * (4 * 8 + 6) * tile_words
+    word_ops = (sbox_ops + ark_ops + mix_ops) * aes_iters
+    for name, enc in (("aes256", aes256_encrypt_planes_bitmajor),
+                      ("aes256_v2", aes256_encrypt_planes_bitmajor_v2)):
+        sec, t1 = _slope(
+            lambda it: partial(_aes_kernel, iters=it, enc=enc), (rk, st),
+            jax.ShapeDtypeStruct((128, lanes), jnp.int32), aes_iters)
+        print(json.dumps({
+            "probe": name, "word_ops": word_ops, "seconds": sec,
+            "tera_ops": round(word_ops / sec / 1e12, 3),
+            "t_single": round(t1, 4),
+            "ns_per_32B_block": round(
+                sec / aes_iters / (lanes * 32 / 16) * 1e9, 3)}))
+
+
+if __name__ == "__main__":
+    main()

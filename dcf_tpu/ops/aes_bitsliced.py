@@ -159,3 +159,101 @@ def aes256_encrypt_planes_bitmajor(xp, rk_all, state, ones):
     for rnd in range(1, 14):
         s = mix(shift(sub(s))).reshape(128, l) ^ rk_all[rnd]
     return shift(sub(s)).reshape(128, l) ^ rk_all[14]
+
+
+# ---------------------------------------------------------------------------
+# Block-permutation variant of the bit-major cipher (the fast kernel path).
+#
+# ShiftRows∘MixColumns is re-expressed per bit-block as a 4-term XOR of
+# statically byte-permuted [16, L] blocks.  With state byte index 4c + r
+# (column-major AES state) and the MDS circulant {02,03,01,01} indexed by
+# row distance d = r' - r:
+#
+#     out(c, r) = Σ_d m_d ⊗ sb((c + r + d) % 4, (r + d) % 4)
+#
+# so each distance d contributes ONE fixed byte permutation P_d applied to a
+# whole bit-block (m_0 = xtime, m_1 = xtime ⊕ 1, m_2 = m_3 = 1):
+#
+#     out[b] = P0(xt[b]) ^ P1(xt[b] ^ sb[b]) ^ P2(sb[b]) ^ P3(sb[b])
+#
+# Everything stays in [16, L] tiles (full 8-sublane vregs) — no [4, ...]
+# intermediates, no cross-bit stacks — which is why this lowers ~4x faster
+# under Mosaic than the reshape/concat formulation above.  Semantics are
+# identical (tested against the v1 path and the numpy oracle).
+# ---------------------------------------------------------------------------
+
+
+def _mcsr_perms() -> tuple[np.ndarray, np.ndarray]:
+    perms = np.empty((4, 16), dtype=np.int32)
+    for d in range(4):
+        for c in range(4):
+            for r in range(4):
+                perms[d, 4 * c + r] = 4 * ((c + r + d) % 4) + (r + d) % 4
+    sr = np.array(
+        [4 * ((c + r) % 4) + r for c in range(4) for r in range(4)],
+        dtype=np.int32,
+    )
+    return perms, sr
+
+
+_MCSR_PERMS, _SR_PERM = _mcsr_perms()
+
+
+def _xt_blocks(b):
+    """GF(2^8) xtime at block level: b is a list of 8 bit-blocks [16, L]."""
+    return [b[7], b[0] ^ b[7], b[1], b[2] ^ b[7], b[3] ^ b[7],
+            b[4], b[5], b[6]]
+
+
+def _perm_rows(xp, x, perm):
+    """Static row permutation of x [16, L] (Pallas-safe: no index arrays).
+
+    Emitted as a concat of maximal contiguous source slices so Mosaic sees
+    plain static slicing instead of a gather with captured constants.
+    """
+    if xp is np:
+        return x[perm]
+    parts = []
+    i = 0
+    while i < len(perm):
+        j = i
+        while j + 1 < len(perm) and perm[j + 1] == perm[j] + 1:
+            j += 1
+        parts.append(x[perm[i]:perm[j] + 1])
+        i = j + 1
+    return xp.concatenate(parts, axis=0)
+
+
+def aes256_encrypt_blocks_bitmajor(xp, rk_all, blocks, ones):
+    """Encrypt in bit-major block-list representation.
+
+    rk_all: [15, 128, 1] plane masks (round_key_masks_bitmajor).  blocks:
+    list of 8 arrays [16, L] (block i = bit-i planes of all 16 bytes).
+    Returns a list of 8 [16, L] blocks.  xp is numpy or jnp.
+    """
+    rk = rk_all.reshape(15, 8, 16, 1)
+    p0, p1, p2, p3 = (list(_MCSR_PERMS[d]) for d in range(4))
+    b = [blocks[i] ^ rk[0, i] for i in range(8)]
+    for rnd in range(1, 14):
+        sb = sbox_planes([b[i] for i in range(8)], ones)
+        xb = _xt_blocks(sb)
+        b = [
+            _perm_rows(xp, xb[i], p0)
+            ^ _perm_rows(xp, xb[i] ^ sb[i], p1)
+            ^ _perm_rows(xp, sb[i], p2)
+            ^ _perm_rows(xp, sb[i], p3)
+            ^ rk[rnd, i]
+            for i in range(8)
+        ]
+    sb = sbox_planes([b[i] for i in range(8)], ones)
+    return [_perm_rows(xp, sb[i], list(_SR_PERM)) ^ rk[14, i]
+            for i in range(8)]
+
+
+def aes256_encrypt_planes_bitmajor_v2(xp, rk_all, state, ones):
+    """Drop-in for ``aes256_encrypt_planes_bitmajor`` via the block path."""
+    l = state.shape[-1]
+    s3 = state.reshape(8, 16, l)
+    out = aes256_encrypt_blocks_bitmajor(
+        xp, rk_all, [s3[i] for i in range(8)], ones)
+    return xp.stack(out).reshape(128, l)

@@ -36,7 +36,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from dcf_tpu.ops.aes_bitsliced import aes256_encrypt_planes_bitmajor
+from dcf_tpu.ops.aes_bitsliced import (
+    aes256_encrypt_planes_bitmajor,
+    aes256_encrypt_planes_bitmajor_v2,
+)
 
 __all__ = ["dcf_eval_pallas", "DEFAULT_TILE_WORDS"]
 
@@ -48,7 +51,13 @@ DEFAULT_TILE_WORDS = 128
 
 
 def _kernel(rk_ref, s0_ref, cw_s_ref, cw_v_ref, cw_np1_ref, cw_t_ref, xm_ref,
-            y_ref, *, b: int, n: int):
+            y_ref, *, b: int, n: int, interpret: bool):
+    # The block-permutation cipher (v2) lowers ~2x faster under Mosaic but
+    # its unrolled slice-concat graph makes the CPU interpreter crawl; the
+    # two are bit-identical (tests/test_bitsliced.py), so interpret mode
+    # keeps the compact v1 graph.
+    aes = (aes256_encrypt_planes_bitmajor if interpret
+           else aes256_encrypt_planes_bitmajor_v2)
     wt = xm_ref.shape[3]
     ones = jnp.int32(-1)
     rk = rk_ref[:]
@@ -67,9 +76,7 @@ def _kernel(rk_ref, s0_ref, cw_s_ref, cw_v_ref, cw_np1_ref, cw_t_ref, xm_ref,
         s, t, v = carry
         sp = s ^ ones
         # One Hirose PRG call = AES-256 over (seed, seed^c) side by side.
-        enc = aes256_encrypt_planes_bitmajor(
-            jnp, rk, jnp.concatenate([s, sp], axis=1), ones
-        )
+        enc = aes(jnp, rk, jnp.concatenate([s, sp], axis=1), ones)
         sl_raw = enc[:, :wt] ^ s   # left child seed planes (pre-mask)
         vl_raw = enc[:, wt:] ^ sp  # left child value planes (pre-mask)
         # t bits come from the pre-mask planes (src/prg.rs:63-64); the right
@@ -126,7 +133,7 @@ def dcf_eval_pallas(
 
     grid = (k_num, w // wt)
     return pl.pallas_call(
-        partial(_kernel, b=b, n=n),
+        partial(_kernel, b=b, n=n, interpret=interpret),
         out_shape=jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
         grid=grid,
         in_specs=[
