@@ -32,6 +32,12 @@ __all__ = [
     "round_key_masks_bitmajor",
     "aes256_encrypt_planes",
     "aes256_encrypt_planes_bitmajor",
+    "aes256_encrypt_planes_bitmajor_v2",
+    "aes256_encrypt_planes_bitmajor_v3",
+    "aes256_encrypt_blocks_bitmajor",
+    "aes256_encrypt_blocks_bitmajor_v3",
+    "prep_rk_bitmajor_v3",
+    "aes_walk_cipher_v3",
 ]
 
 
@@ -256,4 +262,99 @@ def aes256_encrypt_planes_bitmajor_v2(xp, rk_all, state, ones):
     s3 = state.reshape(8, 16, l)
     out = aes256_encrypt_blocks_bitmajor(
         xp, rk_all, [s3[i] for i in range(8)], ones)
+    return xp.stack(out).reshape(128, l)
+
+
+# ---------------------------------------------------------------------------
+# Conjugated-ShiftRows variant (v3): the round permutations of v2 are
+# generic 16-row gathers (16 slice parts each under Mosaic).  Conjugating
+# the round state by powers of ShiftRows turns them into near-rolls:
+#
+#   keep state_k in P_SR^{-k} byte order; then the d-term permutation
+#   P_SR^{k}∘P_d∘P_SR^{-(k+1)} maps (c, r) <- (c + (k+1)d, r + d) — a 2D
+#   cyclic roll with at most 8 contiguous runs, and the d=0 term is the
+#   IDENTITY.  Per round: 3 cheap rolls instead of 4 generic gathers
+#   (24 slice parts vs 64); one generic realign restores true byte order in
+#   the final (mix-less) round.  Round keys are pre-permuted into each
+#   round's conjugated order once per call (hoist `prep_rk_bitmajor_v3`
+#   outside any inner loop).  Bit-identical to v1/v2 (tests).
+# ---------------------------------------------------------------------------
+
+
+def _conjugated_perms():
+    sr = np.asarray(_SR_PERM)
+    sr_inv = np.argsort(sr)
+    qs = [np.arange(16)]  # q_k = index array of P_SR^{-k}
+    for _ in range(14):
+        qs.append(sr_inv[qs[-1]])
+    term_perms = []  # per round 1..13: [e_1, e_2, e_3] (e_0 is identity)
+    rk_orders = []   # per round 1..13: row order of that round's key mask
+    for rnd in range(1, 14):
+        q, qp = qs[rnd - 1], qs[rnd]
+        qinv = np.argsort(q)
+        es = [qinv[_MCSR_PERMS[d][qp]] for d in range(4)]
+        assert np.array_equal(es[0], np.arange(16))
+        term_perms.append([list(e) for e in es[1:]])
+        rk_orders.append(list(qp))
+    final_perm = list(np.argsort(qs[13])[sr])  # realign to true byte order
+    return term_perms, rk_orders, final_perm
+
+
+_V3_TERM_PERMS, _V3_RK_ORDERS, _V3_FINAL_PERM = _conjugated_perms()
+
+
+def prep_rk_bitmajor_v3(xp, rk_all):
+    """[15, 128, 1] round-key masks -> v3 conjugated-order masks.
+
+    One-time cost; hoist outside the per-level loop in kernels."""
+    rk = rk_all.reshape(15, 8, 16, 1)
+    out = [rk[0]]
+    for rnd in range(1, 14):
+        order = _V3_RK_ORDERS[rnd - 1]
+        out.append(xp.stack([_perm_rows(xp, rk[rnd, i], order)
+                             for i in range(8)]))
+    out.append(rk[14])
+    return xp.stack(out)
+
+
+def aes256_encrypt_blocks_bitmajor_v3(xp, rk_prepped, blocks, ones):
+    """v3 cipher over bit-block lists; rk_prepped from prep_rk_bitmajor_v3.
+
+    blocks: list of 8 [16, L] arrays in TRUE byte order; returns the same
+    (the conjugated order is internal only).
+    """
+    rk = rk_prepped
+    b = [blocks[i] ^ rk[0, i] for i in range(8)]
+    for rnd in range(1, 14):
+        e1, e2, e3 = _V3_TERM_PERMS[rnd - 1]
+        sb = sbox_planes([b[i] for i in range(8)], ones)
+        xb = _xt_blocks(sb)
+        b = [
+            xb[i]
+            ^ _perm_rows(xp, xb[i] ^ sb[i], e1)
+            ^ _perm_rows(xp, sb[i], e2)
+            ^ _perm_rows(xp, sb[i], e3)
+            ^ rk[rnd, i]
+            for i in range(8)
+        ]
+    sb = sbox_planes([b[i] for i in range(8)], ones)
+    return [_perm_rows(xp, sb[i], _V3_FINAL_PERM) ^ rk[14, i]
+            for i in range(8)]
+
+
+def aes256_encrypt_planes_bitmajor_v3(xp, rk_all, state, ones):
+    """Drop-in for ``aes256_encrypt_planes_bitmajor`` via the v3 path."""
+    return aes_walk_cipher_v3(xp, prep_rk_bitmajor_v3(xp, rk_all),
+                              state, ones)
+
+
+def aes_walk_cipher_v3(xp, rk_prepped, state, ones):
+    """The exact cipher body the walk kernel runs: prepped round keys in,
+    [128, L] planes in/out.  Kept as a standalone function so the CPU test
+    suite can exercise the kernel's cipher glue (reshape/blocks/stack)
+    without Mosaic (tests/test_bitsliced.py)."""
+    l = state.shape[-1]
+    s3 = state.reshape(8, 16, l)
+    out = aes256_encrypt_blocks_bitmajor_v3(
+        xp, rk_prepped, [s3[i] for i in range(8)], ones)
     return xp.stack(out).reshape(128, l)

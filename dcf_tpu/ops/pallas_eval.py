@@ -38,29 +38,37 @@ from jax.experimental.pallas import tpu as pltpu
 
 from dcf_tpu.ops.aes_bitsliced import (
     aes256_encrypt_planes_bitmajor,
-    aes256_encrypt_planes_bitmajor_v2,
+    aes_walk_cipher_v3,
+    prep_rk_bitmajor_v3,
 )
 
 __all__ = ["dcf_eval_pallas", "DEFAULT_TILE_WORDS"]
 
 # 4096 points per grid step.  128 is the Mosaic lane-granule minimum and
-# measured fastest on v5e (224 ms vs 311/339/354 ms for 256/512/1024 at 2^20
-# points): smaller tiles mean fewer vregs per gate op in the 113-gate S-box
-# chain, which schedules better, and a smaller VMEM live set.
+# measured fastest on v5e with the v3 cipher (124 ms vs 195/215 ms for
+# 256/512 at 2^20 points): smaller tiles mean fewer vregs per gate op in the
+# 113-gate S-box chain, which schedules better, and a smaller VMEM live set.
+# See benchmarks/ROOFLINE.md for the full attribution.
 DEFAULT_TILE_WORDS = 128
 
 
 def _kernel(rk_ref, s0_ref, cw_s_ref, cw_v_ref, cw_np1_ref, cw_t_ref, xm_ref,
             y_ref, *, b: int, n: int, interpret: bool):
-    # The block-permutation cipher (v2) lowers ~2x faster under Mosaic but
-    # its unrolled slice-concat graph makes the CPU interpreter crawl; the
-    # two are bit-identical (tests/test_bitsliced.py), so interpret mode
+    # The conjugated-ShiftRows cipher (v3) lowers ~2.5x faster under Mosaic
+    # but its unrolled slice-concat graph makes the CPU interpreter crawl;
+    # the two are bit-identical (tests/test_bitsliced.py), so interpret mode
     # keeps the compact v1 graph.
-    aes = (aes256_encrypt_planes_bitmajor if interpret
-           else aes256_encrypt_planes_bitmajor_v2)
     wt = xm_ref.shape[3]
     ones = jnp.int32(-1)
     rk = rk_ref[:]
+    if interpret:
+        def aes(state):
+            return aes256_encrypt_planes_bitmajor(jnp, rk, state, ones)
+    else:
+        rk_p = prep_rk_bitmajor_v3(jnp, rk)  # hoisted: once per grid step
+
+        def aes(state):
+            return aes_walk_cipher_v3(jnp, rk_p, state, ones)
 
     # PRG mask: output bit 8*lam-1 is cleared (reference src/prg.rs:65-68);
     # for lam=16 that is byte 15 bit 0 -> bit-major plane 15.
@@ -76,7 +84,7 @@ def _kernel(rk_ref, s0_ref, cw_s_ref, cw_v_ref, cw_np1_ref, cw_t_ref, xm_ref,
         s, t, v = carry
         sp = s ^ ones
         # One Hirose PRG call = AES-256 over (seed, seed^c) side by side.
-        enc = aes(jnp, rk, jnp.concatenate([s, sp], axis=1), ones)
+        enc = aes(jnp.concatenate([s, sp], axis=1))
         sl_raw = enc[:, :wt] ^ s   # left child seed planes (pre-mask)
         vl_raw = enc[:, wt:] ^ sp  # left child value planes (pre-mask)
         # t bits come from the pre-mask planes (src/prg.rs:63-64); the right

@@ -77,33 +77,33 @@ def test_bitsliced_aes_matches_table():
     assert np.array_equal(got, want)
 
 
+@pytest.mark.parametrize("variant", ["v2", "v3"])
 @pytest.mark.parametrize("use_jnp", [False, True])
-def test_block_permutation_aes_matches_v1(use_jnp):
-    """The Mosaic-fast block-permutation cipher (v2) is bit-identical to the
-    reshape/concat formulation the interpreter tests run (v1).  Covers both
-    _perm_rows branches: numpy fancy indexing and the jnp slice-concat
-    decomposition the compiled kernel actually uses."""
-    from dcf_tpu.ops.aes_bitsliced import (
-        aes256_encrypt_planes_bitmajor,
-        aes256_encrypt_planes_bitmajor_v2,
-        round_key_masks_bitmajor,
-    )
+def test_permutation_aes_variants_match_v1(variant, use_jnp):
+    """The Mosaic-fast cipher variants (v2 block-permutation, v3
+    conjugated-ShiftRows) are bit-identical to the reshape/concat
+    formulation the interpreter tests run (v1).  Covers both _perm_rows
+    branches: numpy fancy indexing and the jnp slice-concat decomposition
+    the compiled kernel actually uses."""
+    from dcf_tpu.ops import aes_bitsliced as ab
 
+    enc = {"v2": ab.aes256_encrypt_planes_bitmajor_v2,
+           "v3": ab.aes256_encrypt_planes_bitmajor_v3}[variant]
     if use_jnp:
         import jax.numpy as jnp
     rng = np.random.default_rng(7)
     for trial in range(3):
-        rk = round_key_masks_bitmajor(rng.bytes(32))
+        rk = ab.round_key_masks_bitmajor(rng.bytes(32))
         state = rng.integers(
             -(2**31), 2**31, (128, 5 + trial), dtype=np.int64
         ).astype(np.int32)
-        v1 = aes256_encrypt_planes_bitmajor(np, rk, state, np.int32(-1))
+        v1 = ab.aes256_encrypt_planes_bitmajor(np, rk, state, np.int32(-1))
         if use_jnp:
-            v2 = np.asarray(aes256_encrypt_planes_bitmajor_v2(
+            got = np.asarray(enc(
                 jnp, jnp.asarray(rk), jnp.asarray(state), jnp.int32(-1)))
         else:
-            v2 = aes256_encrypt_planes_bitmajor_v2(np, rk, state, np.int32(-1))
-        assert np.array_equal(v1, v2)
+            got = enc(np, rk, state, np.int32(-1))
+        assert np.array_equal(v1, got)
 
 
 @pytest.mark.parametrize("bound", [spec.Bound.LT_BETA, spec.Bound.GT_BETA])
