@@ -216,6 +216,38 @@ def _xs_to_mask_dev(xs):
     return _pack_lanes_dev(bits)
 
 
+@partial(jax.jit, static_argnames=("m", "nb"))
+def _range_xs_dev(start, m: int, nb: int):
+    """Big-endian bytes of points start..start+m-1, generated ON DEVICE.
+
+    The full-domain workload (BASELINE config 3, n=24) never ships xs from
+    the host: one iota expands into the [1, m, nb] uint8 batch inside the
+    jitted program (SURVEY.md section 7 step 5).  uint32 arithmetic covers
+    the whole n_bits=32 domain without wraparound artifacts.
+    """
+    idx = start + jnp.arange(m, dtype=jnp.uint32)
+    shifts = jnp.asarray([8 * (nb - 1 - k) for k in range(nb)], jnp.uint32)
+    return ((idx[:, None] >> shifts) & 0xFF).astype(jnp.uint8)[None]
+
+
+def _lt_lane_mask_dev(start, alpha, w: int, gt: bool):
+    """uint32 [1, W] lane mask: bit set iff (start + lane index) <cmp> alpha.
+    Trace-time helper (w static at trace time); unsigned 32-bit compare."""
+    idx = start + jnp.arange(32 * w, dtype=jnp.uint32)
+    inside = (idx > alpha) if gt else (idx < alpha)
+    return _pack_lanes_dev(inside.astype(jnp.uint32)[None])
+
+
+@partial(jax.jit, static_argnames=("gt",))
+def _fd_mismatch_bytemajor(y0, y1, beta_mask, start, alpha, *, gt: bool):
+    """Mismatching-point count for byte-major planes [8lam, K, W] (K = 1)."""
+    w = y0.shape[-1]
+    ltw = _lt_lane_mask_dev(start, alpha, w, gt)  # [1, W]
+    expect = beta_mask[:, None, None] & ltw[None, :, :]
+    diff = jnp.bitwise_or.reduce(y0 ^ y1 ^ expect, axis=0)  # [K, W]
+    return jnp.sum(jax.lax.population_count(diff).astype(jnp.int32))
+
+
 def _planes_to_bytes_dev(planes, lam: int):
     """uint32 [8*lam, K, W] -> uint8 [K, W*32, lam]."""
     p, k, w = planes.shape
@@ -251,6 +283,11 @@ def _eval_keylanes_bytes(
         cw_np1_pl, x_mask, b, lam,
     )
     return _planes_to_bytes_dev(y_planes, lam)
+
+
+@partial(jax.jit, static_argnames=("m", "nb"))
+def _stage_range_mask_jit(start, m: int, nb: int):
+    return _xs_to_mask_dev(_range_xs_dev(start, m, nb))
 
 
 _eval_jit = partial(jax.jit, static_argnames=("b", "lam"))(_eval_bytes)
@@ -325,6 +362,32 @@ class BitslicedBackend(_BitslicedBase):
         xs = pad_xs(xs, shared, m, (m + 31) // 32 * 32)
         x_mask = _stage_xs_jit(jnp.asarray(np.ascontiguousarray(xs)))
         return {"x_mask": x_mask, "m": m}
+
+    def stage_range(self, start: int, count: int) -> dict:
+        """Stage the consecutive points start..start+count-1 WITHOUT any
+        host->device xs transfer: the batch is generated from an iota inside
+        the jitted program (full-domain workload, BASELINE config 3)."""
+        if self._bundle_dev is None:
+            raise ValueError("no key bundle on device; call put_bundle first")
+        if count % 32 != 0:
+            raise ValueError(f"count {count} must be a multiple of 32")
+        n = self._bundle_dev["cw_s"].shape[0]
+        x_mask = _stage_range_mask_jit(
+            jnp.uint32(start), m=count, nb=n // 8)
+        return {"x_mask": x_mask, "m": count}
+
+    def mismatch_count(self, y0, y1, alpha: int, beta: bytes, start: int,
+                       gt: bool = False) -> jax.Array:
+        """Device-side verification for full-domain runs: number of points in
+        this staged chunk whose XOR reconstruction differs from the plain
+        comparison function.  y0/y1: ``eval_staged`` outputs for the two
+        parties over points start..start+32*W-1 (single key).  Returns a
+        DEVICE int32 scalar so chunked callers can accumulate without a
+        host round-trip per chunk."""
+        beta_mask = jnp.asarray(expand_bits_to_masks(
+            byte_bits_lsb(np.frombuffer(beta, dtype=np.uint8))))
+        return _fd_mismatch_bytemajor(
+            y0, y1, beta_mask, jnp.uint32(start), jnp.uint32(alpha), gt=gt)
 
     def eval_staged(self, b: int, staged: dict) -> jax.Array:
         """Party ``b`` eval on staged points; returns DEVICE-resident y planes

@@ -17,7 +17,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from dcf_tpu.backends._common import pad_xs, validate_xs
-from dcf_tpu.backends.jax_bitsliced import _planes_to_bytes_dev, _xs_to_mask_dev
+from dcf_tpu.backends.jax_bitsliced import (
+    _lt_lane_mask_dev,
+    _planes_to_bytes_dev,
+    _range_xs_dev,
+    _xs_to_mask_dev,
+)
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes_bitsliced import round_key_masks_bitmajor
 from dcf_tpu.ops.pallas_eval import DEFAULT_TILE_WORDS, dcf_eval_pallas
@@ -45,6 +50,23 @@ def _eval_staged(rk, s0_t, cw_s_t, cw_v_t, cw_np1_t, cw_t, x_mask,
         rk, s0_t, cw_s_t, cw_v_t, cw_np1_t, cw_t, x_mask,
         b=b, tile_words=tile_words, interpret=interpret,
     )
+
+
+@partial(jax.jit, static_argnames=("m", "nb"))
+def _stage_range_jit(start, m: int, nb: int):
+    return _stage_xs(_range_xs_dev(start, m, nb))
+
+
+@partial(jax.jit, static_argnames=("gt",))
+def _fd_mismatch_bitmajor(y0, y1, beta_mask, start, alpha, *, gt: bool):
+    """Mismatching-point count for bit-major planes int32 [K, 128, W], K=1."""
+    w = y0.shape[-1]
+    ltw = jax.lax.bitcast_convert_type(
+        _lt_lane_mask_dev(start, alpha, w, gt), jnp.int32)  # [1, W]
+    expect = beta_mask[None, :, :] & ltw[:, None, :]
+    diff = jnp.bitwise_or.reduce(y0 ^ y1 ^ expect, axis=1)  # [K, W]
+    return jnp.sum(jax.lax.population_count(
+        jax.lax.bitcast_convert_type(diff, jnp.uint32)).astype(jnp.int32))
 
 
 @jax.jit
@@ -149,6 +171,35 @@ class PallasBackend:
         xs = pad_xs(xs, shared, m, 32 * w_pad)
         x_mask = _stage_xs(jnp.asarray(np.ascontiguousarray(xs)))
         return {"x_mask": x_mask, "m": m, "wt": wt}
+
+    def stage_range(self, start: int, count: int) -> dict:
+        """Stage the consecutive points start..start+count-1 WITHOUT any
+        host->device xs transfer: the batch is generated from an iota inside
+        the jitted program (full-domain workload, BASELINE config 3)."""
+        if self._bundle_dev is None:
+            raise ValueError("no key bundle on device; call put_bundle first")
+        n = self._bundle_dev["cw_s"].shape[1]
+        wt, w_pad = self._plan_tiles(count)
+        if 32 * w_pad != count:
+            raise ValueError(
+                f"count {count} must be a whole number of {32 * wt}-point "
+                "tiles for the range path")
+        x_mask = _stage_range_jit(jnp.uint32(start), m=count, nb=n // 8)
+        return {"x_mask": x_mask, "m": count, "wt": wt}
+
+    def mismatch_count(self, y0, y1, alpha: int, beta: bytes, start: int,
+                       gt: bool = False) -> jax.Array:
+        """Device-side verification for full-domain runs: number of points in
+        this staged chunk whose XOR reconstruction differs from the plain
+        comparison function.  y0/y1: ``eval_staged`` outputs for the two
+        parties over points start..start+32*W-1 (single key).  Returns a
+        DEVICE int32 scalar so chunked callers can accumulate without a
+        host round-trip per chunk."""
+        bits = byte_bits_lsb(np.frombuffer(beta, dtype=np.uint8))[_PERM]
+        beta_mask = jnp.asarray(
+            expand_bits_to_masks(bits).view(np.int32)[:, None])
+        return _fd_mismatch_bitmajor(
+            y0, y1, beta_mask, jnp.uint32(start), jnp.uint32(alpha), gt=gt)
 
     def eval_staged(self, b: int, staged: dict) -> jax.Array:
         """Party ``b`` eval on staged points; returns DEVICE-resident y planes

@@ -7,7 +7,12 @@ import numpy as np
 from dcf_tpu import spec
 from dcf_tpu.gen import gen_batch, random_s0s
 from dcf_tpu.ops.prg import HirosePrgNp
-from dcf_tpu.workloads import domain_points, full_domain_check, secure_relu_eval
+from dcf_tpu.workloads import (
+    domain_points,
+    full_domain_check,
+    full_domain_check_device,
+    secure_relu_eval,
+)
 
 
 def rand_bytes(rng, n):
@@ -48,6 +53,67 @@ def test_full_domain_check_bitsliced_n16():
         chunk=1 << 14,
     )
     assert mism == 0
+
+
+def test_full_domain_check_device_n16():
+    """Device-resident config 3: on-device iota points + on-device verify.
+
+    Also a negative control: a wrong alpha must be detected, proving the
+    device-side comparison actually compares."""
+    from dcf_tpu.backends.jax_bitsliced import BitslicedBackend
+
+    rng = random.Random(63)
+    ck = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    prg = HirosePrgNp(16, ck)
+    nprng = np.random.default_rng(7)
+    alpha = 0x2FA7
+    beta = rand_bytes(rng, 16)
+    bundle = gen_batch(
+        prg,
+        np.array([[0x2F, 0xA7]], dtype=np.uint8),
+        np.frombuffer(beta, dtype=np.uint8)[None],
+        random_s0s(1, 16, nprng),
+        spec.Bound.LT_BETA,
+    )
+    be0 = BitslicedBackend(16, ck)
+    be0.put_bundle(bundle.for_party(0))
+    be1 = BitslicedBackend(16, ck)
+    be1.put_bundle(bundle.for_party(1))
+    assert full_domain_check_device(
+        be0, be1, alpha, beta, n_bits=16, chunk=1 << 14) == 0
+    # wrong alpha: exactly |alpha' - alpha| points flip classification
+    assert full_domain_check_device(
+        be0, be1, alpha + 5, beta, n_bits=16, chunk=1 << 14) == 5
+
+
+def test_full_domain_check_device_pallas_interpret_n8():
+    """The bit-major (Pallas) variant of the device full-domain path —
+    stage_range tile planning, the _PERM-permuted beta mask, and the
+    int32/uint32 bitcasts in _fd_mismatch_bitmajor — via the interpreter."""
+    from dcf_tpu.backends.pallas_backend import PallasBackend
+
+    rng = random.Random(64)
+    ck = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    prg = HirosePrgNp(16, ck)
+    nprng = np.random.default_rng(8)
+    alpha = 0x6D
+    beta = rand_bytes(rng, 16)
+    bundle = gen_batch(
+        prg,
+        np.array([[0x6D]], dtype=np.uint8),
+        np.frombuffer(beta, dtype=np.uint8)[None],
+        random_s0s(1, 16, nprng),
+        spec.Bound.LT_BETA,
+    )
+    be0 = PallasBackend(16, ck, interpret=True)
+    be0.put_bundle(bundle.for_party(0))
+    be1 = PallasBackend(16, ck, interpret=True)
+    be1.put_bundle(bundle.for_party(1))
+    assert full_domain_check_device(
+        be0, be1, alpha, beta, n_bits=8, chunk=128) == 0
+    # negative control: a shifted alpha flips exactly that many points
+    assert full_domain_check_device(
+        be0, be1, alpha + 3, beta, n_bits=8, chunk=128) == 3
 
 
 def test_secure_relu_eval_streams_keys():
