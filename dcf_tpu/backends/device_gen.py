@@ -1,0 +1,179 @@
+"""Device-side batched key generation (keys-in-lanes layout).
+
+Keygen (reference ``src/lib.rs:86-161``) is sequential across the n = 8*N
+levels but embarrassingly parallel across keys, so at secure-ReLU scale
+(BASELINE config 5: 10^6 keys) it belongs ON the accelerator: the host
+ships only alphas + betas + starting seeds (~64 MB for 10^6 keys) and the
+~4.4 GB correction-word image is born directly in HBM, in exactly the
+packed keys-in-lanes form the keylanes evaluators consume — instead of
+being generated on one CPU core and dragged through the host->device link.
+
+Layout: keys packed 32-per-uint32-lane-word (Wk = K/32 words).  Seeds and
+values live as byte-major planes [8*lam, Wk] (plane p = byte*8 + bit, the
+``prg_planes`` convention); per-level outputs stack to [n, 8*lam, Wk].
+Correctness is pinned to the numpy ``gen_batch`` bit-for-bit
+(tests/test_device_gen.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcf_tpu.backends.jax_bitsliced import _pack_lanes_dev, prg_planes
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.ops.aes_bitsliced import round_key_masks
+from dcf_tpu.spec import Bound, hirose_used_cipher_indices
+from dcf_tpu.utils.bits import bits_lsb_to_bytes, unpack_lanes
+
+__all__ = ["DeviceKeyGen"]
+
+_ONES = jnp.uint32(0xFFFFFFFF)
+
+
+@partial(jax.jit, static_argnames=("n", "lam"))
+def _stage_inputs_dev(alphas, betas, s0s, n: int, lam: int):
+    """Raw uint8 inputs -> packed keys-in-lanes masks/planes.
+
+    alphas uint8 [K, n/8], betas uint8 [K, lam], s0s uint8 [K, 2, lam]
+    (K % 32 == 0).  Returns (alpha_mask [n, Wk], beta_pl [8lam, Wk],
+    s0a_pl, s0b_pl [8lam, Wk]) — all uint32.
+    """
+    k = alphas.shape[0]
+
+    def planes_lsb(a):  # uint8 [K, nbytes] -> planes [8*nbytes, Wk]
+        bits = (a[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
+        return _pack_lanes_dev(bits.reshape(k, -1).T)
+
+    # alpha walk bits are MSB-first (reference Msb0 view, src/lib.rs:106)
+    abits = (alphas[..., None] >> jnp.arange(7, -1, -1, dtype=jnp.uint8)) \
+        & jnp.uint8(1)
+    alpha_mask = _pack_lanes_dev(abits.reshape(k, n).T)
+    return (alpha_mask, planes_lsb(betas),
+            planes_lsb(s0s[:, 0, :]), planes_lsb(s0s[:, 1, :]))
+
+
+@partial(jax.jit, static_argnames=("lam", "lt_beta"))
+def _gen_core(rk_masks, last_bit_mask, alpha_mask, beta_pl, s0a_pl, s0b_pl,
+              lam: int, lt_beta: bool):
+    """The level scan.  Mirrors gen.gen_batch line for line, with per-key
+    uint8 selects replaced by lane-mask muxes.  Returns (cw_s [n, 8lam, Wk],
+    cw_v [n, 8lam, Wk], cw_tl [n, Wk], cw_tr [n, Wk], cw_np1 [8lam, Wk])."""
+
+    def mux(m, if_one, if_zero):
+        return (if_one & m) | (if_zero & (m ^ _ONES))
+
+    def body(carry, a_i):
+        s_a, s_b, t_a, t_b, v_alpha = carry
+        al, vl_a, tl_a, ar, vr_a, tr_a = prg_planes(
+            rk_masks, last_bit_mask, lam, s_a, _ONES)
+        bl, vl_b, tl_b, br, vr_b, tr_b = prg_planes(
+            rk_masks, last_bit_mask, lam, s_b, _ONES)
+        am = a_i[None, :]  # broadcast over planes
+        # lose side: L when alpha bit is 1, R when 0 (src/lib.rs:107-111)
+        s_cw = mux(am, al ^ bl, ar ^ br)
+        v_cw = mux(am, vl_a ^ vl_b, vr_a ^ vr_b) ^ v_alpha
+        # beta folds into v_cw when the lose side matches the bound
+        # (src/lib.rs:114-125)
+        beta_gate = am if lt_beta else (am ^ _ONES)
+        v_cw = v_cw ^ (beta_pl & beta_gate)
+        v_keep = mux(am, vr_a ^ vr_b, vl_a ^ vl_b)
+        v_alpha = v_alpha ^ v_keep ^ v_cw
+        tl_cw = tl_a ^ tl_b ^ a_i ^ _ONES
+        tr_cw = tr_a ^ tr_b ^ a_i
+        t_cw_keep = mux(a_i, tr_cw, tl_cw)
+        gate_a = t_a[None, :]
+        gate_b = t_b[None, :]
+        new_s_a = mux(am, ar, al) ^ (s_cw & gate_a)
+        new_s_b = mux(am, br, bl) ^ (s_cw & gate_b)
+        new_t_a = mux(a_i, tr_a, tl_a) ^ (t_a & t_cw_keep)
+        new_t_b = mux(a_i, tr_b, tl_b) ^ (t_b & t_cw_keep)
+        return ((new_s_a, new_s_b, new_t_a, new_t_b, v_alpha),
+                (s_cw, v_cw, tl_cw, tr_cw))
+
+    wk = alpha_mask.shape[1]
+    init = (
+        s0a_pl, s0b_pl,
+        jnp.zeros((wk,), jnp.uint32),   # t^(0)_0 = 0
+        jnp.full((wk,), _ONES),         # t^(0)_1 = 1
+        jnp.zeros((8 * lam, wk), jnp.uint32),
+    )
+    (s_a, s_b, _t_a, _t_b, v_alpha), (cw_s, cw_v, cw_tl, cw_tr) = \
+        jax.lax.scan(body, init, alpha_mask)
+    cw_np1 = s_a ^ s_b ^ v_alpha
+    return cw_s, cw_v, cw_tl, cw_tr, cw_np1
+
+
+class DeviceKeyGen:
+    """On-device batched GGM keygen producing keys-in-lanes device bundles.
+
+    The output dict matches ``KeyLanesBackend._bundle_dev`` (plus both
+    parties' seeds), so the generated image feeds the keylanes evaluators
+    without ever leaving HBM.  ``to_host_bundle`` downloads and unpacks to
+    a standard KeyBundle for interop/persistence.
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes]):
+        used = hirose_used_cipher_indices(lam, len(cipher_keys))
+        self.lam = lam
+        self.rk_masks = tuple(
+            jnp.asarray(round_key_masks(cipher_keys[i])) for i in used)
+        lbm = np.full(8 * lam, 0xFFFFFFFF, dtype=np.uint32)
+        lbm[(lam - 1) * 8] = 0
+        self._last_bit_mask = jnp.asarray(lbm)
+
+    def gen(self, alphas: np.ndarray, betas: np.ndarray, s0s: np.ndarray,
+            bound: Bound) -> dict:
+        """alphas uint8 [K, n_bytes], betas uint8 [K, lam], s0s uint8
+        [K, 2, lam].  Returns a device bundle dict: s0 (per party
+        [2][8lam, Wk]), cw_s/cw_v [n, 8lam, Wk], cw_tl/cw_tr [n, Wk],
+        cw_np1 [8lam, Wk], num_keys.  K is padded to a multiple of 32
+        internally (pad keys are generated and ignored)."""
+        k, n_bytes = alphas.shape
+        if betas.shape != (k, self.lam) or s0s.shape != (k, 2, self.lam):
+            raise ValueError("alphas/betas/s0s shape mismatch")
+        k_pad = (k + 31) // 32 * 32
+        if k_pad != k:
+            pad = [(0, k_pad - k)]
+            alphas = np.pad(alphas, pad + [(0, 0)])
+            betas = np.pad(betas, pad + [(0, 0)])
+            s0s = np.pad(s0s, pad + [(0, 0), (0, 0)])
+        n = 8 * n_bytes
+        alpha_mask, beta_pl, s0a_pl, s0b_pl = _stage_inputs_dev(
+            jnp.asarray(alphas), jnp.asarray(betas), jnp.asarray(s0s),
+            n=n, lam=self.lam)
+        cw_s, cw_v, cw_tl, cw_tr, cw_np1 = _gen_core(
+            self.rk_masks, self._last_bit_mask, alpha_mask, beta_pl,
+            s0a_pl, s0b_pl, lam=self.lam,
+            lt_beta=(bound is Bound.LT_BETA))
+        return dict(
+            s0=(s0a_pl, s0b_pl), cw_s=cw_s, cw_v=cw_v, cw_tl=cw_tl,
+            cw_tr=cw_tr, cw_np1=cw_np1, num_keys=k,
+        )
+
+    def to_host_bundle(self, dev: dict) -> KeyBundle:
+        """Download + unpack a device bundle to a standard KeyBundle."""
+        k = dev["num_keys"]
+
+        def unpack_planes(a):  # [..., 8lam, Wk] -> uint8 [K, ..., lam]
+            bits = unpack_lanes(np.asarray(a))  # [..., 8lam, K_pad]
+            return bits_lsb_to_bytes(np.moveaxis(bits, -1, 0)[:k])
+
+        def unpack_bits(a):  # [n, Wk] -> uint8 [K, n]
+            return np.moveaxis(unpack_lanes(np.asarray(a)), -1, 0)[:k]
+
+        s0a = unpack_planes(dev["s0"][0])
+        s0b = unpack_planes(dev["s0"][1])
+        return KeyBundle(
+            s0s=np.stack([s0a, s0b], axis=1),
+            cw_s=unpack_planes(dev["cw_s"]),
+            cw_v=unpack_planes(dev["cw_v"]),
+            cw_t=np.stack(
+                [unpack_bits(dev["cw_tl"]), unpack_bits(dev["cw_tr"])],
+                axis=2),
+            cw_np1=unpack_planes(dev["cw_np1"]),
+        )

@@ -1,0 +1,228 @@
+"""KeyLanesPallasBackend — many-keys DCF evaluator on the keylanes kernel.
+
+The config-5 (secure-ReLU) pipeline stays device-resident end to end:
+DeviceKeyGen writes the packed keys-in-lanes CW image straight into HBM,
+this backend walks it with the Pallas kernel (ops.pallas_keylanes), and
+``relu_mismatch_count`` verifies the two-party XOR reconstruction against
+the plain comparison on device — the host ships alphas/betas/seeds/xs and
+receives one mismatch counter.
+
+Unlike the one-party bundles of the other backends, a device bundle here
+carries BOTH parties' seeds (the CW image is shared between parties —
+reference src/lib.rs:269-272 — and at 4 GB it should exist once, not
+twice).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.ops.aes_bitsliced import round_key_masks_bitmajor
+from dcf_tpu.ops.pallas_keylanes import dcf_eval_keylanes_pallas
+from dcf_tpu.spec import hirose_used_cipher_indices
+from dcf_tpu.utils.bits import (
+    bitmajor_perm,
+    bits_lsb_to_bytes,
+    byte_bits_lsb,
+    pack_lanes,
+    unpack_lanes,
+)
+
+__all__ = ["KeyLanesPallasBackend"]
+
+_PERM = bitmajor_perm(16)
+_INV_PERM = np.argsort(_PERM)
+
+
+@jax.jit
+def _to_bitmajor_planes(a, perm):
+    """uint32 [..., 8lam, Wk] byte-major planes -> int32 bit-major."""
+    return jax.lax.bitcast_convert_type(
+        jnp.take(a, perm, axis=-2), jnp.int32)
+
+
+@jax.jit
+def _stage_xs_keylanes(xs):
+    """uint8 [M, nb] -> walk-order masks int32 [n, M, 1] (0 / -1)."""
+    m, nb = xs.shape
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = ((xs[..., None] >> shifts) & jnp.uint8(1)).reshape(m, nb * 8)
+    return (bits.T.astype(jnp.int32) * jnp.int32(-1))[:, :, None]
+
+
+@partial(jax.jit, static_argnames=("b", "m_tile", "kw_tile", "level_chunk",
+                                   "interpret"))
+def _eval_staged(rk, s0_t, cw_s_t, cw_v_t, cw_tl, cw_tr, cw_np1_t, x_mask,
+                 b: int, m_tile: int, kw_tile: int, level_chunk: int,
+                 interpret: bool):
+    return dcf_eval_keylanes_pallas(
+        rk, s0_t, cw_s_t, cw_v_t, cw_tl, cw_tr, cw_np1_t, x_mask, b=b,
+        m_tile=m_tile, kw_tile=kw_tile, level_chunk=level_chunk,
+        interpret=interpret)
+
+
+@jax.jit
+def _relu_mismatch(y0, y1, beta_t, alphas, xs):
+    """Mismatch count for [128, M, Kw] bit-major shares vs the plain
+    comparison: expected(k, m) = beta_k iff x_m < alpha_k else 0."""
+    m, nb = xs.shape
+    lt = jnp.zeros((m, alphas.shape[0]), jnp.bool_)
+    eq = jnp.ones((m, alphas.shape[0]), jnp.bool_)
+    for j in range(nb):  # lexicographic big-endian unsigned compare
+        xj = xs[:, j][:, None]
+        aj = alphas[None, :, j]
+        lt = lt | (eq & (xj < aj))
+        eq = eq & (xj == aj)
+    ltb = lt.astype(jnp.uint32).reshape(m, -1, 32)
+    ltw = jax.lax.bitcast_convert_type(
+        jnp.sum(ltb << jnp.arange(32, dtype=jnp.uint32), axis=-1,
+                dtype=jnp.uint32), jnp.int32)  # [M, Kw]
+    expect = beta_t[:, None, :] & ltw[None, :, :]
+    diff = jnp.bitwise_or.reduce(y0 ^ y1 ^ expect, axis=0)  # [M, Kw]
+    return jnp.sum(jax.lax.population_count(
+        jax.lax.bitcast_convert_type(diff, jnp.uint32)).astype(jnp.int32))
+
+
+class KeyLanesPallasBackend:
+    """Many-keys DCF evaluator (keys in lanes) on the Pallas walk kernel.
+
+    lam = 16 only (one AES block per seed).  Bundles carry both parties.
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes],
+                 m_tile: int = 8, kw_tile: int = 128,
+                 level_chunk: int = 8, interpret: bool = False):
+        if lam != 16:
+            raise ValueError(
+                f"KeyLanesPallasBackend supports lam=16 only (got {lam})")
+        used = hirose_used_cipher_indices(lam, len(cipher_keys))
+        self.lam = lam
+        self.m_tile = m_tile
+        self.kw_tile = kw_tile
+        self.level_chunk = level_chunk
+        self.interpret = interpret
+        self.rk = jnp.asarray(round_key_masks_bitmajor(cipher_keys[used[0]]))
+        self._perm = jnp.asarray(_PERM)
+        self._bundle_dev = None
+        self._num_keys = 0
+
+    def put_bundle_device(self, dev: dict) -> None:
+        """Adopt a DeviceKeyGen bundle (byte-major planes, both parties);
+        planes are reordered to the kernel's bit-major layout on device."""
+        p = self._perm
+        self._num_keys = dev["num_keys"]
+        self._bundle_dev = dict(
+            s0=tuple(_to_bitmajor_planes(s, p) for s in dev["s0"]),
+            cw_s=_to_bitmajor_planes(dev["cw_s"], p),
+            cw_v=_to_bitmajor_planes(dev["cw_v"], p),
+            cw_tl=jax.lax.bitcast_convert_type(dev["cw_tl"], jnp.int32),
+            cw_tr=jax.lax.bitcast_convert_type(dev["cw_tr"], jnp.int32),
+            cw_np1=_to_bitmajor_planes(dev["cw_np1"], p),
+        )
+
+    def put_bundle(self, bundle: KeyBundle) -> None:
+        """Host-bundle path (tests / interop): pack a full two-party
+        KeyBundle into the device layout."""
+        if bundle.lam != self.lam:
+            raise ValueError("bundle lam mismatch")
+        if bundle.s0s.shape[1] != 2:
+            raise ValueError(
+                "KeyLanesPallasBackend wants the full two-party bundle")
+        k = bundle.num_keys
+        k_pad = (k + 31) // 32 * 32
+
+        def pad_keys(a):
+            return np.pad(a, [(0, k_pad - k)] + [(0, 0)] * (a.ndim - 1))
+
+        def planes(a):  # [K, ..., lam] -> uint32 [..., 8lam, Wk]
+            bits = byte_bits_lsb(pad_keys(a))  # [K, ..., 8lam]
+            return jnp.asarray(pack_lanes(
+                np.ascontiguousarray(np.moveaxis(bits, 0, -1))))
+
+        def packed_bits(a):  # [K, n] -> uint32 [n, Wk]
+            return jnp.asarray(pack_lanes(np.ascontiguousarray(
+                pad_keys(a).T)))
+
+        self.put_bundle_device(dict(
+            s0=(planes(bundle.s0s[:, 0]), planes(bundle.s0s[:, 1])),
+            cw_s=planes(bundle.cw_s),
+            cw_v=planes(bundle.cw_v),
+            cw_tl=packed_bits(bundle.cw_t[:, :, 0]),
+            cw_tr=packed_bits(bundle.cw_t[:, :, 1]),
+            cw_np1=planes(bundle.cw_np1),
+            num_keys=k,
+        ))
+
+    def stage(self, xs: np.ndarray) -> dict:
+        """Shared points uint8 [M, nb] -> staged walk masks (M padded to a
+        multiple of m_tile; pad points evaluated and discarded)."""
+        if self._bundle_dev is None:
+            raise ValueError("no key bundle on device; call put_bundle first")
+        if xs.ndim != 2:
+            raise ValueError("keylanes backends need shared points [M, nb]")
+        n = self._bundle_dev["cw_s"].shape[0]
+        if xs.shape[1] * 8 != n:
+            raise ValueError("xs width mismatch with bundle")
+        m = xs.shape[0]
+        m_pad = -(-m // self.m_tile) * self.m_tile
+        if m_pad != m:
+            xs = np.pad(xs, [(0, m_pad - m), (0, 0)])
+        x_mask = _stage_xs_keylanes(jnp.asarray(np.ascontiguousarray(xs)))
+        return {"x_mask": x_mask, "m": m}
+
+    def eval_staged(self, b: int, staged: dict) -> jax.Array:
+        """Party ``b`` eval; returns DEVICE y planes int32 [128, M_pad, Kw]
+        (bit-major).  Force completion with a fetch."""
+        dev = self._bundle_dev
+        return _eval_staged(
+            self.rk, dev["s0"][b], dev["cw_s"], dev["cw_v"], dev["cw_tl"],
+            dev["cw_tr"], dev["cw_np1"], staged["x_mask"], b=int(b),
+            m_tile=self.m_tile, kw_tile=self.kw_tile,
+            level_chunk=self.level_chunk, interpret=self.interpret)
+
+    def staged_to_bytes(self, y_planes: jax.Array, m: int) -> np.ndarray:
+        """int32 [128, M_pad, Kw] -> uint8 [K, M, lam] on host."""
+        y = np.asarray(y_planes).view(np.uint32)[_INV_PERM]  # byte-major
+        bits = unpack_lanes(y)  # [8lam, M_pad, K_pad]
+        bits = np.moveaxis(bits, -1, 0).transpose(0, 2, 1)  # [K, M, 8lam]
+        return bits_lsb_to_bytes(bits[: self._num_keys, :m])
+
+    def eval(self, b: int, xs: np.ndarray,
+             bundle: KeyBundle | None = None) -> np.ndarray:
+        """Convenience bytes-out path: uint8 [K, M, lam]."""
+        if bundle is not None:
+            self.put_bundle(bundle)
+        staged = self.stage(xs)
+        return self.staged_to_bytes(self.eval_staged(b, staged), staged["m"])
+
+    def relu_mismatch_count(self, y0, y1, alphas: np.ndarray,
+                            betas: np.ndarray, xs: np.ndarray) -> jax.Array:
+        """Config-5 device verification: count (key, point) pairs where the
+        XOR reconstruction differs from `beta_k if x_m < alpha_k else 0`.
+        Correct when the bundle came from DeviceKeyGen (pad keys are real
+        alpha=0/beta=0 keys whose reconstruction is 0, matching the padded
+        expectation); host bundles packed via put_bundle zero-pad raw CW
+        material instead, which is NOT a valid key — don't verify those
+        through this method.  Returns a DEVICE scalar.
+        """
+        k = alphas.shape[0]
+        k_pad = (k + 31) // 32 * 32
+        m_pad = y0.shape[1]
+        alphas_p = np.pad(alphas, [(0, k_pad - k), (0, 0)])
+        xs_p = np.pad(xs, [(0, m_pad - xs.shape[0]), (0, 0)])
+        betas_p = np.pad(betas, [(0, k_pad - k), (0, 0)])
+        # pad keys compare x < 0 = false -> expected 0; pad keys' shares are
+        # real DCF shares of alpha=0 keys... their reconstruction equals
+        # f_{0,beta=0} = 0 everywhere, matching.  Pad points likewise use
+        # real evaluated shares vs their own expected value.
+        beta_t = _to_bitmajor_planes(
+            jnp.asarray(pack_lanes(np.ascontiguousarray(
+                byte_bits_lsb(betas_p).T))), self._perm)
+        return _relu_mismatch(
+            y0, y1, beta_t, jnp.asarray(alphas_p), jnp.asarray(xs_p))

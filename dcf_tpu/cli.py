@@ -11,17 +11,31 @@ Reference harness (no published numbers, SURVEY.md §6):
 
 plus ``secure_relu`` — the BASELINE.json config-5 many-keys workload.
 
+plus ``full_domain`` — the BASELINE.json config-3 workload (two-party
+reconstruction over the whole 2^n domain, on-device point generation for
+the staged backends).
+
 Usage::
 
     python -m dcf_tpu.cli dcf_batch_eval --backend=pallas --points=1048576
-    python -m dcf_tpu.cli all --backend=cpu
+    python -m dcf_tpu.cli full_domain --backend=pallas --n-bits=24
+    python -m dcf_tpu.cli secure_relu --backend=sharded --mesh=4x2
+    python -m dcf_tpu.cli all --backend=cpu --profile=/tmp/trace
+
+The criterion benches are single-key, so their sharded variant shards
+points only (mesh 1xN); the multi-key mesh factorizations (8x1 / 4x2 /
+2x4) are compared on ``secure_relu --backend=sharded --mesh=KxP``.
 
 Backends: ``cpu`` (C++ core, all threads), ``cpu1`` (C++ single thread —
 the stand-in for the reference's serial feature matrix), ``numpy``,
 ``jax`` (XLA scan/vmap), ``bitsliced`` (XLA bit-planes), ``pallas``
-(fused TPU kernel, lam=16 only).  Each bench prints one human line and one
-JSON line; gen always runs on the C++ host core (keys ship to the device
-once, SURVEY.md §2.2).
+(fused TPU kernel, lam=16 only), ``sharded`` (shard_map over a device
+mesh; ``--mesh=KxP`` picks the factorization).  Each bench prints one
+human line and one JSON line with criterion-grade stats (median +- MAD of
+``--reps`` samples after warmup).  ``--profile=DIR`` wraps the timed
+region in a ``jax.profiler`` trace.  gen runs on the C++ host core except
+where a bench states otherwise (secure_relu --backend=pallas-keylanes
+generates keys on device).
 """
 
 from __future__ import annotations
@@ -37,7 +51,7 @@ from dcf_tpu.gen import random_s0s
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.spec import Bound
 
-BACKENDS = ("cpu", "cpu1", "numpy", "jax", "bitsliced", "pallas")
+BACKENDS = ("cpu", "cpu1", "numpy", "jax", "bitsliced", "pallas", "sharded")
 
 
 def log(msg: str) -> None:
@@ -49,7 +63,18 @@ def _cipher_keys(lam: int, rng) -> list[bytes]:
     return [rng.bytes(32) for _ in range(n_keys)]
 
 
-def _make_evaluator(backend: str, lam: int, cipher_keys, native):
+def _parse_mesh(spec: str):
+    """'4x2' -> (4, 2); '' -> None (auto factorization)."""
+    if not spec:
+        return None
+    try:
+        k, p = spec.lower().split("x")
+        return (int(k), int(p))
+    except ValueError:
+        raise SystemExit(f"--mesh wants KxP (e.g. 4x2), got {spec!r}")
+
+
+def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
     """Returns eval_fn(b, bundle_party, xs) -> uint8 [K, M, lam]."""
     if backend in ("cpu", "cpu1"):
         threads = 1 if backend == "cpu1" else None
@@ -76,26 +101,77 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native):
         from dcf_tpu.backends.pallas_backend import PallasBackend
 
         be = PallasBackend(lam, cipher_keys)
+    elif backend == "sharded":
+        import jax
+
+        from dcf_tpu.parallel import ShardedJaxBackend, make_mesh
+
+        shape = _parse_mesh(getattr(args, "mesh", ""))
+        if shape is None:
+            # criterion benches are single-key: put every device on points
+            shape = (1, len(jax.devices()))
+        mesh = make_mesh(shape=shape)
+        log(f"mesh: {dict(mesh.shape)}")
+        be = ShardedJaxBackend(lam, cipher_keys, mesh)
     else:
         raise ValueError(f"unknown backend {backend!r}")
     return lambda b, bundle, xs: be.eval(b, xs, bundle=bundle)
 
 
-def _timed(fn, reps: int):
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        fn()
-        best = min(best, time.perf_counter() - t0)
-    return best
+class _Profiler:
+    """Optional jax.profiler trace around the timed region (--profile)."""
+
+    def __init__(self, trace_dir: str):
+        self.trace_dir = trace_dir
+
+    def __enter__(self):
+        if self.trace_dir:
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+        return self
+
+    def __exit__(self, *exc):
+        if self.trace_dir:
+            import jax
+
+            jax.profiler.stop_trace()
+            log(f"profiler trace written to {self.trace_dir}")
+        return False
 
 
-def _emit(name: str, backend: str, metric: str, value: float, unit: str):
-    log(f"{name}[{backend}]: {value:,.1f} {unit}")
+def _timed(fn, reps: int, profile: str = ""):
+    """Criterion-grade sampling: ``reps`` timed samples (caller warmed up),
+    median +- MAD (benches/dcf_batch_eval.rs:35-39 methodology analog).
+    Returns (median_s, mad_s, samples)."""
+    samples = []
+    with _Profiler(profile):
+        for _ in range(max(reps, 1)):
+            t0 = time.perf_counter()
+            fn()
+            samples.append(time.perf_counter() - t0)
+    arr = np.array(samples)
+    med = float(np.median(arr))
+    mad = float(np.median(np.abs(arr - med)))
+    return med, mad, samples
+
+
+def _emit(name: str, backend: str, metric: str, value: float, unit: str,
+          med_s: float | None = None, mad_s: float | None = None,
+          samples: int | None = None):
+    extra = {}
+    if med_s is not None:
+        extra = {"median_s": round(med_s, 6), "mad_s": round(mad_s or 0, 6),
+                 "samples": samples}
+        log(f"{name}[{backend}]: {value:,.1f} {unit} "
+            f"(median {med_s * 1e3:.3f} ms +- MAD {(mad_s or 0) * 1e3:.3f} ms, "
+            f"{samples} samples)")
+    else:
+        log(f"{name}[{backend}]: {value:,.1f} {unit}")
     print(
         json.dumps(
             {"bench": name, "backend": backend, "metric": metric,
-             "value": round(value, 1), "unit": unit}
+             "value": round(value, 1), "unit": unit, **extra}
         ),
         flush=True,
     )
@@ -105,6 +181,11 @@ def bench_dcf(args) -> None:
     """Single gen + single-point eval latency (benches/dcf.rs analog)."""
     from dcf_tpu.native import NativeDcf
 
+    if args.backend == "sharded":
+        raise SystemExit(
+            "dcf is a single-point latency bench; sharding one point over "
+            "a mesh is meaningless — use any single-device backend")
+
     lam, nb = 16, 16
     rng = np.random.default_rng(args.seed)
     ck = _cipher_keys(lam, rng)
@@ -113,18 +194,20 @@ def bench_dcf(args) -> None:
     betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
     s0s = random_s0s(1, lam, rng)
 
-    gen_s = _timed(
+    gen_s, gen_mad, gs = _timed(
         lambda: native.gen_batch(alphas, betas, s0s, Bound.LT_BETA), args.reps
     )
-    _emit("dcf_gen", "cpu", "gen_latency_us", gen_s * 1e6, "us")
+    _emit("dcf_gen", "cpu", "gen_latency_us", gen_s * 1e6, "us",
+          gen_s, gen_mad, len(gs))
 
     bundle = native.gen_batch(alphas, betas, s0s, Bound.LT_BETA)
-    run = _make_evaluator(args.backend, lam, ck, native)
+    run = _make_evaluator(args.backend, lam, ck, native, args)
     xs = rng.integers(0, 256, (1, nb), dtype=np.uint8)
     k0 = bundle.for_party(0)
     run(0, k0, xs)  # warmup / compile
-    ev_s = _timed(lambda: run(0, k0, xs), args.reps)
-    _emit("dcf_eval_1pt", args.backend, "eval_latency_us", ev_s * 1e6, "us")
+    ev_s, ev_mad, es = _timed(lambda: run(0, k0, xs), args.reps, args.profile)
+    _emit("dcf_eval_1pt", args.backend, "eval_latency_us", ev_s * 1e6, "us",
+          ev_s, ev_mad, len(es))
 
 
 def bench_batch(args) -> None:
@@ -143,15 +226,16 @@ def bench_batch(args) -> None:
         Bound.LT_BETA,
     )
     xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
-    run = _make_evaluator(args.backend, lam, ck, native)
+    run = _make_evaluator(args.backend, lam, ck, native, args)
     k0 = bundle.for_party(0)
     y = run(0, k0, xs)  # warmup / compile
     if args.check:
         want = native.eval(0, bundle, xs[:2048])
         assert np.array_equal(y[0, :2048], want[0]), "parity mismatch vs C++"
         log("parity vs C++ core: OK (first 2048 pts)")
-    dt = _timed(lambda: run(0, k0, xs), args.reps)
-    _emit("dcf_batch_eval", args.backend, "evals_per_sec", m / dt, "evals/s")
+    dt, mad, ss = _timed(lambda: run(0, k0, xs), args.reps, args.profile)
+    _emit("dcf_batch_eval", args.backend, "evals_per_sec", m / dt, "evals/s",
+          dt, mad, len(ss))
 
 
 def bench_large_lambda(args) -> None:
@@ -173,46 +257,143 @@ def bench_large_lambda(args) -> None:
         Bound.LT_BETA,
     )
     xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
-    run = _make_evaluator(args.backend, lam, ck, native)
+    run = _make_evaluator(args.backend, lam, ck, native, args)
     k0 = bundle.for_party(0)
     y = run(0, k0, xs)  # warmup / compile
     if args.check:
         want = native.eval(0, bundle, xs[:64])
         assert np.array_equal(y[0, :64], want[0]), "parity mismatch vs C++"
         log("parity vs C++ core: OK (first 64 pts)")
-    dt = _timed(lambda: run(0, k0, xs), args.reps)
-    _emit("dcf_large_lambda", args.backend, "evals_per_sec", m / dt, "evals/s")
+    dt, mad, ss = _timed(lambda: run(0, k0, xs), args.reps, args.profile)
+    _emit("dcf_large_lambda", args.backend, "evals_per_sec", m / dt, "evals/s",
+          dt, mad, len(ss))
 
 
 def bench_secure_relu(args) -> None:
-    """Many-keys x few-points workload (BASELINE.json config 5, scaled)."""
-    from dcf_tpu.backends.jax_bitsliced import KeyLanesBackend
-    from dcf_tpu.native import NativeDcf
-    from dcf_tpu.workloads import secure_relu_eval
+    """Many-keys x few-points workload (BASELINE.json config 5, scaled).
 
+    Default path: C++ host keygen + XLA keys-in-lanes eval.  With
+    ``--device-gen``: fully device-resident — DeviceKeyGen + the Pallas
+    keylanes kernel + on-device verification (the config-5 pipeline that
+    runs 10^6 keys x 1024 points, see benchmarks/RESULTS_r02.jsonl).
+    """
     lam, nb = 16, 16
     k = args.keys or 65_536
     m = args.points or 1_024
     rng = np.random.default_rng(args.seed)
     ck = _cipher_keys(lam, rng)
+    alphas = rng.integers(0, 256, (k, nb), dtype=np.uint8)
+    betas = rng.integers(0, 256, (k, lam), dtype=np.uint8)
+    s0s = random_s0s(k, lam, rng)
+    xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
+
+    if args.device_gen:
+        from dcf_tpu.workloads import secure_relu_check_device
+
+        t0 = time.perf_counter()
+        with _Profiler(args.profile):
+            mism = secure_relu_check_device(
+                lam, ck, alphas, betas, s0s, xs)
+        dt = time.perf_counter() - t0
+        if mism:
+            raise SystemExit(f"secure_relu: {mism} reconstruction mismatches")
+        log(f"on-device verification: 0 mismatches of {k * m}")
+        _emit("secure_relu", "device-gen+pallas-keylanes", "evals_per_sec",
+              2 * k * m / dt, "evals/s (incl device keygen + verify)")
+        return
+
+    from dcf_tpu.native import NativeDcf
+    from dcf_tpu.workloads import secure_relu_eval
+
     native = NativeDcf(lam, ck)
     log(f"gen {k} keys ...")
+    bundle = native.gen_batch(alphas, betas, s0s, Bound.LT_BETA)
+    if args.backend == "sharded":
+        # The one multi-key CLI workload: this is where mesh factorizations
+        # (8x1 / 4x2 / 2x4) are meaningfully compared via --mesh.
+        from dcf_tpu.parallel import ShardedJaxBackend, make_mesh
+
+        mesh = make_mesh(shape=_parse_mesh(args.mesh))
+        log(f"mesh: {dict(mesh.shape)}")
+        be0 = ShardedJaxBackend(lam, ck, mesh)
+        be1 = ShardedJaxBackend(lam, ck, mesh)
+        name = "sharded"
+    else:
+        from dcf_tpu.backends.jax_bitsliced import KeyLanesBackend
+
+        be0 = KeyLanesBackend(lam, ck)
+        be1 = KeyLanesBackend(lam, ck)
+        name = "bitsliced-keylanes"
+    secure_relu_eval(be0, be1, bundle, xs)  # warmup / compile
+    dt, mad, ss = _timed(
+        lambda: secure_relu_eval(be0, be1, bundle, xs), args.reps,
+        args.profile)
+    # Two parties evaluated -> 2*K*M DCF evals.
+    _emit("secure_relu", name, "evals_per_sec",
+          2 * k * m / dt, "evals/s", dt, mad, len(ss))
+
+
+def bench_full_domain(args) -> None:
+    """Full-domain two-party reconstruction (BASELINE.json config 3).
+
+    Staged backends (pallas/bitsliced) run fully device-resident: points
+    generated from an iota on device, XOR reconstruction verified on
+    device, only the mismatch counter fetched.  Other backends use the
+    host chunk loop.  The metric counts both parties' evals.
+    """
+    from dcf_tpu.native import NativeDcf
+    from dcf_tpu.workloads import full_domain_check, full_domain_check_device
+
+    lam = 16
+    n_bits = args.n_bits or 24
+    if n_bits % 8 != 0 or n_bits < 8:
+        raise SystemExit(f"--n-bits must be a positive multiple of 8, "
+                         f"got {n_bits} (domains are byte-granular, "
+                         "SURVEY.md section 0)")
+    nb = n_bits // 8
+    rng = np.random.default_rng(args.seed)
+    ck = _cipher_keys(lam, rng)
+    native = NativeDcf(lam, ck)
+    alpha = int(rng.integers(0, 1 << n_bits))
+    beta = rng.bytes(lam)
     bundle = native.gen_batch(
-        rng.integers(0, 256, (k, nb), dtype=np.uint8),
-        rng.integers(0, 256, (k, lam), dtype=np.uint8),
-        random_s0s(k, lam, rng),
+        np.frombuffer(alpha.to_bytes(nb, "big"), dtype=np.uint8)[None],
+        np.frombuffer(beta, dtype=np.uint8)[None],
+        random_s0s(1, lam, rng),
         Bound.LT_BETA,
     )
-    xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
-    be0 = KeyLanesBackend(lam, ck)
-    be1 = KeyLanesBackend(lam, ck)
-    secure_relu_eval(be0, be1, bundle, xs)  # warmup / compile
-    t0 = time.perf_counter()
-    secure_relu_eval(be0, be1, bundle, xs)
-    dt = time.perf_counter() - t0
-    # Two parties evaluated -> 2*K*M DCF evals.
-    _emit("secure_relu", "bitsliced-keylanes", "evals_per_sec",
-          2 * k * m / dt, "evals/s")
+    chunk = min(1 << 20, 1 << n_bits)
+    if args.backend in ("pallas", "bitsliced"):
+        if args.backend == "pallas":
+            from dcf_tpu.backends.pallas_backend import PallasBackend as B
+        else:
+            from dcf_tpu.backends.jax_bitsliced import BitslicedBackend as B
+        be0, be1 = B(lam, ck), B(lam, ck)
+        be0.put_bundle(bundle.for_party(0))
+        be1.put_bundle(bundle.for_party(1))
+
+        def run():
+            mism = full_domain_check_device(
+                be0, be1, alpha, beta, n_bits, chunk=chunk)
+            if mism:
+                raise SystemExit(f"full_domain: {mism} mismatches")
+    else:
+        run0 = _make_evaluator(args.backend, lam, ck, native, args)
+        k0 = bundle.for_party(0)
+        k1 = bundle.for_party(1)
+
+        def run():
+            mism = full_domain_check(
+                lambda xs: run0(0, k0, xs), lambda xs: run0(1, k1, xs),
+                alpha, beta, n_bits, chunk=chunk)
+            if mism:
+                raise SystemExit(f"full_domain: {mism} mismatches")
+
+    run()  # warmup / compile + correctness
+    log(f"full domain 2^{n_bits}: 0 mismatches")
+    dt, mad, ss = _timed(run, args.reps, args.profile)
+    _emit("full_domain", args.backend, "evals_per_sec",
+          2 * (1 << n_bits) / dt, "evals/s", dt, mad, len(ss))
 
 
 BENCHES = {
@@ -220,10 +401,31 @@ BENCHES = {
     "dcf_batch_eval": bench_batch,
     "dcf_large_lambda": bench_large_lambda,
     "secure_relu": bench_secure_relu,
+    "full_domain": bench_full_domain,
 }
 
 
+def _maybe_force_cpu_devices() -> None:
+    """DCF_CPU_DEVICES=N runs the CLI on N virtual XLA CPU devices (the
+    sharded backend's no-hardware mode; same recipe as tests/conftest.py —
+    needed because this environment's sitecustomize pins JAX_PLATFORMS at
+    interpreter start, so env vars alone are too late)."""
+    import os
+
+    n = os.environ.get("DCF_CPU_DEVICES")
+    if not n:
+        return
+    from dcf_tpu.utils.provision import force_cpu_devices
+
+    force_cpu_devices(os.environ, int(n))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    log(f"forced {n} virtual CPU devices")
+
+
 def main(argv=None) -> None:
+    _maybe_force_cpu_devices()
     p = argparse.ArgumentParser(
         prog="python -m dcf_tpu.cli",
         description="DCF benchmark CLI (reference criterion-bench analogs)",
@@ -238,11 +440,23 @@ def main(argv=None) -> None:
     p.add_argument("--seed", type=int, default=2026)
     p.add_argument("--check", action="store_true",
                    help="verify parity vs the C++ core before timing")
+    p.add_argument("--mesh", default="",
+                   help="mesh shape KxP for --backend=sharded (e.g. 4x2)")
+    p.add_argument("--profile", default="",
+                   help="write a jax.profiler trace of the timed region")
+    p.add_argument("--n-bits", type=int, default=0,
+                   help="domain bits for full_domain (0 = 24)")
+    p.add_argument("--device-gen", action="store_true",
+                   help="secure_relu: device keygen + pallas keylanes path")
     args = p.parse_args(argv)
     for name in BENCHES if args.bench == "all" else [args.bench]:
         if args.bench == "all" and name == "dcf_large_lambda" and \
-                args.backend == "pallas":
-            log("skipping dcf_large_lambda (pallas is lam=16 only)")
+                args.backend in ("pallas", "sharded"):
+            log("skipping dcf_large_lambda (lam=16-only backend)")
+            continue
+        if args.bench == "all" and name == "dcf" and \
+                args.backend == "sharded":
+            log("skipping dcf (single-point bench, not shardable)")
             continue
         BENCHES[name](args)
 

@@ -317,14 +317,23 @@ def prep_rk_bitmajor_v3(xp, rk_all):
     return xp.stack(out)
 
 
+def _rk_block(rk, rnd, i, n_rest: int):
+    """Round-key block [16, 1] viewed for states with n_rest trailing dims."""
+    blk = rk[rnd, i]
+    return blk.reshape((16,) + (1,) * n_rest)
+
+
 def aes256_encrypt_blocks_bitmajor_v3(xp, rk_prepped, blocks, ones):
     """v3 cipher over bit-block lists; rk_prepped from prep_rk_bitmajor_v3.
 
-    blocks: list of 8 [16, L] arrays in TRUE byte order; returns the same
-    (the conjugated order is internal only).
+    blocks: list of 8 arrays [16, *rest] in TRUE byte order; returns the
+    same (the conjugated order is internal only).  Trailing dims are
+    arbitrary: [16, L] for the points-in-lanes kernel, [16, M, Kw] for the
+    keys-in-lanes kernel.
     """
     rk = rk_prepped
-    b = [blocks[i] ^ rk[0, i] for i in range(8)]
+    nr = blocks[0].ndim - 1
+    b = [blocks[i] ^ _rk_block(rk, 0, i, nr) for i in range(8)]
     for rnd in range(1, 14):
         e1, e2, e3 = _V3_TERM_PERMS[rnd - 1]
         sb = sbox_planes([b[i] for i in range(8)], ones)
@@ -334,11 +343,11 @@ def aes256_encrypt_blocks_bitmajor_v3(xp, rk_prepped, blocks, ones):
             ^ _perm_rows(xp, xb[i] ^ sb[i], e1)
             ^ _perm_rows(xp, sb[i], e2)
             ^ _perm_rows(xp, sb[i], e3)
-            ^ rk[rnd, i]
+            ^ _rk_block(rk, rnd, i, nr)
             for i in range(8)
         ]
     sb = sbox_planes([b[i] for i in range(8)], ones)
-    return [_perm_rows(xp, sb[i], _V3_FINAL_PERM) ^ rk[14, i]
+    return [_perm_rows(xp, sb[i], _V3_FINAL_PERM) ^ _rk_block(rk, 14, i, nr)
             for i in range(8)]
 
 
@@ -349,12 +358,12 @@ def aes256_encrypt_planes_bitmajor_v3(xp, rk_all, state, ones):
 
 
 def aes_walk_cipher_v3(xp, rk_prepped, state, ones):
-    """The exact cipher body the walk kernel runs: prepped round keys in,
-    [128, L] planes in/out.  Kept as a standalone function so the CPU test
-    suite can exercise the kernel's cipher glue (reshape/blocks/stack)
+    """The exact cipher body the walk kernels run: prepped round keys in,
+    [128, *rest] planes in/out.  Kept as a standalone function so the CPU
+    test suite can exercise the kernel's cipher glue (reshape/blocks/stack)
     without Mosaic (tests/test_bitsliced.py)."""
-    l = state.shape[-1]
-    s3 = state.reshape(8, 16, l)
+    rest = state.shape[1:]
+    s3 = state.reshape(8, 16, *rest)
     out = aes256_encrypt_blocks_bitmajor_v3(
         xp, rk_prepped, [s3[i] for i in range(8)], ones)
-    return xp.stack(out).reshape(128, l)
+    return xp.stack(out).reshape(128, *rest)

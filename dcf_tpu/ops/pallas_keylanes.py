@@ -1,0 +1,175 @@
+"""Pallas TPU kernel for the many-keys DCF walk (keys packed in lanes).
+
+The secure-ReLU regime (BASELINE config 5: 10^6 keys x 10^3 shared points)
+is the dual of the flagship batch-eval shape: keys ride the lane axis
+(32 per uint32 word) so the per-key correction words are PACKED DATA — one
+word of cw planes corrects 32 keys — while the shared points batch on the
+sublane axis.  The XLA keylanes path (backends.jax_bitsliced.
+eval_core_keylanes) round-trips multi-GB plane intermediates through HBM
+every level; this kernel keeps the (s, t, v) carry for a
+(m_tile x kw_tile) tile in VMEM across a whole chunk of levels.
+
+Reference semantics: /root/reference/src/lib.rs:163-204, src/prg.rs:42-73.
+
+Shapes (lam = 16, n levels, M shared points, Kw = keys/32 words):
+
+    s, v      int32 [128, M, Kw]   bit-major planes (p' = bit*16 + byte)
+    t         int32 [M, Kw]        per-(point, key-lane) control bits
+    cw_s/cw_v int32 [n, 128, Kw]   packed per-key correction planes
+    cw_tl/tr  int32 [n, Kw]        packed per-key t-correction bits
+    x_mask    int32 [n, M, 1]      walk-order input-bit masks (0 / -1),
+                                   shared across keys (trailing 1 so the
+                                   point tile rides the sublane block dim)
+
+The n-level walk runs as ceil(n / level_chunk) pallas_calls; each call's
+grid is (Kw/kw_tile, M/m_tile) with the level loop INSIDE the kernel, so
+the carry round-trips HBM only once per level chunk (VMEM cannot hold all
+n levels' correction slabs at once — 2 x 8 MB at n=128/Kw-tile=128).
+The point-tile grid axis is innermost, so Pallas reuses each key tile's
+correction slab across all point tiles without re-fetching.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from dcf_tpu.ops.aes_bitsliced import (
+    aes256_encrypt_planes_bitmajor,
+    aes_walk_cipher_v3,
+    prep_rk_bitmajor_v3,
+)
+
+__all__ = ["dcf_eval_keylanes_pallas"]
+
+
+def _kernel(rk_ref, s_ref, t_ref, v_ref, cw_s_ref, cw_v_ref, cw_tl_ref,
+            cw_tr_ref, xm_ref, so_ref, to_ref, vo_ref, *,
+            lc: int, interpret: bool):
+    ones = jnp.int32(-1)
+    rk = rk_ref[:]
+    if interpret:
+        def aes(state):
+            shp = state.shape
+            return aes256_encrypt_planes_bitmajor(
+                jnp, rk, state.reshape(128, -1), ones).reshape(shp)
+    else:
+        rk_p = prep_rk_bitmajor_v3(jnp, rk)
+
+        def aes(state):
+            return aes_walk_cipher_v3(jnp, rk_p, state, ones)
+
+    # PRG mask: bit-major plane 15 (byte 15 bit 0) is cleared
+    # (reference src/prg.rs:65-68).
+    plane_idx = jax.lax.broadcasted_iota(jnp.int32, (128, 1, 1), 0)
+    lbm = jnp.where(plane_idx == 15, jnp.int32(0), ones)
+
+    kw = s_ref.shape[-1]
+
+    def level(l, carry):
+        s, t, v = carry
+        sp = s ^ ones
+        enc = aes(jnp.concatenate([s, sp], axis=-1))
+        sl_raw = enc[..., :kw] ^ s
+        vl_raw = enc[..., kw:] ^ sp
+        t_l = sl_raw[0]  # plane 0: [m_tile, kw] lane masks
+        t_r = vl_raw[0]
+        s_l = sl_raw & lbm
+        v_l = vl_raw & lbm
+        s_r = s & lbm
+        v_r = sp & lbm
+
+        cs = cw_s_ref[l][:, None, :]   # [128, 1, kw]
+        cv = cw_v_ref[l][:, None, :]
+        ctl = cw_tl_ref[l]             # [kw]
+        ctr = cw_tr_ref[l]
+        gate = t[None, :, :]
+        s_l = s_l ^ (cs & gate)
+        s_r = s_r ^ (cs & gate)
+        t_l = t_l ^ (t & ctl[None, :])
+        t_r = t_r ^ (t & ctr[None, :])
+
+        xm = xm_ref[l]                 # [m_tile, 1]
+        xm_c = xm                      # broadcast over key lanes
+        xm_p = xm[None]                # broadcast over planes + key lanes
+        nxm_c = xm_c ^ ones
+        nxm_p = xm_p ^ ones
+        v = v ^ (v_r & xm_p) ^ (v_l & nxm_p) ^ (cv & gate)
+        s = (s_r & xm_p) | (s_l & nxm_p)
+        t = (t_r & xm_c) | (t_l & nxm_c)
+        return (s, t, v)
+
+    s, t, v = jax.lax.fori_loop(
+        0, lc, level, (s_ref[:], t_ref[:], v_ref[:]))
+    so_ref[:] = s
+    to_ref[:] = t
+    vo_ref[:] = v
+
+
+def dcf_eval_keylanes_pallas(
+    rk,        # int32 [15, 128, 1]   bit-major round-key masks
+    s0_t,      # int32 [128, Kw]      party seed planes (bit-major)
+    cw_s_t,    # int32 [n, 128, Kw]   packed CW seed planes
+    cw_v_t,    # int32 [n, 128, Kw]   packed CW value planes
+    cw_tl,     # int32 [n, Kw]        packed tl bits
+    cw_tr,     # int32 [n, Kw]        packed tr bits
+    cw_np1_t,  # int32 [128, Kw]      packed final CW planes
+    x_mask,    # int32 [n, M, 1]      walk-order input-bit masks
+    *,
+    b: int,
+    m_tile: int = 8,
+    kw_tile: int = 128,
+    level_chunk: int = 8,
+    interpret: bool = False,
+):
+    """Party ``b`` many-keys eval; returns y planes int32 [128, M, Kw]."""
+    n, _, kw = cw_s_t.shape
+    m = x_mask.shape[1]
+    m_tile = min(m_tile, m)
+    kw_tile = min(kw_tile, kw)
+    lc = min(level_chunk, n)
+    if m % m_tile or kw % kw_tile or n % lc:
+        raise ValueError(
+            f"shape ({n} levels, {m} points, {kw} key words) not divisible "
+            f"by tiling ({lc}, {m_tile}, {kw_tile})")
+
+    s = jnp.broadcast_to(s0_t[:, None, :], (128, m, kw))
+    t = jnp.full((m, kw), jnp.int32(-1 if b else 0))
+    v = jnp.zeros((128, m, kw), jnp.int32)
+
+    grid = (kw // kw_tile, m // m_tile)
+    state_spec = pl.BlockSpec((128, m_tile, kw_tile), lambda k, j: (0, j, k))
+    t_spec = pl.BlockSpec((m_tile, kw_tile), lambda k, j: (j, k))
+    call = pl.pallas_call(
+        partial(_kernel, lc=lc, interpret=interpret),
+        out_shape=(
+            jax.ShapeDtypeStruct((128, m, kw), jnp.int32),
+            jax.ShapeDtypeStruct((m, kw), jnp.int32),
+            jax.ShapeDtypeStruct((128, m, kw), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((15, 128, 1), lambda k, j: (0, 0, 0)),
+            state_spec, t_spec, state_spec,
+            pl.BlockSpec((lc, 128, kw_tile), lambda k, j: (0, 0, k)),
+            pl.BlockSpec((lc, 128, kw_tile), lambda k, j: (0, 0, k)),
+            pl.BlockSpec((lc, kw_tile), lambda k, j: (0, k)),
+            pl.BlockSpec((lc, kw_tile), lambda k, j: (0, k)),
+            pl.BlockSpec((lc, m_tile, 1), lambda k, j: (0, j, 0)),
+        ],
+        out_specs=(state_spec, t_spec, state_spec),
+        interpret=interpret,
+    )
+    for c0 in range(0, n, lc):
+        s, t, v = call(
+            rk, s, t, v,
+            jax.lax.dynamic_slice_in_dim(cw_s_t, c0, lc, 0),
+            jax.lax.dynamic_slice_in_dim(cw_v_t, c0, lc, 0),
+            jax.lax.dynamic_slice_in_dim(cw_tl, c0, lc, 0),
+            jax.lax.dynamic_slice_in_dim(cw_tr, c0, lc, 0),
+            jax.lax.dynamic_slice_in_dim(x_mask, c0, lc, 0),
+        )
+    return v ^ s ^ (cw_np1_t[:, None, :] & t[None, :, :])

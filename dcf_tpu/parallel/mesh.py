@@ -19,20 +19,32 @@ __all__ = ["make_mesh", "ShardedJaxBackend"]
 
 
 def make_mesh(
-    n_devices: int | None = None, axis_names: tuple[str, str] = ("keys", "points")
+    n_devices: int | None = None,
+    axis_names: tuple[str, str] = ("keys", "points"),
+    shape: tuple[int, int] | None = None,
 ) -> Mesh:
-    """Build a 2D (keys x points) mesh over the first ``n_devices`` devices.
+    """Build a 2D (keys x points) mesh over the first devices.
 
-    The keys axis gets the larger factor: key sharding is what divides the
-    HBM-resident key image, while point sharding only divides transient state.
+    ``shape=(keys_dim, points_dim)`` pins the factorization explicitly
+    (8x1, 4x2, 2x4, ... — benchmarkable against each other via the CLI's
+    ``--mesh``).  Without it, the keys axis gets the larger factor: key
+    sharding is what divides the HBM-resident key image, while point
+    sharding only divides transient state.
     """
     devs = jax.devices()
-    n = len(devs) if n_devices is None else n_devices
-    if n > len(devs):
-        raise ValueError(f"requested {n} devices, have {len(devs)}")
-    # Points axis is 1 or 2; the keys axis takes the rest.
-    points = 2 if n % 2 == 0 else 1
-    keys_dim = n // points
+    if shape is not None:
+        keys_dim, points = shape
+        if n_devices is not None and keys_dim * points != n_devices:
+            raise ValueError(
+                f"mesh shape {shape} does not cover {n_devices} devices")
+    else:
+        n = len(devs) if n_devices is None else n_devices
+        # Points axis is 1 or 2; the keys axis takes the rest.
+        points = 2 if n % 2 == 0 else 1
+        keys_dim = n // points
+    if keys_dim * points > len(devs):
+        raise ValueError(
+            f"requested {keys_dim * points} devices, have {len(devs)}")
     return Mesh(
         np.array(devs[: keys_dim * points]).reshape(keys_dim, points), axis_names
     )

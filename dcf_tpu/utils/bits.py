@@ -70,6 +70,15 @@ def planes_to_bytes(planes: np.ndarray, nbytes: int) -> np.ndarray:
     return np.bitwise_or.reduce(bits << _SHIFTS8, axis=-1).astype(np.uint8)
 
 
+def bits_lsb_to_bytes(bits: np.ndarray) -> np.ndarray:
+    """Inverse of byte_bits_lsb: {0,1} [..., 8*nbytes] -> uint8 [..., nbytes]."""
+    if bits.shape[-1] % 8 != 0:
+        raise ValueError("bit count not a multiple of 8")
+    b8 = bits.reshape(*bits.shape[:-1], bits.shape[-1] // 8, 8)
+    return np.bitwise_or.reduce(
+        b8.astype(np.uint8) << _SHIFTS8, axis=-1).astype(np.uint8)
+
+
 def expand_bits_to_masks(bits: np.ndarray) -> np.ndarray:
     """{0,1} array -> uint32 masks (0 or 0xFFFFFFFF), same shape."""
     return (bits.astype(np.uint32) * np.uint32(0xFFFFFFFF)).astype(np.uint32)

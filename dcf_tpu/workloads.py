@@ -23,6 +23,7 @@ __all__ = [
     "domain_points",
     "full_domain_check",
     "full_domain_check_device",
+    "secure_relu_check_device",
     "secure_relu_eval",
 ]
 
@@ -103,6 +104,59 @@ def full_domain_check_device(
         y1 = backend1.eval_staged(1, staged)
         counters.append(
             backend0.mismatch_count(y0, y1, alpha, beta, start, gt))
+    return int(jnp.sum(jnp.stack(counters)))
+
+
+def secure_relu_check_device(
+    lam: int,
+    cipher_keys,
+    alphas: np.ndarray,
+    betas: np.ndarray,
+    s0s: np.ndarray,
+    xs: np.ndarray,
+    key_chunk: int = 1 << 16,
+    interpret: bool = False,
+    level_chunk: int = 8,
+    kw_tile: int = 128,
+) -> int:
+    """Config 5 fully device-resident: keygen, two-party eval, and
+    verification all on the accelerator, streaming over key chunks.
+
+    DeviceKeyGen writes each chunk's packed CW image straight into HBM (the
+    host ships only alphas/betas/seeds/xs), KeyLanesPallasBackend walks it,
+    and the XOR reconstruction is compared on device against
+    `beta_k if x_m < alpha_k else 0`.  Chunks are zero-padded to the
+    kernel's key granule (32 * kw_tile); pad keys are real alpha=0/beta=0
+    keys whose expected reconstruction is 0, so they cannot contribute
+    false passes.  Returns total mismatching (key, point) pairs (0 = pass).
+    """
+    from dcf_tpu.backends.device_gen import DeviceKeyGen
+    from dcf_tpu.backends.pallas_keylanes import KeyLanesPallasBackend
+    from dcf_tpu.spec import Bound
+
+    import jax.numpy as jnp
+
+    k = alphas.shape[0]
+    gen = DeviceKeyGen(lam, cipher_keys)
+    be = KeyLanesPallasBackend(
+        lam, cipher_keys, kw_tile=kw_tile, level_chunk=level_chunk,
+        interpret=interpret)
+    granule = 32 * kw_tile
+    counters = []
+    staged = None
+    for lo in range(0, k, key_chunk):
+        hi = min(k, lo + key_chunk)
+        pad = -(hi - lo) % granule
+        ap = np.pad(alphas[lo:hi], [(0, pad), (0, 0)])
+        bp = np.pad(betas[lo:hi], [(0, pad), (0, 0)])
+        sp = np.pad(s0s[lo:hi], [(0, pad), (0, 0), (0, 0)])
+        dev = gen.gen(ap, bp, sp, Bound.LT_BETA)
+        be.put_bundle_device(dev)
+        if staged is None:
+            staged = be.stage(xs)
+        y0 = be.eval_staged(0, staged)
+        y1 = be.eval_staged(1, staged)
+        counters.append(be.relu_mismatch_count(y0, y1, ap, bp, xs))
     return int(jnp.sum(jnp.stack(counters)))
 
 

@@ -1,0 +1,97 @@
+"""Keys-in-lanes Pallas kernel: parity vs the numpy oracle + device-gen
+pipeline (interpret mode on CPU; the same code is the Mosaic kernel on TPU).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from dcf_tpu import spec
+from dcf_tpu.backends.device_gen import DeviceKeyGen
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.backends.pallas_keylanes import KeyLanesPallasBackend
+from dcf_tpu.gen import gen_batch, random_s0s
+from dcf_tpu.ops.prg import HirosePrgNp
+
+
+def rand_bytes(rng, n):
+    return bytes(rng.getrandbits(8) for _ in range(n))
+
+
+def _setup(seed, k, nb, m):
+    rng = random.Random(seed)
+    ck = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    prg = HirosePrgNp(16, ck)
+    nprng = np.random.default_rng(seed)
+    alphas = nprng.integers(0, 256, (k, nb), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k, 16), dtype=np.uint8)
+    s0s = random_s0s(k, 16, nprng)
+    bundle = gen_batch(prg, alphas, betas, s0s, spec.Bound.LT_BETA)
+    xs = nprng.integers(0, 256, (m, nb), dtype=np.uint8)
+    xs[0] = alphas[0]  # exact-alpha point
+    return ck, prg, alphas, betas, s0s, bundle, xs
+
+
+@pytest.mark.parametrize("b", [0, 1])
+def test_keylanes_pallas_matches_numpy(b):
+    ck, prg, alphas, betas, s0s, bundle, xs = _setup(81, k=5, nb=2, m=6)
+    be = KeyLanesPallasBackend(
+        16, ck, m_tile=2, kw_tile=1, level_chunk=8, interpret=True)
+    got = be.eval(b, xs, bundle=bundle)
+    xs_k = np.broadcast_to(xs[None], (5, *xs.shape))
+    want = eval_batch_np(prg, b, bundle.for_party(b), xs_k)
+    assert np.array_equal(got, want)
+
+
+def test_keylanes_pallas_device_gen_pipeline():
+    """DeviceKeyGen -> put_bundle_device -> kernel eval -> device verify:
+    the full config-5 pipeline, plus a negative control."""
+    ck, prg, alphas, betas, s0s, bundle, xs = _setup(82, k=7, nb=2, m=4)
+    gen = DeviceKeyGen(16, ck)
+    dev = gen.gen(alphas, betas, s0s, spec.Bound.LT_BETA)
+    be = KeyLanesPallasBackend(
+        16, ck, m_tile=2, kw_tile=1, level_chunk=16, interpret=True)
+    be.put_bundle_device(dev)
+    staged = be.stage(xs)
+    y0 = be.eval_staged(0, staged)
+    y1 = be.eval_staged(1, staged)
+    assert int(be.relu_mismatch_count(y0, y1, alphas, betas, xs)) == 0
+    # negative control: flip one beta byte -> that key mismatches wherever
+    # x < alpha (at least the exact-alpha-minus... count must be > 0 only
+    # if some xs fall below alpha; xs[0] == alphas[0] gives f=0 there, so
+    # perturb alpha instead: claim alpha+1 for key 0 flips point xs[0]).
+    alphas_wrong = alphas.copy()
+    a0 = int.from_bytes(alphas[0].tobytes(), "big")
+    alphas_wrong[0] = np.frombuffer(
+        (a0 + 1).to_bytes(2, "big"), dtype=np.uint8)
+    assert int(be.relu_mismatch_count(y0, y1, alphas_wrong, betas, xs)) == 1
+
+
+def test_secure_relu_check_device_chunks():
+    """The streaming config-5 driver: ragged key chunks, zero-pad keys, one
+    device-summed mismatch counter."""
+    from dcf_tpu.workloads import secure_relu_check_device
+
+    ck, prg, alphas, betas, s0s, bundle, xs = _setup(84, k=40, nb=2, m=4)
+    assert secure_relu_check_device(
+        16, ck, alphas, betas, s0s, xs,
+        key_chunk=32, kw_tile=1, interpret=True) == 0
+    # (The driver regenerates keys from its inputs, so gen and verify are
+    # self-consistent by construction; the detection power of the device
+    # comparison itself is proven by the shifted-alpha negative control in
+    # test_keylanes_pallas_device_gen_pipeline.)
+
+
+def test_keylanes_pallas_matches_xla_keylanes():
+    """Same bundle through the XLA keylanes path and the Pallas kernel."""
+    from dcf_tpu.backends.jax_bitsliced import KeyLanesBackend
+
+    ck, prg, alphas, betas, s0s, bundle, xs = _setup(83, k=33, nb=2, m=4)
+    pb = KeyLanesPallasBackend(
+        16, ck, m_tile=4, kw_tile=2, level_chunk=16, interpret=True)
+    xb = KeyLanesBackend(16, ck)
+    for b in (0, 1):
+        got = pb.eval(b, xs, bundle=bundle)
+        want = xb.eval(b, xs, bundle=bundle.for_party(b))
+        assert np.array_equal(got, want)
