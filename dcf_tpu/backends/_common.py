@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["validate_xs", "pad_xs"]
+__all__ = ["validate_xs", "pad_xs", "prepare_batch"]
 
 
 def validate_xs(xs: np.ndarray, k_num: int, n_bits: int) -> tuple[bool, int]:
@@ -35,3 +35,17 @@ def pad_xs(xs: np.ndarray, shared: bool, m: int, m_pad: int) -> np.ndarray:
                else [(0, 0), (0, m_pad - m), (0, 0)])
         xs = np.pad(xs, pad)
     return xs[None] if shared else xs
+
+
+def prepare_batch(dims: tuple[int, int], xs: np.ndarray,
+                  m_pad_of) -> tuple[np.ndarray, bool, int]:
+    """The stage/eval preamble the device backends share: shape validation
+    against the on-device bundle dims (k_num, n_bits), point padding
+    (``m_pad_of(m)`` -> padded point count), contiguity.  Returns
+    (xs_padded [Kx, M_pad, nb], shared, m).  Callers apply their own
+    m == 0 policy on the returned m (the helper passes it through;
+    m_pad_of must tolerate 0)."""
+    k_num, n_bits = dims
+    shared, m = validate_xs(xs, k_num, n_bits)
+    xs = pad_xs(xs, shared, m, m_pad_of(m))
+    return np.ascontiguousarray(xs), shared, m

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dcf_tpu.backends._common import pad_xs, validate_xs
+from dcf_tpu.backends._common import prepare_batch
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes_bitsliced import aes256_encrypt_planes, round_key_masks
 from dcf_tpu.spec import hirose_used_cipher_indices
@@ -321,6 +321,12 @@ class _BitslicedBase:
 class BitslicedBackend(_BitslicedBase):
     """Device-resident bitsliced DCF evaluator (API-compatible with JaxBackend)."""
 
+    def _dims(self) -> tuple[int, int]:
+        """(k_num, n_bits) of the on-device bundle; raises if absent."""
+        if self._bundle_dev is None:
+            raise ValueError("no key bundle on device; call put_bundle first")
+        return self._bundle_dev["s0"].shape[1], self._bundle_dev["cw_s"].shape[0]
+
     def put_bundle(self, bundle: KeyBundle) -> None:
         """Ship a party-restricted bundle to device as plane masks."""
         if bundle.lam != self.lam:
@@ -352,15 +358,11 @@ class BitslicedBackend(_BitslicedBase):
         Same protocol as ``PallasBackend.stage``: conversion + transfer happen
         here, outside any timed region.
         """
-        if self._bundle_dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
-        k_num = self._bundle_dev["s0"].shape[1]
-        n = self._bundle_dev["cw_s"].shape[0]
-        shared, m = validate_xs(xs, k_num, n)
+        xs, _, m = prepare_batch(self._dims(), xs,
+                                 lambda m: (m + 31) // 32 * 32)
         if m == 0:
             raise ValueError("cannot stage an empty batch")
-        xs = pad_xs(xs, shared, m, (m + 31) // 32 * 32)
-        x_mask = _stage_xs_jit(jnp.asarray(np.ascontiguousarray(xs)))
+        x_mask = _stage_xs_jit(jnp.asarray(xs))
         return {"x_mask": x_mask, "m": m}
 
     def stage_range(self, start: int, count: int) -> dict:
@@ -416,13 +418,9 @@ class BitslicedBackend(_BitslicedBase):
         """
         if bundle is not None:
             self.put_bundle(bundle)
-        if self._bundle_dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
+        xs, _, m = prepare_batch(self._dims(), xs,
+                                 lambda m: (m + 31) // 32 * 32)
         dev = self._bundle_dev
-        k_num = dev["s0"].shape[1]
-        n = dev["cw_s"].shape[0]
-        shared, m = validate_xs(xs, k_num, n)
-        xs = pad_xs(xs, shared, m, (m + 31) // 32 * 32)
         y = _eval_jit(
             self.rk_masks,
             self._last_bit_mask,
@@ -432,7 +430,7 @@ class BitslicedBackend(_BitslicedBase):
             dev["cw_tl"],
             dev["cw_tr"],
             dev["cw_np1"],
-            jnp.asarray(np.ascontiguousarray(xs)),
+            jnp.asarray(xs),
             b=int(b),
             lam=self.lam,
         )  # uint8 [K, m_pad, lam]

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dcf_tpu.backends._common import pad_xs, validate_xs
+from dcf_tpu.backends._common import prepare_batch
 from dcf_tpu.backends.jax_bitsliced import (
     _lt_lane_mask_dev,
     _planes_to_bytes_dev,
@@ -133,6 +133,12 @@ class PallasBackend:
             cw_t=jnp.asarray(bundle.cw_t.astype(np.int32) * -1),
         )
 
+    def _dims(self) -> tuple[int, int]:
+        """(k_num, n_bits) of the on-device bundle; raises if absent."""
+        if self._bundle_dev is None:
+            raise ValueError("no key bundle on device; call put_bundle first")
+        return self._bundle_dev["s0"].shape[0], self._bundle_dev["cw_s"].shape[1]
+
     def _plan_tiles(self, m: int) -> tuple[int, int]:
         """Pick (tile words, padded total words) for an m-point batch.
 
@@ -160,17 +166,17 @@ class PallasBackend:
         reference bench's untimed xs setup
         (/root/reference/benches/dcf_batch_eval.rs:17-24).
         """
-        if self._bundle_dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
-        k_num = self._bundle_dev["s0"].shape[0]
-        n = self._bundle_dev["cw_s"].shape[1]
-        shared, m = validate_xs(xs, k_num, n)
+        plan = {}
+
+        def m_pad(m):
+            plan["wt"], plan["w_pad"] = self._plan_tiles(m)
+            return 32 * plan["w_pad"]
+
+        xs, _, m = prepare_batch(self._dims(), xs, m_pad)
         if m == 0:
             raise ValueError("cannot stage an empty batch")
-        wt, w_pad = self._plan_tiles(m)
-        xs = pad_xs(xs, shared, m, 32 * w_pad)
-        x_mask = _stage_xs(jnp.asarray(np.ascontiguousarray(xs)))
-        return {"x_mask": x_mask, "m": m, "wt": wt}
+        x_mask = _stage_xs(jnp.asarray(xs))
+        return {"x_mask": x_mask, "m": m, "wt": plan["wt"]}
 
     def stage_range(self, start: int, count: int) -> dict:
         """Stage the consecutive points start..start+count-1 WITHOUT any
@@ -225,20 +231,20 @@ class PallasBackend:
         """
         if bundle is not None:
             self.put_bundle(bundle)
-        if self._bundle_dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
+        plan = {}
+
+        def m_pad(m):
+            plan["wt"], plan["w_pad"] = self._plan_tiles(m)
+            return 32 * plan["w_pad"]
+
+        xs, _, m = prepare_batch(self._dims(), xs, m_pad)
         dev = self._bundle_dev
-        k_num = dev["s0"].shape[0]
-        n = dev["cw_s"].shape[1]
-        shared, m = validate_xs(xs, k_num, n)
         if m == 0:
-            return np.zeros((k_num, 0, self.lam), dtype=np.uint8)
-        wt, w_pad = self._plan_tiles(m)
-        xs = pad_xs(xs, shared, m, 32 * w_pad)
+            return np.zeros((dev["s0"].shape[0], 0, self.lam), dtype=np.uint8)
         y = _eval_bytes(
             self.rk, dev["s0"], dev["cw_s"], dev["cw_v"], dev["cw_np1"],
-            dev["cw_t"], jnp.asarray(np.ascontiguousarray(xs)),
-            self._inv_perm, b=int(b), tile_words=wt,
+            dev["cw_t"], jnp.asarray(xs),
+            self._inv_perm, b=int(b), tile_words=plan["wt"],
             interpret=self.interpret,
         )
         return np.asarray(y[:, :m, :])

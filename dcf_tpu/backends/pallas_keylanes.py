@@ -68,9 +68,10 @@ def _eval_staged(rk, s0_t, cw_s_t, cw_v_t, cw_tl, cw_tr, cw_np1_t, x_mask,
 
 
 @jax.jit
-def _relu_mismatch(y0, y1, beta_t, alphas, xs):
+def _relu_mismatch(y0, y1, beta_t, alphas, xs, valid):
     """Mismatch count for [128, M, Kw] bit-major shares vs the plain
-    comparison: expected(k, m) = beta_k iff x_m < alpha_k else 0."""
+    comparison: expected(k, m) = beta_k iff x_m < alpha_k else 0.  ``valid``
+    [1, Kw] masks out padding key lanes (which may hold garbage shares)."""
     m, nb = xs.shape
     lt = jnp.zeros((m, alphas.shape[0]), jnp.bool_)
     eq = jnp.ones((m, alphas.shape[0]), jnp.bool_)
@@ -84,7 +85,7 @@ def _relu_mismatch(y0, y1, beta_t, alphas, xs):
         jnp.sum(ltb << jnp.arange(32, dtype=jnp.uint32), axis=-1,
                 dtype=jnp.uint32), jnp.int32)  # [M, Kw]
     expect = beta_t[:, None, :] & ltw[None, :, :]
-    diff = jnp.bitwise_or.reduce(y0 ^ y1 ^ expect, axis=0)  # [M, Kw]
+    diff = jnp.bitwise_or.reduce(y0 ^ y1 ^ expect, axis=0) & valid  # [M, Kw]
     return jnp.sum(jax.lax.population_count(
         jax.lax.bitcast_convert_type(diff, jnp.uint32)).astype(jnp.int32))
 
@@ -114,16 +115,30 @@ class KeyLanesPallasBackend:
 
     def put_bundle_device(self, dev: dict) -> None:
         """Adopt a DeviceKeyGen bundle (byte-major planes, both parties);
-        planes are reordered to the kernel's bit-major layout on device."""
+        planes are reordered to the kernel's bit-major layout on device and
+        the key-word axis is zero-padded to the kernel's kw_tile granule
+        (pad lanes hold garbage shares; every consumer truncates or masks
+        by num_keys)."""
         p = self._perm
+        kw = dev["cw_s"].shape[-1]
+        if kw > self.kw_tile and kw % self.kw_tile:
+            pad = -kw % self.kw_tile
+
+            def padded(a):
+                return jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad)])
+        else:
+            def padded(a):
+                return a
         self._num_keys = dev["num_keys"]
         self._bundle_dev = dict(
-            s0=tuple(_to_bitmajor_planes(s, p) for s in dev["s0"]),
-            cw_s=_to_bitmajor_planes(dev["cw_s"], p),
-            cw_v=_to_bitmajor_planes(dev["cw_v"], p),
-            cw_tl=jax.lax.bitcast_convert_type(dev["cw_tl"], jnp.int32),
-            cw_tr=jax.lax.bitcast_convert_type(dev["cw_tr"], jnp.int32),
-            cw_np1=_to_bitmajor_planes(dev["cw_np1"], p),
+            s0=tuple(_to_bitmajor_planes(padded(s), p) for s in dev["s0"]),
+            cw_s=_to_bitmajor_planes(padded(dev["cw_s"]), p),
+            cw_v=_to_bitmajor_planes(padded(dev["cw_v"]), p),
+            cw_tl=jax.lax.bitcast_convert_type(
+                padded(dev["cw_tl"]), jnp.int32),
+            cw_tr=jax.lax.bitcast_convert_type(
+                padded(dev["cw_tr"]), jnp.int32),
+            cw_np1=_to_bitmajor_planes(padded(dev["cw_np1"]), p),
         )
 
     def put_bundle(self, bundle: KeyBundle) -> None:
@@ -205,24 +220,26 @@ class KeyLanesPallasBackend:
                             betas: np.ndarray, xs: np.ndarray) -> jax.Array:
         """Config-5 device verification: count (key, point) pairs where the
         XOR reconstruction differs from `beta_k if x_m < alpha_k else 0`.
-        Correct when the bundle came from DeviceKeyGen (pad keys are real
-        alpha=0/beta=0 keys whose reconstruction is 0, matching the padded
-        expectation); host bundles packed via put_bundle zero-pad raw CW
-        material instead, which is NOT a valid key — don't verify those
-        through this method.  Returns a DEVICE scalar.
+        Padding key lanes (from the 32-key word granule or the kw_tile
+        granule) are masked out of the count, so both DeviceKeyGen and
+        host-packed bundles verify correctly.  Pad points use real evaluated
+        shares compared against their own expected value.  Returns a DEVICE
+        scalar.
         """
         k = alphas.shape[0]
-        k_pad = (k + 31) // 32 * 32
+        if k != self._num_keys:
+            raise ValueError(
+                f"got {k} alphas for a bundle of {self._num_keys} keys")
+        k_pad = y0.shape[-1] * 32
         m_pad = y0.shape[1]
         alphas_p = np.pad(alphas, [(0, k_pad - k), (0, 0)])
         xs_p = np.pad(xs, [(0, m_pad - xs.shape[0]), (0, 0)])
         betas_p = np.pad(betas, [(0, k_pad - k), (0, 0)])
-        # pad keys compare x < 0 = false -> expected 0; pad keys' shares are
-        # real DCF shares of alpha=0 keys... their reconstruction equals
-        # f_{0,beta=0} = 0 everywhere, matching.  Pad points likewise use
-        # real evaluated shares vs their own expected value.
         beta_t = _to_bitmajor_planes(
             jnp.asarray(pack_lanes(np.ascontiguousarray(
                 byte_bits_lsb(betas_p).T))), self._perm)
+        valid = jnp.asarray(pack_lanes(
+            (np.arange(k_pad) < k).astype(np.uint8)[None]
+        ).view(np.int32))  # [1, Kw]
         return _relu_mismatch(
-            y0, y1, beta_t, jnp.asarray(alphas_p), jnp.asarray(xs_p))
+            y0, y1, beta_t, jnp.asarray(alphas_p), jnp.asarray(xs_p), valid)

@@ -288,18 +288,24 @@ def bench_secure_relu(args) -> None:
     xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
 
     if args.device_gen:
+        if args.backend != "cpu":
+            raise SystemExit(
+                "--device-gen is its own pipeline (DeviceKeyGen + Pallas "
+                "keylanes); it does not combine with --backend")
         from dcf_tpu.workloads import secure_relu_check_device
 
-        t0 = time.perf_counter()
-        with _Profiler(args.profile):
-            mism = secure_relu_check_device(
-                lam, ck, alphas, betas, s0s, xs)
-        dt = time.perf_counter() - t0
-        if mism:
-            raise SystemExit(f"secure_relu: {mism} reconstruction mismatches")
+        def run():
+            mism = secure_relu_check_device(lam, ck, alphas, betas, s0s, xs)
+            if mism:
+                raise SystemExit(
+                    f"secure_relu: {mism} reconstruction mismatches")
+
+        run()  # warmup (compile) + correctness
         log(f"on-device verification: 0 mismatches of {k * m}")
+        dt, mad, ss = _timed(run, args.reps, args.profile)
         _emit("secure_relu", "device-gen+pallas-keylanes", "evals_per_sec",
-              2 * k * m / dt, "evals/s (incl device keygen + verify)")
+              2 * k * m / dt, "evals/s (incl device keygen + verify)",
+              dt, mad, len(ss))
         return
 
     from dcf_tpu.native import NativeDcf

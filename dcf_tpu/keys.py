@@ -15,6 +15,25 @@ the HBM upload image for the eval kernels:
 (src/lib.rs:269-272).  Two codecs are provided: ``.npz`` (convenience) and a
 flat framed binary (``DCFK`` magic) that is the documented wire format the
 reference's unused bincode/serde deps gesture at (SURVEY.md §3.5).
+
+DCFK bytes on the wire (frozen; this is also the HBM upload image — the
+device backends consume these exact arrays, reinterpreted, without any
+re-serialization):
+
+    offset  size            field
+    0       4               magic ``b"DCFK"``
+    4       2               version (uint16 LE, currently 1)
+    6       2               P — parties stored (2 full bundle, 1 per-party)
+    8       4               K — number of keys (uint32 LE)
+    12      4               n — tree depth in bits = 8 * n_bytes (uint32 LE)
+    16      2               lam — range size in bytes (uint16 LE)
+    18      K*P*lam         s0s, C-order uint8
+    ...     K*n*lam         cw_s
+    ...     K*n*lam         cw_v
+    ...     K*n*2           cw_t (tl, tr per level)
+    ...     K*lam           cw_np1
+
+No padding or alignment between sections; total size must match exactly.
 """
 
 from __future__ import annotations

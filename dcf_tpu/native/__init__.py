@@ -22,7 +22,7 @@ from dcf_tpu.spec import Bound, hirose_used_cipher_indices
 __all__ = ["NativeDcf", "build", "load"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_LIB = None
+_LIBS: dict = {}  # portable-flag -> loaded CDLL (each variant opened once)
 
 
 def build(portable: bool = False) -> str:
@@ -42,16 +42,14 @@ def build(portable: bool = False) -> str:
 
 
 def load(portable: bool = False) -> ctypes.CDLL:
-    global _LIB
-    if _LIB is None or portable:
+    lib = _LIBS.get(portable)
+    if lib is None:
         lib = ctypes.CDLL(build(portable))
         lib.dcf_prg_sizeof.restype = ctypes.c_uint32
         lib.dcf_has_aesni.restype = ctypes.c_int
         lib.dcf_prg_init.restype = ctypes.c_int
-        if portable:
-            return lib
-        _LIB = lib
-    return _LIB
+        _LIBS[portable] = lib
+    return lib
 
 
 def _ptr(a: np.ndarray):

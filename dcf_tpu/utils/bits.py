@@ -21,6 +21,7 @@ __all__ = [
     "byte_bits_lsb",
     "byte_bits_msb",
     "planes_to_bytes",
+    "bits_lsb_to_bytes",
     "expand_bits_to_masks",
     "bitmajor_perm",
 ]

@@ -148,6 +148,35 @@ def test_bitsliced_eval_per_key_points_and_reference_keys():
         assert np.array_equal(got, want)
 
 
+@pytest.mark.slow
+def test_bitsliced_lambda_2048():
+    """lam=2048 (256 AES keys, 16384 planes): the multi-block plane assembly
+    well beyond the lam=144 regime — 1022 of 1024 half-blocks are the
+    never-encrypted Miyaguchi copies (reference src/prg.rs:48-62 zip quirk
+    at scale).  Slow-marked: ~1 min on one CPU core."""
+    from dcf_tpu.backends.jax_bitsliced import BitslicedBackend
+
+    rng = random.Random(54)
+    lam = 2048
+    ck = [rand_bytes(rng, 32) for _ in range(2 * (lam // 16))]
+    prg = HirosePrgNp(lam, ck)
+    nprng = np.random.default_rng(9)
+    bundle = gen_batch(
+        prg,
+        nprng.integers(0, 256, (1, 1), dtype=np.uint8),
+        nprng.integers(0, 256, (1, lam), dtype=np.uint8),
+        random_s0s(1, lam, nprng),
+        spec.Bound.LT_BETA,
+    )
+    xs = nprng.integers(0, 256, (4, 1), dtype=np.uint8)
+    be = BitslicedBackend(lam, ck)
+    y = {}
+    for b in (0, 1):
+        want = eval_batch_np(prg, b, bundle.for_party(b), xs)
+        y[b] = be.eval(b, xs, bundle=bundle.for_party(b))
+        assert np.array_equal(y[b], want), f"party {b}"
+
+
 def test_bitsliced_large_lambda():
     # lam=144: two encrypted block positions, plane assembly across blocks.
     from dcf_tpu.backends.jax_bitsliced import BitslicedBackend
