@@ -66,12 +66,17 @@ def main() -> None:
     bundle = native.gen_batch(alphas, betas, random_s0s(1, LAM, rng), Bound.LT_BETA)
     xs = rng.integers(0, 256, (M_TPU, N_BYTES), dtype=np.uint8)
 
-    # --- single-core CPU baseline (Rust stand-in) ---
-    t0 = time.perf_counter()
-    y_cpu = native.eval(0, bundle, xs[:M_CPU], num_threads=1)
-    cpu_s = time.perf_counter() - t0
+    # --- single-core CPU baseline (Rust stand-in); median of 3 samples so
+    # the vs_baseline denominator isn't one noisy measurement ---
+    cpu_samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        y_cpu = native.eval(0, bundle, xs[:M_CPU], num_threads=1)
+        cpu_samples.append(time.perf_counter() - t0)
+    cpu_s = float(np.median(cpu_samples))
     cpu_rate = M_CPU / cpu_s
-    log(f"cpu single-core: {M_CPU} pts in {cpu_s:.3f}s = {cpu_rate:,.0f} evals/s")
+    log(f"cpu single-core: {M_CPU} pts in {cpu_s:.3f}s (median of 3) = "
+        f"{cpu_rate:,.0f} evals/s")
 
     # --- accelerator backend: Pallas kernel, XLA bitsliced fallback ---
     import jax

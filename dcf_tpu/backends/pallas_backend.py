@@ -139,6 +139,13 @@ class PallasBackend:
             raise ValueError("no key bundle on device; call put_bundle first")
         return self._bundle_dev["s0"].shape[0], self._bundle_dev["cw_s"].shape[1]
 
+    def _prepare(self, xs: np.ndarray) -> tuple[np.ndarray, int, int]:
+        """Shared stage/eval preamble with one tile plan: returns
+        (xs padded+contiguous, m, tile words)."""
+        xs, _, m = prepare_batch(
+            self._dims(), xs, lambda m: 32 * self._plan_tiles(m)[1])
+        return xs, m, self._plan_tiles(m)[0]
+
     def _plan_tiles(self, m: int) -> tuple[int, int]:
         """Pick (tile words, padded total words) for an m-point batch.
 
@@ -166,17 +173,11 @@ class PallasBackend:
         reference bench's untimed xs setup
         (/root/reference/benches/dcf_batch_eval.rs:17-24).
         """
-        plan = {}
-
-        def m_pad(m):
-            plan["wt"], plan["w_pad"] = self._plan_tiles(m)
-            return 32 * plan["w_pad"]
-
-        xs, _, m = prepare_batch(self._dims(), xs, m_pad)
+        xs, m, wt = self._prepare(xs)
         if m == 0:
             raise ValueError("cannot stage an empty batch")
         x_mask = _stage_xs(jnp.asarray(xs))
-        return {"x_mask": x_mask, "m": m, "wt": plan["wt"]}
+        return {"x_mask": x_mask, "m": m, "wt": wt}
 
     def stage_range(self, start: int, count: int) -> dict:
         """Stage the consecutive points start..start+count-1 WITHOUT any
@@ -231,20 +232,14 @@ class PallasBackend:
         """
         if bundle is not None:
             self.put_bundle(bundle)
-        plan = {}
-
-        def m_pad(m):
-            plan["wt"], plan["w_pad"] = self._plan_tiles(m)
-            return 32 * plan["w_pad"]
-
-        xs, _, m = prepare_batch(self._dims(), xs, m_pad)
+        xs, m, wt = self._prepare(xs)
         dev = self._bundle_dev
         if m == 0:
             return np.zeros((dev["s0"].shape[0], 0, self.lam), dtype=np.uint8)
         y = _eval_bytes(
             self.rk, dev["s0"], dev["cw_s"], dev["cw_v"], dev["cw_np1"],
             dev["cw_t"], jnp.asarray(xs),
-            self._inv_perm, b=int(b), tile_words=plan["wt"],
+            self._inv_perm, b=int(b), tile_words=wt,
             interpret=self.interpret,
         )
         return np.asarray(y[:, :m, :])

@@ -75,20 +75,23 @@ def _parse_mesh(spec: str):
 
 
 def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
-    """Returns eval_fn(b, bundle_party, xs) -> uint8 [K, M, lam]."""
+    """Returns (eval_fn, backend_obj_or_None) where eval_fn(b, bundle_party,
+    xs) -> uint8 [K, M, lam].  The backend object is None for host paths;
+    benches use it to reach the staged protocol where one exists."""
     if backend in ("cpu", "cpu1"):
         threads = 1 if backend == "cpu1" else None
 
         def run(b, bundle, xs):
             return native.eval(b, bundle, xs, num_threads=threads)
 
-        return run
+        return run, None
     if backend == "numpy":
         from dcf_tpu.backends.numpy_backend import eval_batch_np
         from dcf_tpu.ops.prg import HirosePrgNp
 
         prg = HirosePrgNp(lam, cipher_keys)
-        return lambda b, bundle, xs: eval_batch_np(prg, b, bundle, xs)
+        return (lambda b, bundle, xs: eval_batch_np(prg, b, bundle, xs),
+                None)
     if backend == "jax":
         from dcf_tpu.backends.jax_backend import JaxBackend
 
@@ -115,7 +118,11 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
         be = ShardedJaxBackend(lam, cipher_keys, mesh)
     else:
         raise ValueError(f"unknown backend {backend!r}")
-    return lambda b, bundle, xs: be.eval(b, xs, bundle=bundle)
+
+    def run(b, bundle, xs):
+        return be.eval(b, xs, bundle=bundle)
+
+    return run, be
 
 
 class _Profiler:
@@ -201,7 +208,7 @@ def bench_dcf(args) -> None:
           gen_s, gen_mad, len(gs))
 
     bundle = native.gen_batch(alphas, betas, s0s, Bound.LT_BETA)
-    run = _make_evaluator(args.backend, lam, ck, native, args)
+    run, _ = _make_evaluator(args.backend, lam, ck, native, args)
     xs = rng.integers(0, 256, (1, nb), dtype=np.uint8)
     k0 = bundle.for_party(0)
     run(0, k0, xs)  # warmup / compile
@@ -226,16 +233,46 @@ def bench_batch(args) -> None:
         Bound.LT_BETA,
     )
     xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
-    run = _make_evaluator(args.backend, lam, ck, native, args)
+    run, be = _make_evaluator(args.backend, lam, ck, native, args)
     k0 = bundle.for_party(0)
     y = run(0, k0, xs)  # warmup / compile
     if args.check:
         want = native.eval(0, bundle, xs[:2048])
         assert np.array_equal(y[0, :2048], want[0]), "parity mismatch vs C++"
         log("parity vs C++ core: OK (first 2048 pts)")
-    dt, mad, ss = _timed(lambda: run(0, k0, xs), args.reps, args.profile)
-    _emit("dcf_batch_eval", args.backend, "evals_per_sec", m / dt, "evals/s",
-          dt, mad, len(ss))
+    if be is not None and hasattr(be, "stage"):
+        # Staged protocol (bench.py methodology): xs conversion + transfer
+        # happen outside the timed region, like criterion's untimed setup
+        # (/root/reference/benches/dcf_batch_eval.rs:17-24); results stay in
+        # HBM where a secure-computation consumer reads them.  Completion is
+        # forced by a digest fetch (block_until_ready doesn't block on the
+        # tunneled dev device).
+        import jax
+        import jax.numpy as jnp
+
+        staged = be.stage(xs)
+
+        def sync(y):
+            np.asarray(jnp.max(jax.lax.bitcast_convert_type(
+                y.reshape(-1)[-8:], jnp.int32)))
+
+        y = be.eval_staged(0, staged)
+        sync(y)  # staged-path warmup
+        iters = 4  # dispatches per sample: amortizes the ~85ms tunnel sync
+
+        def timed():
+            for _ in range(iters):
+                y = be.eval_staged(0, staged)
+            sync(y)
+
+        unit = "evals/s (staged, results HBM-resident)"
+    else:
+        iters = 1
+        timed = lambda: run(0, k0, xs)  # noqa: E731
+        unit = "evals/s"
+    dt, mad, ss = _timed(timed, args.reps, args.profile)
+    _emit("dcf_batch_eval", args.backend, "evals_per_sec",
+          m * iters / dt, unit, dt / iters, mad / iters, len(ss))
 
 
 def bench_large_lambda(args) -> None:
@@ -257,7 +294,7 @@ def bench_large_lambda(args) -> None:
         Bound.LT_BETA,
     )
     xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
-    run = _make_evaluator(args.backend, lam, ck, native, args)
+    run, _ = _make_evaluator(args.backend, lam, ck, native, args)
     k0 = bundle.for_party(0)
     y = run(0, k0, xs)  # warmup / compile
     if args.check:
@@ -384,7 +421,7 @@ def bench_full_domain(args) -> None:
             if mism:
                 raise SystemExit(f"full_domain: {mism} mismatches")
     else:
-        run0 = _make_evaluator(args.backend, lam, ck, native, args)
+        run0, _ = _make_evaluator(args.backend, lam, ck, native, args)
         k0 = bundle.for_party(0)
         k1 = bundle.for_party(1)
 
@@ -400,6 +437,34 @@ def bench_full_domain(args) -> None:
     dt, mad, ss = _timed(run, args.reps, args.profile)
     _emit("full_domain", args.backend, "evals_per_sec",
           2 * (1 << n_bits) / dt, "evals/s", dt, mad, len(ss))
+
+
+def bench_baseline(args) -> None:
+    """All five BASELINE.json configs in one run, one JSON line each.
+
+    Per-config backend = the measured winner on this hardware (accelerator
+    for configs 1-3 and 5, CPU for the HBM-copy-bound large-lambda).
+    secure_relu defaults to 2^18 keys here to keep the report minutes-long;
+    pass --keys=1000000 for the full config-5 scale (the 10^6 artifact
+    lives in benchmarks/RESULTS_r02.jsonl).
+    """
+    import copy
+
+    specs = [
+        ("dcf", dict(backend="cpu")),
+        ("dcf_batch_eval", dict(backend="pallas", points=1 << 20)),
+        ("full_domain", dict(backend="pallas", n_bits=24)),
+        ("dcf_large_lambda", dict(backend="cpu", points=10_000)),
+        ("secure_relu", dict(backend="cpu", device_gen=True,
+                             keys=args.keys or 1 << 18,
+                             points=args.points or 1_024)),
+    ]
+    for i, (name, over) in enumerate(specs, 1):
+        log(f"--- BASELINE config {i}: {name} {over} ---")
+        a = copy.copy(args)
+        for key, val in over.items():
+            setattr(a, key, val)
+        BENCHES[name](a)
 
 
 BENCHES = {
@@ -436,7 +501,7 @@ def main(argv=None) -> None:
         prog="python -m dcf_tpu.cli",
         description="DCF benchmark CLI (reference criterion-bench analogs)",
     )
-    p.add_argument("bench", choices=(*BENCHES, "all"))
+    p.add_argument("bench", choices=(*BENCHES, "all", "baseline"))
     p.add_argument("--backend", default="cpu", choices=BACKENDS)
     p.add_argument("--points", type=int, default=0,
                    help="batch size (0 = bench default)")
@@ -455,6 +520,9 @@ def main(argv=None) -> None:
     p.add_argument("--device-gen", action="store_true",
                    help="secure_relu: device keygen + pallas keylanes path")
     args = p.parse_args(argv)
+    if args.bench == "baseline":
+        bench_baseline(args)
+        return
     for name in BENCHES if args.bench == "all" else [args.bench]:
         if args.bench == "all" and name == "dcf_large_lambda" and \
                 args.backend in ("pallas", "sharded"):

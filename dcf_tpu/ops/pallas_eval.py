@@ -101,8 +101,9 @@ def _kernel(rk_ref, s0_ref, cw_s_ref, cw_v_ref, cw_np1_ref, cw_t_ref, xm_ref,
         ctl = cw_t_ref[0, i, 0]
         ctr = cw_t_ref[0, i, 1]
         gate = t  # [1, wt], broadcasts over planes
-        s_l = s_l ^ (cs & gate)
-        s_r = s_r ^ (cs & gate)
+        csg = cs & gate  # materialized once: both children consume it
+        s_l = s_l ^ csg
+        s_r = s_r ^ csg
         t_l = t_l ^ (t & ctl)
         t_r = t_r ^ (t & ctr)
 
