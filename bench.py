@@ -44,7 +44,6 @@ M_TPU = 1 << 20  # accelerator batch (points)
 M_CPU = 1 << 13  # single-core baseline batch (scaled up to a rate)
 M_PARITY = 4096  # bit-exact check subset
 SAMPLES = 10
-ITERS = 4  # evals per timed sample (amortizes the ~85ms tunnel sync RTT)
 
 
 def log(msg: str) -> None:
@@ -80,15 +79,12 @@ def main() -> None:
 
     # --- accelerator backend: Pallas kernel, XLA bitsliced fallback ---
     import jax
-    import jax.numpy as jnp
+
+    from dcf_tpu.utils.benchtime import DISPATCHES_PER_SAMPLE as ITERS
+    from dcf_tpu.utils.benchtime import device_sync as sync
 
     dev = jax.devices()[0]
     log(f"jax device: {dev.platform} {getattr(dev, 'device_kind', '')}")
-
-    def sync(y) -> None:
-        # Tiny fetch that depends on all of y; forces execution through the
-        # async tunnel (block_until_ready returns before compute finishes).
-        np.asarray(jnp.max(jax.lax.bitcast_convert_type(y[..., -1:], jnp.int32)))
 
     party_bundle = bundle.for_party(0)
 

@@ -244,26 +244,18 @@ def bench_batch(args) -> None:
         # Staged protocol (bench.py methodology): xs conversion + transfer
         # happen outside the timed region, like criterion's untimed setup
         # (/root/reference/benches/dcf_batch_eval.rs:17-24); results stay in
-        # HBM where a secure-computation consumer reads them.  Completion is
-        # forced by a digest fetch (block_until_ready doesn't block on the
-        # tunneled dev device).
-        import jax
-        import jax.numpy as jnp
+        # HBM where a secure-computation consumer reads them.
+        from dcf_tpu.utils.benchtime import DISPATCHES_PER_SAMPLE, device_sync
 
         staged = be.stage(xs)
-
-        def sync(y):
-            np.asarray(jnp.max(jax.lax.bitcast_convert_type(
-                y.reshape(-1)[-8:], jnp.int32)))
-
         y = be.eval_staged(0, staged)
-        sync(y)  # staged-path warmup
-        iters = 4  # dispatches per sample: amortizes the ~85ms tunnel sync
+        device_sync(y)  # staged-path warmup
+        iters = DISPATCHES_PER_SAMPLE
 
         def timed():
             for _ in range(iters):
                 y = be.eval_staged(0, staged)
-            sync(y)
+            device_sync(y)
 
         unit = "evals/s (staged, results HBM-resident)"
     else:

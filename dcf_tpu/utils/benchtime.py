@@ -1,0 +1,27 @@
+"""Shared timing constants/helpers for the accelerator benches.
+
+One digest-fetch sync costs a ~85ms round-trip on the tunneled dev device
+(``block_until_ready`` does not block there), so timed samples dispatch
+DISPATCHES_PER_SAMPLE evals and sync once; bench.py and the CLI share the
+value so their methodologies cannot drift.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["DISPATCHES_PER_SAMPLE", "device_sync"]
+
+# ~5ms of amortized sync against ~1.6s of kernel time at the flagship shape.
+DISPATCHES_PER_SAMPLE = 16
+
+
+def device_sync(y) -> None:
+    """Tiny fetch depending on (the tail of) y; forces execution through
+    the async tunnel.  In-order dispatch means the last output's readiness
+    implies all prior dispatches completed."""
+    import jax
+    import jax.numpy as jnp
+
+    np.asarray(jnp.max(jax.lax.bitcast_convert_type(
+        y.reshape(-1)[-8:], jnp.int32)))
