@@ -27,7 +27,7 @@ from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes_bitsliced import round_key_masks_bitmajor
 from dcf_tpu.ops.pallas_eval import DEFAULT_TILE_WORDS, dcf_eval_pallas
 from dcf_tpu.spec import hirose_used_cipher_indices
-from dcf_tpu.utils.bits import bitmajor_perm, byte_bits_lsb, expand_bits_to_masks
+from dcf_tpu.utils.bits import bitmajor_perm, bitmajor_plane_masks
 
 __all__ = ["PallasBackend"]
 
@@ -115,15 +115,11 @@ class PallasBackend:
         if bundle.s0s.shape[1] != 1:
             raise ValueError("put_bundle requires a party-restricted bundle")
 
-        def plane_masks(a):  # uint8 [..., lam] -> int32 masks [..., 128]
-            bits = byte_bits_lsb(a)[..., _PERM]
-            return expand_bits_to_masks(bits).view(np.int32)
-
         def keyed(a):  # [K, lam] -> [K, 128, 1]
-            return jnp.asarray(plane_masks(a)[:, :, None])
+            return jnp.asarray(bitmajor_plane_masks(a)[:, :, None])
 
         def leveled(a):  # [K, n, lam] -> [K, n, 128, 1]
-            return jnp.asarray(plane_masks(a)[:, :, :, None])
+            return jnp.asarray(bitmajor_plane_masks(a)[:, :, :, None])
 
         self._bundle_dev = dict(
             s0=keyed(bundle.s0s[:, 0, :]),
@@ -202,9 +198,8 @@ class PallasBackend:
         parties over points start..start+32*W-1 (single key).  Returns a
         DEVICE int32 scalar so chunked callers can accumulate without a
         host round-trip per chunk."""
-        bits = byte_bits_lsb(np.frombuffer(beta, dtype=np.uint8))[_PERM]
-        beta_mask = jnp.asarray(
-            expand_bits_to_masks(bits).view(np.int32)[:, None])
+        beta_mask = jnp.asarray(bitmajor_plane_masks(
+            np.frombuffer(beta, dtype=np.uint8))[:, None])
         return _fd_mismatch_bitmajor(
             y0, y1, beta_mask, jnp.uint32(start), jnp.uint32(alpha), gt=gt)
 

@@ -398,7 +398,24 @@ def bench_full_domain(args) -> None:
         Bound.LT_BETA,
     )
     chunk = min(1 << 20, 1 << n_bits)
-    if args.backend in ("pallas", "bitsliced"):
+    per_run_checks = 1
+    if args.backend == "tree":
+        # Device-accumulated counters, fetched once per sample — the same
+        # sync-amortization methodology as the staged batch bench.
+        from dcf_tpu.backends.fulldomain import TreeFullDomain
+        from dcf_tpu.utils.benchtime import DISPATCHES_PER_SAMPLE
+
+        import jax.numpy as jnp
+
+        fd = TreeFullDomain(lam, ck)
+        per_run_checks = DISPATCHES_PER_SAMPLE
+
+        def run():
+            counters = [fd.check_device(bundle, alpha, beta, n_bits)
+                        for _ in range(per_run_checks)]
+            if int(jnp.sum(jnp.stack(counters))):
+                raise SystemExit("full_domain: reconstruction mismatches")
+    elif args.backend in ("pallas", "bitsliced"):
         if args.backend == "pallas":
             from dcf_tpu.backends.pallas_backend import PallasBackend as B
         else:
@@ -427,6 +444,7 @@ def bench_full_domain(args) -> None:
     run()  # warmup / compile + correctness
     log(f"full domain 2^{n_bits}: 0 mismatches")
     dt, mad, ss = _timed(run, args.reps, args.profile)
+    dt, mad = dt / per_run_checks, mad / per_run_checks
     _emit("full_domain", args.backend, "evals_per_sec",
           2 * (1 << n_bits) / dt, "evals/s", dt, mad, len(ss))
 
@@ -445,7 +463,7 @@ def bench_baseline(args) -> None:
     specs = [
         ("dcf", dict(backend="cpu")),
         ("dcf_batch_eval", dict(backend="pallas", points=1 << 20)),
-        ("full_domain", dict(backend="pallas", n_bits=24)),
+        ("full_domain", dict(backend="tree", n_bits=24)),
         ("dcf_large_lambda", dict(backend="cpu", points=10_000)),
         ("secure_relu", dict(backend="cpu", device_gen=True,
                              keys=args.keys or 1 << 18,
@@ -494,7 +512,8 @@ def main(argv=None) -> None:
         description="DCF benchmark CLI (reference criterion-bench analogs)",
     )
     p.add_argument("bench", choices=(*BENCHES, "all", "baseline"))
-    p.add_argument("--backend", default="cpu", choices=BACKENDS)
+    p.add_argument("--backend", default="cpu", choices=(*BACKENDS, "tree"),
+                   help="'tree' (full_domain only): GGM tree expansion")
     p.add_argument("--points", type=int, default=0,
                    help="batch size (0 = bench default)")
     p.add_argument("--keys", type=int, default=0,
@@ -512,6 +531,11 @@ def main(argv=None) -> None:
     p.add_argument("--device-gen", action="store_true",
                    help="secure_relu: device keygen + pallas keylanes path")
     args = p.parse_args(argv)
+    if args.backend == "tree" and args.bench not in ("full_domain",
+                                                     "baseline"):
+        raise SystemExit(
+            "--backend=tree is the full-domain tree evaluator; it only "
+            "applies to the full_domain bench (and baseline)")
     if args.bench == "baseline":
         bench_baseline(args)
         return

@@ -1,0 +1,129 @@
+"""Pallas TPU kernel for breadth-first (tree) full-domain DCF evaluation.
+
+The walk backends evaluate each point's n-level path independently — for
+the full domain that is n * 2^n PRG calls.  But the 2^n evaluation paths
+form the GGM tree: expanding the tree level by level costs only
+sum_i 2^i ≈ 2^{n+1} PRG calls, ~n/2 x less work (the classic FSS
+full-domain-eval optimization; the reference crate has no analog and
+would pay the full walk cost, src/lib.rs:163-204).
+
+One kernel application = one level: a tile of parent nodes (packed 32 per
+uint32 lane word, bit-major planes like ops.pallas_eval) expands into its
+left- and right-child tiles with the correction word applied and the
+value accumulator pushed down both branches:
+
+    v_child = v_parent ^ v_hat_dir ^ (t_parent & cw_v)      (lib.rs:181-189)
+    s/t children per lib.rs:177-180
+
+Levels double the arrays as [all-left-children ; all-right-children], so
+leaf array position p holds domain point bitreverse_n(p) — consumers
+account for it arithmetically (dcf_tpu.backends.fulldomain).
+
+The top of the tree (< 2^k0 nodes) is host-expanded (tiny and irregular);
+the device runs levels k0..n-1, which hold ~100% of the work.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dcf_tpu.ops.aes_bitsliced import (
+    aes256_encrypt_planes_bitmajor,
+    aes_walk_cipher_v3,
+    prep_rk_bitmajor_v3,
+)
+
+__all__ = ["tree_expand_device"]
+
+
+def _expand_kernel(rk_ref, cs_ref, cv_ref, ct_ref, s_ref, v_ref, t_ref,
+                   sl_o, vl_o, tl_o, sr_o, vr_o, tr_o, *, interpret: bool):
+    ones = jnp.int32(-1)
+    rk = rk_ref[:]
+    if interpret:
+        def aes(state):
+            return aes256_encrypt_planes_bitmajor(jnp, rk, state, ones)
+    else:
+        rk_p = prep_rk_bitmajor_v3(jnp, rk)
+
+        def aes(state):
+            return aes_walk_cipher_v3(jnp, rk_p, state, ones)
+
+    plane_idx = jax.lax.broadcasted_iota(jnp.int32, (128, 1), 0)
+    lbm = jnp.where(plane_idx == 15, jnp.int32(0), ones)
+
+    wt = s_ref.shape[1]
+    s = s_ref[:]
+    v = v_ref[:]
+    t = t_ref[:]  # [1, wt]
+    sp = s ^ ones
+    enc = aes(jnp.concatenate([s, sp], axis=1))
+    sl_raw = enc[:, :wt] ^ s
+    vl_raw = enc[:, wt:] ^ sp
+    t_l = sl_raw[0:1, :]
+    t_r = vl_raw[0:1, :]
+    csg = cs_ref[:] & t
+    cvg = cv_ref[:] & t
+    sl_o[:] = (sl_raw & lbm) ^ csg
+    sr_o[:] = (s & lbm) ^ csg
+    tl_o[:] = t_l ^ (t & ct_ref[0])
+    tr_o[:] = t_r ^ (t & ct_ref[1])
+    vl_o[:] = v ^ (vl_raw & lbm) ^ cvg
+    vr_o[:] = v ^ (sp & lbm) ^ cvg
+
+
+def _expand_level(rk, cs, cv, ct, s, v, t, *, interpret: bool):
+    """One tree level: [128, W] parents -> six [.., W] child halves."""
+    w = s.shape[1]
+    wt = min(128, w)
+    grid = (w // wt,)
+    state_spec = pl.BlockSpec((128, wt), lambda j: (0, j))
+    t_spec = pl.BlockSpec((1, wt), lambda j: (0, j))
+    return pl.pallas_call(
+        partial(_expand_kernel, interpret=interpret),
+        out_shape=(
+            jax.ShapeDtypeStruct((128, w), jnp.int32),
+            jax.ShapeDtypeStruct((128, w), jnp.int32),
+            jax.ShapeDtypeStruct((1, w), jnp.int32),
+            jax.ShapeDtypeStruct((128, w), jnp.int32),
+            jax.ShapeDtypeStruct((128, w), jnp.int32),
+            jax.ShapeDtypeStruct((1, w), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((15, 128, 1), lambda j: (0, 0, 0)),
+            pl.BlockSpec((128, 1), lambda j: (0, 0)),
+            pl.BlockSpec((128, 1), lambda j: (0, 0)),
+            pl.BlockSpec((2,), lambda j: (0,), memory_space=pltpu.SMEM),
+            state_spec, state_spec, t_spec,
+        ],
+        out_specs=(state_spec, state_spec, t_spec,
+                   state_spec, state_spec, t_spec),
+        interpret=interpret,
+    )(rk, cs, cv, ct, s, v, t)
+
+
+@partial(jax.jit, static_argnames=("k0", "n", "interpret"))
+def tree_expand_device(rk, cw_s_t, cw_v_t, cw_t_pm, cw_np1_t, s, v, t,
+                       k0: int, n: int, interpret: bool = False):
+    """Expand levels k0..n-1 and finalize leaves.
+
+    rk int32 [15, 128, 1]; cw_s_t/cw_v_t int32 [n, 128, 1] bit-major CW
+    plane masks; cw_t_pm int32 [n, 2] (0/-1); cw_np1_t int32 [128, 1];
+    s/v int32 [128, 2^k0 / 32], t int32 [1, 2^k0 / 32] — the level-k0
+    state in leaf order (position = bitreverse of the k0-bit prefix).
+    Returns y planes int32 [128, 2^n / 32], leaf order bitreverse_n.
+    """
+    for i in range(k0, n):
+        s_l, v_l, t_l, s_r, v_r, t_r = _expand_level(
+            rk, cw_s_t[i], cw_v_t[i], cw_t_pm[i], s, v, t,
+            interpret=interpret)
+        s = jnp.concatenate([s_l, s_r], axis=1)
+        v = jnp.concatenate([v_l, v_r], axis=1)
+        t = jnp.concatenate([t_l, t_r], axis=1)
+    return v ^ s ^ (cw_np1_t & t)

@@ -24,6 +24,7 @@ __all__ = [
     "bits_lsb_to_bytes",
     "expand_bits_to_masks",
     "bitmajor_perm",
+    "bitmajor_plane_masks",
 ]
 
 _SHIFTS32 = np.arange(32, dtype=np.uint32)
@@ -83,6 +84,18 @@ def bits_lsb_to_bytes(bits: np.ndarray) -> np.ndarray:
 def expand_bits_to_masks(bits: np.ndarray) -> np.ndarray:
     """{0,1} array -> uint32 masks (0 or 0xFFFFFFFF), same shape."""
     return (bits.astype(np.uint32) * np.uint32(0xFFFFFFFF)).astype(np.uint32)
+
+
+def bitmajor_plane_masks(a: np.ndarray) -> np.ndarray:
+    """uint8 [..., 16] -> int32 bit-major plane masks [..., 128] (0 / -1).
+
+    The staging step shared by every bit-major device backend (lam = 16):
+    LSB-first bit planes, reordered to p' = bit*16 + byte, expanded to
+    full/zero lane masks."""
+    if a.shape[-1] != 16:
+        raise ValueError("bit-major plane masks are lam=16 only")
+    bits = byte_bits_lsb(a)[..., bitmajor_perm(16)]
+    return expand_bits_to_masks(bits).view(np.int32)
 
 
 def bitmajor_perm(lam: int) -> np.ndarray:

@@ -1,0 +1,87 @@
+"""Tree full-domain evaluator: host oracle + device kernel parity
+(interpret mode on CPU; same code is the Mosaic kernel on TPU)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from dcf_tpu import spec
+from dcf_tpu.backends.fulldomain import (
+    TreeFullDomain,
+    _finalize_np,
+    tree_expand_np,
+)
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.gen import gen_batch, random_s0s
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.workloads import domain_points
+
+
+def rand_bytes(rng, n):
+    return bytes(rng.getrandbits(8) for _ in range(n))
+
+
+def _bitrev(x: int, n: int) -> int:
+    return int(bin(x)[2:].zfill(n)[::-1], 2)
+
+
+def _setup(seed, alpha_bytes, bound=spec.Bound.LT_BETA):
+    rng = random.Random(seed)
+    ck = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    prg = HirosePrgNp(16, ck)
+    nprng = np.random.default_rng(seed)
+    beta = rand_bytes(rng, 16)
+    bundle = gen_batch(
+        prg,
+        np.frombuffer(alpha_bytes, dtype=np.uint8)[None],
+        np.frombuffer(beta, dtype=np.uint8)[None],
+        random_s0s(1, 16, nprng),
+        bound,
+    )
+    return ck, prg, beta, bundle
+
+
+def test_tree_expand_np_matches_pointwise_walk():
+    """Host breadth-first leaves == the per-point numpy walk, with the
+    bitreverse position mapping."""
+    n_bits = 16
+    ck, prg, beta, bundle = _setup(91, (0x2A7).to_bytes(2, "big"))
+    for b in (0, 1):
+        kb = bundle.for_party(b)
+        s, v, t = tree_expand_np(prg, kb, b, n_bits)
+        leaves = _finalize_np(kb, s, v, t)  # [2^16, 16] bitrev order
+        xs = domain_points(2, 0, 256)  # spot-check first 256 domain points
+        want = eval_batch_np(prg, b, kb, xs)[0]
+        pos = np.array([_bitrev(x, n_bits) for x in range(256)])
+        assert np.array_equal(leaves[pos], want), f"party {b}"
+
+
+@pytest.mark.parametrize("gt", [False, True])
+def test_tree_fulldomain_check_interpret(gt):
+    alpha = 0x51C3
+    ck, prg, beta, bundle = _setup(
+        92, alpha.to_bytes(2, "big"),
+        spec.Bound.GT_BETA if gt else spec.Bound.LT_BETA)
+    fd = TreeFullDomain(16, ck, host_levels=8, interpret=True)
+    assert fd.check(bundle, alpha, beta, n_bits=16, gt=gt) == 0
+    # negative control: a shifted alpha flips exactly that many leaves
+    assert fd.check(bundle, alpha + 7, beta, n_bits=16, gt=gt) == 7
+
+
+def test_tree_device_matches_host_expansion():
+    """Device pyramid leaves == the pure-host expansion, leaf for leaf."""
+    alpha = 0xBE11
+    ck, prg, beta, bundle = _setup(93, alpha.to_bytes(2, "big"))
+    fd = TreeFullDomain(16, ck, host_levels=8, interpret=True)
+    from dcf_tpu.utils.bits import bitmajor_perm, bits_lsb_to_bytes, unpack_lanes
+
+    inv = np.argsort(bitmajor_perm(16))
+    for b in (0, 1):
+        kb = bundle.for_party(b)
+        y = np.asarray(fd.eval_party(b, kb, 16))  # int32 [128, 2^11]
+        got = bits_lsb_to_bytes(
+            unpack_lanes(y.view(np.uint32)[inv]).T)  # [2^16, 16]
+        s, v, t = tree_expand_np(prg, kb, b, 16)
+        want = _finalize_np(kb, s, v, t)
+        assert np.array_equal(got, want), f"party {b}"
