@@ -1,0 +1,296 @@
+"""Hybrid large-lambda evaluator: narrow walk + GF(2) affine wide part.
+
+For lam >= 48 the Hirose PRG's truncated encryption loop
+(reference src/prg.rs:48-56, the zip quirk) means every 16-byte block
+beyond the first two is a structural COPY of the seed / its complement:
+no AES ever touches it.  Consequently the walk state beyond byte 32
+evolves affinely in the per-level control bits — the input x enters only
+through the t-bit trajectory:
+
+    s_{i+1}[wide] = mask(s_i[wide]) ^ t_i * cw_s_i[wide]
+    v      [wide]+= mask(~s_i[wide]) ^ t_i * cw_v_i[wide]   (dir-independent!)
+
+(v-hat's wide blocks are identical for both children because both get the
+seed_p feed-forward, src/prg.rs:57-62; mask clears the global 8*lam-1 bit,
+a linear map.)  So
+
+    y[32:] = const_b ^ XOR_k t_k * W[k]          -- a GF(2) matrix product
+
+with t_0 = b and t_n gating cw_np1.  The full evaluation becomes:
+
+  1. a NARROW 32-byte walk — bit-identical to lam=32 (same cipher indices
+     0/17, same Hirose wiring) minus the final-bit masking (the big PRG's
+     masked byte is wide) — which yields y[:32] and the t trajectory;
+  2. an (n+1) x 8*(lam-32) GF(2) matmul, computed on the MXU as an int8
+     dot with parity extraction.
+
+Per point this replaces n * lam bytes of plane algebra with a ~lam=32
+walk plus a matmul — the regime where the plane-materializing paths lost
+to the CPU (benchmarks/RESULTS_r02.jsonl, dcf_large_lambda).
+
+The affine matrix is derived by basis probing (run the wide recursion on
+unit t-vectors), so no hand-derived coefficient formula can rot.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcf_tpu.backends.jax_bitsliced import (
+    _pack_lanes_dev,
+    _planes_to_bytes_dev,
+    _xs_to_mask_dev,
+    prg_planes,
+)
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.ops.aes_bitsliced import round_key_masks
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.spec import hirose_used_cipher_indices
+from dcf_tpu.utils.bits import byte_bits_lsb
+
+__all__ = ["LargeLambdaBackend", "wide_affine_np", "narrow_walk_np"]
+
+NARROW = 32  # bytes covered by the real (encrypted) blocks
+
+
+def _clear_masked(a: np.ndarray, lam: int) -> np.ndarray:
+    """Clear the global 8*lam-1 bit if it lies in this wide slice
+    (byte lam-1, i.e. wide index lam-1-NARROW; it always does for
+    lam > NARROW)."""
+    a = a.copy()
+    a[..., lam - 1 - NARROW] &= np.uint8(0xFE)
+    return a
+
+
+def wide_affine_np(bundle: KeyBundle):
+    """Affine decomposition of the wide output.
+
+    bundle: party-restricted, lam > 32.  Returns (const [lam-32],
+    w [n+1, lam-32]) uint8 such that y[32:] = const ^ XOR_k t_k * w[k],
+    where t_k is the control bit GATING level k (t_0 = the party bit) and
+    t_n the final bit gating cw_np1.  The party enters only through the
+    trajectory, so const/w are party-independent.  Derived by running the
+    wide recursion on the zero trajectory and the n+1 unit trajectories
+    at once.
+    """
+    lam, n = bundle.lam, bundle.n_bits
+    if lam <= NARROW:
+        raise ValueError("wide part needs lam > 32")
+    s0w = bundle.s0s[0, 0, NARROW:]
+    cw_s_w = bundle.cw_s[0, :, NARROW:]
+    cw_v_w = bundle.cw_v[0, :, NARROW:]
+    np1w = bundle.cw_np1[0, NARROW:]
+
+    nb = n + 2  # basis: [zero, e_0 .. e_n]
+    t_basis = np.zeros((nb, n + 1), dtype=np.uint8)
+    t_basis[1:] = np.eye(n + 1, dtype=np.uint8)
+    s = np.broadcast_to(s0w, (nb, lam - NARROW)).copy()
+    v = np.zeros((nb, lam - NARROW), dtype=np.uint8)
+    for i in range(n):
+        gate = t_basis[:, i][:, None]
+        v ^= _clear_masked(s ^ 0xFF, lam) ^ cw_v_w[i] * gate
+        s = _clear_masked(s, lam) ^ cw_s_w[i] * gate
+    y = v ^ s ^ np1w * t_basis[:, n][:, None]
+    const = y[0]
+    return const, y[1:] ^ const
+
+
+def narrow_walk_np(cipher_keys: Sequence[bytes], bundle: KeyBundle, b: int,
+                   xs: np.ndarray):
+    """Host oracle for the narrow walk: y32 [M, 32] and the t trajectory
+    [M, n+1] (t[:, 0] = b; t[:, k] gates level k; t[:, n] gates cw_np1).
+
+    bundle: party-restricted with FULL lam (sliced to 32 bytes here).
+    """
+    n = bundle.n_bits
+    prg = HirosePrgNp(NARROW, cipher_keys, mask=False)
+    m = xs.shape[0]
+    s = np.broadcast_to(bundle.s0s[0, 0, :NARROW], (m, NARROW)).copy()
+    t = np.full(m, b, dtype=np.uint8)
+    v = np.zeros((m, NARROW), dtype=np.uint8)
+    traj = np.empty((m, n + 1), dtype=np.uint8)
+    bits = np.unpackbits(xs, axis=1)  # MSB-first walk order
+    for i in range(n):
+        traj[:, i] = t
+        p = prg.gen(s)
+        cs = bundle.cw_s[0, i, :NARROW]
+        cv = bundle.cw_v[0, i, :NARROW]
+        ctl, ctr = bundle.cw_t[0, i]
+        tc = t[:, None]
+        xm = bits[:, i].astype(bool)
+        v ^= np.where(xm[:, None], p.v_r, p.v_l) ^ cv * tc
+        s = np.where(xm[:, None], p.s_r, p.s_l) ^ cs * tc
+        t = np.where(xm, p.t_r, p.t_l) ^ (t & np.where(xm, ctr, ctl))
+    traj[:, n] = t
+    y32 = v ^ s ^ bundle.cw_np1[0, :NARROW] * t[:, None]
+    return y32, traj
+
+
+# ---------------------------------------------------------------------------
+# Device path: narrow bitsliced walk with trajectory capture + MXU matmul.
+# ---------------------------------------------------------------------------
+
+
+def _narrow_core(rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
+                 x_mask, b: int):
+    """eval_core_bitsliced at lam=32 with NO masking, also returning the
+    packed t trajectory [n+1, K, W]."""
+    ones = jnp.uint32(0xFFFFFFFF)
+    p = 8 * NARROW
+    kx, w = x_mask.shape[1], x_mask.shape[2]
+    k_num = s0_pl.shape[1]
+
+    s = jnp.broadcast_to(s0_pl[:, :, None], (p, k_num, 1)) ^ jnp.zeros(
+        (p, k_num, w), jnp.uint32)
+    t = jnp.full((k_num, w), ones if b else jnp.uint32(0))
+    v = jnp.zeros((p, k_num, w), jnp.uint32)
+    no_mask = jnp.full(p, ones)
+
+    def body(carry, level):
+        s, t, v = carry
+        cs, cv, ctl, ctr, xm = level
+        s_l, v_l, t_l, s_r, v_r, t_r = prg_planes(
+            rk_masks, no_mask, NARROW, s, ones)
+        gate = t[None, :, :]
+        s_l = s_l ^ (cs[:, None, None] & gate)
+        s_r = s_r ^ (cs[:, None, None] & gate)
+        t_l = t_l ^ (t & ctl)
+        t_r = t_r ^ (t & ctr)
+        xm_e = xm[None, :, :]
+        v2 = v ^ (v_r & xm_e) ^ (v_l & (xm_e ^ ones)) ^ (cv[:, None, None] & gate)
+        s2 = (s_r & xm_e) | (s_l & (xm_e ^ ones))
+        t2 = (t_r & xm) | (t_l & (xm ^ ones))
+        return (s2, t2, v2), t  # emit the GATE t of this level
+
+    (s, t, v), traj = jax.lax.scan(
+        body, (s, t, v), (cw_s_pl, cw_v_pl, cw_tl, cw_tr, x_mask))
+    y = v ^ s ^ (cw_np1_pl[:, None, None] & t[None, :, :])
+    traj = jnp.concatenate([traj, t[None]], axis=0)  # + final t
+    return y, traj
+
+
+@partial(jax.jit, static_argnames=("b", "col_chunk"))
+def _hybrid_eval(rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
+                 wide_const, wide_w8, xs, b: int, col_chunk: int):
+    """Full device program: narrow walk + wide MXU matmul -> uint8 bytes.
+
+    wide_const: uint8 [lam-32]; wide_w8: int8 {0,1} [n+1, 8*(lam-32)].
+    Returns uint8 [1, M, lam].
+    """
+    x_mask = _xs_to_mask_dev(xs)
+    y32_pl, traj = _narrow_core(
+        rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
+        x_mask, b)
+    y32 = _planes_to_bytes_dev(y32_pl, NARROW)  # [1, M, 32]
+    m = y32.shape[1]
+    # trajectory planes [n+1, 1, W] -> int8 bits [M, n+1]
+    tb = (traj[:, 0, :, None] >> jnp.arange(32, dtype=jnp.uint32)) \
+        & jnp.uint32(1)
+    t_bits = tb.reshape(traj.shape[0], -1).T.astype(jnp.int8)  # [M, n+1]
+    cols = wide_w8.shape[1]
+    outs = []
+    for c0 in range(0, cols, col_chunk):
+        w_c = jax.lax.dynamic_slice_in_dim(
+            wide_w8, c0, min(col_chunk, cols - c0), 1)
+        acc = jax.lax.dot(t_bits, w_c,
+                          preferred_element_type=jnp.int32)  # [M, cc]
+        bits = (acc & 1).astype(jnp.uint8)
+        by = bits.reshape(m, -1, 8)
+        outs.append(jnp.sum(by << jnp.arange(8, dtype=jnp.uint8), axis=-1,
+                            dtype=jnp.uint8))
+    y_wide = jnp.concatenate(outs, axis=1) ^ wide_const[None, :]
+    return jnp.concatenate([y32[0], y_wide], axis=1)[None]
+
+
+class LargeLambdaBackend:
+    """Device evaluator for lam >= 48 via the narrow-walk + affine split.
+
+    Single-key (the reference large-lambda bench shape).  Bit-exact with
+    the full-width oracle (tests/test_large_lambda.py).
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes],
+                 col_chunk: int = 1 << 15):
+        if lam < 48 or lam % 16:
+            raise ValueError(
+                "LargeLambdaBackend wants lam >= 48 (a multiple of 16); "
+                "use the pallas/bitsliced backends for small lam")
+        used = hirose_used_cipher_indices(lam, len(cipher_keys))
+        assert tuple(used) == (0, 17)
+        self.lam = lam
+        self.col_chunk = col_chunk
+        self.rk_masks = tuple(
+            jnp.asarray(round_key_masks(cipher_keys[i])) for i in used)
+        self._dev = None
+
+    def put_bundle(self, bundle: KeyBundle) -> None:
+        if bundle.lam != self.lam:
+            raise ValueError("bundle lam mismatch")
+        if bundle.s0s.shape[1] != 1 or bundle.num_keys != 1:
+            raise ValueError(
+                "LargeLambdaBackend wants a party-restricted single key")
+        # The wide affine matrices are party-independent (the party enters
+        # via the trajectory's t_0); staged lazily on first eval.
+        self._bundle = bundle
+
+        def masks(a):  # uint8 [..., 32] -> uint32 masks [..., 256]
+            return (byte_bits_lsb(a).astype(np.uint32)
+                    * np.uint32(0xFFFFFFFF))
+
+        self._dev = dict(
+            cw_s=jnp.asarray(masks(bundle.cw_s[0, :, :NARROW])),
+            cw_v=jnp.asarray(masks(bundle.cw_v[0, :, :NARROW])),
+            cw_tl=jnp.asarray(bundle.cw_t[0, :, 0].astype(np.uint32)
+                              * np.uint32(0xFFFFFFFF)),
+            cw_tr=jnp.asarray(bundle.cw_t[0, :, 1].astype(np.uint32)
+                              * np.uint32(0xFFFFFFFF)),
+            cw_np1=jnp.asarray(masks(bundle.cw_np1[0, :NARROW])),
+            s0_pl=jnp.asarray(masks(bundle.s0s[0, 0, :NARROW]))[:, None],
+        )
+        self._wide = None
+
+    def _wide_staged(self):
+        if self._wide is None:
+            const, w = wide_affine_np(self._bundle)
+            self._wide = (
+                jnp.asarray(const),
+                jnp.asarray(byte_bits_lsb(w).astype(np.int8)),
+            )
+        return self._wide
+
+    def stage(self, xs: np.ndarray) -> dict:
+        """Ship xs (uint8 [M, n_bytes], padded mod 32 internally)."""
+        if self._dev is None:
+            raise ValueError("no key bundle on device; call put_bundle first")
+        if xs.ndim != 2:
+            raise ValueError("LargeLambdaBackend wants shared points [M, nb]")
+        m = xs.shape[0]
+        m_pad = (m + 31) // 32 * 32
+        if m_pad != m:
+            xs = np.pad(xs, [(0, m_pad - m), (0, 0)])
+        return {"xs": jnp.asarray(np.ascontiguousarray(xs))[None], "m": m}
+
+    def eval_staged(self, b: int, staged: dict) -> jax.Array:
+        """Party ``b`` eval; returns DEVICE uint8 [1, M_pad, lam]."""
+        const, w8 = self._wide_staged()
+        dev = self._dev
+        return _hybrid_eval(
+            self.rk_masks, dev["s0_pl"], dev["cw_s"], dev["cw_v"],
+            dev["cw_tl"], dev["cw_tr"], dev["cw_np1"], const, w8,
+            staged["xs"], b=int(b), col_chunk=self.col_chunk)
+
+    def staged_to_bytes(self, y: jax.Array, m: int) -> np.ndarray:
+        return np.asarray(y[:, :m, :])
+
+    def eval(self, b: int, xs: np.ndarray,
+             bundle: KeyBundle | None = None) -> np.ndarray:
+        """uint8 [1, M, lam]; xs uint8 [M, n_bytes] (points padded mod 32)."""
+        if bundle is not None:
+            self.put_bundle(bundle)
+        staged = self.stage(xs)
+        return self.staged_to_bytes(self.eval_staged(b, staged), staged["m"])

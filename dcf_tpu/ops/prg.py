@@ -35,10 +35,17 @@ class PrgOut:
 
 
 class HirosePrgNp:
-    """Numpy twin of ``spec.HirosePrgSpec`` (same key-count contract)."""
+    """Numpy twin of ``spec.HirosePrgSpec`` (same key-count contract).
 
-    def __init__(self, lam: int, keys: Sequence[bytes]):
+    ``mask=False`` skips the final 8*lam-1-bit clearing (src/prg.rs:65-68)
+    — used by the large-lambda hybrid evaluator, whose narrow 32-byte walk
+    replicates the first two blocks of a bigger PRG whose masked byte lies
+    in the wide region (backends.large_lambda).
+    """
+
+    def __init__(self, lam: int, keys: Sequence[bytes], mask: bool = True):
         self.lam = lam
+        self.mask = mask
         used = hirose_used_cipher_indices(lam, len(keys))
         self.round_keys = {i: expand_key_np(keys[i]) for i in used}
 
@@ -63,8 +70,9 @@ class HirosePrgNp:
         t_l = buf0[..., 0, 0] & np.uint8(1)
         t_r = buf1[..., 0, 0] & np.uint8(1)
         # Clear LSB of the last byte of all four outputs (src/prg.rs:65-68).
-        buf0[..., lam - 1] &= np.uint8(0xFE)
-        buf1[..., lam - 1] &= np.uint8(0xFE)
+        if self.mask:
+            buf0[..., lam - 1] &= np.uint8(0xFE)
+            buf1[..., lam - 1] &= np.uint8(0xFE)
         return PrgOut(
             s_l=buf0[..., 0, :],
             v_l=buf1[..., 0, :],

@@ -1,0 +1,80 @@
+"""Hybrid large-lambda evaluator: the narrow-walk + affine-wide split must
+be bit-identical to the full-width oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+from dcf_tpu import spec
+from dcf_tpu.backends.large_lambda import (
+    LargeLambdaBackend,
+    narrow_walk_np,
+    wide_affine_np,
+)
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.gen import gen_batch, random_s0s
+from dcf_tpu.ops.prg import HirosePrgNp
+
+
+def rand_bytes(rng, n):
+    return bytes(rng.getrandbits(8) for _ in range(n))
+
+
+def _setup(seed, lam, nb=2, m=9, bound=spec.Bound.LT_BETA):
+    rng = random.Random(seed)
+    ck = [rand_bytes(rng, 32) for _ in range(2 * (lam // 16))]
+    prg = HirosePrgNp(lam, ck)
+    nprng = np.random.default_rng(seed)
+    alphas = nprng.integers(0, 256, (1, nb), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (1, lam), dtype=np.uint8)
+    bundle = gen_batch(prg, alphas, betas, random_s0s(1, lam, nprng), bound)
+    xs = nprng.integers(0, 256, (m, nb), dtype=np.uint8)
+    xs[0] = alphas[0]
+    return ck, prg, alphas, betas, bundle, xs
+
+
+@pytest.mark.parametrize("bound", [spec.Bound.LT_BETA, spec.Bound.GT_BETA])
+def test_hybrid_numpy_matches_oracle(bound):
+    """Pure-host split (narrow walk + basis-probed affine wide) == the
+    full-width numpy oracle, byte for byte, lam=144."""
+    ck, prg, alphas, betas, bundle, xs = _setup(95, 144, bound=bound)
+    for b in (0, 1):
+        kb = bundle.for_party(b)
+        want = eval_batch_np(prg, b, kb, xs)[0]  # [M, 144]
+        y32, traj = narrow_walk_np(ck, kb, b, xs)
+        const, w = wide_affine_np(kb)
+        wide = const ^ np.bitwise_xor.reduce(
+            w[None] * traj[:, :, None], axis=1)
+        got = np.concatenate([y32, wide], axis=1)
+        assert np.array_equal(got, want), f"party {b}"
+
+
+def test_large_lambda_backend_matches_oracle():
+    """Device (XLA) hybrid path == oracle at lam=144, both parties,
+    plus XOR reconstruction sanity."""
+    ck, prg, alphas, betas, bundle, xs = _setup(96, 144)
+    be = LargeLambdaBackend(144, ck)
+    ys = {}
+    for b in (0, 1):
+        kb = bundle.for_party(b)
+        want = eval_batch_np(prg, b, kb, xs)
+        got = be.eval(b, xs, bundle=kb)
+        assert np.array_equal(got, want), f"party {b}"
+        ys[b] = got
+    recon = ys[0][0] ^ ys[1][0]
+    a = alphas[0].tobytes()
+    for j in range(xs.shape[0]):
+        want_y = betas[0].tobytes() if xs[j].tobytes() < a else bytes(144)
+        assert recon[j].tobytes() == want_y
+
+
+@pytest.mark.slow
+def test_large_lambda_backend_lam2048():
+    ck, prg, alphas, betas, bundle, xs = _setup(97, 2048, m=4)
+    be = LargeLambdaBackend(2048, ck)
+    for b in (0, 1):
+        kb = bundle.for_party(b)
+        want = eval_batch_np(prg, b, kb, xs)
+        got = be.eval(b, xs, bundle=kb)
+        assert np.array_equal(got, want), f"party {b}"
