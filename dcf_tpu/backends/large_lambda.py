@@ -220,6 +220,10 @@ class LargeLambdaBackend:
             raise ValueError(
                 "LargeLambdaBackend wants lam >= 48 (a multiple of 16); "
                 "use the pallas/bitsliced backends for small lam")
+        if col_chunk % 8:
+            raise ValueError(
+                f"col_chunk must be a multiple of 8 (byte packing), "
+                f"got {col_chunk}")
         used = hirose_used_cipher_indices(lam, len(cipher_keys))
         assert tuple(used) == (0, 17)
         self.lam = lam

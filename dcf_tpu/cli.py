@@ -129,11 +129,11 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
     return run, be
 
 
-def _timed_staged(be, xs, m: int, reps: int, profile: str):
+def _timed_staged(be, xs, reps: int, profile: str):
     """Shared staged-bench timing: stage once (untimed, criterion-setup
     analog), DISPATCHES_PER_SAMPLE dispatches per sample with one digest
-    sync, results HBM-resident.  Returns (per-eval median, MAD, samples,
-    unit)."""
+    sync, results HBM-resident.  Returns (per-dispatch median — i.e. per
+    full-batch eval — MAD, samples, unit)."""
     from dcf_tpu.utils.benchtime import DISPATCHES_PER_SAMPLE, device_sync
 
     staged = be.stage(xs)
@@ -270,7 +270,7 @@ def bench_batch(args) -> None:
         # happen outside the timed region, like criterion's untimed setup
         # (/root/reference/benches/dcf_batch_eval.rs:17-24); results stay in
         # HBM where a secure-computation consumer reads them.
-        dt, mad, ss, unit = _timed_staged(be, xs, m, args.reps, args.profile)
+        dt, mad, ss, unit = _timed_staged(be, xs, args.reps, args.profile)
     else:
         dt, mad, ss = _timed(lambda: run(0, k0, xs), args.reps, args.profile)
         unit = "evals/s"
@@ -313,7 +313,8 @@ def bench_large_lambda(args) -> None:
     if be is not None and hasattr(be, "stage"):
         # Staged methodology: at lam=16384 the per-rep result image is
         # 160MB, which the dev tunnel would otherwise dominate.
-        dt, mad, ss, unit = _timed_staged(be, xs, m, args.reps, args.profile)
+        be.put_bundle(k0)
+        dt, mad, ss, unit = _timed_staged(be, xs, args.reps, args.profile)
     else:
         run(0, k0, xs)  # warmup
         dt, mad, ss = _timed(lambda: run(0, k0, xs), args.reps, args.profile)

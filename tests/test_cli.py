@@ -34,3 +34,23 @@ def test_cli_rejects_pallas_large_lambda():
 
     with pytest.raises(SystemExit, match="lam=16"):
         cli.main(["dcf_large_lambda", "--backend=pallas"])
+
+
+@pytest.mark.slow
+def test_cli_large_lambda_hybrid_smoke(capsys):
+    """The staged hybrid CLI path end to end WITHOUT --check — the flow
+    that once shipped without its put_bundle call and crashed at bench
+    time with a green suite."""
+    recs = run_cli(
+        capsys,
+        ["dcf_large_lambda", "--backend=hybrid", "--points=32", "--reps=1"],
+    )
+    assert recs[0]["backend"] == "hybrid"
+    assert recs[0]["value"] > 0
+    # and with the parity gate on
+    recs = run_cli(
+        capsys,
+        ["dcf_large_lambda", "--backend=hybrid", "--points=64", "--reps=1",
+         "--check"],
+    )
+    assert recs[0]["value"] > 0
