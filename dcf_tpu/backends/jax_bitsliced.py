@@ -318,6 +318,29 @@ class _BitslicedBase:
         self._bundle_dev = None
 
 
+def bundle_plane_arrays(bundle: KeyBundle) -> dict:
+    """Party-restricted bundle -> host uint32 plane-mask arrays in the
+    keys-LAST layout both the local and the mesh-sharded bitsliced
+    evaluators consume (s0/cw_np1 [8lam, K]; cw_s/cw_v [n, 8lam, K];
+    cw_tl/cw_tr [n, K])."""
+    if bundle.s0s.shape[1] != 1:
+        raise ValueError("put_bundle requires a party-restricted bundle")
+
+    def cw_planes(a):  # [K, n, lam] -> [n, 8lam, K]
+        bits = byte_bits_lsb(a)
+        return expand_bits_to_masks(
+            np.ascontiguousarray(bits.transpose(1, 2, 0)))
+
+    return dict(
+        s0=expand_bits_to_masks(byte_bits_lsb(bundle.s0s[:, 0, :]).T),
+        cw_s=cw_planes(bundle.cw_s),
+        cw_v=cw_planes(bundle.cw_v),
+        cw_tl=expand_bits_to_masks(bundle.cw_t[:, :, 0].T),
+        cw_tr=expand_bits_to_masks(bundle.cw_t[:, :, 1].T),
+        cw_np1=expand_bits_to_masks(byte_bits_lsb(bundle.cw_np1).T),
+    )
+
+
 class BitslicedBackend(_BitslicedBase):
     """Device-resident bitsliced DCF evaluator (API-compatible with JaxBackend)."""
 
@@ -331,26 +354,9 @@ class BitslicedBackend(_BitslicedBase):
         """Ship a party-restricted bundle to device as plane masks."""
         if bundle.lam != self.lam:
             raise ValueError("bundle lam mismatch")
-        if bundle.s0s.shape[1] != 1:
-            raise ValueError("put_bundle requires a party-restricted bundle")
-        # [K, n, lam] u8 -> bits [K, n, 8lam] -> [n, 8lam, K] masks.
-        def cw_planes(a):
-            bits = byte_bits_lsb(a)  # [K, n, 8lam]
-            return jnp.asarray(
-                expand_bits_to_masks(np.ascontiguousarray(bits.transpose(1, 2, 0)))
-            )
-
-        s0_bits = byte_bits_lsb(bundle.s0s[:, 0, :])  # [K, 8lam]
-        self._bundle_dev = dict(
-            s0=jnp.asarray(expand_bits_to_masks(s0_bits.T)),
-            cw_s=cw_planes(bundle.cw_s),
-            cw_v=cw_planes(bundle.cw_v),
-            cw_tl=jnp.asarray(expand_bits_to_masks(bundle.cw_t[:, :, 0].T)),
-            cw_tr=jnp.asarray(expand_bits_to_masks(bundle.cw_t[:, :, 1].T)),
-            cw_np1=jnp.asarray(
-                expand_bits_to_masks(byte_bits_lsb(bundle.cw_np1).T)
-            ),
-        )
+        self._bundle_dev = {
+            k: jnp.asarray(v) for k, v in bundle_plane_arrays(bundle).items()
+        }
 
     def stage(self, xs: np.ndarray) -> dict:
         """Ship xs to device as walk-order lane masks (criterion-setup analog).

@@ -111,7 +111,7 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
     elif backend == "sharded":
         import jax
 
-        from dcf_tpu.parallel import ShardedJaxBackend, make_mesh
+        from dcf_tpu.parallel import ShardedBitslicedBackend, make_mesh
 
         shape = _parse_mesh(getattr(args, "mesh", ""))
         if shape is None:
@@ -119,7 +119,7 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
             shape = (1, len(jax.devices()))
         mesh = make_mesh(shape=shape)
         log(f"mesh: {dict(mesh.shape)}")
-        be = ShardedJaxBackend(lam, cipher_keys, mesh)
+        be = ShardedBitslicedBackend(lam, cipher_keys, mesh)
     else:
         raise ValueError(f"unknown backend {backend!r}")
 
@@ -243,10 +243,16 @@ def bench_dcf(args) -> None:
 
 
 def bench_batch(args) -> None:
-    """Batch eval throughput (benches/dcf_batch_eval.rs analog)."""
+    """Batch eval throughput (benches/dcf_batch_eval.rs analog).
+
+    --domain-bytes picks the input width: 16 (the reference bench's
+    N=16-byte domain, 128 scan levels — the default and the flagship
+    number) or 4 (BASELINE.json config 2's literal "n=32" wording).
+    """
     from dcf_tpu.native import NativeDcf
 
-    lam, nb = 16, 16
+    lam = 16
+    nb = args.domain_bytes or 16
     m = args.points or 100_000
     rng = np.random.default_rng(args.seed)
     ck = _cipher_keys(lam, rng)
@@ -313,7 +319,8 @@ def bench_large_lambda(args) -> None:
     if be is not None and hasattr(be, "stage"):
         # Staged methodology: at lam=16384 the per-rep result image is
         # 160MB, which the dev tunnel would otherwise dominate.
-        be.put_bundle(k0)
+        if not args.check:  # --check's parity run already shipped the bundle
+            be.put_bundle(k0)
         dt, mad, ss, unit = _timed_staged(be, xs, args.reps, args.profile)
     else:
         run(0, k0, xs)  # warmup
@@ -371,12 +378,12 @@ def bench_secure_relu(args) -> None:
     if args.backend == "sharded":
         # The one multi-key CLI workload: this is where mesh factorizations
         # (8x1 / 4x2 / 2x4) are meaningfully compared via --mesh.
-        from dcf_tpu.parallel import ShardedJaxBackend, make_mesh
+        from dcf_tpu.parallel import ShardedBitslicedBackend, make_mesh
 
         mesh = make_mesh(shape=_parse_mesh(args.mesh))
         log(f"mesh: {dict(mesh.shape)}")
-        be0 = ShardedJaxBackend(lam, ck, mesh)
-        be1 = ShardedJaxBackend(lam, ck, mesh)
+        be0 = ShardedBitslicedBackend(lam, ck, mesh)
+        be1 = ShardedBitslicedBackend(lam, ck, mesh)
         name = "sharded"
     else:
         from dcf_tpu.backends.jax_bitsliced import KeyLanesBackend
@@ -557,6 +564,8 @@ def main(argv=None) -> None:
                    help="write a jax.profiler trace of the timed region")
     p.add_argument("--n-bits", type=int, default=0,
                    help="domain bits for full_domain (0 = 24)")
+    p.add_argument("--domain-bytes", type=int, default=0,
+                   help="input width for dcf_batch_eval (0 = 16)")
     p.add_argument("--device-gen", action="store_true",
                    help="secure_relu: device keygen + pallas keylanes path")
     args = p.parse_args(argv)

@@ -14,4 +14,8 @@ Keys stream host->HBM sharded over the "keys" axis, which is what makes the
 holds 1/8 of the ~4.4 GB key image.
 """
 
-from dcf_tpu.parallel.mesh import ShardedJaxBackend, make_mesh  # noqa: F401
+from dcf_tpu.parallel.mesh import (  # noqa: F401
+    ShardedBitslicedBackend,
+    ShardedJaxBackend,
+    make_mesh,
+)

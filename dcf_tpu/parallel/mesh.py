@@ -11,11 +11,17 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dcf_tpu.backends.jax_backend import eval_core
+from dcf_tpu.backends.jax_bitsliced import (
+    _BitslicedBase,
+    _eval_bytes,
+    bundle_plane_arrays,
+)
+from dcf_tpu.backends._common import prepare_batch
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes import expand_key_np
 from dcf_tpu.spec import hirose_used_cipher_indices
 
-__all__ = ["make_mesh", "ShardedJaxBackend"]
+__all__ = ["make_mesh", "ShardedJaxBackend", "ShardedBitslicedBackend"]
 
 
 def make_mesh(
@@ -150,3 +156,98 @@ class ShardedJaxBackend:
             xs_dev,
         )
         return np.asarray(y)
+
+
+class ShardedBitslicedBackend(_BitslicedBase):
+    """The bitsliced (fast portable) eval core sharded over a device mesh.
+
+    Same mesh contract as ``ShardedJaxBackend`` but each chip runs the
+    bit-plane core (``backends.jax_bitsliced.eval_core_bitsliced``) on its
+    local (key-shard, point-shard) block — the path a multi-chip
+    deployment would actually use (on real TPU pods the per-shard body
+    can be swapped for the Pallas walk kernel; the XLA core is the
+    variant testable on virtual CPU meshes).  No collectives inside the
+    walk (pure map); keys shard the HBM-resident plane image, points
+    shard transient state.
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes], mesh: Mesh):
+        super().__init__(lam, cipher_keys)
+        self.mesh = mesh
+        kaxis, paxis = mesh.axis_names
+        self._spec_keyed = P(None, kaxis)       # [8lam|n, K]
+        self._spec_level = P(None, None, kaxis)  # [n, 8lam, K]
+        self._spec_xs = P(kaxis, paxis, None)    # [K, M, nb]
+        self._spec_xs_shared = P(None, paxis, None)  # [1, M, nb]
+        self._spec_y = P(kaxis, paxis, None)     # [K, M, lam]
+        bundle_specs = (
+            P(),                # round keys (tuple, replicated)
+            P(),                # last-bit mask
+            self._spec_keyed,   # s0 planes
+            self._spec_level,   # cw_s planes
+            self._spec_level,   # cw_v planes
+            self._spec_keyed,   # cw_tl
+            self._spec_keyed,   # cw_tr
+            self._spec_keyed,   # cw_np1 planes
+        )
+        self._fn = {
+            (b, shared): jax.jit(
+                jax.shard_map(
+                    partial(_eval_bytes, b=b, lam=lam),
+                    mesh=mesh,
+                    in_specs=(
+                        *bundle_specs,
+                        self._spec_xs_shared if shared else self._spec_xs,
+                    ),
+                    out_specs=self._spec_y,
+                    check_vma=False,
+                )
+            )
+            for b in (0, 1)
+            for shared in (False, True)
+        }
+
+    def _put(self, arr, spec: P) -> jax.Array:
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def put_bundle(self, bundle: KeyBundle) -> None:
+        """Ship a party-restricted bundle as plane masks, keys sharded."""
+        if bundle.lam != self.lam:
+            raise ValueError("bundle lam mismatch")
+        ksize = self.mesh.shape[self.mesh.axis_names[0]]
+        if bundle.num_keys % ksize != 0:
+            raise ValueError(
+                f"num_keys={bundle.num_keys} not divisible by keys-axis "
+                f"size {ksize}")
+        self._bundle_dev = {
+            k: self._put(
+                v, self._spec_level if v.ndim == 3 else self._spec_keyed)
+            for k, v in bundle_plane_arrays(bundle).items()
+        }
+
+    def eval(self, b: int, xs: np.ndarray,
+             bundle: KeyBundle | None = None) -> np.ndarray:
+        """Party ``b`` eval; xs uint8 [M, nb] or [K, M, nb] -> [K, M, lam].
+
+        The point axis is padded so each point-shard is a whole number of
+        32-point lane words (pad points computed and discarded).
+        """
+        if bundle is not None:
+            self.put_bundle(bundle)
+        if self._bundle_dev is None:
+            raise ValueError("no key bundle on device; call put_bundle first")
+        dev = self._bundle_dev
+        k_num = dev["s0"].shape[1]
+        n = dev["cw_s"].shape[0]
+        psize = self.mesh.shape[self.mesh.axis_names[1]]
+        granule = 32 * psize  # whole lane words per point-shard
+        shared = xs.ndim == 2
+        xs_p, _, m = prepare_batch(
+            (k_num, n), xs, lambda m: -(-m // granule) * granule)
+        xs_dev = self._put(
+            xs_p, self._spec_xs_shared if shared else self._spec_xs)
+        y = self._fn[(int(b), shared)](
+            self.rk_masks, self._last_bit_mask, dev["s0"], dev["cw_s"],
+            dev["cw_v"], dev["cw_tl"], dev["cw_tr"], dev["cw_np1"], xs_dev,
+        )
+        return np.asarray(y)[:, :m, :]
