@@ -1,0 +1,151 @@
+"""Top-level facade mirroring the reference's entry point.
+
+The reference crate's surface is `DcfImpl::<N, LAMBDA>::new(prg)` with
+``gen(f, s0s, bound) -> Share`` and ``eval(b, k, xs, ys)``
+(/root/reference/src/lib.rs:24-35, 63-77).  ``Dcf`` is the runtime-shape
+equivalent: construct once with (n_bytes, lam, cipher_keys), pick an
+execution backend by name, and go.
+
+    >>> dcf = Dcf(n_bytes=16, lam=16, cipher_keys=[k0, k1])
+    >>> bundle = dcf.gen(alphas, betas)              # K keys at once
+    >>> y0 = dcf.eval(0, bundle.for_party(0), xs)    # uint8 [K, M, lam]
+
+Backends (selected at construction, ``backend=``):
+
+    auto       pallas on TPU / bitsliced elsewhere (lam=16), hybrid for
+               lam >= 48, bitsliced otherwise
+    cpu        the C++ native core (AES-NI, threaded)
+    numpy      the host oracle
+    jax        byte-level lax.scan walk
+    bitsliced  XLA bit-plane walk
+    pallas     fused VMEM walk kernel (lam=16)
+    hybrid     narrow walk + GF(2)-affine wide part (lam >= 48)
+
+Key generation runs on the C++ core when available, else numpy.  For
+many-keys-on-accelerator workflows use ``backends.device_gen.DeviceKeyGen``
+/ ``backends.pallas_keylanes`` directly (the config-5 pipeline); for
+full-domain evaluation use ``backends.fulldomain.TreeFullDomain``; for
+mesh sharding use ``parallel.ShardedBitslicedBackend``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from dcf_tpu.gen import gen_batch, random_s0s
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.spec import Bound
+
+__all__ = ["Dcf"]
+
+
+def _default_backend(lam: int) -> str:
+    if lam == 16:
+        try:
+            import jax
+
+            if jax.devices()[0].platform == "tpu":  # Mosaic is TPU-only
+                return "pallas"
+        except Exception:
+            pass
+        return "bitsliced"
+    return "hybrid" if lam >= 48 else "bitsliced"
+
+
+class Dcf:
+    """Runtime-configured DCF: the `DcfImpl` equivalent.
+
+    Shapes are runtime values (JAX specializes at trace time) instead of
+    the reference's const generics.
+    """
+
+    def __init__(self, n_bytes: int, lam: int, cipher_keys: Sequence[bytes],
+                 backend: str = "auto"):
+        if n_bytes < 1:
+            raise ValueError("n_bytes must be >= 1")
+        self.n_bytes = n_bytes
+        self.lam = lam
+        self.cipher_keys = list(cipher_keys)
+        self.backend_name = (
+            _default_backend(lam) if backend == "auto" else backend)
+        self._prg = HirosePrgNp(lam, self.cipher_keys)
+        self._gen_native = None
+        try:
+            from dcf_tpu.native import NativeDcf
+
+            self._gen_native = NativeDcf(lam, self.cipher_keys)
+        except Exception:  # no toolchain: numpy keygen still works
+            pass
+        self._eval_backend = self._make_backend(self.backend_name)
+        self._shipped_bundle = None
+
+    def _make_backend(self, name: str):
+        if name == "cpu":
+            if self._gen_native is None:
+                raise ValueError("cpu backend needs the native core")
+            return None  # native eval goes through _gen_native directly
+        if name == "numpy":
+            return None
+        if name == "jax":
+            from dcf_tpu.backends.jax_backend import JaxBackend
+
+            return JaxBackend(self.lam, self.cipher_keys)
+        if name == "bitsliced":
+            from dcf_tpu.backends.jax_bitsliced import BitslicedBackend
+
+            return BitslicedBackend(self.lam, self.cipher_keys)
+        if name == "pallas":
+            from dcf_tpu.backends.pallas_backend import PallasBackend
+
+            return PallasBackend(self.lam, self.cipher_keys)
+        if name == "hybrid":
+            from dcf_tpu.backends.large_lambda import LargeLambdaBackend
+
+            return LargeLambdaBackend(self.lam, self.cipher_keys)
+        raise ValueError(f"unknown backend {name!r}")
+
+    # -- keygen (reference gen, src/lib.rs:86-161) --------------------------
+
+    def gen(self, alphas: np.ndarray, betas: np.ndarray,
+            s0s: np.ndarray | None = None,
+            bound: Bound = Bound.LT_BETA,
+            rng: np.random.Generator | None = None) -> KeyBundle:
+        """Generate K keys: alphas uint8 [K, n_bytes], betas uint8 [K, lam].
+
+        s0s (uint8 [K, 2, lam]) default to fresh random seeds.  Returns the
+        two-party KeyBundle; ship ``bundle.for_party(b)`` to party b.
+        """
+        alphas = np.asarray(alphas, dtype=np.uint8)
+        betas = np.asarray(betas, dtype=np.uint8)
+        if alphas.ndim != 2 or alphas.shape[1] != self.n_bytes:
+            raise ValueError(f"alphas must be [K, {self.n_bytes}]")
+        if s0s is None:
+            s0s = random_s0s(
+                alphas.shape[0], self.lam,
+                rng if rng is not None else np.random.default_rng())
+        if self._gen_native is not None:
+            return self._gen_native.gen_batch(alphas, betas, s0s, bound)
+        return gen_batch(self._prg, alphas, betas, s0s, bound)
+
+    # -- eval (reference eval, src/lib.rs:163-204) --------------------------
+
+    def eval(self, b: int, bundle: KeyBundle, xs: np.ndarray) -> np.ndarray:
+        """Party ``b`` batch evaluation: xs uint8 [M, n_bytes] (shared) or
+        [K, M, n_bytes] (per-key, backend permitting).  Returns uint8
+        [K, M, lam]; XOR both parties' outputs to reconstruct f(x)."""
+        xs = np.asarray(xs, dtype=np.uint8)
+        if self.backend_name == "cpu":
+            return self._gen_native.eval(b, bundle, xs)
+        if self.backend_name == "numpy":
+            from dcf_tpu.backends.numpy_backend import eval_batch_np
+
+            return eval_batch_np(self._prg, b, bundle, xs)
+        # Ship the key image once per bundle, not once per eval call
+        # (put_bundle does the full host plane expansion + transfer).
+        if self._shipped_bundle is not bundle:
+            self._eval_backend.put_bundle(bundle)
+            self._shipped_bundle = bundle
+        return self._eval_backend.eval(b, xs)

@@ -377,13 +377,15 @@ def bench_secure_relu(args) -> None:
     bundle = native.gen_batch(alphas, betas, s0s, Bound.LT_BETA)
     if args.backend == "sharded":
         # The one multi-key CLI workload: this is where mesh factorizations
-        # (8x1 / 4x2 / 2x4) are meaningfully compared via --mesh.
-        from dcf_tpu.parallel import ShardedBitslicedBackend, make_mesh
+        # (8x1 / 4x2 / 2x4) are meaningfully compared via --mesh.  Uses the
+        # byte-layout sharded backend: at K=65536+ the bit-plane variant's
+        # 32x key-image blow-up would dominate host RAM and the links.
+        from dcf_tpu.parallel import ShardedJaxBackend, make_mesh
 
         mesh = make_mesh(shape=_parse_mesh(args.mesh))
         log(f"mesh: {dict(mesh.shape)}")
-        be0 = ShardedBitslicedBackend(lam, ck, mesh)
-        be1 = ShardedBitslicedBackend(lam, ck, mesh)
+        be0 = ShardedJaxBackend(lam, ck, mesh)
+        be1 = ShardedJaxBackend(lam, ck, mesh)
         name = "sharded"
     else:
         from dcf_tpu.backends.jax_bitsliced import KeyLanesBackend

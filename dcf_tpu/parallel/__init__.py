@@ -11,7 +11,10 @@ block locally; collectives ride ICI only for input/result redistribution, and
 no communication happens during the walk itself (the eval is a pure map).
 Keys stream host->HBM sharded over the "keys" axis, which is what makes the
 10^6-keys secure-ReLU workload (BASELINE config 5) fit: each of 8 chips
-holds 1/8 of the ~4.4 GB key image.
+holds 1/8 of the ~4.4 GB key image — in ``ShardedJaxBackend``'s byte
+layout (the right sharded backend for many-keys work; the bit-plane
+``ShardedBitslicedBackend`` is faster per chip but its key image is 32x
+larger, so it suits few-keys x many-points shapes).
 """
 
 from dcf_tpu.parallel.mesh import (  # noqa: F401

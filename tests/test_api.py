@@ -1,0 +1,67 @@
+"""Top-level Dcf facade: the reference DcfImpl-equivalent entry point."""
+
+import random
+
+import numpy as np
+import pytest
+
+from dcf_tpu import Bound, Dcf
+
+
+def rand_bytes(rng, n):
+    return bytes(rng.getrandbits(8) for _ in range(n))
+
+
+@pytest.mark.parametrize("backend", ["numpy", "bitsliced", "jax", "cpu"])
+def test_facade_two_party_roundtrip(backend):
+    rng = random.Random(99)
+    ck = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    dcf = Dcf(n_bytes=2, lam=16, cipher_keys=ck, backend=backend)
+    nprng = np.random.default_rng(99)
+    k = 3
+    alphas = nprng.integers(0, 256, (k, 2), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k, 16), dtype=np.uint8)
+    bundle = dcf.gen(alphas, betas, rng=nprng)
+    xs = nprng.integers(0, 256, (7, 2), dtype=np.uint8)
+    xs[0] = alphas[0]
+    y0 = dcf.eval(0, bundle.for_party(0), xs)
+    y1 = dcf.eval(1, bundle.for_party(1), xs)
+    recon = y0 ^ y1
+    for i in range(k):
+        a = alphas[i].tobytes()
+        for j in range(7):
+            want = betas[i].tobytes() if xs[j].tobytes() < a else bytes(16)
+            assert recon[i, j].tobytes() == want
+
+
+def test_facade_auto_and_validation():
+    rng = random.Random(98)
+    ck = [rand_bytes(rng, 32) for _ in range(18)]  # lam>=32 uses index 17
+    # auto on CPU at lam=16 -> bitsliced; lam=64 -> hybrid
+    assert Dcf(2, 16, ck[:2]).backend_name == "bitsliced"
+    assert Dcf(2, 64, ck).backend_name == "hybrid"
+    with pytest.raises(ValueError, match="unknown backend"):
+        Dcf(2, 16, ck[:2], backend="nope")
+    dcf = Dcf(2, 16, ck[:2])
+    with pytest.raises(ValueError, match="alphas"):
+        dcf.gen(np.zeros((1, 3), dtype=np.uint8),
+                np.zeros((1, 16), dtype=np.uint8))
+
+
+def test_facade_gt_bound_hybrid():
+    rng = random.Random(97)
+    lam = 64
+    ck = [rand_bytes(rng, 32) for _ in range(18)]  # index 17 needed
+    dcf = Dcf(n_bytes=2, lam=lam, cipher_keys=ck)  # auto -> hybrid
+    nprng = np.random.default_rng(97)
+    alphas = nprng.integers(0, 256, (1, 2), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (1, lam), dtype=np.uint8)
+    bundle = dcf.gen(alphas, betas, bound=Bound.GT_BETA, rng=nprng)
+    xs = nprng.integers(0, 256, (6, 2), dtype=np.uint8)
+    y0 = dcf.eval(0, bundle.for_party(0), xs)
+    y1 = dcf.eval(1, bundle.for_party(1), xs)
+    recon = y0[0] ^ y1[0]
+    a = alphas[0].tobytes()
+    for j in range(6):
+        want = betas[0].tobytes() if xs[j].tobytes() > a else bytes(lam)
+        assert recon[j].tobytes() == want
