@@ -8,7 +8,7 @@ execution backend by name, and go.
 
     >>> dcf = Dcf(n_bytes=16, lam=16, cipher_keys=[k0, k1])
     >>> bundle = dcf.gen(alphas, betas)              # K keys at once
-    >>> y0 = dcf.eval(0, bundle.for_party(0), xs)    # uint8 [K, M, lam]
+    >>> y0 = dcf.eval(0, bundle, xs)                 # uint8 [K, M, lam]
 
 Backends (selected at construction, ``backend=``):
 
@@ -122,6 +122,11 @@ class Dcf:
         betas = np.asarray(betas, dtype=np.uint8)
         if alphas.ndim != 2 or alphas.shape[1] != self.n_bytes:
             raise ValueError(f"alphas must be [K, {self.n_bytes}]")
+        if self.backend_name == "hybrid" and alphas.shape[0] != 1:
+            raise ValueError(
+                "the hybrid (large-lambda) backend is single-key; gen one "
+                "key per Dcf, or pick backend='bitsliced' for multi-key "
+                "large-lambda work")
         if s0s is None:
             s0s = random_s0s(
                 alphas.shape[0], self.lam,
@@ -135,17 +140,27 @@ class Dcf:
     def eval(self, b: int, bundle: KeyBundle, xs: np.ndarray) -> np.ndarray:
         """Party ``b`` batch evaluation: xs uint8 [M, n_bytes] (shared) or
         [K, M, n_bytes] (per-key, backend permitting).  Returns uint8
-        [K, M, lam]; XOR both parties' outputs to reconstruct f(x)."""
+        [K, M, lam]; XOR both parties' outputs to reconstruct f(x).
+
+        ``bundle`` may be the full two-party bundle (restricted to party
+        ``b`` internally — the recommended form, since the shipped key
+        image is cached per (bundle, party) and reused across calls) or an
+        already-restricted ``bundle.for_party(b)``.
+        """
         xs = np.asarray(xs, dtype=np.uint8)
+        kb = bundle.for_party(b) if bundle.s0s.shape[1] == 2 else bundle
         if self.backend_name == "cpu":
-            return self._gen_native.eval(b, bundle, xs)
+            return self._gen_native.eval(b, kb, xs)
         if self.backend_name == "numpy":
             from dcf_tpu.backends.numpy_backend import eval_batch_np
 
-            return eval_batch_np(self._prg, b, bundle, xs)
-        # Ship the key image once per bundle, not once per eval call
+            return eval_batch_np(self._prg, b, kb, xs)
+        # Ship the key image once per (bundle, party), not once per call
         # (put_bundle does the full host plane expansion + transfer).
-        if self._shipped_bundle is not bundle:
-            self._eval_backend.put_bundle(bundle)
-            self._shipped_bundle = bundle
+        # Keyed on the CALLER's object so repeated evals with the same
+        # bundle hit the cache even though for_party() allocates.
+        key = (id(bundle), int(b) if bundle is not kb else None)
+        if self._shipped_bundle != key:
+            self._eval_backend.put_bundle(kb)
+            self._shipped_bundle = key
         return self._eval_backend.eval(b, xs)

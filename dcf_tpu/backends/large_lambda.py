@@ -174,24 +174,12 @@ def _narrow_core(rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
     return y, traj
 
 
-@partial(jax.jit, static_argnames=("b", "col_chunk"))
-def _hybrid_eval(rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
-                 wide_const, wide_w8, xs, b: int, col_chunk: int):
-    """Full device program: narrow walk + wide MXU matmul -> uint8 bytes.
-
-    wide_const: uint8 [lam-32]; wide_w8: int8 {0,1} [n+1, 8*(lam-32)].
-    Returns uint8 [1, M, lam].
-    """
-    x_mask = _xs_to_mask_dev(xs)
-    y32_pl, traj = _narrow_core(
-        rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
-        x_mask, b)
-    y32 = _planes_to_bytes_dev(y32_pl, NARROW)  # [1, M, 32]
-    m = y32.shape[1]
-    # trajectory planes [n+1, 1, W] -> int8 bits [M, n+1]
-    tb = (traj[:, 0, :, None] >> jnp.arange(32, dtype=jnp.uint32)) \
+def _wide_tail(t_planes, wide_const, wide_w8, m: int, col_chunk: int):
+    """Shared wide part: packed t-trajectory planes [n+1, W] -> uint8 wide
+    bytes [M, lam-32] via the int8 MXU matmul + parity extraction."""
+    tb = (t_planes[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)) \
         & jnp.uint32(1)
-    t_bits = tb.reshape(traj.shape[0], -1).T.astype(jnp.int8)  # [M, n+1]
+    t_bits = tb.reshape(t_planes.shape[0], -1).T.astype(jnp.int8)  # [M, n+1]
     cols = wide_w8.shape[1]
     outs = []
     for c0 in range(0, cols, col_chunk):
@@ -203,7 +191,46 @@ def _hybrid_eval(rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
         by = bits.reshape(m, -1, 8)
         outs.append(jnp.sum(by << jnp.arange(8, dtype=jnp.uint8), axis=-1,
                             dtype=jnp.uint8))
-    y_wide = jnp.concatenate(outs, axis=1) ^ wide_const[None, :]
+    return jnp.concatenate(outs, axis=1) ^ wide_const[None, :]
+
+
+@partial(jax.jit, static_argnames=("b", "col_chunk"))
+def _hybrid_eval(rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
+                 wide_const, wide_w8, xs, b: int, col_chunk: int):
+    """Full device program (XLA narrow walk): uint8 [1, M, lam]."""
+    x_mask = _xs_to_mask_dev(xs)
+    y32_pl, traj = _narrow_core(
+        rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
+        x_mask, b)
+    y32 = _planes_to_bytes_dev(y32_pl, NARROW)  # [1, M, 32]
+    m = y32.shape[1]
+    y_wide = _wide_tail(traj[:, 0, :], wide_const, wide_w8, m, col_chunk)
+    return jnp.concatenate([y32[0], y_wide], axis=1)[None]
+
+
+@partial(jax.jit, static_argnames=("b", "col_chunk", "interpret"))
+def _hybrid_eval_pallas(rk2, s0a, s0b, cs0, cs1, cv0, cv1, np1a, np1b,
+                        cw_t_pm, inv_perm, wide_const, wide_w8, xs,
+                        b: int, col_chunk: int, interpret: bool):
+    """Full device program (Pallas narrow walk): uint8 [1, M, lam]."""
+    from dcf_tpu.backends.pallas_backend import _stage_xs
+    from dcf_tpu.ops.pallas_narrow import dcf_narrow_walk_pallas
+
+    x_mask = _stage_xs(xs)
+    y0, y1, traj = dcf_narrow_walk_pallas(
+        rk2, s0a, s0b, cs0, cs1, cv0, cv1, np1a, np1b, cw_t_pm, x_mask,
+        b=b, interpret=interpret)
+    # bit-major [1, 128, W] per block -> byte-major planes [256, 1, W]
+    yb = jnp.concatenate([
+        jnp.take(jax.lax.bitcast_convert_type(y0, jnp.uint32)[0],
+                 inv_perm, axis=0),
+        jnp.take(jax.lax.bitcast_convert_type(y1, jnp.uint32)[0],
+                 inv_perm, axis=0),
+    ], axis=0)[:, None, :]
+    y32 = _planes_to_bytes_dev(yb, NARROW)  # [1, M, 32]
+    m = y32.shape[1]
+    tr = jax.lax.bitcast_convert_type(traj, jnp.uint32)[0]  # [n+1, W]
+    y_wide = _wide_tail(tr, wide_const, wide_w8, m, col_chunk)
     return jnp.concatenate([y32[0], y_wide], axis=1)[None]
 
 
@@ -215,7 +242,8 @@ class LargeLambdaBackend:
     """
 
     def __init__(self, lam: int, cipher_keys: Sequence[bytes],
-                 col_chunk: int = 1 << 15):
+                 col_chunk: int = 1 << 15, narrow: str = "auto",
+                 interpret: bool = False):
         if lam < 48 or lam % 16:
             raise ValueError(
                 "LargeLambdaBackend wants lam >= 48 (a multiple of 16); "
@@ -224,12 +252,33 @@ class LargeLambdaBackend:
             raise ValueError(
                 f"col_chunk must be a multiple of 8 (byte packing), "
                 f"got {col_chunk}")
+        if narrow == "auto":
+            try:
+                import jax as _jax
+
+                narrow = ("pallas" if interpret
+                          or _jax.devices()[0].platform == "tpu" else "xla")
+            except Exception:
+                narrow = "xla"
+        if narrow not in ("pallas", "xla"):
+            raise ValueError(f"narrow must be pallas/xla/auto, got {narrow}")
         used = hirose_used_cipher_indices(lam, len(cipher_keys))
         assert tuple(used) == (0, 17)
         self.lam = lam
         self.col_chunk = col_chunk
+        self.narrow = narrow
+        self.interpret = interpret
         self.rk_masks = tuple(
             jnp.asarray(round_key_masks(cipher_keys[i])) for i in used)
+        if narrow == "pallas":
+            from dcf_tpu.ops.aes_bitsliced import round_key_masks_bitmajor
+
+            self.rk2 = jnp.asarray(np.concatenate(
+                [round_key_masks_bitmajor(cipher_keys[i]) for i in used],
+                axis=2))  # [15, 128, 2]
+            from dcf_tpu.utils.bits import bitmajor_perm
+
+            self._inv_perm = jnp.asarray(np.argsort(bitmajor_perm(16)))
         self._dev = None
 
     def put_bundle(self, bundle: KeyBundle) -> None:
@@ -242,20 +291,40 @@ class LargeLambdaBackend:
         # via the trajectory's t_0); staged lazily on first eval.
         self._bundle = bundle
 
-        def masks(a):  # uint8 [..., 32] -> uint32 masks [..., 256]
-            return (byte_bits_lsb(a).astype(np.uint32)
-                    * np.uint32(0xFFFFFFFF))
+        if self.narrow == "pallas":
+            from dcf_tpu.utils.bits import bitmajor_plane_masks
 
-        self._dev = dict(
-            cw_s=jnp.asarray(masks(bundle.cw_s[0, :, :NARROW])),
-            cw_v=jnp.asarray(masks(bundle.cw_v[0, :, :NARROW])),
-            cw_tl=jnp.asarray(bundle.cw_t[0, :, 0].astype(np.uint32)
-                              * np.uint32(0xFFFFFFFF)),
-            cw_tr=jnp.asarray(bundle.cw_t[0, :, 1].astype(np.uint32)
-                              * np.uint32(0xFFFFFFFF)),
-            cw_np1=jnp.asarray(masks(bundle.cw_np1[0, :NARROW])),
-            s0_pl=jnp.asarray(masks(bundle.s0s[0, 0, :NARROW]))[:, None],
-        )
+            def blk(a, lo):  # bit-major plane masks for one 16-byte block
+                return jnp.asarray(
+                    bitmajor_plane_masks(a[..., lo:lo + 16])[..., None])
+
+            self._dev = dict(
+                s0a=blk(bundle.s0s[:1, 0, :], 0),
+                s0b=blk(bundle.s0s[:1, 0, :], 16),
+                cs0=blk(bundle.cw_s[:1], 0),
+                cs1=blk(bundle.cw_s[:1], 16),
+                cv0=blk(bundle.cw_v[:1], 0),
+                cv1=blk(bundle.cw_v[:1], 16),
+                np1a=blk(bundle.cw_np1[:1], 0),
+                np1b=blk(bundle.cw_np1[:1], 16),
+                cw_t=jnp.asarray(bundle.cw_t[:1].astype(np.int32) * -1),
+            )
+        else:
+            def masks(a):  # uint8 [..., 32] -> uint32 masks [..., 256]
+                return (byte_bits_lsb(a).astype(np.uint32)
+                        * np.uint32(0xFFFFFFFF))
+
+            self._dev = dict(
+                cw_s=jnp.asarray(masks(bundle.cw_s[0, :, :NARROW])),
+                cw_v=jnp.asarray(masks(bundle.cw_v[0, :, :NARROW])),
+                cw_tl=jnp.asarray(bundle.cw_t[0, :, 0].astype(np.uint32)
+                                  * np.uint32(0xFFFFFFFF)),
+                cw_tr=jnp.asarray(bundle.cw_t[0, :, 1].astype(np.uint32)
+                                  * np.uint32(0xFFFFFFFF)),
+                cw_np1=jnp.asarray(masks(bundle.cw_np1[0, :NARROW])),
+                s0_pl=jnp.asarray(
+                    masks(bundle.s0s[0, 0, :NARROW]))[:, None],
+            )
         self._wide = None
 
     def _wide_staged(self):
@@ -274,7 +343,10 @@ class LargeLambdaBackend:
         if xs.ndim != 2:
             raise ValueError("LargeLambdaBackend wants shared points [M, nb]")
         m = xs.shape[0]
-        m_pad = (m + 31) // 32 * 32
+        # Pallas narrow walk tiles 128 lane words per grid step; batches
+        # beyond one tile pad to whole tiles (<= one tile stays exact).
+        granule = 4096 if self.narrow == "pallas" and m > 4096 else 32
+        m_pad = -(-m // granule) * granule
         if m_pad != m:
             xs = np.pad(xs, [(0, m_pad - m), (0, 0)])
         return {"xs": jnp.asarray(np.ascontiguousarray(xs))[None], "m": m}
@@ -283,6 +355,13 @@ class LargeLambdaBackend:
         """Party ``b`` eval; returns DEVICE uint8 [1, M_pad, lam]."""
         const, w8 = self._wide_staged()
         dev = self._dev
+        if self.narrow == "pallas":
+            return _hybrid_eval_pallas(
+                self.rk2, dev["s0a"], dev["s0b"], dev["cs0"], dev["cs1"],
+                dev["cv0"], dev["cv1"], dev["np1a"], dev["np1b"],
+                dev["cw_t"], self._inv_perm, const, w8, staged["xs"],
+                b=int(b), col_chunk=self.col_chunk,
+                interpret=self.interpret)
         return _hybrid_eval(
             self.rk_masks, dev["s0_pl"], dev["cw_s"], dev["cw_v"],
             dev["cw_tl"], dev["cw_tr"], dev["cw_np1"], const, w8,

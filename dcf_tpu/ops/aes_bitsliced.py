@@ -304,10 +304,12 @@ _V3_TERM_PERMS, _V3_RK_ORDERS, _V3_FINAL_PERM = _conjugated_perms()
 
 
 def prep_rk_bitmajor_v3(xp, rk_all):
-    """[15, 128, 1] round-key masks -> v3 conjugated-order masks.
+    """[15, 128, L] round-key masks -> v3 conjugated-order masks.
 
+    L is usually 1 (one cipher broadcast over all lanes); the narrow-walk
+    kernel passes lane-wide masks (L = lanes) for its two-cipher batch.
     One-time cost; hoist outside the per-level loop in kernels."""
-    rk = rk_all.reshape(15, 8, 16, 1)
+    rk = rk_all.reshape(15, 8, 16, rk_all.shape[-1])
     out = [rk[0]]
     for rnd in range(1, 14):
         order = _V3_RK_ORDERS[rnd - 1]
@@ -318,9 +320,10 @@ def prep_rk_bitmajor_v3(xp, rk_all):
 
 
 def _rk_block(rk, rnd, i, n_rest: int):
-    """Round-key block [16, 1] viewed for states with n_rest trailing dims."""
+    """Round-key block [16, L] viewed for states with n_rest trailing dims
+    (L = 1 broadcasts one cipher everywhere; L = lanes is per-lane keys)."""
     blk = rk[rnd, i]
-    return blk.reshape((16,) + (1,) * n_rest)
+    return blk.reshape((16,) + (1,) * (n_rest - 1) + (blk.shape[-1],))
 
 
 def aes256_encrypt_blocks_bitmajor_v3(xp, rk_prepped, blocks, ones):

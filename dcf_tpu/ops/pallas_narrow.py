@@ -1,0 +1,166 @@
+"""Pallas kernel for the hybrid evaluator's NARROW walk (lam >= 48).
+
+The large-lambda hybrid split (backends.large_lambda) reduces a lam-byte
+evaluation to a 32-byte walk plus a GF(2) matmul; this kernel is that
+32-byte walk, fused in VMEM like the flagship lam=16 kernel
+(ops.pallas_eval) — it replaced an XLA plane path that was 82% of the
+hybrid's runtime.
+
+Narrow PRG dataflow (the first two blocks of a big-lambda Hirose PRG,
+reference src/prg.rs:48-62; identical to lam=32 except NO final-bit mask
+— the big PRG's masked byte is wide):
+
+    cipher 0  encrypts (s_b0, ~s_b0): left child's block 0 (s and v)
+    cipher 17 encrypts (s_b1, ~s_b1): RIGHT child's block 1
+    all other child blocks are feed-forward copies:
+        left  = (E0(s_b0)^s_b0,  s_b1)        right = (s_b0, E17(s_b1)^s_b1)
+        v_l   = (E0(~s_b0)^~s_b0, ~s_b1)      v_r   = (~s_b0, E17(~s_b1)^~s_b1)
+    t_l / t_r = bit 0 of byte 0 of the two block-0 outputs
+
+State per DCF block is a separate [128, wt] bit-major plane tile; the 4
+AES encryptions per level run as ONE cipher application over [128, 4*wt]
+with lane-dependent round keys (cipher 0 on the first half, cipher 17 on
+the second).  Besides the two y blocks the kernel emits the t-bit
+TRAJECTORY (the gate bit of every level plus the final bit) — the wide
+part is an affine function of exactly that.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dcf_tpu.ops.aes_bitsliced import (
+    aes256_encrypt_planes_bitmajor,
+    aes_walk_cipher_v3,
+    prep_rk_bitmajor_v3,
+)
+
+__all__ = ["dcf_narrow_walk_pallas"]
+
+
+def _kernel(rk2_ref, s0a_ref, s0b_ref, cs0_ref, cs1_ref, cv0_ref, cv1_ref,
+            np1a_ref, np1b_ref, cw_t_ref, xm_ref,
+            y0_ref, y1_ref, tr_ref, *, b: int, n: int, interpret: bool):
+    wt = xm_ref.shape[3]
+    ones = jnp.int32(-1)
+    # Lane-dependent round keys: cipher 0 over lanes [0, 2wt), cipher 17
+    # over [2wt, 4wt).  rk2_ref is [15, 128, 2]; expand once per grid step.
+    z2 = jnp.zeros((15, 128, 2 * wt), jnp.int32)
+    rk_wide = jnp.concatenate(
+        [rk2_ref[:, :, 0:1] ^ z2, rk2_ref[:, :, 1:2] ^ z2], axis=2)
+    if interpret:
+        def aes(state):
+            # v1 path with per-lane keys: ARK via the wide masks
+            return aes256_encrypt_planes_bitmajor(
+                jnp, rk_wide, state, ones)
+    else:
+        rk_p = prep_rk_bitmajor_v3(jnp, rk_wide)
+
+        def aes(state):
+            return aes_walk_cipher_v3(jnp, rk_p, state, ones)
+
+    z = jnp.zeros((128, wt), jnp.int32)
+    sa = s0a_ref[0] ^ z  # block 0 seed planes
+    sb = s0b_ref[0] ^ z  # block 1
+    t = jnp.full((1, wt), ones if b else jnp.int32(0), jnp.int32)
+    va = z
+    vb = z
+
+    def level(i, carry):
+        sa, sb, t, va, vb = carry
+        tr_ref[0, pl.dslice(i, 1)] = t  # emit the GATE bit of this level
+        spa = sa ^ ones
+        spb = sb ^ ones
+        enc = aes(jnp.concatenate([sa, spa, sb, spb], axis=1))
+        e_sa = enc[:, :wt] ^ sa           # left child block 0 (s)
+        e_va = enc[:, wt:2 * wt] ^ spa    # left child block 0 (v)
+        e_sb = enc[:, 2 * wt:3 * wt] ^ sb  # RIGHT child block 1 (s)
+        e_vb = enc[:, 3 * wt:] ^ spb      # right child block 1 (v)
+        t_l = e_sa[0:1, :]
+        t_r = e_va[0:1, :]
+
+        cs0 = cs0_ref[0, i]  # [128, 1] per level
+        cs1 = cs1_ref[0, i]
+        cv0 = cv0_ref[0, i]
+        cv1 = cv1_ref[0, i]
+        ctl = cw_t_ref[0, i, 0]
+        ctr = cw_t_ref[0, i, 1]
+        cs0g = cs0 & t
+        cs1g = cs1 & t
+        # children (block0, block1) with CW correction
+        sl0, sl1 = e_sa ^ cs0g, sb ^ cs1g
+        sr0, sr1 = sa ^ cs0g, e_sb ^ cs1g
+        vl0, vl1 = e_va, spb
+        vr0, vr1 = spa, e_vb
+        t_l = t_l ^ (t & ctl)
+        t_r = t_r ^ (t & ctr)
+
+        xm = xm_ref[0, i]  # [1, wt]
+        nxm = xm ^ ones
+        va = va ^ (vr0 & xm) ^ (vl0 & nxm) ^ (cv0 & t)
+        vb = vb ^ (vr1 & xm) ^ (vl1 & nxm) ^ (cv1 & t)
+        sa = (sr0 & xm) | (sl0 & nxm)
+        sb = (sr1 & xm) | (sl1 & nxm)
+        t = (t_r & xm) | (t_l & nxm)
+        return (sa, sb, t, va, vb)
+
+    sa, sb, t, va, vb = jax.lax.fori_loop(0, n, level, (sa, sb, t, va, vb))
+    tr_ref[0, pl.dslice(n, 1)] = t
+    y0_ref[0] = va ^ sa ^ (np1a_ref[0] & t)
+    y1_ref[0] = vb ^ sb ^ (np1b_ref[0] & t)
+
+
+def dcf_narrow_walk_pallas(
+    rk2,      # int32 [15, 128, 2]   bit-major round keys (ciphers 0, 17)
+    s0a, s0b,  # int32 [K, 128, 1]   seed planes per narrow block
+    cs0, cs1,  # int32 [K, n, 128, 1]  CW seed planes per block
+    cv0, cv1,  # int32 [K, n, 128, 1]  CW value planes per block
+    np1a, np1b,  # int32 [K, 128, 1]  final CW planes per block
+    cw_t,     # int32 [K, n, 2]      (tl, tr) 0/-1
+    x_mask,   # int32 [1, n, 1, W]   walk-order input-bit masks (shared)
+    *,
+    b: int,
+    tile_words: int = 128,
+    interpret: bool = False,
+):
+    """Narrow walk for party ``b``: returns (y_block0 [K, 128, W],
+    y_block1 [K, 128, W], trajectory [K, n+1, W])."""
+    k_num = s0a.shape[0]
+    n = cs0.shape[1]
+    w = x_mask.shape[3]
+    wt = min(tile_words, w)
+    if w % wt != 0:
+        raise ValueError(f"point words {w} not a multiple of tile {wt}")
+
+    grid = (k_num, w // wt)
+    keyed = pl.BlockSpec((1, 128, 1), lambda k, j: (k, 0, 0))
+    level_spec = pl.BlockSpec((1, n, 128, 1), lambda k, j: (k, 0, 0, 0))
+    state_out = pl.BlockSpec((1, 128, wt), lambda k, j: (k, 0, j))
+    return pl.pallas_call(
+        partial(_kernel, b=b, n=n, interpret=interpret),
+        out_shape=(
+            jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((k_num, n + 1, w), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((15, 128, 2), lambda k, j: (0, 0, 0)),
+            keyed, keyed,
+            level_spec, level_spec, level_spec, level_spec,
+            keyed, keyed,
+            pl.BlockSpec((1, n, 2), lambda k, j: (k, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, n, 1, wt), lambda k, j: (0, 0, 0, j)),
+        ],
+        out_specs=(
+            state_out, state_out,
+            pl.BlockSpec((1, n + 1, wt), lambda k, j: (k, 0, j)),
+        ),
+        interpret=interpret,
+    )(rk2, s0a, s0b, cs0, cs1, cv0, cv1, np1a, np1b, cw_t, x_mask)

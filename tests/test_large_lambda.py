@@ -50,11 +50,13 @@ def test_hybrid_numpy_matches_oracle(bound):
         assert np.array_equal(got, want), f"party {b}"
 
 
-def test_large_lambda_backend_matches_oracle():
-    """Device (XLA) hybrid path == oracle at lam=144, both parties,
-    plus XOR reconstruction sanity."""
+@pytest.mark.parametrize("narrow", ["xla", "pallas"])
+def test_large_lambda_backend_matches_oracle(narrow):
+    """Device hybrid path (both narrow-walk variants) == oracle at
+    lam=144, both parties, plus XOR reconstruction sanity."""
     ck, prg, alphas, betas, bundle, xs = _setup(96, 144)
-    be = LargeLambdaBackend(144, ck)
+    be = LargeLambdaBackend(144, ck, narrow=narrow,
+                            interpret=(narrow == "pallas"))
     ys = {}
     for b in (0, 1):
         kb = bundle.for_party(b)
@@ -67,6 +69,35 @@ def test_large_lambda_backend_matches_oracle():
     for j in range(xs.shape[0]):
         want_y = betas[0].tobytes() if xs[j].tobytes() < a else bytes(144)
         assert recon[j].tobytes() == want_y
+
+
+def test_lane_dependent_round_keys_v3():
+    """The narrow kernel's compiled path uses the v3 cipher with
+    LANE-DEPENDENT round keys (rk [15, 128, L]); pin it against two
+    per-half v1 encryptions so a regression in the generalized
+    prep_rk_bitmajor_v3/_rk_block L>1 path is caught without hardware."""
+    from dcf_tpu.ops.aes_bitsliced import (
+        aes256_encrypt_planes_bitmajor,
+        aes256_encrypt_planes_bitmajor_v3,
+        round_key_masks_bitmajor,
+    )
+
+    rng = np.random.default_rng(11)
+    rk_a = round_key_masks_bitmajor(rng.bytes(32))
+    rk_b = round_key_masks_bitmajor(rng.bytes(32))
+    lanes = 6
+    st = rng.integers(-(2**31), 2**31, (128, 2 * lanes),
+                      dtype=np.int64).astype(np.int32)
+    rk_wide = np.concatenate(
+        [np.broadcast_to(rk_a, (15, 128, lanes)),
+         np.broadcast_to(rk_b, (15, 128, lanes))], axis=2).copy()
+    got = aes256_encrypt_planes_bitmajor_v3(np, rk_wide, st, np.int32(-1))
+    want_a = aes256_encrypt_planes_bitmajor(
+        np, rk_a, st[:, :lanes], np.int32(-1))
+    want_b = aes256_encrypt_planes_bitmajor(
+        np, rk_b, st[:, lanes:], np.int32(-1))
+    assert np.array_equal(got[:, :lanes], want_a)
+    assert np.array_equal(got[:, lanes:], want_b)
 
 
 @pytest.mark.slow
