@@ -34,8 +34,10 @@ mesh; ``--mesh=KxP`` picks the factorization).  Each bench prints one
 human line and one JSON line with criterion-grade stats (median +- MAD of
 ``--reps`` samples after warmup).  ``--profile=DIR`` wraps the timed
 region in a ``jax.profiler`` trace.  gen runs on the C++ host core except
-where a bench states otherwise (secure_relu --backend=pallas-keylanes
-generates keys on device).
+where a bench states otherwise (``secure_relu --device-gen`` generates
+keys on device).  Two bench-specific backends: ``tree`` (full_domain:
+GGM tree expansion) and ``hybrid`` (dcf_large_lambda: Pallas narrow walk
++ GF(2)-affine wide part).
 """
 
 from __future__ import annotations
