@@ -157,10 +157,15 @@ class Dcf:
             return eval_batch_np(self._prg, b, kb, xs)
         # Ship the key image once per (bundle, party), not once per call
         # (put_bundle does the full host plane expansion + transfer).
-        # Keyed on the CALLER's object so repeated evals with the same
-        # bundle hit the cache even though for_party() allocates.
-        key = (id(bundle), int(b) if bundle is not kb else None)
-        if self._shipped_bundle != key:
+        # Keyed on the CALLER's object by IDENTITY, and the object is
+        # RETAINED in the cache entry — comparing raw id() of a temporary
+        # like for_party(b) would false-hit when the allocator reuses the
+        # address of a freed bundle.
+        party = int(b) if bundle is not kb else None
+        hit = (self._shipped_bundle is not None
+               and self._shipped_bundle[0] is bundle
+               and self._shipped_bundle[1] == party)
+        if not hit:
             self._eval_backend.put_bundle(kb)
-            self._shipped_bundle = key
+            self._shipped_bundle = (bundle, party)
         return self._eval_backend.eval(b, xs)
