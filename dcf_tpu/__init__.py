@@ -10,6 +10,6 @@ AES-256 Hirose PRG, key serialization), redesigned for TPU:
 """
 
 from dcf_tpu.api import Dcf  # noqa: F401
-from dcf_tpu.spec import Bound, CmpFn  # noqa: F401
+from dcf_tpu.spec import Bound, CmpFn, ReferenceContractWarning  # noqa: F401
 
 __version__ = "0.1.0"

@@ -25,7 +25,9 @@ Key generation runs on the C++ core when available, else numpy.  For
 many-keys-on-accelerator workflows use ``backends.device_gen.DeviceKeyGen``
 / ``backends.pallas_keylanes`` directly (the config-5 pipeline); for
 full-domain evaluation use ``backends.fulldomain.TreeFullDomain``; for
-mesh sharding use ``parallel.ShardedBitslicedBackend``.
+mesh sharding use ``parallel.ShardedPallasBackend`` (the flagship walk
+kernel) / ``parallel.ShardedKeyLanesBackend`` (many keys) on TPU meshes,
+or ``parallel.ShardedBitslicedBackend`` for the XLA-core variant.
 """
 
 from __future__ import annotations
@@ -34,10 +36,16 @@ from typing import Sequence
 
 import numpy as np
 
+import warnings
+
 from dcf_tpu.gen import gen_batch, random_s0s
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.prg import HirosePrgNp
-from dcf_tpu.spec import Bound
+from dcf_tpu.spec import (
+    Bound,
+    ReferenceContractWarning,
+    hirose_used_cipher_indices,
+)
 
 __all__ = ["Dcf"]
 
@@ -71,16 +79,46 @@ class Dcf:
         self.cipher_keys = list(cipher_keys)
         self.backend_name = (
             _default_backend(lam) if backend == "auto" else backend)
-        self._prg = HirosePrgNp(lam, self.cipher_keys)
-        self._gen_native = None
-        try:
-            from dcf_tpu.native import NativeDcf
+        if self.backend_name not in (
+                "cpu", "numpy", "jax", "bitsliced", "pallas", "hybrid"):
+            raise ValueError(f"unknown backend {self.backend_name!r}")
+        # Fail fast on backend/shape incompatibility (the backends repeat
+        # these checks, but construction is where the user should hear it).
+        if self.backend_name == "pallas" and lam != 16:
+            raise ValueError(
+                f"the pallas backend supports lam=16 only (got {lam}); "
+                "use bitsliced or hybrid")
+        if self.backend_name == "hybrid" and (lam < 48 or lam % 16):
+            raise ValueError(
+                "the hybrid (large-lambda) backend wants lam >= 48, a "
+                f"multiple of 16 (got {lam}); use pallas/bitsliced")
+        # The facade is the API edge: any ReferenceContractWarning fires
+        # exactly once, here, attributed to the caller's Dcf(...) line
+        # (warnings skip package-internal frames); the nested constructions
+        # below (PRG, native core, backends) revalidate the same shape
+        # internally and are silenced so one Dcf() does not repeat the
+        # identical warning.
+        hirose_used_cipher_indices(lam, len(self.cipher_keys))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", ReferenceContractWarning)
+            self._prg = HirosePrgNp(lam, self.cipher_keys)
+            self._gen_native = None
+            try:
+                from dcf_tpu.native import NativeDcf
 
-            self._gen_native = NativeDcf(lam, self.cipher_keys)
-        except Exception:  # no toolchain: numpy keygen still works
-            pass
-        self._eval_backend = self._make_backend(self.backend_name)
-        self._shipped_bundle = None
+                self._gen_native = NativeDcf(lam, self.cipher_keys)
+            except Exception:  # no toolchain: numpy keygen still works
+                pass
+        if self.backend_name == "cpu" and self._gen_native is None:
+            raise ValueError("cpu backend needs the native core")
+        # One backend slot per party, created lazily on first eval(b, ...):
+        # each slot retains its own shipped key image, so the documented
+        # alternating two-party pattern (eval(0, bundle, xs);
+        # eval(1, bundle, xs) across rounds) ships each party's image once
+        # instead of re-staging on every call — and a single-party process
+        # never constructs the other party's backend.
+        self._eval_backends: dict = {}
+        self._shipped_bundle: dict = {}
 
     def _make_backend(self, name: str):
         if name == "cpu":
@@ -155,17 +193,21 @@ class Dcf:
             from dcf_tpu.backends.numpy_backend import eval_batch_np
 
             return eval_batch_np(self._prg, b, kb, xs)
-        # Ship the key image once per (bundle, party), not once per call
+        # Ship the key image once per (party, bundle), not once per call
         # (put_bundle does the full host plane expansion + transfer).
         # Keyed on the CALLER's object by IDENTITY, and the object is
         # RETAINED in the cache entry — comparing raw id() of a temporary
         # like for_party(b) would false-hit when the allocator reuses the
         # address of a freed bundle.
-        party = int(b) if bundle is not kb else None
-        hit = (self._shipped_bundle is not None
-               and self._shipped_bundle[0] is bundle
-               and self._shipped_bundle[1] == party)
-        if not hit:
-            self._eval_backend.put_bundle(kb)
-            self._shipped_bundle = (bundle, party)
-        return self._eval_backend.eval(b, xs)
+        slot = int(b)
+        be = self._eval_backends.get(slot)
+        if be is None:
+            # Shape warnings already fired once at construction.
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", ReferenceContractWarning)
+                be = self._make_backend(self.backend_name)
+            self._eval_backends[slot] = be
+        if self._shipped_bundle.get(slot) is not bundle:
+            be.put_bundle(kb)
+            self._shipped_bundle[slot] = bundle
+        return be.eval(b, xs)

@@ -73,10 +73,12 @@ def wide_affine_np(bundle: KeyBundle):
     bundle: party-restricted, lam > 32.  Returns (const [lam-32],
     w [n+1, lam-32]) uint8 such that y[32:] = const ^ XOR_k t_k * w[k],
     where t_k is the control bit GATING level k (t_0 = the party bit) and
-    t_n the final bit gating cw_np1.  The party enters only through the
-    trajectory, so const/w are party-independent.  Derived by running the
-    wide recursion on the zero trajectory and the n+1 unit trajectories
-    at once.
+    t_n the final bit gating cw_np1.  Only the matrix ``w`` is
+    party-independent (it is built purely from the shared correction
+    words); ``const`` depends on this party's wide seed s0, so it must be
+    recomputed per party-restricted bundle — do NOT cache (const, w)
+    across parties.  Derived by running the wide recursion on the zero
+    trajectory and the n+1 unit trajectories at once.
     """
     lam, n = bundle.lam, bundle.n_bits
     if lam <= NARROW:
@@ -108,7 +110,7 @@ def narrow_walk_np(cipher_keys: Sequence[bytes], bundle: KeyBundle, b: int,
     bundle: party-restricted with FULL lam (sliced to 32 bytes here).
     """
     n = bundle.n_bits
-    prg = HirosePrgNp(NARROW, cipher_keys, mask=False)
+    prg = HirosePrgNp(NARROW, cipher_keys, mask=False, warn=False)
     m = xs.shape[0]
     s = np.broadcast_to(bundle.s0s[0, 0, :NARROW], (m, NARROW)).copy()
     t = np.full(m, b, dtype=np.uint8)
@@ -287,8 +289,10 @@ class LargeLambdaBackend:
         if bundle.s0s.shape[1] != 1 or bundle.num_keys != 1:
             raise ValueError(
                 "LargeLambdaBackend wants a party-restricted single key")
-        # The wide affine matrices are party-independent (the party enters
-        # via the trajectory's t_0); staged lazily on first eval.
+        # Only the affine matrix w is party-independent; const depends on
+        # this party's wide seed, so (const, w) are re-derived for every
+        # put_bundle (staged lazily on first eval) and never reused across
+        # parties.
         self._bundle = bundle
 
         if self.narrow == "pallas":

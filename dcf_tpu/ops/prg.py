@@ -43,10 +43,14 @@ class HirosePrgNp:
     in the wide region (backends.large_lambda).
     """
 
-    def __init__(self, lam: int, keys: Sequence[bytes], mask: bool = True):
+    def __init__(self, lam: int, keys: Sequence[bytes], mask: bool = True,
+                 warn: bool = True):
         self.lam = lam
         self.mask = mask
-        used = hirose_used_cipher_indices(lam, len(keys))
+        # warn=False marks internal constructions (the hybrid evaluator's
+        # narrow sub-walk of a larger contract-conforming shape) that are
+        # not user API edges.
+        used = hirose_used_cipher_indices(lam, len(keys), warn=warn)
         self.round_keys = {i: expand_key_np(keys[i]) for i in used}
 
     def gen(self, seeds: np.ndarray) -> PrgOut:

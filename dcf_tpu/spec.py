@@ -27,6 +27,8 @@ Everything here operates on ``bytes`` and Python ints; no numpy, no JAX.
 
 from __future__ import annotations
 
+import os
+import warnings
 from dataclasses import dataclass
 from enum import Enum
 from typing import Sequence
@@ -34,6 +36,7 @@ from typing import Sequence
 __all__ = [
     "AES_SBOX",
     "SHIFT_ROWS",
+    "ReferenceContractWarning",
     "aes256_expand_key",
     "hirose_used_cipher_indices",
     "aes256_encrypt_block",
@@ -148,19 +151,71 @@ def xor_bytes(*parts: bytes) -> bytes:
 # ---------------------------------------------------------------------------
 
 
-def hirose_used_cipher_indices(lam: int, num_keys: int) -> list[int]:
+class ReferenceContractWarning(UserWarning):
+    """The requested shape is an extension the reference itself cannot run.
+
+    Emitted (not raised — the framework supports these shapes, bit-exactly
+    extending the reference's semantics) when either
+
+    * ``32 <= lam < 144``: the reference's own key-count contract
+      ``N_KEYS = 2*(lam/16)`` (src/prg.rs:17-18) supplies <= 17 ciphers, so
+      its encryption loop would panic indexing ``ciphers[17]``
+      (src/prg.rs:51) — no reference execution of this shape exists; or
+    * ``num_keys < 2*(lam/16)``: fewer ciphers than the reference contract
+      demands (only indices 0 and 17 are ever touched, so this framework
+      accepts any count covering them).
+    """
+
+
+# Warning attribution skips package-internal frames so every API edge
+# (facade, backend constructors, the PRG classes) points the user at THEIR
+# call site, and warning dedup keys on distinct user locations.
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def hirose_used_cipher_indices(
+    lam: int, num_keys: int, warn: bool = True
+) -> list[int]:
     """Validate a Hirose PRG shape and return the cipher indices it uses.
 
     The used indices are ``17*k for k < min(2, lam // 16)`` — a consequence of
     the reference's truncating encryption loop (src/prg.rs:48-51).  Shared by
     every PRG implementation in this framework so the parity-critical key-count
-    contract cannot desynchronize between backends.
+    contract cannot desynchronize between backends.  Shapes the reference
+    could not execute itself warn with ``ReferenceContractWarning`` so the
+    extension surface is explicit at every API edge; ``warn=False`` is for
+    internal sub-walks (e.g. the hybrid evaluator's lam=32 narrow slice of
+    a larger, contract-conforming shape), which are not API edges.
+    Warnings are attributed to the first stack frame outside this package,
+    i.e. the user's constructor call, whichever API edge it went through.
     """
     if lam % 16 != 0:
         raise ValueError("lam must be a multiple of 16 bytes")
     used = [17 * k for k in range(min(2, lam // 16))]
     if used and used[-1] >= num_keys:
         raise ValueError(f"lam={lam} uses cipher indices {used}; got {num_keys} keys")
+    if not warn:
+        return used
+    if 32 <= lam < 144:
+        warnings.warn(
+            f"lam={lam} is reference-inexecutable: its key-count contract "
+            f"2*(lam/16)={2 * (lam // 16)} cannot cover cipher index 17 "
+            "(src/prg.rs:17-18,51); this framework runs it as an extension",
+            ReferenceContractWarning,
+            stacklevel=2,
+            skip_file_prefixes=(_PKG_DIR,),
+        )
+    elif num_keys < 2 * (lam // 16):
+        idx = "/".join(str(i) for i in used)
+        warnings.warn(
+            f"{num_keys} cipher keys relaxes the reference contract "
+            f"N_KEYS=2*(lam/16)={2 * (lam // 16)} (src/prg.rs:17-18); only "
+            f"the used cipher {'index' if len(used) == 1 else 'indices'} "
+            f"({idx}) affect outputs, which are unchanged",
+            ReferenceContractWarning,
+            stacklevel=2,
+            skip_file_prefixes=(_PKG_DIR,),
+        )
     return used
 
 

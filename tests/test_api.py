@@ -1,11 +1,13 @@
 """Top-level Dcf facade: the reference DcfImpl-equivalent entry point."""
 
 import random
+import warnings
 
 import numpy as np
 import pytest
 
-from dcf_tpu import Bound, Dcf
+from dcf_tpu import Bound, Dcf, ReferenceContractWarning
+from dcf_tpu.spec import hirose_used_cipher_indices
 
 
 def rand_bytes(rng, n):
@@ -46,6 +48,62 @@ def test_facade_auto_and_validation():
     with pytest.raises(ValueError, match="alphas"):
         dcf.gen(np.zeros((1, 3), dtype=np.uint8),
                 np.zeros((1, 16), dtype=np.uint8))
+
+
+def test_reference_contract_warnings():
+    """Reference-inexecutable shapes warn at the API edge (src/prg.rs:17-18):
+    lam in [32, 144) (the reference's own contract cannot cover cipher
+    index 17) and relaxed cipher counts (fewer than 2*(lam/16))."""
+    with pytest.warns(ReferenceContractWarning, match="reference-inexecutable"):
+        hirose_used_cipher_indices(64, 18)
+    with pytest.warns(ReferenceContractWarning, match="relaxes the reference"):
+        hirose_used_cipher_indices(16384, 18)
+    rng = random.Random(96)
+    ck = [rand_bytes(rng, 32) for _ in range(18)]
+    with pytest.warns(ReferenceContractWarning):
+        Dcf(2, 128, ck)  # the BASELINE lam=128 extension shape
+    # Reference-executable shapes stay silent: lam=16 (2 keys) and
+    # lam=144 at the exact contract count (18 keys).
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", ReferenceContractWarning)
+        hirose_used_cipher_indices(16, 2)
+        hirose_used_cipher_indices(144, 18)
+        Dcf(2, 16, ck[:2])
+
+
+def test_facade_ships_once_per_party():
+    """Alternating two-party eval of the same bundle ships each party's key
+    image once (per-party cache slots), not once per call."""
+    rng = random.Random(95)
+    ck = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    dcf = Dcf(n_bytes=2, lam=16, cipher_keys=ck, backend="bitsliced")
+    nprng = np.random.default_rng(95)
+    alphas = nprng.integers(0, 256, (1, 2), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (1, 16), dtype=np.uint8)
+    bundle = dcf.gen(alphas, betas, rng=nprng)
+    xs = nprng.integers(0, 256, (5, 2), dtype=np.uint8)
+
+    from dcf_tpu.backends.jax_bitsliced import BitslicedBackend
+
+    ships = []
+    orig = BitslicedBackend.put_bundle
+
+    def counting_put(self, kb):
+        ships.append(kb.s0s.tobytes())
+        return orig(self, kb)
+
+    import unittest.mock as mock
+
+    with mock.patch.object(BitslicedBackend, "put_bundle", counting_put):
+        for _ in range(3):  # three rounds of the documented pattern
+            y0 = dcf.eval(0, bundle, xs)
+            y1 = dcf.eval(1, bundle, xs)
+    assert len(ships) == 2, f"expected 2 ships (one per party), got {len(ships)}"
+    recon = y0[0] ^ y1[0]
+    a = alphas[0].tobytes()
+    for j in range(5):
+        want = betas[0].tobytes() if xs[j].tobytes() < a else bytes(16)
+        assert recon[j].tobytes() == want
 
 
 def test_facade_gt_bound_hybrid():
