@@ -109,25 +109,35 @@ class PallasBackend:
         self._bundle_dev = None
 
     def put_bundle(self, bundle: KeyBundle) -> None:
-        """Ship a party-restricted bundle as bit-major plane masks."""
+        """Ship a party-restricted bundle as bit-major plane masks.
+
+        The plane image is built on host and placed via ``_put_plane`` —
+        the hook sharded subclasses override so each device receives only
+        its key shard (no full-image transient on one chip).
+        """
         if bundle.lam != self.lam:
             raise ValueError("bundle lam mismatch")
         if bundle.s0s.shape[1] != 1:
             raise ValueError("put_bundle requires a party-restricted bundle")
 
         def keyed(a):  # [K, lam] -> [K, 128, 1]
-            return jnp.asarray(bitmajor_plane_masks(a)[:, :, None])
+            return bitmajor_plane_masks(a)[:, :, None]
 
         def leveled(a):  # [K, n, lam] -> [K, n, 128, 1]
-            return jnp.asarray(bitmajor_plane_masks(a)[:, :, :, None])
+            return bitmajor_plane_masks(a)[:, :, :, None]
 
-        self._bundle_dev = dict(
+        host = dict(
             s0=keyed(bundle.s0s[:, 0, :]),
             cw_s=leveled(bundle.cw_s),
             cw_v=leveled(bundle.cw_v),
             cw_np1=keyed(bundle.cw_np1),
-            cw_t=jnp.asarray(bundle.cw_t.astype(np.int32) * -1),
+            cw_t=np.ascontiguousarray(bundle.cw_t.astype(np.int32) * -1),
         )
+        self._bundle_dev = {k: self._put_plane(k, v) for k, v in host.items()}
+
+    def _put_plane(self, name: str, arr: np.ndarray) -> jax.Array:
+        """Placement hook for one staged bundle array (single device here)."""
+        return jnp.asarray(arr)
 
     def _dims(self) -> tuple[int, int]:
         """(k_num, n_bits) of the on-device bundle; raises if absent."""

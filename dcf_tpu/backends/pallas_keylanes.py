@@ -113,6 +113,20 @@ class KeyLanesPallasBackend:
         self._bundle_dev = None
         self._num_keys = 0
 
+    def _kw_pad(self, kw: int) -> int:
+        """Zero-padding of the key-word axis required by the kernel tiling
+        (sharded subclasses pad to whole per-shard granules instead)."""
+        if kw > self.kw_tile and kw % self.kw_tile:
+            return -kw % self.kw_tile
+        return 0
+
+    def _place_kw(self, arr):
+        """Placement hook for one padded byte-major bundle array; sharded
+        subclasses device_put the key-word axis across the mesh here, so
+        the bit-major conversion below runs distributed and no chip holds
+        the full image."""
+        return arr
+
     def put_bundle_device(self, dev: dict) -> None:
         """Adopt a DeviceKeyGen bundle (byte-major planes, both parties);
         planes are reordered to the kernel's bit-major layout on device and
@@ -121,14 +135,15 @@ class KeyLanesPallasBackend:
         by num_keys)."""
         p = self._perm
         kw = dev["cw_s"].shape[-1]
-        if kw > self.kw_tile and kw % self.kw_tile:
-            pad = -kw % self.kw_tile
+        pad = self._kw_pad(kw)
 
-            def padded(a):
-                return jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad)])
-        else:
-            def padded(a):
-                return a
+        def padded(a):
+            if pad:
+                widths = [(0, 0)] * (a.ndim - 1) + [(0, pad)]
+                a = (np.pad(a, widths) if isinstance(a, np.ndarray)
+                     else jnp.pad(a, widths))
+            return self._place_kw(a)
+
         self._num_keys = dev["num_keys"]
         self._bundle_dev = dict(
             s0=tuple(_to_bitmajor_planes(padded(s), p) for s in dev["s0"]),
@@ -155,14 +170,15 @@ class KeyLanesPallasBackend:
         def pad_keys(a):
             return np.pad(a, [(0, k_pad - k)] + [(0, 0)] * (a.ndim - 1))
 
+        # Stays numpy until put_bundle_device's placement hook, so sharded
+        # subclasses can split the host image straight to the shards.
         def planes(a):  # [K, ..., lam] -> uint32 [..., 8lam, Wk]
             bits = byte_bits_lsb(pad_keys(a))  # [K, ..., 8lam]
-            return jnp.asarray(pack_lanes(
-                np.ascontiguousarray(np.moveaxis(bits, 0, -1))))
+            return pack_lanes(
+                np.ascontiguousarray(np.moveaxis(bits, 0, -1)))
 
         def packed_bits(a):  # [K, n] -> uint32 [n, Wk]
-            return jnp.asarray(pack_lanes(np.ascontiguousarray(
-                pad_keys(a).T)))
+            return pack_lanes(np.ascontiguousarray(pad_keys(a).T))
 
         self.put_bundle_device(dict(
             s0=(planes(bundle.s0s[:, 0]), planes(bundle.s0s[:, 1])),
@@ -174,9 +190,19 @@ class KeyLanesPallasBackend:
             num_keys=k,
         ))
 
+    def _m_granule(self) -> int:
+        """Point-count granule (per-shard tile granule when sharded)."""
+        return self.m_tile
+
+    def _stage_mask(self, xs: np.ndarray) -> jax.Array:
+        """xs -> walk-order masks; the hook sharded subclasses override to
+        place the mask across the mesh's point axis."""
+        return _stage_xs_keylanes(jnp.asarray(xs))
+
     def stage(self, xs: np.ndarray) -> dict:
         """Shared points uint8 [M, nb] -> staged walk masks (M padded to a
-        multiple of m_tile; pad points evaluated and discarded)."""
+        multiple of the point granule; pad points evaluated and
+        discarded)."""
         if self._bundle_dev is None:
             raise ValueError("no key bundle on device; call put_bundle first")
         if xs.ndim != 2:
@@ -185,11 +211,12 @@ class KeyLanesPallasBackend:
         if xs.shape[1] * 8 != n:
             raise ValueError("xs width mismatch with bundle")
         m = xs.shape[0]
-        m_pad = -(-m // self.m_tile) * self.m_tile
+        gran = self._m_granule()
+        m_pad = -(-m // gran) * gran
         if m_pad != m:
             xs = np.pad(xs, [(0, m_pad - m), (0, 0)])
-        x_mask = _stage_xs_keylanes(jnp.asarray(np.ascontiguousarray(xs)))
-        return {"x_mask": x_mask, "m": m}
+        return {"x_mask": self._stage_mask(np.ascontiguousarray(xs)),
+                "m": m}
 
     def eval_staged(self, b: int, staged: dict) -> jax.Array:
         """Party ``b`` eval; returns DEVICE y planes int32 [128, M_pad, Kw]

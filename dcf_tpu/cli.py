@@ -29,8 +29,11 @@ points only (mesh 1xN); the multi-key mesh factorizations (8x1 / 4x2 /
 Backends: ``cpu`` (C++ core, all threads), ``cpu1`` (C++ single thread —
 the stand-in for the reference's serial feature matrix), ``numpy``,
 ``jax`` (XLA scan/vmap), ``bitsliced`` (XLA bit-planes), ``pallas``
-(fused TPU kernel, lam=16 only), ``sharded`` (shard_map over a device
-mesh; ``--mesh=KxP`` picks the factorization).  Each bench prints one
+(fused TPU kernel, lam=16 only), ``sharded`` (the XLA bit-plane core
+under shard_map over a device mesh; ``--mesh=KxP`` picks the
+factorization), ``sharded-pallas`` (the Pallas kernels under shard_map:
+the flagship walk kernel for dcf_batch_eval, the keys-in-lanes kernel
+for secure_relu; lam=16 only).  Each bench prints one
 human line and one JSON line with criterion-grade stats (median +- MAD of
 ``--reps`` samples after warmup).  ``--profile=DIR`` wraps the timed
 region in a ``jax.profiler`` trace.  gen runs on the C++ host core except
@@ -53,7 +56,8 @@ from dcf_tpu.gen import random_s0s
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.spec import Bound
 
-BACKENDS = ("cpu", "cpu1", "numpy", "jax", "bitsliced", "pallas", "sharded")
+BACKENDS = ("cpu", "cpu1", "numpy", "jax", "bitsliced", "pallas", "sharded",
+            "sharded-pallas")
 
 
 def log(msg: str) -> None:
@@ -110,10 +114,14 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
         from dcf_tpu.backends.pallas_backend import PallasBackend
 
         be = PallasBackend(lam, cipher_keys)
-    elif backend == "sharded":
+    elif backend in ("sharded", "sharded-pallas"):
         import jax
 
-        from dcf_tpu.parallel import ShardedBitslicedBackend, make_mesh
+        from dcf_tpu.parallel import (
+            ShardedBitslicedBackend,
+            ShardedPallasBackend,
+            make_mesh,
+        )
 
         shape = _parse_mesh(getattr(args, "mesh", ""))
         if shape is None:
@@ -121,7 +129,14 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
             shape = (1, len(jax.devices()))
         mesh = make_mesh(shape=shape)
         log(f"mesh: {dict(mesh.shape)}")
-        be = ShardedBitslicedBackend(lam, cipher_keys, mesh)
+        if backend == "sharded-pallas":
+            # Mosaic on TPU meshes; the Pallas interpreter elsewhere
+            # (the DCF_CPU_DEVICES virtual-mesh smoke mode).
+            be = ShardedPallasBackend(
+                lam, cipher_keys, mesh,
+                interpret=jax.devices()[0].platform != "tpu")
+        else:
+            be = ShardedBitslicedBackend(lam, cipher_keys, mesh)
     else:
         raise ValueError(f"unknown backend {backend!r}")
 
@@ -215,7 +230,7 @@ def bench_dcf(args) -> None:
     """Single gen + single-point eval latency (benches/dcf.rs analog)."""
     from dcf_tpu.native import NativeDcf
 
-    if args.backend == "sharded":
+    if args.backend in ("sharded", "sharded-pallas"):
         raise SystemExit(
             "dcf is a single-point latency bench; sharding one point over "
             "a mesh is meaningless — use any single-device backend")
@@ -296,8 +311,9 @@ def bench_large_lambda(args) -> None:
 
     lam, nb = 16384, 16
     m = args.points or 10_000
-    if args.backend == "pallas":
-        raise SystemExit("pallas backend is lam=16 only; use hybrid/cpu")
+    if args.backend in ("pallas", "sharded-pallas"):
+        raise SystemExit(f"{args.backend} backend is lam=16 only; "
+                         "use hybrid/cpu")
     rng = np.random.default_rng(args.seed)
     ck = _cipher_keys(lam, rng)
     native = NativeDcf(lam, ck)
@@ -377,6 +393,40 @@ def bench_secure_relu(args) -> None:
     native = NativeDcf(lam, ck)
     log(f"gen {k} keys ...")
     bundle = native.gen_batch(alphas, betas, s0s, Bound.LT_BETA)
+    if args.backend == "sharded-pallas":
+        # The keys-in-lanes Pallas kernel sharded over the mesh — the path
+        # a TPU pod runs for config 5.  Staged methodology (results stay
+        # HBM-resident, like _timed_staged): the packed CW image ships
+        # once, both parties walk it per rep.
+        import jax
+
+        from dcf_tpu.parallel import ShardedKeyLanesBackend, make_mesh
+        from dcf_tpu.utils.benchtime import device_sync
+
+        mesh = make_mesh(shape=_parse_mesh(args.mesh))
+        log(f"mesh: {dict(mesh.shape)}")
+        be = ShardedKeyLanesBackend(
+            lam, ck, mesh, interpret=jax.devices()[0].platform != "tpu")
+        be.put_bundle(bundle)
+        staged = be.stage(xs)
+        y0 = be.eval_staged(0, staged)
+        y1 = be.eval_staged(1, staged)
+        mism = int(be.relu_mismatch_count(y0, y1, alphas, betas, xs))
+        if mism:
+            raise SystemExit(f"secure_relu: {mism} reconstruction mismatches")
+        log(f"on-device verification: 0 mismatches of {k * m}")
+
+        def run():
+            y0 = be.eval_staged(0, staged)
+            y1 = be.eval_staged(1, staged)
+            device_sync(y0 ^ y1)
+
+        dt, mad, ss = _timed(run, args.reps, args.profile)
+        _emit("secure_relu", "sharded-keylanes-pallas", "evals_per_sec",
+              2 * k * m / dt, "evals/s (staged, results HBM-resident)",
+              dt, mad, len(ss))
+        return
+
     if args.backend == "sharded":
         # The one multi-key CLI workload: this is where mesh factorizations
         # (8x1 / 4x2 / 2x4) are meaningfully compared via --mesh.  Uses the
@@ -588,11 +638,11 @@ def main(argv=None) -> None:
         return
     for name in BENCHES if args.bench == "all" else [args.bench]:
         if args.bench == "all" and name == "dcf_large_lambda" and \
-                args.backend in ("pallas", "sharded"):
+                args.backend in ("pallas", "sharded", "sharded-pallas"):
             log("skipping dcf_large_lambda (lam=16-only backend)")
             continue
         if args.bench == "all" and name == "dcf" and \
-                args.backend == "sharded":
+                args.backend in ("sharded", "sharded-pallas"):
             log("skipping dcf (single-point bench, not shardable)")
             continue
         BENCHES[name](args)

@@ -22,3 +22,7 @@ from dcf_tpu.parallel.mesh import (  # noqa: F401
     ShardedJaxBackend,
     make_mesh,
 )
+from dcf_tpu.parallel.pallas_sharded import (  # noqa: F401
+    ShardedKeyLanesBackend,
+    ShardedPallasBackend,
+)

@@ -163,12 +163,12 @@ class ShardedBitslicedBackend(_BitslicedBase):
 
     Same mesh contract as ``ShardedJaxBackend`` but each chip runs the
     bit-plane core (``backends.jax_bitsliced.eval_core_bitsliced``) on its
-    local (key-shard, point-shard) block — the path a multi-chip
-    deployment would actually use (on real TPU pods the per-shard body
-    can be swapped for the Pallas walk kernel; the XLA core is the
-    variant testable on virtual CPU meshes).  No collectives inside the
-    walk (pure map); keys shard the HBM-resident plane image, points
-    shard transient state.
+    local (key-shard, point-shard) block.  For the Pallas kernels sharded
+    over the same mesh (the path a real TPU pod runs) see
+    ``parallel.pallas_sharded.ShardedPallasBackend`` /
+    ``ShardedKeyLanesBackend``.  No collectives inside the walk (pure
+    map); keys shard the HBM-resident plane image, points shard
+    transient state.
     """
 
     def __init__(self, lam: int, cipher_keys: Sequence[bytes], mesh: Mesh):

@@ -1,0 +1,232 @@
+"""The Pallas fast path sharded over a device mesh.
+
+Round 2 sharded only the XLA cores (``ShardedJaxBackend`` /
+``ShardedBitslicedBackend``); the kernels the headline numbers come from
+existed solely as single-chip programs.  This module runs them under
+``jax.shard_map`` on the same (keys, points) mesh contract:
+
+* ``ShardedPallasBackend`` — the fused VMEM walk kernel
+  (``ops.pallas_eval.dcf_eval_pallas``, the flagship batch-eval path):
+  keys shard the HBM-resident plane image, points shard the lane-word
+  axis.  Each chip runs the unmodified kernel on its local
+  (key-shard, word-shard) block; the walk is a pure map (reference
+  parallelism: rayon over points, /root/reference/src/lib.rs:194-199), so
+  there are no collectives inside it and scaling is linear modulo
+  input/result movement.
+* ``ShardedKeyLanesBackend`` — the many-keys kernel
+  (``ops.pallas_keylanes``, the config-5 secure-ReLU path): the packed
+  key-word axis shards over ``keys``, the shared-point axis over
+  ``points``.
+
+Both are testable without hardware: construct with ``interpret=True`` on a
+virtual CPU mesh (tests/test_sharding.py) — the Pallas interpreter lowers
+to plain JAX ops, which shard_map partitions like any other program.  On a
+real TPU mesh the same classes compile the Mosaic kernels per shard.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dcf_tpu.backends._common import prepare_batch
+from dcf_tpu.backends.pallas_backend import (
+    PallasBackend,
+    _from_planes_jit,
+    _stage_xs,
+)
+from dcf_tpu.backends.pallas_keylanes import KeyLanesPallasBackend
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.ops.pallas_eval import DEFAULT_TILE_WORDS, dcf_eval_pallas
+from dcf_tpu.ops.pallas_keylanes import dcf_eval_keylanes_pallas
+
+__all__ = ["ShardedPallasBackend", "ShardedKeyLanesBackend"]
+
+
+class ShardedPallasBackend(PallasBackend):
+    """The flagship Pallas walk kernel under shard_map on a (keys, points)
+    mesh.  Same API as ``PallasBackend`` (put_bundle / stage / eval_staged /
+    eval); key count must divide the keys axis, and the point axis is padded
+    so every point-shard is a whole number of kernel tiles."""
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes], mesh: Mesh,
+                 tile_words: int = DEFAULT_TILE_WORDS,
+                 interpret: bool = False):
+        super().__init__(lam, cipher_keys, tile_words=tile_words,
+                         interpret=interpret)
+        self.mesh = mesh
+        kaxis, paxis = mesh.axis_names
+        self._ksize = mesh.shape[kaxis]
+        self._psize = mesh.shape[paxis]
+        self._spec_keyed = P(kaxis)                     # [K, 128, 1]
+        self._spec_xmask = P(kaxis, None, None, paxis)  # [K, n, 1, W]
+        self._spec_xmask_shared = P(None, None, None, paxis)
+        self._spec_y = P(kaxis, None, paxis)            # [K, 128, W]
+        self._fns: dict = {}
+
+    def _shard_fn(self, b: int, shared: bool, wt: int):
+        """Cached jit(shard_map(kernel)) per (party, shared, tile)."""
+        key = (b, shared, wt)
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                jax.shard_map(
+                    partial(dcf_eval_pallas, b=b, tile_words=wt,
+                            interpret=self.interpret),
+                    mesh=self.mesh,
+                    in_specs=(
+                        P(),                 # rk (replicated)
+                        self._spec_keyed,    # s0_t
+                        self._spec_keyed,    # cw_s_t
+                        self._spec_keyed,    # cw_v_t
+                        self._spec_keyed,    # cw_np1_t
+                        self._spec_keyed,    # cw_t
+                        self._spec_xmask_shared if shared
+                        else self._spec_xmask,
+                    ),
+                    out_specs=self._spec_y,
+                    check_vma=False,  # pure map, no collectives in the walk
+                )
+            )
+            self._fns[key] = fn
+        return fn
+
+    def put_bundle(self, bundle: KeyBundle) -> None:
+        if bundle.num_keys % self._ksize:
+            raise ValueError(
+                f"num_keys={bundle.num_keys} not divisible by keys-axis "
+                f"size {self._ksize}")
+        super().put_bundle(bundle)
+
+    def _put_plane(self, name: str, arr: np.ndarray) -> jax.Array:
+        """Each device receives only its key shard of the host plane image
+        (every staged array is keyed on axis 0) — no full-image transient
+        on any single chip."""
+        return jax.device_put(arr, NamedSharding(self.mesh, self._spec_keyed))
+
+    def _plan_tiles(self, m: int) -> tuple[int, int]:
+        """Per-SHARD tile plan: each point-shard gets the same whole number
+        of kernel tiles; returns (tile words, padded total words across all
+        shards)."""
+        m_local = -(-m // self._psize) if m else 0
+        wt, w_local = super()._plan_tiles(m_local)
+        return wt, w_local * self._psize
+
+    def _stage_sharded(self, xs: np.ndarray, shared: bool):
+        key = ("stage", shared)
+        stage = self._fns.get(key)
+        if stage is None:
+            spec = self._spec_xmask_shared if shared else self._spec_xmask
+            stage = jax.jit(_stage_xs,
+                            out_shardings=NamedSharding(self.mesh, spec))
+            self._fns[key] = stage
+        return stage(jnp.asarray(xs))
+
+    def stage(self, xs: np.ndarray) -> dict:
+        xs, m, wt = self._prepare(xs)
+        if m == 0:
+            raise ValueError("cannot stage an empty batch")
+        x_mask = self._stage_sharded(xs, xs.shape[0] == 1)
+        return {"x_mask": x_mask, "m": m, "wt": wt}
+
+    def eval_staged(self, b: int, staged: dict) -> jax.Array:
+        dev = self._bundle_dev
+        shared = staged["x_mask"].shape[0] == 1
+        fn = self._shard_fn(int(b), shared, staged["wt"])
+        return fn(self.rk, dev["s0"], dev["cw_s"], dev["cw_v"],
+                  dev["cw_np1"], dev["cw_t"], staged["x_mask"])
+
+    def eval(self, b: int, xs: np.ndarray,
+             bundle: KeyBundle | None = None) -> np.ndarray:
+        if bundle is not None:
+            self.put_bundle(bundle)
+        xs, m, wt = self._prepare(xs)
+        if m == 0:
+            return np.zeros(
+                (self._bundle_dev["s0"].shape[0], 0, self.lam),
+                dtype=np.uint8)
+        x_mask = self._stage_sharded(xs, xs.shape[0] == 1)
+        y = self.eval_staged(b, {"x_mask": x_mask, "m": m, "wt": wt})
+        return self.staged_to_bytes(y, m)
+
+
+class ShardedKeyLanesBackend(KeyLanesPallasBackend):
+    """The many-keys (config-5) Pallas kernel under shard_map: the packed
+    key-word axis shards over ``keys``, shared points over ``points``.
+    Same API as ``KeyLanesPallasBackend``; the key-word count is padded to
+    a whole number of per-shard ``kw_tile`` granules."""
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes], mesh: Mesh,
+                 m_tile: int = 8, kw_tile: int = 128,
+                 level_chunk: int = 8, interpret: bool = False):
+        super().__init__(lam, cipher_keys, m_tile=m_tile, kw_tile=kw_tile,
+                         level_chunk=level_chunk, interpret=interpret)
+        self.mesh = mesh
+        kaxis, paxis = mesh.axis_names
+        self._ksize = mesh.shape[kaxis]
+        self._psize = mesh.shape[paxis]
+        self._spec_kw = P(None, kaxis)          # [n|128, Kw]
+        self._spec_cw = P(None, None, kaxis)    # [n, 128, Kw]
+        self._spec_xm = P(None, paxis, None)    # [n, M, 1]
+        self._spec_y = P(None, paxis, kaxis)    # [128, M, Kw]
+        self._fns: dict = {}
+
+    def _kw_pad(self, kw: int) -> int:
+        # Every shard must hold a whole number of kw_tile x 32-key granules.
+        return -kw % (self._ksize * self.kw_tile)
+
+    def _place_kw(self, arr):
+        """Split each byte-major bundle array straight to the shards (the
+        key-word axis is the trailing axis), so the bit-major conversion in
+        the parent runs distributed and no chip holds the full image."""
+        spec = self._spec_cw if arr.ndim == 3 else self._spec_kw
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def _m_granule(self) -> int:
+        return self.m_tile * self._psize
+
+    def _stage_mask(self, xs: np.ndarray) -> jax.Array:
+        stage = self._fns.get("stage")
+        if stage is None:
+            from dcf_tpu.backends.pallas_keylanes import _stage_xs_keylanes
+
+            stage = jax.jit(
+                _stage_xs_keylanes,
+                out_shardings=NamedSharding(self.mesh, self._spec_xm))
+            self._fns["stage"] = stage
+        return stage(jnp.asarray(xs))
+
+    def eval_staged(self, b: int, staged: dict) -> jax.Array:
+        dev = self._bundle_dev
+        fn = self._fns.get(int(b))
+        if fn is None:
+            fn = jax.jit(
+                jax.shard_map(
+                    partial(dcf_eval_keylanes_pallas, b=int(b),
+                            m_tile=self.m_tile, kw_tile=self.kw_tile,
+                            level_chunk=self.level_chunk,
+                            interpret=self.interpret),
+                    mesh=self.mesh,
+                    in_specs=(
+                        P(),            # rk
+                        self._spec_kw,  # s0
+                        self._spec_cw,  # cw_s
+                        self._spec_cw,  # cw_v
+                        self._spec_kw,  # cw_tl
+                        self._spec_kw,  # cw_tr
+                        self._spec_kw,  # cw_np1
+                        self._spec_xm,  # x_mask
+                    ),
+                    out_specs=self._spec_y,
+                    check_vma=False,
+                )
+            )
+            self._fns[int(b)] = fn
+        return fn(self.rk, dev["s0"][b], dev["cw_s"], dev["cw_v"],
+                  dev["cw_tl"], dev["cw_tr"], dev["cw_np1"],
+                  staged["x_mask"])
