@@ -50,10 +50,38 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def run_tpu_suite() -> str:
+    """Run the on-hardware test lane (tests/test_tpu.py: all four compiled
+    Mosaic kernels + DeviceKeyGen + the sharded wrappers vs the numpy
+    oracle) in a subprocess and return its one-line verdict.
+
+    Runs BEFORE this process touches the accelerator so the subprocess has
+    the chip to itself during its compiles.
+    """
+    import subprocess
+
+    env = dict(os.environ)
+    env["DCF_TPU_TESTS"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-m", "tpu", "-q"],
+            capture_output=True, text=True, env=env, timeout=1200,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return "timeout"
+    tail = [ln for ln in proc.stdout.splitlines()
+            if " passed" in ln or " failed" in ln or " error" in ln]
+    return tail[-1].strip() if tail else f"rc={proc.returncode}"
+
+
 def main() -> None:
     from dcf_tpu.gen import random_s0s
     from dcf_tpu.native import NativeDcf
     from dcf_tpu.spec import Bound
+
+    log("on-TPU test lane (compiled kernels vs oracle) ...")
+    tpu_tests = run_tpu_suite()
+    log(f"on-TPU test lane: {tpu_tests}")
 
     rng = np.random.default_rng(2026)
     cipher_keys = [rng.bytes(32), rng.bytes(32)]
@@ -88,14 +116,23 @@ def main() -> None:
 
     party_bundle = bundle.for_party(0)
 
-    def bring_up(backend):
-        """Parity gate + staging + full-batch warmup; any Mosaic/hardware
+    def bring_up(cls):
+        """Parity gates + staging + full-batch warmup; any Mosaic/hardware
         failure (including ones that only appear at the full 2^20 grid)
-        surfaces here, inside the fallback guard."""
+        surfaces here, inside the fallback guard.
+
+        Parity is two-layered: a C++-core byte anchor on the first
+        M_PARITY points (the cross-implementation check) and a FULL
+        on-device two-party reconstruction of all 2^20 points against the
+        comparison function (party 1 evaluated once on a second backend
+        instance, outside the timed region).
+        """
+        backend = cls(LAM, cipher_keys)
         backend.put_bundle(party_bundle)
         y_small = backend.eval(0, xs[:M_PARITY])
         parity_ok = bool(np.array_equal(y_small[0], y_cpu[0, :M_PARITY]))
-        log(f"parity (first {M_PARITY} pts): {'OK' if parity_ok else 'MISMATCH'}")
+        log(f"parity vs C++ (first {M_PARITY} pts): "
+            f"{'OK' if parity_ok else 'MISMATCH'}")
         if not parity_ok:
             raise SystemExit("bit-exact parity check failed")
         t0 = time.perf_counter()
@@ -107,13 +144,21 @@ def main() -> None:
         sync(y)
         log(f"warmup (compile + first run): {time.perf_counter() - t0:.1f}s")
         backend.staged_to_bytes(y, 32)  # compile the d2h conversion untimed
-        return staged
+        be1 = cls(LAM, cipher_keys)
+        be1.put_bundle(bundle.for_party(1))
+        y1 = be1.eval_staged(1, staged)  # the x image is party-independent
+        mism = int(backend.points_mismatch_count(
+            y, y1, alphas[0].tobytes(), betas[0].tobytes(), staged))
+        log(f"parity (device, all {M_TPU} pts two-party): "
+            f"{mism} mismatches")
+        if mism:
+            raise SystemExit("full on-device parity check failed")
+        return backend, staged
 
     try:
         from dcf_tpu.backends.pallas_backend import PallasBackend
 
-        backend = PallasBackend(LAM, cipher_keys)
-        staged = bring_up(backend)
+        backend, staged = bring_up(PallasBackend)
         name = "pallas"
     except SystemExit:
         raise
@@ -122,8 +167,7 @@ def main() -> None:
             "falling back to XLA bitsliced")
         from dcf_tpu.backends.jax_bitsliced import BitslicedBackend
 
-        backend = BitslicedBackend(LAM, cipher_keys)
-        staged = bring_up(backend)
+        backend, staged = bring_up(BitslicedBackend)
         name = "bitsliced"
     log(f"backend: {name}")
 
@@ -163,6 +207,11 @@ def main() -> None:
                     f"{name} kernel, median of {SAMPLES})"
                 ),
                 "vs_baseline": round(dev_rate / cpu_rate, 2),
+                "parity": (
+                    f"full (device, {M_TPU} pts two-party) + "
+                    f"C++ {M_PARITY}-pt anchor"
+                ),
+                "tpu_tests": tpu_tests,
             }
         )
     )

@@ -248,6 +248,50 @@ def _fd_mismatch_bytemajor(y0, y1, beta_mask, start, alpha, *, gt: bool):
     return jnp.sum(jax.lax.population_count(diff).astype(jnp.int32))
 
 
+def walk_inside_mask(x_of, alpha_bits: tuple, w: int, dtype, gt: bool):
+    """Lexicographic compare on walk-order lane masks, the shared core of
+    the random-points parity counters: returns the ``inside`` word mask
+    [1, W] — all-ones in lanes where x < alpha (x > alpha for gt).
+
+    ``x_of(i)`` yields walk-bit i's lane mask [1, W] (0 / all-ones);
+    ``alpha_bits`` is alpha MSB-first (static, so the n-step compare
+    unrolls to plain word ops).  Used by both the bit-major (Pallas) and
+    byte-major (bitsliced) counters so the bound semantics cannot
+    desynchronize between the two bench parity gates.
+    """
+    inside = jnp.zeros((1, w), dtype)
+    eq = ~inside  # all-ones
+    for i, ai in enumerate(alpha_bits):  # static unroll: n word-ops
+        xi = x_of(i)
+        if ai and not gt:
+            inside = inside | (eq & ~xi)
+            eq = eq & xi
+        elif not ai and gt:
+            inside = inside | (eq & xi)
+            eq = eq & ~xi
+        else:  # the walk bit cannot move x past alpha in this direction
+            eq = eq & (xi if ai else ~xi)
+    return inside
+
+
+@partial(jax.jit, static_argnames=("alpha_bits", "gt"))
+def _points_mismatch_bytemajor(y0, y1, beta_mask, x_mask, *,
+                               alpha_bits: tuple, gt: bool):
+    """Mismatch count vs the comparison function for staged RANDOM points.
+
+    y0/y1: eval_staged outputs uint32 [8lam, 1, W]; x_mask: staged
+    walk-order lane masks uint32 [n, 1, W]; alpha_bits: alpha's n bits
+    MSB-first (static).  The lexicographic compare runs on the bit-mask
+    planes directly; padding points are genuine evaluations of x=0 and
+    self-verify."""
+    w = y0.shape[-1]
+    inside = walk_inside_mask(
+        lambda i: x_mask[i], alpha_bits, w, jnp.uint32, gt)
+    expect = beta_mask[:, None, None] & inside[None, :, :]
+    diff = jnp.bitwise_or.reduce(y0 ^ y1 ^ expect, axis=0)  # [1, W]
+    return jnp.sum(jax.lax.population_count(diff).astype(jnp.int32))
+
+
 def _planes_to_bytes_dev(planes, lam: int):
     """uint32 [8*lam, K, W] -> uint8 [K, W*32, lam]."""
     p, k, w = planes.shape
@@ -396,6 +440,23 @@ class BitslicedBackend(_BitslicedBase):
             byte_bits_lsb(np.frombuffer(beta, dtype=np.uint8))))
         return _fd_mismatch_bytemajor(
             y0, y1, beta_mask, jnp.uint32(start), jnp.uint32(alpha), gt=gt)
+
+    def points_mismatch_count(self, y0, y1, alpha: bytes, beta: bytes,
+                              staged: dict, gt: bool = False) -> jax.Array:
+        """Full on-device two-party verification for staged RANDOM points
+        (the bench parity gate): count of points whose XOR reconstruction
+        differs from ``beta if x < alpha else 0`` (``>`` for gt).  y0/y1:
+        both parties' ``eval_staged`` outputs over the SAME staged batch.
+        Single key.  Returns a DEVICE int32 scalar."""
+        if y0.shape[1] != 1:
+            raise ValueError("points_mismatch_count is single-key")
+        from dcf_tpu.utils.bits import alpha_walk_bits
+
+        beta_mask = jnp.asarray(expand_bits_to_masks(
+            byte_bits_lsb(np.frombuffer(beta, dtype=np.uint8))))
+        return _points_mismatch_bytemajor(
+            y0, y1, beta_mask, staged["x_mask"],
+            alpha_bits=alpha_walk_bits(alpha), gt=gt)
 
     def eval_staged(self, b: int, staged: dict) -> jax.Array:
         """Party ``b`` eval on staged points; returns DEVICE-resident y planes

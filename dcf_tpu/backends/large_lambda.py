@@ -196,6 +196,26 @@ def _wide_tail(t_planes, wide_const, wide_w8, m: int, col_chunk: int):
     return jnp.concatenate(outs, axis=1) ^ wide_const[None, :]
 
 
+@partial(jax.jit, static_argnames=("gt",))
+def _points_mismatch_bytes(y0, y1, alpha_a, beta_a, xs, *, gt: bool):
+    """Mismatch count vs the comparison function for byte-level staged
+    outputs (the large-lambda regime, where plane layouts would be
+    wasteful): y0/y1 uint8 [1, M_pad, lam]; xs uint8 [1, M_pad, nb].
+    Padding points are genuine evaluations of x=0 and self-verify."""
+    x = xs[0]
+    nb = x.shape[1]
+    inside = jnp.zeros((x.shape[0],), jnp.bool_)
+    eq = jnp.ones((x.shape[0],), jnp.bool_)
+    for j in range(nb):  # lexicographic big-endian unsigned compare
+        xj = x[:, j]
+        aj = alpha_a[j]
+        inside = inside | (eq & ((xj > aj) if gt else (xj < aj)))
+        eq = eq & (xj == aj)
+    expect = jnp.where(inside[:, None], beta_a[None, :], jnp.uint8(0))
+    recon = y0[0] ^ y1[0]
+    return jnp.sum(jnp.any(recon != expect, axis=1).astype(jnp.int32))
+
+
 @partial(jax.jit, static_argnames=("b", "col_chunk"))
 def _hybrid_eval(rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
                  wide_const, wide_w8, xs, b: int, col_chunk: int):
@@ -373,6 +393,19 @@ class LargeLambdaBackend:
 
     def staged_to_bytes(self, y: jax.Array, m: int) -> np.ndarray:
         return np.asarray(y[:, :m, :])
+
+    def points_mismatch_count(self, y0, y1, alpha: bytes, beta: bytes,
+                              staged: dict, gt: bool = False) -> jax.Array:
+        """Full on-device two-party verification for the staged batch:
+        count of points whose XOR reconstruction differs from
+        ``beta if x < alpha else 0`` (``>`` for gt).  y0/y1: both parties'
+        ``eval_staged`` outputs over the SAME staged dict.  Returns a
+        DEVICE int32 scalar."""
+        return _points_mismatch_bytes(
+            y0, y1,
+            jnp.asarray(np.frombuffer(alpha, dtype=np.uint8)),
+            jnp.asarray(np.frombuffer(beta, dtype=np.uint8)),
+            staged["xs"], gt=gt)
 
     def eval(self, b: int, xs: np.ndarray,
              bundle: KeyBundle | None = None) -> np.ndarray:

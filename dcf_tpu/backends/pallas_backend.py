@@ -22,12 +22,17 @@ from dcf_tpu.backends.jax_bitsliced import (
     _planes_to_bytes_dev,
     _range_xs_dev,
     _xs_to_mask_dev,
+    walk_inside_mask,
 )
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes_bitsliced import round_key_masks_bitmajor
 from dcf_tpu.ops.pallas_eval import DEFAULT_TILE_WORDS, dcf_eval_pallas
 from dcf_tpu.spec import hirose_used_cipher_indices
-from dcf_tpu.utils.bits import bitmajor_perm, bitmajor_plane_masks
+from dcf_tpu.utils.bits import (
+    alpha_walk_bits,
+    bitmajor_perm,
+    bitmajor_plane_masks,
+)
 
 __all__ = ["PallasBackend"]
 
@@ -55,6 +60,28 @@ def _eval_staged(rk, s0_t, cw_s_t, cw_v_t, cw_np1_t, cw_t, x_mask,
 @partial(jax.jit, static_argnames=("m", "nb"))
 def _stage_range_jit(start, m: int, nb: int):
     return _stage_xs(_range_xs_dev(start, m, nb))
+
+
+@partial(jax.jit, static_argnames=("alpha_bits", "gt"))
+def _points_mismatch_bitmajor(y0, y1, beta_mask, x_mask, *,
+                              alpha_bits: tuple, gt: bool):
+    """Mismatch count vs the comparison function for staged RANDOM points.
+
+    y0/y1: eval_staged outputs int32 [1, 128, W]; x_mask: the staged
+    walk-order lane masks int32 [1, n, 1, W]; alpha_bits: the n bits of
+    alpha MSB-first (static — one compile per key, the bench shape).  The
+    lexicographic compare runs directly on the bit-mask planes
+    (jax_bitsliced.walk_inside_mask, shared with the byte-major counter),
+    so no extra host->device traffic is needed.  Padding points are
+    genuine evaluations of x=0 and therefore self-verify.
+    """
+    w = y0.shape[-1]
+    inside = walk_inside_mask(
+        lambda i: x_mask[0, i, 0][None, :], alpha_bits, w, jnp.int32, gt)
+    expect = beta_mask[None, :, :] & inside[:, None, :]  # [1, 128, W]
+    diff = jnp.bitwise_or.reduce(y0 ^ y1 ^ expect, axis=1)
+    return jnp.sum(jax.lax.population_count(
+        jax.lax.bitcast_convert_type(diff, jnp.uint32)).astype(jnp.int32))
 
 
 @partial(jax.jit, static_argnames=("gt",))
@@ -212,6 +239,22 @@ class PallasBackend:
             np.frombuffer(beta, dtype=np.uint8))[:, None])
         return _fd_mismatch_bitmajor(
             y0, y1, beta_mask, jnp.uint32(start), jnp.uint32(alpha), gt=gt)
+
+    def points_mismatch_count(self, y0, y1, alpha: bytes, beta: bytes,
+                              staged: dict, gt: bool = False) -> jax.Array:
+        """Full on-device two-party verification for staged RANDOM points
+        (the bench parity gate): count of points whose XOR reconstruction
+        differs from ``beta if x < alpha else 0`` (``> `` for gt).  y0/y1:
+        ``eval_staged`` outputs of the two parties over the SAME staged
+        batch (the x image is party-independent).  Single key.  Returns a
+        DEVICE int32 scalar."""
+        if y0.shape[0] != 1:
+            raise ValueError("points_mismatch_count is single-key")
+        beta_mask = jnp.asarray(bitmajor_plane_masks(
+            np.frombuffer(beta, dtype=np.uint8))[:, None])
+        return _points_mismatch_bitmajor(
+            y0, y1, beta_mask, staged["x_mask"],
+            alpha_bits=alpha_walk_bits(alpha), gt=gt)
 
     def eval_staged(self, b: int, staged: dict) -> jax.Array:
         """Party ``b`` eval on staged points; returns DEVICE-resident y planes

@@ -65,7 +65,12 @@ def log(msg: str) -> None:
 
 
 def _cipher_keys(lam: int, rng) -> list[bytes]:
+    """The reference contract count 2*(lam/16), floored at 18 for lam >= 32
+    (any such shape touches cipher index 17 — reference-inexecutable lams
+    48..128 run here as extensions and need the extra keys)."""
     n_keys = max(2, 2 * (lam // 16))
+    if lam >= 32:
+        n_keys = max(n_keys, 18)
     return [rng.bytes(32) for _ in range(n_keys)]
 
 
@@ -226,6 +231,30 @@ def _emit(name: str, backend: str, metric: str, value: float, unit: str,
     )
 
 
+def _full_device_parity(args, be, lam, ck, native, bundle, alphas, betas,
+                        xs) -> None:
+    """Full on-device two-party parity for staged backends: every staged
+    point's XOR reconstruction is checked against the comparison function
+    on device (VERDICT's replacement for the old spot checks); the C++
+    anchor the caller already ran remains the cross-implementation gate.
+    No-op for backends without the staged counter."""
+    if be is None or not hasattr(be, "points_mismatch_count") \
+            or not hasattr(be, "stage"):
+        return
+    _run1, be1 = _make_evaluator(args.backend, lam, ck, native, args)
+    st = be.stage(xs)
+    y0 = be.eval_staged(0, st)
+    be1.put_bundle(bundle.for_party(1))
+    y1 = be1.eval_staged(1, st)
+    mism = int(be.points_mismatch_count(
+        y0, y1, alphas[0].tobytes(), betas[0].tobytes(), st))
+    if mism:
+        raise SystemExit(
+            f"full on-device parity: {mism} mismatching points")
+    log(f"parity: full (device, all {xs.shape[0]} pts two-party): "
+        "0 mismatches")
+
+
 def bench_dcf(args) -> None:
     """Single gen + single-point eval latency (benches/dcf.rs analog)."""
     from dcf_tpu.native import NativeDcf
@@ -274,12 +303,10 @@ def bench_batch(args) -> None:
     rng = np.random.default_rng(args.seed)
     ck = _cipher_keys(lam, rng)
     native = NativeDcf(lam, ck)
+    alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
+    betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
     bundle = native.gen_batch(
-        rng.integers(0, 256, (1, nb), dtype=np.uint8),
-        rng.integers(0, 256, (1, lam), dtype=np.uint8),
-        random_s0s(1, lam, rng),
-        Bound.LT_BETA,
-    )
+        alphas, betas, random_s0s(1, lam, rng), Bound.LT_BETA)
     xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
     run, be = _make_evaluator(args.backend, lam, ck, native, args)
     k0 = bundle.for_party(0)
@@ -288,6 +315,8 @@ def bench_batch(args) -> None:
         want = native.eval(0, bundle, xs[:2048])
         assert np.array_equal(y[0, :2048], want[0]), "parity mismatch vs C++"
         log("parity vs C++ core: OK (first 2048 pts)")
+        _full_device_parity(args, be, lam, ck, native, bundle,
+                            alphas, betas, xs)
     if be is not None and hasattr(be, "stage"):
         # Staged methodology (_timed_staged): xs conversion + transfer
         # happen outside the timed region, like criterion's untimed setup
@@ -306,10 +335,16 @@ def bench_large_lambda(args) -> None:
 
     --backend=hybrid: the narrow-walk + GF(2)-affine split
     (backends.large_lambda) — the device path built for this regime.
+    --lam picks the range size: 16384 (the reference bench's literal
+    shape, 2048 AES ciphers) or e.g. 256 (BASELINE.json config 4).
     """
     from dcf_tpu.native import NativeDcf
 
-    lam, nb = 16384, 16
+    lam, nb = args.lam or 16384, 16
+    if lam < 48 or lam % 16:
+        raise SystemExit(
+            f"--lam must be a multiple of 16 >= 48 for the large-lambda "
+            f"bench, got {lam}")
     m = args.points or 10_000
     if args.backend in ("pallas", "sharded-pallas"):
         raise SystemExit(f"{args.backend} backend is lam=16 only; "
@@ -317,23 +352,24 @@ def bench_large_lambda(args) -> None:
     rng = np.random.default_rng(args.seed)
     ck = _cipher_keys(lam, rng)
     native = NativeDcf(lam, ck)
-    log(f"gen (lam=16384, {2 * (lam // 16)} ciphers) ...")
+    log(f"gen (lam={lam}, {2 * (lam // 16)} ciphers) ...")
+    alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
+    betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
     bundle = native.gen_batch(
-        rng.integers(0, 256, (1, nb), dtype=np.uint8),
-        rng.integers(0, 256, (1, lam), dtype=np.uint8),
-        random_s0s(1, lam, rng),
-        Bound.LT_BETA,
-    )
+        alphas, betas, random_s0s(1, lam, rng), Bound.LT_BETA)
     xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
     run, be = _make_evaluator(args.backend, lam, ck, native, args)
     k0 = bundle.for_party(0)
     if args.check:
-        # Parity needs only a small slice; at lam=16384 a full-batch bytes
-        # fetch is ~160MB through the dev tunnel.
+        # The C++ byte anchor needs only a small slice (at lam=16384 a
+        # full-batch bytes fetch is ~160MB through the dev tunnel); the
+        # full batch is then verified on device, both parties.
         y = run(0, k0, xs[:64])
         want = native.eval(0, bundle, xs[:64])
         assert np.array_equal(y[0, :64], want[0]), "parity mismatch vs C++"
         log("parity vs C++ core: OK (first 64 pts)")
+        _full_device_parity(args, be, lam, ck, native, bundle,
+                            alphas, betas, xs)
     if be is not None and hasattr(be, "stage"):
         # Staged methodology: at lam=16384 the per-rep result image is
         # 160MB, which the dev tunnel would otherwise dominate.
@@ -618,6 +654,9 @@ def main(argv=None) -> None:
                    help="write a jax.profiler trace of the timed region")
     p.add_argument("--n-bits", type=int, default=0,
                    help="domain bits for full_domain (0 = 24)")
+    p.add_argument("--lam", type=int, default=0,
+                   help="range bytes for dcf_large_lambda (0 = 16384; "
+                        "256 = BASELINE config 4)")
     p.add_argument("--domain-bytes", type=int, default=0,
                    help="input width for dcf_batch_eval (0 = 16)")
     p.add_argument("--device-gen", action="store_true",

@@ -25,10 +25,20 @@ __all__ = [
     "expand_bits_to_masks",
     "bitmajor_perm",
     "bitmajor_plane_masks",
+    "alpha_walk_bits",
 ]
 
 _SHIFTS32 = np.arange(32, dtype=np.uint32)
 _SHIFTS8 = np.arange(8, dtype=np.uint8)
+
+
+def alpha_walk_bits(alpha: bytes) -> tuple:
+    """alpha bytes -> its MSB-first walk-order bit tuple.
+
+    Static (hashable) so the on-device parity counters can unroll the
+    lexicographic compare over the staged bit-mask planes — one compile
+    per key, the bench shape."""
+    return tuple((byte >> (7 - k)) & 1 for byte in alpha for k in range(8))
 
 
 def pack_lanes(bits: np.ndarray) -> np.ndarray:

@@ -5,17 +5,26 @@ can run without TPU hardware.  In this environment a sitecustomize module
 imports jax at interpreter start with JAX_PLATFORMS=axon (the TPU tunnel), so
 setting env vars here is too late for jax's config defaults — we override the
 live config instead, before any backend initializes.
+
+``DCF_TPU_TESTS=1`` flips the suite onto the real accelerator instead: use
+it with ``-m tpu`` to run the on-hardware lane (tests/test_tpu.py), which
+exercises the COMPILED Mosaic kernels — the code the headline numbers come
+from — rather than the interpreter graphs the CPU lane checks.
 """
 
 import os
 
-from dcf_tpu.utils.provision import force_cpu_devices
+ON_TPU_LANE = os.environ.get("DCF_TPU_TESTS") == "1"
 
-if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
-    force_cpu_devices(os.environ, 8)
-else:
-    os.environ["JAX_PLATFORMS"] = "cpu"
+if not ON_TPU_LANE:
+    from dcf_tpu.utils.provision import force_cpu_devices
 
-import jax  # noqa: E402
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        force_cpu_devices(os.environ, 8)
+    else:
+        os.environ["JAX_PLATFORMS"] = "cpu"
 
-jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

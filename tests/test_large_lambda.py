@@ -100,6 +100,26 @@ def test_lane_dependent_round_keys_v3():
     assert np.array_equal(got[:, lanes:], want_b)
 
 
+def test_hybrid_points_mismatch_count():
+    """The hybrid backend's on-device full-batch parity counter: zero for
+    a correct pair, nonzero under corruption (lam=144, xla narrow)."""
+    ck, prg, alphas, betas, bundle, xs = _setup(94, 144)
+    be0 = LargeLambdaBackend(144, ck, narrow="xla")
+    be1 = LargeLambdaBackend(144, ck, narrow="xla")
+    be0.put_bundle(bundle.for_party(0))
+    be1.put_bundle(bundle.for_party(1))
+    st = be0.stage(xs)
+    y0 = be0.eval_staged(0, st)
+    y1 = be1.eval_staged(1, st)
+    a, b = alphas[0].tobytes(), betas[0].tobytes()
+    assert int(be0.points_mismatch_count(y0, y1, a, b, st)) == 0
+    import jax.numpy as jnp
+
+    y1_bad = jnp.asarray(np.asarray(y1)).at[0, 0, 0].set(
+        np.asarray(y1)[0, 0, 0] ^ 1)
+    assert int(be0.points_mismatch_count(y0, y1_bad, a, b, st)) > 0
+
+
 @pytest.mark.slow
 def test_large_lambda_backend_lam2048():
     ck, prg, alphas, betas, bundle, xs = _setup(97, 2048, m=4)

@@ -131,6 +131,46 @@ def test_pallas_two_party_reconstruction():
     assert np.array_equal(recon, want)
 
 
+@pytest.mark.parametrize("gt", [False, True])
+def test_points_mismatch_count_device(gt):
+    """The full on-device random-points parity counter (the bench gate):
+    zero for a correct two-party pair, nonzero under a corrupted share —
+    both the bit-major (Pallas) and byte-major (bitsliced) variants."""
+    from dcf_tpu.backends.jax_bitsliced import BitslicedBackend
+    from dcf_tpu.backends.pallas_backend import PallasBackend
+
+    rng = random.Random(65)
+    ck = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    prg = HirosePrgNp(16, ck)
+    nprng = np.random.default_rng(9)
+    bound = spec.Bound.GT_BETA if gt else spec.Bound.LT_BETA
+    alphas = nprng.integers(0, 256, (1, 2), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (1, 16), dtype=np.uint8)
+    bundle = gen_batch(prg, alphas, betas, random_s0s(1, 16, nprng), bound)
+    xs = nprng.integers(0, 256, (43, 2), dtype=np.uint8)
+    xs[0] = alphas[0]
+
+    for cls, kwargs in ((PallasBackend, dict(interpret=True)),
+                        (BitslicedBackend, dict())):
+        be0 = cls(16, ck, **kwargs)
+        be1 = cls(16, ck, **kwargs)
+        be0.put_bundle(bundle.for_party(0))
+        be1.put_bundle(bundle.for_party(1))
+        st = be0.stage(xs)
+        y0 = be0.eval_staged(0, st)
+        y1 = be1.eval_staged(1, st)
+        a, b = alphas[0].tobytes(), betas[0].tobytes()
+        assert int(be0.points_mismatch_count(y0, y1, a, b, st, gt=gt)) == 0, \
+            cls.__name__
+        # Negative control: corrupt one lane of party 1's share.
+        import jax.numpy as jnp
+
+        y1_bad = jnp.asarray(np.asarray(y1)).at[..., 0].set(
+            np.asarray(y1)[..., 0] ^ 1)
+        assert int(be0.points_mismatch_count(y0, y1_bad, a, b, st,
+                                             gt=gt)) > 0, cls.__name__
+
+
 def test_pallas_rejects_other_lambda():
     from dcf_tpu.backends.pallas_backend import PallasBackend
 
