@@ -1,0 +1,115 @@
+"""Microbenchmark: byte-permutation styles on [16, L] bit-blocks.
+
+The v3 cipher's linear layers run at ~48% of peak VPU issue while the
+S-box runs at ~80% (micro_vpu.py) — the gap is the permutation copies
+(slice+concat chains).  This probe prices the candidate encodings of a
+16-row byte permutation so the kernel can pick the cheapest:
+
+  xor3        3-term XOR, no permutation (the floor: pure compute)
+  generic16   16 single-row slices + concat (the v3 final realign)
+  roll8       concat(x[8:], x[:8]) — one 2-part roll
+  nearroll    a real v3 round-term permutation (2D torus translation,
+              8 contiguous runs -> 8-part concat)
+  maskroll    (x & Me) | (roll8(x) & Mo) — the shear decomposition of
+              the drift perm sr^2 (see aes_bitsliced v4 notes)
+  translate2  (roll_a(x) & M1) | (roll_b(x) & M2) — 2-roll form of a
+              2D torus translation (candidate round-term encoding)
+
+Usage: python -m benchmarks.micro_perm [--lanes 256] [--iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from dcf_tpu.ops.aes_bitsliced import _V3_TERM_PERMS
+from dcf_tpu.utils.benchtime import device_sync as _sync
+
+GENERIC = [0, 9, 2, 11, 4, 13, 6, 15, 8, 1, 10, 3, 12, 5, 14, 7]  # sr^2
+# A REAL v3 round-term permutation (2D torus translation, 8 contiguous
+# runs under _perm_concat) — the thing the kernel actually pays for.
+NEARROLL = [int(i) for i in _V3_TERM_PERMS[0][0]]
+
+
+def _perm_concat(x, perm):
+    parts = []
+    i = 0
+    while i < len(perm):
+        j = i
+        while j + 1 < len(perm) and perm[j + 1] == perm[j] + 1:
+            j += 1
+        parts.append(x[perm[i]:perm[j] + 1])
+        i = j + 1
+    return jnp.concatenate(parts, axis=0)
+
+
+def _kernel(x_ref, m_ref, y_ref, *, iters: int, style: str):
+    me = m_ref[0]
+    mo = m_ref[1]
+
+    def step(_i, s):
+        if style == "xor3":
+            return s ^ me ^ mo
+        if style == "generic16":
+            return _perm_concat(s, GENERIC) ^ me
+        if style == "roll8":
+            return jnp.concatenate([s[8:], s[:8]], axis=0) ^ me
+        if style == "nearroll":
+            return _perm_concat(s, NEARROLL) ^ me
+        if style == "maskroll":
+            r = jnp.concatenate([s[8:], s[:8]], axis=0)
+            return (s & me) | (r & mo)
+        if style == "translate2":
+            ra = jnp.concatenate([s[5:], s[:5]], axis=0)
+            rb = jnp.concatenate([s[9:], s[:9]], axis=0)
+            return (ra & me) | (rb & mo)
+        raise ValueError(style)
+
+    y_ref[:] = jax.lax.fori_loop(0, iters, step, x_ref[:])
+
+
+def _time(style, x, m, out_shape, iters, reps=3):
+    f = jax.jit(lambda *a: pl.pallas_call(
+        partial(_kernel, iters=iters, style=style),
+        out_shape=out_shape)(*a))
+    _sync(f(x, m))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(f(x, m))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lanes", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=2_000_000)
+    args = ap.parse_args()
+    lanes, iters = args.lanes, args.iters
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(-(2**31), 2**31, (16, lanes),
+                                 dtype=np.int64).astype(np.int32))
+    m = jnp.asarray(rng.integers(-(2**31), 2**31, (2, 16, lanes),
+                                 dtype=np.int64).astype(np.int32))
+    out = jax.ShapeDtypeStruct((16, lanes), jnp.int32)
+    for style in ("xor3", "generic16", "roll8", "nearroll", "maskroll",
+                  "translate2"):
+        t1 = _time(style, x, m, out, iters)
+        t2 = _time(style, x, m, out, 2 * iters)
+        slope = max(t2 - t1, 1e-9)
+        ns = slope / iters * 1e9
+        print(json.dumps({"style": style, "ns_per_step": round(ns, 3),
+                          "t1": round(t1, 3)}))
+
+
+if __name__ == "__main__":
+    main()

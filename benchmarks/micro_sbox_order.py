@@ -1,0 +1,209 @@
+"""Probe: does gate EMISSION ORDER of the BP113 S-box change Mosaic's
+schedule quality?
+
+The S-box runs at ~80% of peak VPU issue (micro_vpu.py); the serial
+GF(2^4)-inversion middle bounds it.  Mosaic schedules the traced jaxpr
+with limited reordering, so the order we emit gates in may shape register
+pressure and issue slots.  This probe rebuilds BP113 as an explicit gate
+list (verified against the hand-written evaluator) and times three
+emission orders back-to-back:
+
+  published   the Boyar-Peralta paper order (what sbox_planes_bp113 does)
+  asap        levelized: all depth-k gates before any depth-k+1 gate
+  greedy      pressure-aware list schedule: among ready gates, prefer ones
+              that kill live values (Sethi-Ullman-ish)
+
+Usage: python -m benchmarks.micro_sbox_order [--iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from dcf_tpu.ops.sbox_circuit import sbox_planes_bp113
+from dcf_tpu.utils.benchtime import device_sync as _sync
+
+# Gate list: (name, op, a, b) with op in {"^", "&", "~^"}; inputs are
+# x0..x7 (MSB-first: x0 = bits[7]) or prior gate names.
+BP113_GATES = [
+    ("y14", "^", "x3", "x5"), ("y13", "^", "x0", "x6"),
+    ("y9", "^", "x0", "x3"), ("y8", "^", "x0", "x5"),
+    ("t0", "^", "x1", "x2"), ("y1", "^", "t0", "x7"),
+    ("y4", "^", "y1", "x3"), ("y12", "^", "y13", "y14"),
+    ("y2", "^", "y1", "x0"), ("y5", "^", "y1", "x6"),
+    ("y3", "^", "y5", "y8"), ("t1", "^", "x4", "y12"),
+    ("y15", "^", "t1", "x5"), ("y20", "^", "t1", "x1"),
+    ("y6", "^", "y15", "x7"), ("y10", "^", "y15", "t0"),
+    ("y11", "^", "y20", "y9"), ("y7", "^", "x7", "y11"),
+    ("y17", "^", "y10", "y11"), ("y19", "^", "y10", "y8"),
+    ("y16", "^", "t0", "y11"), ("y21", "^", "y13", "y16"),
+    ("y18", "^", "x0", "y16"),
+    ("t2", "&", "y12", "y15"), ("t3", "&", "y3", "y6"),
+    ("t4", "^", "t3", "t2"), ("t5", "&", "y4", "x7"),
+    ("t6", "^", "t5", "t2"), ("t7", "&", "y13", "y16"),
+    ("t8", "&", "y5", "y1"), ("t9", "^", "t8", "t7"),
+    ("t10", "&", "y2", "y7"), ("t11", "^", "t10", "t7"),
+    ("t12", "&", "y9", "y11"), ("t13", "&", "y14", "y17"),
+    ("t14", "^", "t13", "t12"), ("t15", "&", "y8", "y10"),
+    ("t16", "^", "t15", "t12"), ("t17", "^", "t4", "t14"),
+    ("t18", "^", "t6", "t16"), ("t19", "^", "t9", "t14"),
+    ("t20", "^", "t11", "t16"), ("t21", "^", "t17", "y20"),
+    ("t22", "^", "t18", "y19"), ("t23", "^", "t19", "y21"),
+    ("t24", "^", "t20", "y18"), ("t25", "^", "t21", "t22"),
+    ("t26", "&", "t21", "t23"), ("t27", "^", "t24", "t26"),
+    ("t28", "&", "t25", "t27"), ("t29", "^", "t28", "t22"),
+    ("t30", "^", "t23", "t24"), ("t31", "^", "t22", "t26"),
+    ("t32", "&", "t31", "t30"), ("t33", "^", "t32", "t24"),
+    ("t34", "^", "t23", "t33"), ("t35", "^", "t27", "t33"),
+    ("t36", "&", "t24", "t35"), ("t37", "^", "t36", "t34"),
+    ("t38", "^", "t27", "t36"), ("t39", "&", "t29", "t38"),
+    ("t40", "^", "t25", "t39"), ("t41", "^", "t40", "t37"),
+    ("t42", "^", "t29", "t33"), ("t43", "^", "t29", "t40"),
+    ("t44", "^", "t33", "t37"), ("t45", "^", "t42", "t41"),
+    ("z0", "&", "t44", "y15"), ("z1", "&", "t37", "y6"),
+    ("z2", "&", "t33", "x7"), ("z3", "&", "t43", "y16"),
+    ("z4", "&", "t40", "y1"), ("z5", "&", "t29", "y7"),
+    ("z6", "&", "t42", "y11"), ("z7", "&", "t45", "y17"),
+    ("z8", "&", "t41", "y10"), ("z9", "&", "t44", "y12"),
+    ("z10", "&", "t37", "y3"), ("z11", "&", "t33", "y4"),
+    ("z12", "&", "t43", "y13"), ("z13", "&", "t40", "y5"),
+    ("z14", "&", "t29", "y2"), ("z15", "&", "t42", "y9"),
+    ("z16", "&", "t45", "y14"), ("z17", "&", "t41", "y8"),
+    ("t46", "^", "z15", "z16"), ("t47", "^", "z10", "z11"),
+    ("t48", "^", "z5", "z13"), ("t49", "^", "z9", "z10"),
+    ("t50", "^", "z2", "z12"), ("t51", "^", "z2", "z5"),
+    ("t52", "^", "z7", "z8"), ("t53", "^", "z0", "z3"),
+    ("t54", "^", "z6", "z7"), ("t55", "^", "z16", "z17"),
+    ("t56", "^", "z12", "t48"), ("t57", "^", "t50", "t53"),
+    ("t58", "^", "z4", "t46"), ("t59", "^", "z3", "t54"),
+    ("t60", "^", "t46", "t57"), ("t61", "^", "z14", "t57"),
+    ("t62", "^", "t52", "t58"), ("t63", "^", "t49", "t58"),
+    ("t64", "^", "z4", "t59"), ("t65", "^", "t61", "t62"),
+    ("t66", "^", "z1", "t63"), ("s0", "^", "t59", "t63"),
+    ("s6", "~^", "t56", "t62"), ("s7", "~^", "t48", "t60"),
+    ("t67", "^", "t64", "t65"), ("s3", "^", "t53", "t66"),
+    ("s4", "^", "t51", "t66"), ("s5", "^", "t47", "t65"),
+    ("s1", "~^", "t64", "s3"), ("s2", "~^", "t55", "t67"),
+]
+OUTS = ["s7", "s6", "s5", "s4", "s3", "s2", "s1", "s0"]
+
+
+def eval_gates(bits, ones, order):
+    env = {f"x{i}": bits[7 - i] for i in range(8)}
+    for name, op, a, b in order:
+        if op == "^":
+            env[name] = env[a] ^ env[b]
+        elif op == "&":
+            env[name] = env[a] & env[b]
+        else:
+            env[name] = env[a] ^ env[b] ^ ones
+    return [env[s] for s in OUTS]
+
+
+def order_asap():
+    depth = {f"x{i}": 0 for i in range(8)}
+    gates = []
+    for g in BP113_GATES:
+        depth[g[0]] = max(depth[g[2]], depth[g[3]]) + 1
+        gates.append((depth[g[0]], g))
+    gates.sort(key=lambda dg: dg[0])
+    return [g for _, g in gates]
+
+
+def order_greedy():
+    """List schedule minimizing live values: prefer gates whose emission
+    kills operands (last use), then deeper-critical-path gates."""
+    remaining = list(BP113_GATES)
+    users: dict = {}
+    for g in BP113_GATES:
+        for src in (g[2], g[3]):
+            users.setdefault(src, set()).add(g[0])
+    # critical-path height for tie-breaking
+    height: dict = {}
+    for g in reversed(BP113_GATES):
+        height[g[0]] = 1 + max(
+            (height.get(u, 0) for u in users.get(g[0], ())), default=0)
+    done = {f"x{i}" for i in range(8)}
+    out = []
+    remaining_users = {k: set(v) for k, v in users.items()}
+    while remaining:
+        ready = [g for g in remaining if g[2] in done and g[3] in done]
+        def score(g):
+            kills = sum(
+                1 for src in {g[2], g[3]}
+                if remaining_users.get(src, set()) == {g[0]})
+            return (-kills, -height.get(g[0], 0))
+        g = min(ready, key=score)
+        remaining.remove(g)
+        out.append(g)
+        done.add(g[0])
+        for src in (g[2], g[3]):
+            remaining_users.get(src, set()).discard(g[0])
+    return out
+
+
+def _kernel(x_ref, y_ref, *, iters: int, order):
+    ones = jnp.int32(-1)
+
+    def body(i, ps):
+        return tuple(eval_gates(list(ps), ones, order))
+
+    out = jax.lax.fori_loop(0, iters, body, tuple(x_ref[i] for i in range(8)))
+    acc = out[0]
+    for p in out[1:]:
+        acc = acc ^ p
+    y_ref[0] = acc
+
+
+def _time(order, x, out_shape, iters, reps=4):
+    f = jax.jit(lambda a: pl.pallas_call(
+        partial(_kernel, iters=iters, order=order), out_shape=out_shape)(a))
+    _sync(f(x))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(f(x))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=6_000_000)
+    ap.add_argument("--lanes", type=int, default=256)
+    args = ap.parse_args()
+
+    # Verify the gate list and both reorders against the reference impl.
+    rng = np.random.default_rng(0)
+    xs = np.arange(256, dtype=np.uint16)
+    bits = [((xs >> i) & 1).astype(bool) for i in range(8)]
+    ones = np.ones(256, dtype=bool)
+    want = sbox_planes_bp113(bits, ones)
+    for nm, order in (("published", BP113_GATES), ("asap", order_asap()),
+                      ("greedy", order_greedy())):
+        got = eval_gates(bits, ones, order)
+        assert all(np.array_equal(g, w) for g, w in zip(got, want)), nm
+
+    x = jnp.asarray(rng.integers(-(2**31), 2**31, (8, 16, args.lanes),
+                                 dtype=np.int64).astype(np.int32))
+    out = jax.ShapeDtypeStruct((1, 16, args.lanes), jnp.int32)
+    for nm, order in (("published", BP113_GATES), ("asap", order_asap()),
+                      ("greedy", order_greedy())):
+        t1 = _time(order, x, out, args.iters)
+        t2 = _time(order, x, out, 2 * args.iters)
+        ns = max(t2 - t1, 1e-9) / args.iters * 1e9
+        tera = 113 * 16 * args.lanes / ns / 1e3
+        print(json.dumps({"order": nm, "ns_per_sbox": round(ns, 2),
+                          "tera_ops": round(tera, 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -153,22 +153,33 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
 
 def _timed_staged(be, xs, reps: int, profile: str):
     """Shared staged-bench timing: stage once (untimed, criterion-setup
-    analog), DISPATCHES_PER_SAMPLE dispatches per sample with one digest
-    sync, results HBM-resident.  Returns (per-dispatch median — i.e. per
+    analog), k dispatches per sample with one digest sync, results
+    HBM-resident.  k adapts to the measured dispatch time: fast dispatches
+    need many per sample to amortize the tunnel-sync RTT; for slow ones
+    (>= ~0.3s) the sync share is already small and the full count would
+    take minutes per sample.  Returns (per-dispatch median — i.e. per
     full-batch eval — MAD, samples, unit)."""
-    from dcf_tpu.utils.benchtime import DISPATCHES_PER_SAMPLE, device_sync
+    from dcf_tpu.utils.benchtime import (
+        DISPATCHES_PER_SAMPLE,
+        DISPATCHES_PER_SAMPLE_SLOW,
+        device_sync,
+    )
 
     staged = be.stage(xs)
     y = be.eval_staged(0, staged)
     device_sync(y)  # staged-path warmup / compile
+    t0 = time.perf_counter()
+    y = be.eval_staged(0, staged)
+    device_sync(y)  # one post-compile dispatch incl. the sync RTT
+    k = (DISPATCHES_PER_SAMPLE if time.perf_counter() - t0 < 0.4
+         else DISPATCHES_PER_SAMPLE_SLOW)
 
     def timed():
-        for _ in range(DISPATCHES_PER_SAMPLE):
+        for _ in range(k):
             y = be.eval_staged(0, staged)
         device_sync(y)
 
     dt, mad, ss = _timed(timed, reps, profile)
-    k = DISPATCHES_PER_SAMPLE
     return dt / k, mad / k, ss, "evals/s (staged, results HBM-resident)"
 
 
@@ -525,12 +536,12 @@ def bench_full_domain(args) -> None:
         # Device-accumulated counters, fetched once per sample — the same
         # sync-amortization methodology as the staged batch bench.
         from dcf_tpu.backends.fulldomain import TreeFullDomain
-        from dcf_tpu.utils.benchtime import DISPATCHES_PER_SAMPLE
+        from dcf_tpu.utils.benchtime import DISPATCHES_PER_SAMPLE_SLOW
 
         import jax.numpy as jnp
 
         fd = TreeFullDomain(lam, ck)
-        per_run_checks = DISPATCHES_PER_SAMPLE
+        per_run_checks = DISPATCHES_PER_SAMPLE_SLOW
 
         def run():
             counters = [fd.check_device(bundle, alpha, beta, n_bits)

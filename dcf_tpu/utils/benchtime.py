@@ -1,19 +1,26 @@
 """Shared timing constants/helpers for the accelerator benches.
 
-One digest-fetch sync costs a ~85ms round-trip on the tunneled dev device
-(``block_until_ready`` does not block there), so timed samples dispatch
-DISPATCHES_PER_SAMPLE evals and sync once; bench.py and the CLI share the
-value so their methodologies cannot drift.
+One digest-fetch sync costs a ~85-155ms round-trip on the tunneled dev
+device (``block_until_ready`` does not block there), so timed samples
+dispatch DISPATCHES_PER_SAMPLE evals and sync once; bench.py and the CLI
+share the value so their methodologies cannot drift.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["DISPATCHES_PER_SAMPLE", "device_sync"]
+__all__ = ["DISPATCHES_PER_SAMPLE", "DISPATCHES_PER_SAMPLE_SLOW",
+           "device_sync"]
 
-# ~5ms of amortized sync against ~1.6s of kernel time at the flagship shape.
-DISPATCHES_PER_SAMPLE = 16
+# ~1.2ms of amortized sync against ~100ms per dispatch at the flagship
+# shape (measured 2026-07-31: 16 dispatches under-reported the chip by
+# ~6% once the tunnel RTT grew to ~155ms).
+DISPATCHES_PER_SAMPLE = 128
+
+# For benches whose single dispatch is >= ~0.3s (full-domain tree): the
+# sync share is already < 3% at 16, and 128 would take minutes per sample.
+DISPATCHES_PER_SAMPLE_SLOW = 16
 
 
 def device_sync(y) -> None:
