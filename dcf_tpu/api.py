@@ -160,11 +160,6 @@ class Dcf:
         betas = np.asarray(betas, dtype=np.uint8)
         if alphas.ndim != 2 or alphas.shape[1] != self.n_bytes:
             raise ValueError(f"alphas must be [K, {self.n_bytes}]")
-        if self.backend_name == "hybrid" and alphas.shape[0] != 1:
-            raise ValueError(
-                "the hybrid (large-lambda) backend is single-key; gen one "
-                "key per Dcf, or pick backend='bitsliced' for multi-key "
-                "large-lambda work")
         if s0s is None:
             s0s = random_s0s(
                 alphas.shape[0], self.lam,

@@ -53,7 +53,8 @@ from dcf_tpu.ops.prg import HirosePrgNp
 from dcf_tpu.spec import hirose_used_cipher_indices
 from dcf_tpu.utils.bits import byte_bits_lsb
 
-__all__ = ["LargeLambdaBackend", "wide_affine_np", "narrow_walk_np"]
+__all__ = ["LargeLambdaBackend", "wide_affine_np", "wide_affine_batch_np",
+           "narrow_walk_np"]
 
 NARROW = 32  # bytes covered by the real (encrypted) blocks
 
@@ -67,39 +68,48 @@ def _clear_masked(a: np.ndarray, lam: int) -> np.ndarray:
     return a
 
 
-def wide_affine_np(bundle: KeyBundle):
-    """Affine decomposition of the wide output.
+def wide_affine_batch_np(bundle: KeyBundle):
+    """Affine decomposition of the wide output, batched over keys.
 
-    bundle: party-restricted, lam > 32.  Returns (const [lam-32],
-    w [n+1, lam-32]) uint8 such that y[32:] = const ^ XOR_k t_k * w[k],
-    where t_k is the control bit GATING level k (t_0 = the party bit) and
-    t_n the final bit gating cw_np1.  Only the matrix ``w`` is
-    party-independent (it is built purely from the shared correction
-    words); ``const`` depends on this party's wide seed s0, so it must be
-    recomputed per party-restricted bundle — do NOT cache (const, w)
-    across parties.  Derived by running the wide recursion on the zero
-    trajectory and the n+1 unit trajectories at once.
+    bundle: party-restricted, lam > 32, K keys.  Returns
+    (const [K, lam-32], w [K, n+1, lam-32]) uint8 such that per key
+    y[32:] = const ^ XOR_k t_k * w[k], where t_k is the control bit
+    GATING level k (t_0 = the party bit) and t_n the final bit gating
+    cw_np1.  Only the matrix ``w`` is party-independent (it is built
+    purely from the shared correction words); ``const`` depends on this
+    party's wide seed s0, so it must be recomputed per party-restricted
+    bundle — do NOT cache (const, w) across parties.  Derived by running
+    the wide recursion on the zero trajectory and the n+1 unit
+    trajectories at once.
     """
-    lam, n = bundle.lam, bundle.n_bits
+    lam, n, k_num = bundle.lam, bundle.n_bits, bundle.num_keys
     if lam <= NARROW:
         raise ValueError("wide part needs lam > 32")
-    s0w = bundle.s0s[0, 0, NARROW:]
-    cw_s_w = bundle.cw_s[0, :, NARROW:]
-    cw_v_w = bundle.cw_v[0, :, NARROW:]
-    np1w = bundle.cw_np1[0, NARROW:]
+    wd = lam - NARROW
+    s0w = bundle.s0s[:, 0, NARROW:]       # [K, Wd]
+    cw_s_w = bundle.cw_s[:, :, NARROW:]   # [K, n, Wd]
+    cw_v_w = bundle.cw_v[:, :, NARROW:]
+    np1w = bundle.cw_np1[:, NARROW:]      # [K, Wd]
 
     nb = n + 2  # basis: [zero, e_0 .. e_n]
     t_basis = np.zeros((nb, n + 1), dtype=np.uint8)
     t_basis[1:] = np.eye(n + 1, dtype=np.uint8)
-    s = np.broadcast_to(s0w, (nb, lam - NARROW)).copy()
-    v = np.zeros((nb, lam - NARROW), dtype=np.uint8)
+    s = np.broadcast_to(s0w[:, None, :], (k_num, nb, wd)).copy()
+    v = np.zeros((k_num, nb, wd), dtype=np.uint8)
     for i in range(n):
-        gate = t_basis[:, i][:, None]
-        v ^= _clear_masked(s ^ 0xFF, lam) ^ cw_v_w[i] * gate
-        s = _clear_masked(s, lam) ^ cw_s_w[i] * gate
-    y = v ^ s ^ np1w * t_basis[:, n][:, None]
-    const = y[0]
-    return const, y[1:] ^ const
+        gate = t_basis[:, i][None, :, None]
+        v ^= _clear_masked(s ^ 0xFF, lam) ^ cw_v_w[:, i][:, None, :] * gate
+        s = _clear_masked(s, lam) ^ cw_s_w[:, i][:, None, :] * gate
+    y = v ^ s ^ np1w[:, None, :] * t_basis[:, n][None, :, None]
+    const = y[:, 0]
+    return const, y[:, 1:] ^ const[:, None, :]
+
+
+def wide_affine_np(bundle: KeyBundle):
+    """Single-key convenience wrapper of ``wide_affine_batch_np``:
+    (const [lam-32], w [n+1, lam-32])."""
+    const, w = wide_affine_batch_np(bundle)
+    return const[0], w[0]
 
 
 def narrow_walk_np(cipher_keys: Sequence[bytes], bundle: KeyBundle, b: int,
@@ -141,10 +151,14 @@ def narrow_walk_np(cipher_keys: Sequence[bytes], bundle: KeyBundle, b: int,
 def _narrow_core(rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
                  x_mask, b: int):
     """eval_core_bitsliced at lam=32 with NO masking, also returning the
-    packed t trajectory [n+1, K, W]."""
+    packed t trajectory [n+1, K, W].
+
+    Multi-key: s0_pl/cw_np1_pl [p, K], cw_s_pl/cw_v_pl [n, p, K],
+    cw_tl/cw_tr [n, K], x_mask [n, 1, W] (shared points).
+    """
     ones = jnp.uint32(0xFFFFFFFF)
     p = 8 * NARROW
-    kx, w = x_mask.shape[1], x_mask.shape[2]
+    w = x_mask.shape[2]
     k_num = s0_pl.shape[1]
 
     s = jnp.broadcast_to(s0_pl[:, :, None], (p, k_num, 1)) ^ jnp.zeros(
@@ -155,86 +169,94 @@ def _narrow_core(rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
 
     def body(carry, level):
         s, t, v = carry
-        cs, cv, ctl, ctr, xm = level
+        cs, cv, ctl, ctr, xm = level  # cs/cv [p, K], ctl/ctr [K], xm [1, W]
         s_l, v_l, t_l, s_r, v_r, t_r = prg_planes(
             rk_masks, no_mask, NARROW, s, ones)
         gate = t[None, :, :]
-        s_l = s_l ^ (cs[:, None, None] & gate)
-        s_r = s_r ^ (cs[:, None, None] & gate)
-        t_l = t_l ^ (t & ctl)
-        t_r = t_r ^ (t & ctr)
+        s_l = s_l ^ (cs[:, :, None] & gate)
+        s_r = s_r ^ (cs[:, :, None] & gate)
+        t_l = t_l ^ (t & ctl[:, None])
+        t_r = t_r ^ (t & ctr[:, None])
         xm_e = xm[None, :, :]
-        v2 = v ^ (v_r & xm_e) ^ (v_l & (xm_e ^ ones)) ^ (cv[:, None, None] & gate)
+        v2 = v ^ (v_r & xm_e) ^ (v_l & (xm_e ^ ones)) ^ (cv[:, :, None] & gate)
         s2 = (s_r & xm_e) | (s_l & (xm_e ^ ones))
         t2 = (t_r & xm) | (t_l & (xm ^ ones))
         return (s2, t2, v2), t  # emit the GATE t of this level
 
     (s, t, v), traj = jax.lax.scan(
         body, (s, t, v), (cw_s_pl, cw_v_pl, cw_tl, cw_tr, x_mask))
-    y = v ^ s ^ (cw_np1_pl[:, None, None] & t[None, :, :])
-    traj = jnp.concatenate([traj, t[None]], axis=0)  # + final t
+    y = v ^ s ^ (cw_np1_pl[:, :, None] & t[None, :, :])
+    traj = jnp.concatenate([traj, t[None]], axis=0)  # + final t [n+1, K, W]
     return y, traj
 
 
 def _wide_tail(t_planes, wide_const, wide_w8, m: int, col_chunk: int):
-    """Shared wide part: packed t-trajectory planes [n+1, W] -> uint8 wide
-    bytes [M, lam-32] via the int8 MXU matmul + parity extraction."""
-    tb = (t_planes[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)) \
+    """Shared wide part, batched over keys: packed t-trajectory planes
+    [n+1, K, W] -> uint8 wide bytes [K, M, lam-32] via the int8 MXU
+    batched matmul + parity extraction.  wide_const [K, lam-32],
+    wide_w8 int8 [K, n+1, 8*(lam-32)]."""
+    nt, k_num, _w = t_planes.shape
+    tb = (t_planes[..., None] >> jnp.arange(32, dtype=jnp.uint32)) \
         & jnp.uint32(1)
-    t_bits = tb.reshape(t_planes.shape[0], -1).T.astype(jnp.int8)  # [M, n+1]
-    cols = wide_w8.shape[1]
+    # [n+1, K, W, 32] -> [K, M, n+1]
+    t_bits = tb.reshape(nt, k_num, -1).transpose(1, 2, 0).astype(jnp.int8)
+    cols = wide_w8.shape[2]
     outs = []
     for c0 in range(0, cols, col_chunk):
         w_c = jax.lax.dynamic_slice_in_dim(
-            wide_w8, c0, min(col_chunk, cols - c0), 1)
-        acc = jax.lax.dot(t_bits, w_c,
-                          preferred_element_type=jnp.int32)  # [M, cc]
+            wide_w8, c0, min(col_chunk, cols - c0), 2)
+        acc = jax.lax.dot_general(
+            t_bits, w_c,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32)  # [K, M, cc]
         bits = (acc & 1).astype(jnp.uint8)
-        by = bits.reshape(m, -1, 8)
+        by = bits.reshape(k_num, m, -1, 8)
         outs.append(jnp.sum(by << jnp.arange(8, dtype=jnp.uint8), axis=-1,
                             dtype=jnp.uint8))
-    return jnp.concatenate(outs, axis=1) ^ wide_const[None, :]
+    return jnp.concatenate(outs, axis=2) ^ wide_const[:, None, :]
 
 
 @partial(jax.jit, static_argnames=("gt",))
 def _points_mismatch_bytes(y0, y1, alpha_a, beta_a, xs, *, gt: bool):
     """Mismatch count vs the comparison function for byte-level staged
     outputs (the large-lambda regime, where plane layouts would be
-    wasteful): y0/y1 uint8 [1, M_pad, lam]; xs uint8 [1, M_pad, nb].
-    Padding points are genuine evaluations of x=0 and self-verify."""
+    wasteful): y0/y1 uint8 [K, M_pad, lam]; alpha_a [K, nb];
+    beta_a [K, lam]; xs uint8 [1, M_pad, nb] (shared points).  Padding
+    points are genuine evaluations of x=0 and self-verify."""
     x = xs[0]
-    nb = x.shape[1]
-    inside = jnp.zeros((x.shape[0],), jnp.bool_)
-    eq = jnp.ones((x.shape[0],), jnp.bool_)
+    m, nb = x.shape
+    k_num = alpha_a.shape[0]
+    inside = jnp.zeros((k_num, m), jnp.bool_)
+    eq = jnp.ones((k_num, m), jnp.bool_)
     for j in range(nb):  # lexicographic big-endian unsigned compare
-        xj = x[:, j]
-        aj = alpha_a[j]
+        xj = x[None, :, j]
+        aj = alpha_a[:, j][:, None]
         inside = inside | (eq & ((xj > aj) if gt else (xj < aj)))
         eq = eq & (xj == aj)
-    expect = jnp.where(inside[:, None], beta_a[None, :], jnp.uint8(0))
-    recon = y0[0] ^ y1[0]
-    return jnp.sum(jnp.any(recon != expect, axis=1).astype(jnp.int32))
+    expect = jnp.where(inside[:, :, None], beta_a[:, None, :], jnp.uint8(0))
+    recon = y0 ^ y1
+    return jnp.sum(jnp.any(recon != expect, axis=2).astype(jnp.int32))
 
 
 @partial(jax.jit, static_argnames=("b", "col_chunk"))
 def _hybrid_eval(rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
                  wide_const, wide_w8, xs, b: int, col_chunk: int):
-    """Full device program (XLA narrow walk): uint8 [1, M, lam]."""
+    """Full device program (XLA narrow walk): uint8 [K, M, lam]."""
     x_mask = _xs_to_mask_dev(xs)
     y32_pl, traj = _narrow_core(
         rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
         x_mask, b)
-    y32 = _planes_to_bytes_dev(y32_pl, NARROW)  # [1, M, 32]
+    y32 = _planes_to_bytes_dev(y32_pl, NARROW)  # [K, M, 32]
     m = y32.shape[1]
-    y_wide = _wide_tail(traj[:, 0, :], wide_const, wide_w8, m, col_chunk)
-    return jnp.concatenate([y32[0], y_wide], axis=1)[None]
+    y_wide = _wide_tail(traj, wide_const, wide_w8, m, col_chunk)
+    return jnp.concatenate([y32, y_wide], axis=2)
 
 
 @partial(jax.jit, static_argnames=("b", "col_chunk", "interpret"))
 def _hybrid_eval_pallas(rk2, s0a, s0b, cs0, cs1, cv0, cv1, np1a, np1b,
                         cw_t_pm, inv_perm, wide_const, wide_w8, xs,
                         b: int, col_chunk: int, interpret: bool):
-    """Full device program (Pallas narrow walk): uint8 [1, M, lam]."""
+    """Full device program (Pallas narrow walk): uint8 [K, M, lam]."""
     from dcf_tpu.backends.pallas_backend import _stage_xs
     from dcf_tpu.ops.pallas_narrow import dcf_narrow_walk_pallas
 
@@ -242,25 +264,28 @@ def _hybrid_eval_pallas(rk2, s0a, s0b, cs0, cs1, cv0, cv1, np1a, np1b,
     y0, y1, traj = dcf_narrow_walk_pallas(
         rk2, s0a, s0b, cs0, cs1, cv0, cv1, np1a, np1b, cw_t_pm, x_mask,
         b=b, interpret=interpret)
-    # bit-major [1, 128, W] per block -> byte-major planes [256, 1, W]
+    # bit-major [K, 128, W] per block -> byte-major planes [256, K, W]
     yb = jnp.concatenate([
-        jnp.take(jax.lax.bitcast_convert_type(y0, jnp.uint32)[0],
-                 inv_perm, axis=0),
-        jnp.take(jax.lax.bitcast_convert_type(y1, jnp.uint32)[0],
-                 inv_perm, axis=0),
-    ], axis=0)[:, None, :]
-    y32 = _planes_to_bytes_dev(yb, NARROW)  # [1, M, 32]
+        jnp.take(jax.lax.bitcast_convert_type(y0, jnp.uint32),
+                 inv_perm, axis=1),
+        jnp.take(jax.lax.bitcast_convert_type(y1, jnp.uint32),
+                 inv_perm, axis=1),
+    ], axis=1).transpose(1, 0, 2)
+    y32 = _planes_to_bytes_dev(yb, NARROW)  # [K, M, 32]
     m = y32.shape[1]
-    tr = jax.lax.bitcast_convert_type(traj, jnp.uint32)[0]  # [n+1, W]
+    # trajectory [K, n+1, W] -> [n+1, K, W]
+    tr = jax.lax.bitcast_convert_type(traj, jnp.uint32).transpose(1, 0, 2)
     y_wide = _wide_tail(tr, wide_const, wide_w8, m, col_chunk)
-    return jnp.concatenate([y32[0], y_wide], axis=1)[None]
+    return jnp.concatenate([y32, y_wide], axis=2)
 
 
 class LargeLambdaBackend:
     """Device evaluator for lam >= 48 via the narrow-walk + affine split.
 
-    Single-key (the reference large-lambda bench shape).  Bit-exact with
-    the full-width oracle (tests/test_large_lambda.py).
+    Multi-key: the narrow Pallas walk grids over keys and the GF(2)
+    affine wide part runs as one batched int8 MXU matmul (per-chunk
+    memory is bounded by scaling the column chunk down with K).
+    Bit-exact with the full-width oracle (tests/test_large_lambda.py).
     """
 
     def __init__(self, lam: int, cipher_keys: Sequence[bytes],
@@ -306,9 +331,9 @@ class LargeLambdaBackend:
     def put_bundle(self, bundle: KeyBundle) -> None:
         if bundle.lam != self.lam:
             raise ValueError("bundle lam mismatch")
-        if bundle.s0s.shape[1] != 1 or bundle.num_keys != 1:
+        if bundle.s0s.shape[1] != 1:
             raise ValueError(
-                "LargeLambdaBackend wants a party-restricted single key")
+                "LargeLambdaBackend wants a party-restricted bundle")
         # Only the affine matrix w is party-independent; const depends on
         # this party's wide seed, so (const, w) are re-derived for every
         # put_bundle (staged lazily on first eval) and never reused across
@@ -323,15 +348,15 @@ class LargeLambdaBackend:
                     bitmajor_plane_masks(a[..., lo:lo + 16])[..., None])
 
             self._dev = dict(
-                s0a=blk(bundle.s0s[:1, 0, :], 0),
-                s0b=blk(bundle.s0s[:1, 0, :], 16),
-                cs0=blk(bundle.cw_s[:1], 0),
-                cs1=blk(bundle.cw_s[:1], 16),
-                cv0=blk(bundle.cw_v[:1], 0),
-                cv1=blk(bundle.cw_v[:1], 16),
-                np1a=blk(bundle.cw_np1[:1], 0),
-                np1b=blk(bundle.cw_np1[:1], 16),
-                cw_t=jnp.asarray(bundle.cw_t[:1].astype(np.int32) * -1),
+                s0a=blk(bundle.s0s[:, 0, :], 0),
+                s0b=blk(bundle.s0s[:, 0, :], 16),
+                cs0=blk(bundle.cw_s, 0),
+                cs1=blk(bundle.cw_s, 16),
+                cv0=blk(bundle.cw_v, 0),
+                cv1=blk(bundle.cw_v, 16),
+                np1a=blk(bundle.cw_np1, 0),
+                np1b=blk(bundle.cw_np1, 16),
+                cw_t=jnp.asarray(bundle.cw_t.astype(np.int32) * -1),
             )
         else:
             def masks(a):  # uint8 [..., 32] -> uint32 masks [..., 256]
@@ -339,26 +364,37 @@ class LargeLambdaBackend:
                         * np.uint32(0xFFFFFFFF))
 
             self._dev = dict(
-                cw_s=jnp.asarray(masks(bundle.cw_s[0, :, :NARROW])),
-                cw_v=jnp.asarray(masks(bundle.cw_v[0, :, :NARROW])),
-                cw_tl=jnp.asarray(bundle.cw_t[0, :, 0].astype(np.uint32)
-                                  * np.uint32(0xFFFFFFFF)),
-                cw_tr=jnp.asarray(bundle.cw_t[0, :, 1].astype(np.uint32)
-                                  * np.uint32(0xFFFFFFFF)),
-                cw_np1=jnp.asarray(masks(bundle.cw_np1[0, :NARROW])),
-                s0_pl=jnp.asarray(
-                    masks(bundle.s0s[0, 0, :NARROW]))[:, None],
+                # [K, n, p] -> scan-major [n, p, K]
+                cw_s=jnp.asarray(np.ascontiguousarray(
+                    masks(bundle.cw_s[:, :, :NARROW]).transpose(1, 2, 0))),
+                cw_v=jnp.asarray(np.ascontiguousarray(
+                    masks(bundle.cw_v[:, :, :NARROW]).transpose(1, 2, 0))),
+                cw_tl=jnp.asarray(np.ascontiguousarray(
+                    bundle.cw_t[:, :, 0].T.astype(np.uint32)
+                    * np.uint32(0xFFFFFFFF))),
+                cw_tr=jnp.asarray(np.ascontiguousarray(
+                    bundle.cw_t[:, :, 1].T.astype(np.uint32)
+                    * np.uint32(0xFFFFFFFF))),
+                cw_np1=jnp.asarray(np.ascontiguousarray(
+                    masks(bundle.cw_np1[:, :NARROW]).T)),
+                s0_pl=jnp.asarray(np.ascontiguousarray(
+                    masks(bundle.s0s[:, 0, :NARROW]).T)),
             )
         self._wide = None
 
     def _wide_staged(self):
         if self._wide is None:
-            const, w = wide_affine_np(self._bundle)
+            const, w = wide_affine_batch_np(self._bundle)
             self._wide = (
                 jnp.asarray(const),
                 jnp.asarray(byte_bits_lsb(w).astype(np.int8)),
             )
         return self._wide
+
+    def _col_chunk_for(self, k_num: int) -> int:
+        """Scale the matmul column chunk down with K so the [K, M, chunk]
+        int32 accumulator stays bounded."""
+        return max(8, (self.col_chunk // max(1, k_num)) // 8 * 8)
 
     def stage(self, xs: np.ndarray) -> dict:
         """Ship xs (uint8 [M, n_bytes], padded mod 32 internally)."""
@@ -376,40 +412,56 @@ class LargeLambdaBackend:
         return {"xs": jnp.asarray(np.ascontiguousarray(xs))[None], "m": m}
 
     def eval_staged(self, b: int, staged: dict) -> jax.Array:
-        """Party ``b`` eval; returns DEVICE uint8 [1, M_pad, lam]."""
+        """Party ``b`` eval; returns DEVICE uint8 [K, M_pad, lam]."""
         const, w8 = self._wide_staged()
         dev = self._dev
+        cc = self._col_chunk_for(self._bundle.num_keys)
         if self.narrow == "pallas":
             return _hybrid_eval_pallas(
                 self.rk2, dev["s0a"], dev["s0b"], dev["cs0"], dev["cs1"],
                 dev["cv0"], dev["cv1"], dev["np1a"], dev["np1b"],
                 dev["cw_t"], self._inv_perm, const, w8, staged["xs"],
-                b=int(b), col_chunk=self.col_chunk,
+                b=int(b), col_chunk=cc,
                 interpret=self.interpret)
         return _hybrid_eval(
             self.rk_masks, dev["s0_pl"], dev["cw_s"], dev["cw_v"],
             dev["cw_tl"], dev["cw_tr"], dev["cw_np1"], const, w8,
-            staged["xs"], b=int(b), col_chunk=self.col_chunk)
+            staged["xs"], b=int(b), col_chunk=cc)
 
     def staged_to_bytes(self, y: jax.Array, m: int) -> np.ndarray:
         return np.asarray(y[:, :m, :])
 
-    def points_mismatch_count(self, y0, y1, alpha: bytes, beta: bytes,
+    def points_mismatch_count(self, y0, y1, alpha, beta,
                               staged: dict, gt: bool = False) -> jax.Array:
         """Full on-device two-party verification for the staged batch:
-        count of points whose XOR reconstruction differs from
-        ``beta if x < alpha else 0`` (``>`` for gt).  y0/y1: both parties'
-        ``eval_staged`` outputs over the SAME staged dict.  Returns a
-        DEVICE int32 scalar."""
+        count of (key, point) pairs whose XOR reconstruction differs from
+        ``beta_k if x < alpha_k else 0`` (``>`` for gt).  y0/y1: both
+        parties' ``eval_staged`` outputs over the SAME staged dict.
+        alpha/beta: bytes (single key) or uint8 arrays [K, nb] / [K, lam].
+        Returns a DEVICE int32 scalar."""
+        def arr(v):
+            if isinstance(v, (bytes, bytearray)):
+                return np.frombuffer(v, dtype=np.uint8)[None]
+            a = np.asarray(v, dtype=np.uint8)
+            return a[None] if a.ndim == 1 else a
+
+        alpha_a, beta_a = arr(alpha), arr(beta)
+        if alpha_a.shape[0] != y0.shape[0] or beta_a.shape[0] != y0.shape[0]:
+            raise ValueError(
+                f"alpha/beta key counts ({alpha_a.shape[0]}/"
+                f"{beta_a.shape[0]}) must match the evaluated bundle's "
+                f"{y0.shape[0]} keys")
         return _points_mismatch_bytes(
-            y0, y1,
-            jnp.asarray(np.frombuffer(alpha, dtype=np.uint8)),
-            jnp.asarray(np.frombuffer(beta, dtype=np.uint8)),
-            staged["xs"], gt=gt)
+            y0, y1, jnp.asarray(alpha_a),
+            jnp.asarray(beta_a), staged["xs"], gt=gt)
+
+    # _full_device_parity capability flag: this counter takes [K, ...] keys.
+    points_mismatch_multikey = True
 
     def eval(self, b: int, xs: np.ndarray,
              bundle: KeyBundle | None = None) -> np.ndarray:
-        """uint8 [1, M, lam]; xs uint8 [M, n_bytes] (points padded mod 32)."""
+        """uint8 [K, M, lam]; xs uint8 [M, n_bytes] shared points (padded
+        mod 32 internally)."""
         if bundle is not None:
             self.put_bundle(bundle)
         staged = self.stage(xs)

@@ -141,8 +141,15 @@ def dcf_narrow_walk_pallas(
     keyed = pl.BlockSpec((1, 128, 1), lambda k, j: (k, 0, 0))
     level_spec = pl.BlockSpec((1, n, 128, 1), lambda k, j: (k, 0, 0, 0))
     state_out = pl.BlockSpec((1, 128, wt), lambda k, j: (k, 0, j))
+    # At many keys x few point-words Mosaic's whole-call staging exceeds
+    # the default 16MB scoped-vmem budget even though each grid step's
+    # blocks are tiny; raise the limit toward the chip's physical VMEM.
+    params = (dict() if interpret else dict(
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024)))
     return pl.pallas_call(
         partial(_kernel, b=b, n=n, interpret=interpret),
+        **params,
         out_shape=(
             jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
             jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),

@@ -21,14 +21,14 @@ def rand_bytes(rng, n):
     return bytes(rng.getrandbits(8) for _ in range(n))
 
 
-def _setup(seed, lam, nb=2, m=9, bound=spec.Bound.LT_BETA):
+def _setup(seed, lam, nb=2, m=9, bound=spec.Bound.LT_BETA, k=1):
     rng = random.Random(seed)
     ck = [rand_bytes(rng, 32) for _ in range(2 * (lam // 16))]
     prg = HirosePrgNp(lam, ck)
     nprng = np.random.default_rng(seed)
-    alphas = nprng.integers(0, 256, (1, nb), dtype=np.uint8)
-    betas = nprng.integers(0, 256, (1, lam), dtype=np.uint8)
-    bundle = gen_batch(prg, alphas, betas, random_s0s(1, lam, nprng), bound)
+    alphas = nprng.integers(0, 256, (k, nb), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k, lam), dtype=np.uint8)
+    bundle = gen_batch(prg, alphas, betas, random_s0s(k, lam, nprng), bound)
     xs = nprng.integers(0, 256, (m, nb), dtype=np.uint8)
     xs[0] = alphas[0]
     return ck, prg, alphas, betas, bundle, xs
@@ -98,6 +98,35 @@ def test_lane_dependent_round_keys_v3():
         np, rk_b, st[:, lanes:], np.int32(-1))
     assert np.array_equal(got[:, :lanes], want_a)
     assert np.array_equal(got[:, lanes:], want_b)
+
+
+@pytest.mark.parametrize("narrow", ["xla", "pallas"])
+def test_large_lambda_backend_multikey(narrow):
+    """Multi-key hybrid (K=3): batched narrow walk + batched GF(2) MXU
+    matmul == the oracle for every key, both parties, plus the multi-key
+    device parity counter."""
+    ck, prg, alphas, betas, bundle, xs = _setup(93, 144, m=7, k=3)
+    be = LargeLambdaBackend(144, ck, narrow=narrow,
+                            interpret=(narrow == "pallas"))
+    ys = {}
+    for b in (0, 1):
+        kb = bundle.for_party(b)
+        want = eval_batch_np(prg, b, kb, xs)  # [3, M, 144]
+        got = be.eval(b, xs, bundle=kb)
+        assert got.shape == want.shape
+        assert np.array_equal(got, want), f"party {b}"
+        ys[b] = got
+    # device parity counter over all keys/points
+    be0 = LargeLambdaBackend(144, ck, narrow=narrow,
+                             interpret=(narrow == "pallas"))
+    be1 = LargeLambdaBackend(144, ck, narrow=narrow,
+                             interpret=(narrow == "pallas"))
+    be0.put_bundle(bundle.for_party(0))
+    be1.put_bundle(bundle.for_party(1))
+    st = be0.stage(xs)
+    y0 = be0.eval_staged(0, st)
+    y1 = be1.eval_staged(1, st)
+    assert int(be0.points_mismatch_count(y0, y1, alphas, betas, st)) == 0
 
 
 def test_hybrid_points_mismatch_count():

@@ -135,10 +135,11 @@ def test_tree_fulldomain_compiled(gt):
 
 def test_narrow_kernel_compiled():
     """The large-lambda hybrid's Pallas narrow walk (lane-dependent round
-    keys) at lam=144, both parties, vs the full-width oracle."""
+    keys) at lam=144, both parties, vs the full-width oracle — K=3 keys
+    (the kernel grids over keys; the wide part is a batched MXU matmul)."""
     from dcf_tpu.backends.large_lambda import LargeLambdaBackend
 
-    ck, prg, _a, _b, bundle, xs = _workload(74, 1, 2, 9, lam=144)
+    ck, prg, _a, _b, bundle, xs = _workload(74, 3, 2, 9, lam=144)
     be = LargeLambdaBackend(144, ck, narrow="pallas")
     assert not be.interpret
     for b in (0, 1):
