@@ -601,19 +601,25 @@ def bench_baseline(args) -> None:
     Per-config backend = the measured winner on this hardware (the
     accelerator everywhere: the hybrid affine split reclaimed large-lambda
     from the CPU, benchmarks/RESULTS_r02.jsonl).
-    secure_relu defaults to 2^18 keys here to keep the report minutes-long;
-    pass --keys=1000000 for the full config-5 scale (the 10^6 artifact
-    lives in benchmarks/RESULTS_r02.jsonl).
+
+    ``--full`` runs config 5 at its literal 10^6-key scale (the whole
+    report then takes ~20 minutes, dominated by three timed 10^6-key
+    pipelines); without it secure_relu uses 2^18 keys to keep the report
+    minutes-long.  The round-3 headline artifact is regenerated by
+    exactly::
+
+        python -m dcf_tpu.cli baseline --full > BASELINE_REPORT_r03.jsonl
     """
     import copy
 
+    full_keys = 1_000_000 if args.full else (args.keys or 1 << 18)
     specs = [
         ("dcf", dict(backend="cpu")),
         ("dcf_batch_eval", dict(backend="pallas", points=1 << 20)),
         ("full_domain", dict(backend="tree", n_bits=24)),
         ("dcf_large_lambda", dict(backend="hybrid", points=10_000, keys=1)),
         ("secure_relu", dict(backend="cpu", device_gen=True,
-                             keys=args.keys or 1 << 18,
+                             keys=full_keys,
                              points=args.points or 1_024)),
     ]
     for i, (name, over) in enumerate(specs, 1):
@@ -686,6 +692,9 @@ def main(argv=None) -> None:
                    help="input width for dcf_batch_eval (0 = 16)")
     p.add_argument("--device-gen", action="store_true",
                    help="secure_relu: device keygen + pallas keylanes path")
+    p.add_argument("--full", action="store_true",
+                   help="baseline: run config 5 at the literal 10^6-key "
+                        "scale (~20 min report)")
     args = p.parse_args(argv)
     if args.backend == "tree" and args.bench not in ("full_domain",
                                                      "baseline"):
