@@ -93,17 +93,35 @@ def main() -> None:
     bundle = native.gen_batch(alphas, betas, random_s0s(1, LAM, rng), Bound.LT_BETA)
     xs = rng.integers(0, 256, (M_TPU, N_BYTES), dtype=np.uint8)
 
-    # --- single-core CPU baseline (Rust stand-in); median of 3 samples so
-    # the vs_baseline denominator isn't one noisy measurement ---
+    # --- single-core CPU baseline (Rust stand-in).  The vs_baseline
+    # DENOMINATOR is the pinned canonical number measured once under the
+    # protocol in benchmarks/CPU_BASELINE.md (fixed batch, median of >= 10
+    # in-process samples, host state recorded) and committed as
+    # benchmarks/cpu_baseline.json — the round-3 in-run denominator swung
+    # 86-112k evals/s run-to-run, moving the headline ratio through the
+    # 100x mark on noise.  A short in-run measurement is kept as a drift
+    # check and as the fallback when the artifact is absent. ---
     cpu_samples = []
     for _ in range(3):
         t0 = time.perf_counter()
         y_cpu = native.eval(0, bundle, xs[:M_CPU], num_threads=1)
         cpu_samples.append(time.perf_counter() - t0)
-    cpu_s = float(np.median(cpu_samples))
-    cpu_rate = M_CPU / cpu_s
-    log(f"cpu single-core: {M_CPU} pts in {cpu_s:.3f}s (median of 3) = "
-        f"{cpu_rate:,.0f} evals/s")
+    inrun_rate = M_CPU / float(np.median(cpu_samples))
+    baseline_src = "in-run (no pinned artifact)"
+    cpu_rate = inrun_rate
+    try:
+        with open("benchmarks/cpu_baseline.json") as f:
+            pinned = json.load(f)
+    except OSError:
+        pinned = None  # genuinely absent: in-run fallback is honest
+    if pinned is not None:
+        # A PRESENT artifact must parse: silently falling back to the
+        # noisy in-run denominator would defeat the pin.
+        cpu_rate = float(pinned["evals_per_sec"])
+        baseline_src = f"pinned ({pinned['date']}, CPU_BASELINE.md protocol)"
+    log(f"cpu single-core: baseline {cpu_rate:,.0f} evals/s "
+        f"[{baseline_src}]; in-run drift check (median of 3): "
+        f"{inrun_rate:,.0f} ({inrun_rate / cpu_rate - 1:+.1%})")
 
     # --- accelerator backend: Pallas kernel, XLA bitsliced fallback ---
     import jax
@@ -171,14 +189,26 @@ def main() -> None:
         name = "bitsliced"
     log(f"backend: {name}")
 
-    # --- timed samples (ITERS dispatches per sample, criterion-style) ---
+    # --- timed samples (ITERS dispatches per sample, criterion-style).
+    # Each sample carries exactly one digest-fetch sync, whose ~85-155ms
+    # round-trip is the DEV TUNNEL's latency, not chip work (ROOFLINE.md
+    # "sync-starved timing"); it is measured bare here and subtracted
+    # once per sample so the metric is the chip rate. ---
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        sync(staged["x_mask"])  # already materialized: bare RTT
+        rtts.append(time.perf_counter() - t0)
+    rtt = float(np.median(rtts))
+    log(f"bare sync RTT: {rtt * 1e3:.0f} ms "
+        "(tunnel artifact; subtracted once per sample)")
     times = []
     for i in range(SAMPLES):
         t0 = time.perf_counter()
         for _ in range(ITERS):
             y = backend.eval_staged(0, staged)
         sync(y)
-        times.append((time.perf_counter() - t0) / ITERS)
+        times.append((time.perf_counter() - t0 - rtt) / ITERS)
     times_a = np.array(times)
     med = float(np.median(times_a))
     mad = float(np.median(np.abs(times_a - med)))
@@ -197,30 +227,40 @@ def main() -> None:
     if not np.array_equal(y_host[0, :M_PARITY], y_cpu[0, :M_PARITY]):
         raise SystemExit("staged-path parity check failed")
 
-    # --- overlapped end-to-end: chunked eval with the download of chunk i
-    # riding under the compute/convert of chunks i+1.. (async dispatch);
-    # this is the meaningful delivery rate — bounded by max(compute,
-    # transfer), not their sum.  The tunnel's ~25MB/s makes it
-    # transfer-bound in this environment; on a real host NIC the compute
-    # rate would dominate. ---
-    x_mask = staged["x_mask"]
-    wt = staged["wt"]
-    w_total = x_mask.shape[-1]
-    chunk_w = max(wt, (w_total // 8) // wt * wt)
-    t0 = time.perf_counter()
-    pending = []
-    for lo in range(0, w_total, chunk_w):
-        hi = min(w_total, lo + chunk_w)
-        y_c = backend.eval_staged(
-            0, {"x_mask": x_mask[..., lo:hi], "wt": wt, "m": 32 * (hi - lo)})
-        pending.append((y_c, 32 * (hi - lo)))
-    parts = [backend.staged_to_bytes(y_c, m_c) for y_c, m_c in pending]
-    e2e_s = time.perf_counter() - t0
-    y_ov = np.concatenate(parts, axis=1)[:, :M_TPU]
-    log(f"overlapped end-to-end (8-chunk pipelined d2h): {e2e_s:.2f}s "
-        f"-> {M_TPU / e2e_s:,.0f} evals/s")
-    if not np.array_equal(y_ov[0], y_host[0]):
-        raise SystemExit("overlapped-path parity check failed")
+    # --- overlapped end-to-end: the batch split in two UNIFORM halves so
+    # the half-shape programs compile once (warmed untimed below — the
+    # round-3 8-chunk variant recompiled inside the timed region and
+    # measured 7.7x WORSE than the single-shot fetch); both computes and
+    # conversions dispatch async, and copy_to_host_async() starts half 0's
+    # d2h while half 1 is still computing, bounding delivery by
+    # max(compute, transfer) instead of their sum.  Pallas staged layout
+    # only — the bitsliced fallback lane (no "wt" granule) skips it and
+    # the single-shot number above stands alone. ---
+    w_total = staged["x_mask"].shape[-1]
+    wt = staged.get("wt", 0)
+    if wt and (w_total // wt) % 2 == 0 and hasattr(backend, "convert_staged"):
+        x_mask = staged["x_mask"]
+        half = w_total // 2
+
+        def half_pass(lo, hi):
+            y_c = backend.eval_staged(
+                0, {"x_mask": x_mask[..., lo:hi], "wt": wt,
+                    "m": 32 * (hi - lo)})
+            y_b = backend.convert_staged(y_c)
+            y_b.copy_to_host_async()
+            return y_b
+
+        sync(half_pass(0, half))  # untimed: compile the half-shape programs
+        t0 = time.perf_counter()
+        pending = [half_pass(0, half), half_pass(half, w_total)]
+        parts = [np.asarray(p) for p in pending]
+        e2e_s = time.perf_counter() - t0
+        y_ov = np.concatenate(parts, axis=1)[:, :M_TPU]
+        log(f"overlapped end-to-end (2-half pipelined d2h): {e2e_s:.2f}s "
+            f"-> {M_TPU / e2e_s:,.0f} evals/s "
+            f"(single-shot: {M_TPU / (med + d2h_s):,.0f})")
+        if not np.array_equal(y_ov[0], y_host[0]):
+            raise SystemExit("overlapped-path parity check failed")
 
     print(
         json.dumps(
@@ -232,6 +272,11 @@ def main() -> None:
                     f"{name} kernel, median of {SAMPLES})"
                 ),
                 "vs_baseline": round(dev_rate / cpu_rate, 2),
+                "vs_baseline_band": [
+                    round(M_TPU / (med + mad) / cpu_rate, 2),
+                    round(M_TPU / max(med - mad, 1e-9) / cpu_rate, 2),
+                ],
+                "baseline": baseline_src,
                 "parity": (
                     f"full (device, {M_TPU} pts two-party) + "
                     f"C++ {M_PARITY}-pt anchor"

@@ -21,13 +21,36 @@ Backends (selected at construction, ``backend=``):
     pallas     fused VMEM walk kernel (lam=16)
     hybrid     narrow walk + GF(2)-affine wide part (lam >= 48)
 
-Key generation runs on the C++ core when available, else numpy.  For
-many-keys-on-accelerator workflows use ``backends.device_gen.DeviceKeyGen``
-/ ``backends.pallas_keylanes`` directly (the config-5 pipeline); for
-full-domain evaluation use ``backends.fulldomain.TreeFullDomain``; for
-mesh sharding use ``parallel.ShardedPallasBackend`` (the flagship walk
-kernel) / ``parallel.ShardedKeyLanesBackend`` (many keys) on TPU meshes,
-or ``parallel.ShardedBitslicedBackend`` for the XLA-core variant.
+Passing ``mesh=parallel.make_mesh(...)`` makes the same facade run the
+sharded variants — the reference gets its parallelism transparently from
+``DcfImpl`` (rayon over points, /root/reference/src/lib.rs:194-199), and
+the mesh equivalent should be just as transparent:
+
+    >>> dcf = Dcf(16, 16, keys, mesh=make_mesh(shape=(4, 2)))
+    >>> dcf.eval(0, bundle, xs)       # ShardedPallasBackend underneath
+
+    auto       sharded pallas walk kernel (lam=16), sharded bitsliced
+               elsewhere
+    pallas     parallel.ShardedPallasBackend (flagship walk kernel)
+    keylanes   parallel.ShardedKeyLanesBackend (many keys x few points,
+               the config-5 shape; both parties share one device image)
+    bitsliced  parallel.ShardedBitslicedBackend
+    jax        parallel.ShardedJaxBackend
+
+Key counts must divide the mesh's keys axis for pallas/bitsliced/jax
+(keylanes pads ragged key counts to its shard granule); ship-once key
+caching works exactly as in the single-device case.  ``cpu``/``numpy``/``hybrid`` are
+host/single-device paths and reject a mesh.  ``backend_opts=`` forwards
+constructor keywords to the selected backend (e.g. ``tile_words`` for
+pallas, ``m_tile``/``kw_tile``/``level_chunk`` for keylanes).
+
+Key generation runs on the C++ core when available, else numpy.  Two
+subsystems stay explicit constructor-level choices rather than facade
+backends (their APIs are pipeline-shaped, not gen/eval-shaped): the
+device-resident keygen pipeline ``backends.device_gen.DeviceKeyGen`` (+
+``backends.pallas_keylanes``, the config-5 path) and full-domain
+evaluation ``backends.fulldomain.TreeFullDomain`` (domain expansion, not
+point evaluation).
 """
 
 from __future__ import annotations
@@ -71,20 +94,38 @@ class Dcf:
     """
 
     def __init__(self, n_bytes: int, lam: int, cipher_keys: Sequence[bytes],
-                 backend: str = "auto"):
+                 backend: str = "auto", mesh=None,
+                 backend_opts: dict | None = None):
         if n_bytes < 1:
             raise ValueError("n_bytes must be >= 1")
         self.n_bytes = n_bytes
         self.lam = lam
         self.cipher_keys = list(cipher_keys)
-        self.backend_name = (
-            _default_backend(lam) if backend == "auto" else backend)
-        if self.backend_name not in (
-                "cpu", "numpy", "jax", "bitsliced", "pallas", "hybrid"):
-            raise ValueError(f"unknown backend {self.backend_name!r}")
+        self.mesh = mesh
+        self._backend_opts = dict(backend_opts or {})
+        if mesh is not None:
+            self.backend_name = (
+                ("pallas" if lam == 16 else "bitsliced")
+                if backend == "auto" else backend)
+            if self.backend_name not in (
+                    "pallas", "keylanes", "bitsliced", "jax"):
+                raise ValueError(
+                    f"backend {self.backend_name!r} has no mesh-sharded "
+                    "variant (cpu/numpy/hybrid are host/single-device "
+                    "paths); use pallas, keylanes, bitsliced or jax")
+            if self.backend_name in ("pallas", "keylanes") and lam != 16:
+                raise ValueError(
+                    f"the {self.backend_name} kernels support lam=16 only "
+                    f"(got {lam}); use bitsliced/jax on the mesh")
+        else:
+            self.backend_name = (
+                _default_backend(lam) if backend == "auto" else backend)
+            if self.backend_name not in (
+                    "cpu", "numpy", "jax", "bitsliced", "pallas", "hybrid"):
+                raise ValueError(f"unknown backend {self.backend_name!r}")
         # Fail fast on backend/shape incompatibility (the backends repeat
         # these checks, but construction is where the user should hear it).
-        if self.backend_name == "pallas" and lam != 16:
+        if mesh is None and self.backend_name == "pallas" and lam != 16:
             raise ValueError(
                 f"the pallas backend supports lam=16 only (got {lam}); "
                 "use bitsliced or hybrid")
@@ -92,6 +133,10 @@ class Dcf:
             raise ValueError(
                 "the hybrid (large-lambda) backend wants lam >= 48, a "
                 f"multiple of 16 (got {lam}); use pallas/bitsliced")
+        if self._backend_opts and self.backend_name in ("cpu", "numpy"):
+            raise ValueError(
+                f"backend_opts {sorted(self._backend_opts)} do not apply "
+                f"to the {self.backend_name} backend")
         # The facade is the API edge: any ReferenceContractWarning fires
         # exactly once, here, attributed to the caller's Dcf(...) line
         # (warnings skip package-internal frames); the nested constructions
@@ -121,28 +166,52 @@ class Dcf:
         self._shipped_bundle: dict = {}
 
     def _make_backend(self, name: str):
-        if name == "cpu":
-            if self._gen_native is None:
-                raise ValueError("cpu backend needs the native core")
-            return None  # native eval goes through _gen_native directly
-        if name == "numpy":
-            return None
+        opts = self._backend_opts
+        if self.mesh is not None:
+            import jax
+
+            # Mosaic kernels on TPU meshes; the Pallas interpreter (plain
+            # JAX ops, shard_map-partitionable) on virtual CPU meshes.
+            interp = jax.devices()[0].platform != "tpu"
+            if name == "pallas":
+                from dcf_tpu.parallel import ShardedPallasBackend
+
+                return ShardedPallasBackend(
+                    self.lam, self.cipher_keys, self.mesh,
+                    interpret=interp, **opts)
+            if name == "keylanes":
+                from dcf_tpu.parallel import ShardedKeyLanesBackend
+
+                return ShardedKeyLanesBackend(
+                    self.lam, self.cipher_keys, self.mesh,
+                    interpret=interp, **opts)
+            if name == "bitsliced":
+                from dcf_tpu.parallel import ShardedBitslicedBackend
+
+                return ShardedBitslicedBackend(
+                    self.lam, self.cipher_keys, self.mesh, **opts)
+            from dcf_tpu.parallel import ShardedJaxBackend
+
+            return ShardedJaxBackend(
+                self.lam, self.cipher_keys, self.mesh, **opts)
+        if name in ("cpu", "numpy"):
+            return None  # host paths dispatch directly in eval()
         if name == "jax":
             from dcf_tpu.backends.jax_backend import JaxBackend
 
-            return JaxBackend(self.lam, self.cipher_keys)
+            return JaxBackend(self.lam, self.cipher_keys, **opts)
         if name == "bitsliced":
             from dcf_tpu.backends.jax_bitsliced import BitslicedBackend
 
-            return BitslicedBackend(self.lam, self.cipher_keys)
+            return BitslicedBackend(self.lam, self.cipher_keys, **opts)
         if name == "pallas":
             from dcf_tpu.backends.pallas_backend import PallasBackend
 
-            return PallasBackend(self.lam, self.cipher_keys)
+            return PallasBackend(self.lam, self.cipher_keys, **opts)
         if name == "hybrid":
             from dcf_tpu.backends.large_lambda import LargeLambdaBackend
 
-            return LargeLambdaBackend(self.lam, self.cipher_keys)
+            return LargeLambdaBackend(self.lam, self.cipher_keys, **opts)
         raise ValueError(f"unknown backend {name!r}")
 
     # -- keygen (reference gen, src/lib.rs:86-161) --------------------------
@@ -181,6 +250,24 @@ class Dcf:
         already-restricted ``bundle.for_party(b)``.
         """
         xs = np.asarray(xs, dtype=np.uint8)
+        if self.backend_name == "keylanes":
+            # The keylanes CW image is shared between parties (reference
+            # src/lib.rs:269-272): ONE backend instance and one shipped
+            # two-party image serve both parties.
+            if bundle.s0s.shape[1] != 2:
+                raise ValueError(
+                    "the keylanes backend wants the full two-party bundle "
+                    "(its CW image is shared between parties)")
+            be = self._eval_backends.get("kl")
+            if be is None:
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore", ReferenceContractWarning)
+                    be = self._make_backend(self.backend_name)
+                self._eval_backends["kl"] = be
+            if self._shipped_bundle.get("kl") is not bundle:
+                be.put_bundle(bundle)
+                self._shipped_bundle["kl"] = bundle
+            return be.eval(int(b), xs)
         kb = bundle.for_party(b) if bundle.s0s.shape[1] == 2 else bundle
         if self.backend_name == "cpu":
             return self._gen_native.eval(b, kb, xs)

@@ -267,9 +267,16 @@ class PallasBackend:
             tile_words=staged["wt"], interpret=self.interpret,
         )
 
+    def convert_staged(self, y_planes: jax.Array) -> jax.Array:
+        """Device-side plane->byte conversion of ``eval_staged`` output;
+        returns a DEVICE uint8 [K, 32*W, lam] array (dispatch async).
+        Pipelined consumers call ``copy_to_host_async()`` on it to overlap
+        the d2h with later chunks' compute."""
+        return _from_planes_jit(y_planes, self._inv_perm)
+
     def staged_to_bytes(self, y_planes: jax.Array, m: int) -> np.ndarray:
         """Convert ``eval_staged`` output to uint8 [K, M, lam] on host."""
-        return np.asarray(_from_planes_jit(y_planes, self._inv_perm))[:, :m, :]
+        return np.asarray(self.convert_staged(y_planes))[:, :m, :]
 
     def eval(self, b: int, xs: np.ndarray,
              bundle: KeyBundle | None = None) -> np.ndarray:

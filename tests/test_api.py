@@ -106,6 +106,113 @@ def test_facade_ships_once_per_party():
         assert recon[j].tobytes() == want
 
 
+def test_facade_mesh_routes_sharded_pallas():
+    """Dcf(..., mesh=...) runs the flagship walk kernel under shard_map on
+    the 8-virtual-device mesh (interpreter mode — no TPU), with the same
+    ship-once-per-party semantics as the single-device facade."""
+    import unittest.mock as mock
+
+    from dcf_tpu.parallel import ShardedPallasBackend, make_mesh
+
+    rng = random.Random(94)
+    ck = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    mesh = make_mesh(8)  # keys=4 x points=2
+    dcf = Dcf(n_bytes=2, lam=16, cipher_keys=ck, mesh=mesh)
+    assert dcf.backend_name == "pallas"  # auto at lam=16
+    nprng = np.random.default_rng(94)
+    k = 4  # divides the keys axis
+    alphas = nprng.integers(0, 256, (k, 2), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k, 16), dtype=np.uint8)
+    bundle = dcf.gen(alphas, betas, rng=nprng)
+    xs = nprng.integers(0, 256, (9, 2), dtype=np.uint8)
+    xs[0] = alphas[0]
+
+    ships = []
+    orig = ShardedPallasBackend.put_bundle
+
+    def counting_put(self, kb):
+        ships.append(kb.s0s.tobytes())
+        return orig(self, kb)
+
+    with mock.patch.object(ShardedPallasBackend, "put_bundle", counting_put):
+        for _ in range(2):
+            y0 = dcf.eval(0, bundle, xs)
+            y1 = dcf.eval(1, bundle, xs)
+    assert len(ships) == 2, f"expected one ship per party, got {len(ships)}"
+    assert isinstance(dcf._eval_backends[0], ShardedPallasBackend)
+    recon = y0 ^ y1
+    for i in range(k):
+        a = alphas[i].tobytes()
+        for j in range(9):
+            want = betas[i].tobytes() if xs[j].tobytes() < a else bytes(16)
+            assert recon[i, j].tobytes() == want
+
+
+def test_facade_mesh_keylanes():
+    """backend='keylanes' on a mesh: one shared two-party device image
+    serves both parties (shipped once, not once per party)."""
+    import unittest.mock as mock
+
+    from dcf_tpu.parallel import ShardedKeyLanesBackend, make_mesh
+
+    rng = random.Random(93)
+    ck = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    mesh = make_mesh(8)
+    dcf = Dcf(n_bytes=2, lam=16, cipher_keys=ck, backend="keylanes",
+              mesh=mesh,
+              backend_opts=dict(m_tile=2, kw_tile=1, level_chunk=4))
+    nprng = np.random.default_rng(93)
+    k = 40  # ragged vs the 4*32-key shard granule
+    alphas = nprng.integers(0, 256, (k, 2), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k, 16), dtype=np.uint8)
+    bundle = dcf.gen(alphas, betas, rng=nprng)
+    xs = nprng.integers(0, 256, (6, 2), dtype=np.uint8)
+    xs[0] = alphas[0]
+
+    ships = []
+    orig = ShardedKeyLanesBackend.put_bundle
+
+    def counting_put(self, kb):
+        ships.append(True)
+        return orig(self, kb)
+
+    with mock.patch.object(ShardedKeyLanesBackend, "put_bundle",
+                           counting_put):
+        for _ in range(2):
+            y0 = dcf.eval(0, bundle, xs)
+            y1 = dcf.eval(1, bundle, xs)
+    assert len(ships) == 1, \
+        f"the two-party image should ship once, shipped {len(ships)}x"
+    recon = y0 ^ y1
+    for i in range(k):
+        a = alphas[i].tobytes()
+        for j in range(6):
+            want = betas[i].tobytes() if xs[j].tobytes() < a else bytes(16)
+            assert recon[i, j].tobytes() == want
+    # A party-restricted bundle cannot feed the shared image.
+    with pytest.raises(ValueError, match="two-party"):
+        dcf.eval(0, bundle.for_party(0), xs)
+
+
+def test_facade_mesh_validation():
+    from dcf_tpu.parallel import make_mesh
+
+    rng = random.Random(92)
+    ck = [rand_bytes(rng, 32) for _ in range(18)]
+    mesh = make_mesh(8)
+    with pytest.raises(ValueError, match="no mesh-sharded variant"):
+        Dcf(2, 16, ck[:2], backend="cpu", mesh=mesh)
+    with pytest.raises(ValueError, match="lam=16 only"):
+        Dcf(2, 64, ck, backend="keylanes", mesh=mesh)
+    # auto at lam != 16 routes to the XLA-sharded fallback.
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", ReferenceContractWarning)
+        assert Dcf(2, 64, ck, mesh=mesh).backend_name == "bitsliced"
+    with pytest.raises(ValueError, match="backend_opts"):
+        Dcf(2, 16, ck[:2], backend="cpu",
+            backend_opts=dict(tile_words=64))
+
+
 def test_facade_gt_bound_hybrid():
     rng = random.Random(97)
     lam = 64
