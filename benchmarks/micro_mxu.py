@@ -1,0 +1,243 @@
+"""MXU probe: can the AES linear layer ride the (idle) matrix unit?
+
+Round 3 priced every VPU-side lever and declared ~11.4 ns/lane/encryption
+the cipher's floor — with one unit left unpriced: the MXU sits idle by
+construction (ROOFLINE.md).  The round's linear layer + ARK is GF(2)-linear
+on the 128 bit-major planes (~35% of cipher time, 1.1 us of 3.11 us per
+[128, 256] application), and this repo already runs GF(2)-affine maps as
+int8/bf16 matmuls (backends/large_lambda.py wide part).  This probe prices
+the same trick INSIDE the cipher:
+
+    out = M . sb  over GF(2),  M in {0,1}^(128x128)
+
+as  unpack planes to one-bit columns -> bf16 matmul on the MXU (sums <=
+128 are exact in bf16 x bf16 -> f32) -> parity (& 1) -> repack to words.
+
+The catch is the data format: the VPU formulation works on PACKED words
+(32 points per 32-bit lane), while a matmul needs each GF(2) component as
+its own element — a 32x element blow-up on both sides of the MXU.  The
+probe therefore measures the components separately (unpack / matmul /
+parity+repack) plus the full mxu-linear cipher against the shipped v3
+cipher, so the ledger can attribute where the time goes.
+
+Matrix derivation: M is built numerically by pushing the 128 basis planes
+through the v2 block formulation of ShiftRows∘MixColumns (ops/
+aes_bitsliced.py:233-253) — reference semantics /root/reference/src/
+prg.rs:42-73 via the AES-256 rounds — and verified bit-exactly against
+the shipped cipher here AND in tests/test_mxu_probe.py.
+
+Usage: python -m benchmarks.micro_mxu [--lanes 128] [--iters N]
+Prints one JSON line per probe.  Run on the TPU (the CPU interpreter
+numbers are meaningless for pricing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from dcf_tpu.ops.aes_bitsliced import (
+    _MCSR_PERMS,
+    _SR_PERM,
+    _xt_blocks,
+    aes256_encrypt_planes_bitmajor,
+    aes_walk_cipher_v3,
+    prep_rk_bitmajor_v3,
+    round_key_masks_bitmajor,
+)
+from dcf_tpu.ops.sbox_circuit import sbox_planes_bp113 as sbox_planes
+
+__all__ = ["linear_layer_matrices", "aes256_mxu_linear"]
+
+
+def linear_layer_matrices() -> tuple[np.ndarray, np.ndarray]:
+    """(M, M_final): the GF(2) matrices of the AES round linear layer
+    (ShiftRows∘MixColumns) and the final round's ShiftRows, over bit-major
+    planes p' = bit*16 + byte.  out = M @ in mod 2; entries 0/1."""
+    eye = np.eye(128, dtype=np.uint32)
+    blocks = [eye[16 * i:16 * (i + 1)] for i in range(8)]  # bit i planes
+    xb = _xt_blocks(blocks)
+    p0, p1, p2, p3 = (_MCSR_PERMS[d] for d in range(4))
+    rows = [
+        xb[i][p0] ^ (xb[i] ^ blocks[i])[p1] ^ blocks[i][p2] ^ blocks[i][p3]
+        for i in range(8)
+    ]
+    m = np.concatenate(rows, axis=0)  # [128, 128]
+    m_final = np.concatenate([blocks[i][_SR_PERM] for i in range(8)], axis=0)
+    return m, m_final
+
+
+def _unpack_bits(sb, l: int):
+    """int32 [128, L] packed planes -> int32 [128, 32L] one-bit columns
+    (column k*L + l = bit k of word-column l)."""
+    return jnp.concatenate(
+        [(sb >> k) & jnp.int32(1) for k in range(32)], axis=1)
+
+
+def _repack_bits(p, l: int):
+    """int32 [128, 32L] one-bit columns -> packed int32 [128, L]."""
+    acc = p[:, :l]
+    for k in range(1, 32):
+        acc = acc | (p[:, k * l:(k + 1) * l] << k)
+    return acc
+
+
+def _mxu_apply(m_bf, sb, l: int):
+    """One GF(2) matmul application: unpack -> MXU bf16 dot -> parity ->
+    repack.  Exact: products are 0/1 and row sums <= 128 < 256, inside
+    bf16's exact-integer range, accumulated in f32."""
+    u = _unpack_bits(sb, l).astype(jnp.bfloat16)
+    y = jax.lax.dot(m_bf, u, preferred_element_type=jnp.float32)
+    return _repack_bits(y.astype(jnp.int32) & jnp.int32(1), l)
+
+
+def aes256_mxu_linear(rk_all, state, m_bf, m_final_bf):
+    """AES-256 with the round linear layer + final ShiftRows on the MXU;
+    S-box and ARK stay on the VPU.  Bit-identical to
+    aes256_encrypt_planes_bitmajor (tests/test_mxu_probe.py)."""
+    l = state.shape[-1]
+    ones = jnp.int32(-1)
+
+    def sub(s):
+        s3 = s.reshape(8, 16, l)
+        return jnp.stack(sbox_planes([s3[i] for i in range(8)], ones)
+                         ).reshape(128, l)
+
+    s = state ^ rk_all[0]
+    for rnd in range(1, 14):
+        s = _mxu_apply(m_bf, sub(s), l) ^ rk_all[rnd]
+    return _mxu_apply(m_final_bf, sub(s), l) ^ rk_all[14]
+
+
+# --------------------------- on-chip probes ---------------------------------
+
+
+def _cipher_kernel(rk_ref, m_ref, mf_ref, x_ref, y_ref, *, iters: int,
+                   variant: str):
+    ones = jnp.int32(-1)
+    rk = rk_ref[:]
+    l = x_ref.shape[-1]
+    if variant == "v3":
+        rk_p = prep_rk_bitmajor_v3(jnp, rk)
+
+        def body(i, s):
+            return aes_walk_cipher_v3(jnp, rk_p, s, ones)
+    else:
+        m_bf = m_ref[:]
+        mf_bf = mf_ref[:]
+
+        def body(i, s):
+            return aes256_mxu_linear(rk, s, m_bf, mf_bf)
+
+    y_ref[:] = jax.lax.fori_loop(0, iters, body, x_ref[:])
+
+
+def _component_kernel(m_ref, x_ref, y_ref, *, iters: int, stage: str):
+    """Component attribution: each stage loops on its own output so the
+    chain stays data-dependent (not hoistable)."""
+    l = x_ref.shape[-1]
+    m_bf = m_ref[:]
+
+    if stage == "unpack_repack":
+        def body(i, s):  # conversions only, no MXU
+            return _repack_bits(_unpack_bits(s, l), l) ^ jnp.int32(i)
+    elif stage == "matmul":
+        def body(i, s):  # MXU only: one unpacked-width bf16 dot + parity
+            y = jax.lax.dot(m_bf, s.astype(jnp.bfloat16),
+                            preferred_element_type=jnp.float32)
+            return y.astype(jnp.int32) & jnp.int32(1) | (s & jnp.int32(2))
+    else:  # full linear application
+        def body(i, s):
+            return _mxu_apply(m_bf, s, l) ^ jnp.int32(i)
+
+    y_ref[:] = jax.lax.fori_loop(0, iters, body, x_ref[:])
+
+
+def _sync(y) -> None:
+    np.asarray(jnp.max(y.reshape(-1)[-8:].astype(jnp.int32)))
+
+
+def _time_one(fn, args, out_shape, reps: int = 3) -> float:
+    f = jax.jit(lambda *a: pl.pallas_call(fn, out_shape=out_shape)(*a))
+    _sync(f(*args))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(f(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _slope(fn_builder, args, out_shape, iters: int):
+    t1 = _time_one(fn_builder(iters), args, out_shape)
+    t2 = _time_one(fn_builder(2 * iters), args, out_shape)
+    return max(t2 - t1, 1e-9), t1
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lanes", type=int, default=128,
+                    help="packed lane width L (the unpacked width is 32L; "
+                         "128 keeps the f32 intermediates in VMEM)")
+    ap.add_argument("--iters", type=int, default=200)
+    args = ap.parse_args()
+    lanes, iters = args.lanes, args.iters
+    rng = np.random.default_rng(0)
+
+    m, m_final = linear_layer_matrices()
+
+    # Host correctness gate before timing anything.
+    rk = round_key_masks_bitmajor(bytes(range(32)))
+    st = rng.integers(-(2 ** 31), 2 ** 31, (128, 8), dtype=np.int64
+                      ).astype(np.int32)
+    want = aes256_encrypt_planes_bitmajor(
+        np, rk.view(np.uint32), st.view(np.uint32), np.uint32(0xFFFFFFFF))
+    got = np.asarray(aes256_mxu_linear(
+        jnp.asarray(rk), jnp.asarray(st), jnp.asarray(m, jnp.bfloat16),
+        jnp.asarray(m_final, jnp.bfloat16)))
+    assert np.array_equal(got.view(np.uint32), want), \
+        "mxu-linear cipher does not match the shipped cipher"
+    print(json.dumps({"probe": "correctness", "ok": True}))
+
+    rk_j = jnp.asarray(rk)
+    m_bf = jnp.asarray(m, jnp.bfloat16)
+    mf_bf = jnp.asarray(m_final, jnp.bfloat16)
+    st_j = jnp.asarray(rng.integers(-(2 ** 31), 2 ** 31, (128, lanes),
+                                    dtype=np.int64).astype(np.int32))
+    out = jax.ShapeDtypeStruct((128, lanes), jnp.int32)
+
+    for variant in ("v3", "mxu"):
+        sec, t1 = _slope(
+            lambda it: partial(_cipher_kernel, iters=it, variant=variant),
+            (rk_j, m_bf, mf_bf, st_j), out, iters)
+        per_app = sec / iters
+        print(json.dumps({
+            "probe": f"cipher_{variant}", "lanes": lanes,
+            "us_per_application": round(per_app * 1e6, 3),
+            "ns_per_lane_per_enc": round(per_app / (32 * lanes) * 1e9, 3),
+            "t_single": round(t1, 4)}))
+
+    st_wide = jnp.asarray(rng.integers(0, 2, (128, 32 * lanes),
+                                       dtype=np.int64).astype(np.int32))
+    out_wide = jax.ShapeDtypeStruct((128, 32 * lanes), jnp.int32)
+    for stage, a, o in (("unpack_repack", st_j, out),
+                        ("matmul", st_wide, out_wide),
+                        ("linear_full", st_j, out)):
+        sec, t1 = _slope(
+            lambda it: partial(_component_kernel, iters=it, stage=stage),
+            (m_bf, a), o, iters)
+        print(json.dumps({
+            "probe": stage, "lanes": lanes,
+            "us_per_application": round(sec / iters * 1e6, 3),
+            "t_single": round(t1, 4)}))
+
+
+if __name__ == "__main__":
+    main()

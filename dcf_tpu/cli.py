@@ -424,6 +424,10 @@ def bench_secure_relu(args) -> None:
     ``--device-gen``: fully device-resident — DeviceKeyGen + the Pallas
     keylanes kernel + on-device verification (the config-5 pipeline that
     runs 10^6 keys x 1024 points, see benchmarks/RESULTS_r02.jsonl).
+    ``--backend=pallas``: host keygen + the keys-in-lanes Pallas kernel
+    (the 1-chip anchor the sharded overhead is measured against);
+    ``--backend=sharded-pallas``: the same kernel under shard_map
+    (``--mesh=KxP``).
     """
     lam, nb = 16, 16
     k = args.keys or 65_536
@@ -462,20 +466,32 @@ def bench_secure_relu(args) -> None:
     native = NativeDcf(lam, ck)
     log(f"gen {k} keys ...")
     bundle = native.gen_batch(alphas, betas, s0s, Bound.LT_BETA)
-    if args.backend == "sharded-pallas":
-        # The keys-in-lanes Pallas kernel sharded over the mesh — the path
-        # a TPU pod runs for config 5.  Staged methodology (results stay
+    if args.backend in ("pallas", "sharded-pallas"):
+        # The keys-in-lanes Pallas kernel — sharded over the mesh
+        # (``sharded-pallas``, the path a TPU pod runs for config 5) or
+        # unsharded (``pallas``, the 1-chip anchor the sharded variant's
+        # overhead is measured against).  Staged methodology (results stay
         # HBM-resident, like _timed_staged): the packed CW image ships
         # once, both parties walk it per rep.
         import jax
 
-        from dcf_tpu.parallel import ShardedKeyLanesBackend, make_mesh
         from dcf_tpu.utils.benchtime import device_sync
 
-        mesh = make_mesh(shape=_parse_mesh(args.mesh))
-        log(f"mesh: {dict(mesh.shape)}")
-        be = ShardedKeyLanesBackend(
-            lam, ck, mesh, interpret=jax.devices()[0].platform != "tpu")
+        interp = jax.devices()[0].platform != "tpu"
+        if args.backend == "sharded-pallas":
+            from dcf_tpu.parallel import ShardedKeyLanesBackend, make_mesh
+
+            mesh = make_mesh(shape=_parse_mesh(args.mesh))
+            log(f"mesh: {dict(mesh.shape)}")
+            be = ShardedKeyLanesBackend(lam, ck, mesh, interpret=interp)
+            name = "sharded-keylanes-pallas"
+        else:
+            from dcf_tpu.backends.pallas_keylanes import (
+                KeyLanesPallasBackend,
+            )
+
+            be = KeyLanesPallasBackend(lam, ck, interpret=interp)
+            name = "keylanes-pallas"
         be.put_bundle(bundle)
         staged = be.stage(xs)
         y0 = be.eval_staged(0, staged)
@@ -491,7 +507,7 @@ def bench_secure_relu(args) -> None:
             device_sync(y0 ^ y1)
 
         dt, mad, ss = _timed(run, args.reps, args.profile)
-        _emit("secure_relu", "sharded-keylanes-pallas", "evals_per_sec",
+        _emit("secure_relu", name, "evals_per_sec",
               2 * k * m / dt, "evals/s (staged, results HBM-resident)",
               dt, mad, len(ss))
         return

@@ -177,7 +177,15 @@ class NativeDcf:
         xs: np.ndarray,
         num_threads: int | None = None,
     ) -> np.ndarray:
-        """Batched eval; same contract as eval_batch_np (xs 2D = shared)."""
+        """Batched eval; same contract as eval_batch_np (xs 2D = shared).
+
+        ``bundle`` may be the full two-party bundle (restricted to party
+        ``b`` here — previously s0s[:, 0] was read unconditionally, which
+        silently ran party 1's walk with party 0's seed) or an
+        already-restricted ``bundle.for_party(b)``.
+        """
+        if bundle.s0s.shape[1] == 2:
+            bundle = bundle.for_party(b)
         k_num, n, lam = bundle.cw_s.shape
         if lam != self.lam:
             raise ValueError("bundle lam mismatch")

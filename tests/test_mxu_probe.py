@@ -1,0 +1,56 @@
+"""The MXU-linear cipher formulation (benchmarks/micro_mxu.py) is
+bit-identical to the shipped bitsliced cipher.
+
+The probe prices the AES linear layer as a GF(2) matmul on the MXU
+(ROOFLINE.md round-4 lever); whatever the pricing verdict, the
+formulation itself must be exact — bf16 x bf16 -> f32 products of 0/1
+with row sums <= 128 are inside bf16's exact-integer range."""
+
+import numpy as np
+
+from benchmarks.micro_mxu import aes256_mxu_linear, linear_layer_matrices
+from dcf_tpu.ops.aes_bitsliced import (
+    aes256_encrypt_planes_bitmajor,
+    round_key_masks_bitmajor,
+)
+
+
+def test_linear_matrices_are_gf2():
+    m, m_final = linear_layer_matrices()
+    assert m.shape == (128, 128) and m_final.shape == (128, 128)
+    assert set(np.unique(m)) <= {0, 1}
+    assert set(np.unique(m_final)) <= {0, 1}
+    # ShiftRows is a permutation: exactly one 1 per row/column.
+    assert (m_final.sum(axis=0) == 1).all()
+    assert (m_final.sum(axis=1) == 1).all()
+    # MixColumns∘ShiftRows is invertible: full GF(2) rank.
+    r = m.copy()
+    rank = 0
+    for col in range(128):
+        rows = np.nonzero(r[rank:, col])[0]
+        if not len(rows):
+            continue
+        pivot = rank + rows[0]
+        r[[rank, pivot]] = r[[pivot, rank]]
+        elim = np.nonzero(r[:, col])[0]
+        for i in elim:
+            if i != rank:
+                r[i] ^= r[rank]
+        rank += 1
+    assert rank == 128
+
+
+def test_mxu_cipher_matches_bitsliced():
+    import jax.numpy as jnp
+
+    m, m_final = linear_layer_matrices()
+    rk = round_key_masks_bitmajor(bytes(range(7, 39)))
+    rng = np.random.default_rng(42)
+    st = rng.integers(-(2 ** 31), 2 ** 31, (128, 8), dtype=np.int64
+                      ).astype(np.int32)
+    want = aes256_encrypt_planes_bitmajor(
+        np, rk.view(np.uint32), st.view(np.uint32), np.uint32(0xFFFFFFFF))
+    got = np.asarray(aes256_mxu_linear(
+        jnp.asarray(rk), jnp.asarray(st), jnp.asarray(m, jnp.bfloat16),
+        jnp.asarray(m_final, jnp.bfloat16)))
+    assert np.array_equal(got.view(np.uint32), want)

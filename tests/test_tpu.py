@@ -99,12 +99,15 @@ def test_walk_kernel_compiled_multi_tile():
         assert np.array_equal(got, want), f"party {b}"
 
 
-def test_keylanes_kernel_compiled():
+@pytest.mark.parametrize("bound", [spec.Bound.LT_BETA, spec.Bound.GT_BETA])
+def test_keylanes_kernel_compiled(bound):
     """The many-keys kernel: ragged key count (40), odd point count (24),
-    both parties, plus the on-device relu mismatch counter."""
+    both parties, BOTH bounds (the reference tests them as peers,
+    src/lib.rs:372-420), plus the on-device relu mismatch counter (whose
+    semantics are the LT comparison)."""
     from dcf_tpu.backends.pallas_keylanes import KeyLanesPallasBackend
 
-    ck, prg, alphas, betas, bundle, xs = _workload(72, 40, 2, 24)
+    ck, prg, alphas, betas, bundle, xs = _workload(72, 40, 2, 24, bound)
     be = KeyLanesPallasBackend(16, ck, level_chunk=4)
     assert not be.interpret
     be.put_bundle(bundle)
@@ -115,8 +118,10 @@ def test_keylanes_kernel_compiled():
         ys[b] = y
         got = be.staged_to_bytes(y, staged["m"])
         want = eval_batch_np(prg, b, bundle.for_party(b), xs)
-        assert np.array_equal(got, want), f"party {b}"
-    assert int(be.relu_mismatch_count(ys[0], ys[1], alphas, betas, xs)) == 0
+        assert np.array_equal(got, want), f"party {b} {bound}"
+    if bound is spec.Bound.LT_BETA:
+        assert int(be.relu_mismatch_count(
+            ys[0], ys[1], alphas, betas, xs)) == 0
 
 
 @pytest.mark.parametrize("gt", [False, True])
@@ -133,19 +138,52 @@ def test_tree_fulldomain_compiled(gt):
     assert fd.check(bundle, alpha, betas[0].tobytes(), 16, gt=gt) == 0
 
 
-def test_narrow_kernel_compiled():
+@pytest.mark.parametrize("bound", [spec.Bound.LT_BETA, spec.Bound.GT_BETA])
+def test_narrow_kernel_compiled(bound):
     """The large-lambda hybrid's Pallas narrow walk (lane-dependent round
-    keys) at lam=144, both parties, vs the full-width oracle — K=3 keys
-    (the kernel grids over keys; the wide part is a batched MXU matmul)."""
+    keys) at lam=144, both parties, BOTH bounds, vs the full-width oracle
+    — K=3 keys (the kernel grids over keys; the wide part is a batched
+    MXU matmul)."""
     from dcf_tpu.backends.large_lambda import LargeLambdaBackend
 
-    ck, prg, _a, _b, bundle, xs = _workload(74, 3, 2, 9, lam=144)
+    ck, prg, _a, _b, bundle, xs = _workload(74, 3, 2, 9, bound, lam=144)
     be = LargeLambdaBackend(144, ck, narrow="pallas")
     assert not be.interpret
     for b in (0, 1):
         kb = bundle.for_party(b)
         got = be.eval(b, xs, bundle=kb)
         want = eval_batch_np(prg, b, kb, xs)
+        assert np.array_equal(got, want), f"party {b} {bound}"
+
+
+def test_hybrid_multikey_lam16384_compiled():
+    """The multi-key large-lambda regime on hardware: K=32 keys at
+    lam=16384 (the reference bench's literal range,
+    benches/dcf_large_lambda.rs:8-43) through the hybrid's gridded narrow
+    walk + batched MXU wide part.  Oracle = the C++ core (the numpy PRG
+    at 2048 ciphers x 32 keys would take minutes)."""
+    import random as _random
+
+    from dcf_tpu.backends.large_lambda import LargeLambdaBackend
+    from dcf_tpu.native import NativeDcf
+
+    lam, k_num, m = 16384, 32, 64
+    rng = _random.Random(77)
+    ck = [rand_bytes(rng, 32) for _ in range(2 * (lam // 16))]
+    native = NativeDcf(lam, ck)
+    nprng = np.random.default_rng(77)
+    alphas = nprng.integers(0, 256, (k_num, 16), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k_num, lam), dtype=np.uint8)
+    bundle = native.gen_batch(alphas, betas, random_s0s(k_num, lam, nprng),
+                              spec.Bound.LT_BETA)
+    xs = nprng.integers(0, 256, (m, 16), dtype=np.uint8)
+    xs[:k_num] = alphas[:, :]  # exact-alpha points
+    be = LargeLambdaBackend(lam, ck)
+    assert not be.interpret
+    for b in (0, 1):
+        kb = bundle.for_party(b)
+        got = be.eval(b, xs, bundle=kb)
+        want = native.eval(b, bundle, xs)
         assert np.array_equal(got, want), f"party {b}"
 
 
@@ -180,3 +218,54 @@ def test_sharded_pallas_1chip_mesh_compiled():
         got = be.eval(b, xs, bundle=kb)
         want = eval_batch_np(prg, b, kb, xs)
         assert np.array_equal(got, want), f"party {b}"
+
+
+def test_sharded_keylanes_1chip_mesh_compiled():
+    """The shard_map-wrapped keylanes kernel on a real 1-device TPU mesh
+    (the config-5 pod path's compiled-plumbing proof), incl. the
+    on-device relu counter through the sharded output layout."""
+    from dcf_tpu.parallel import ShardedKeyLanesBackend, make_mesh
+
+    ck, prg, alphas, betas, bundle, xs = _workload(78, 40, 2, 24)
+    mesh = make_mesh(shape=(1, 1))
+    be = ShardedKeyLanesBackend(16, ck, mesh, level_chunk=4)
+    assert not be.interpret
+    be.put_bundle(bundle)
+    staged = be.stage(xs)
+    ys = {}
+    for b in (0, 1):
+        y = be.eval_staged(b, staged)
+        ys[b] = y
+        got = be.staged_to_bytes(y, staged["m"])
+        want = eval_batch_np(prg, b, bundle.for_party(b), xs)
+        assert np.array_equal(got, want), f"party {b}"
+    assert int(be.relu_mismatch_count(ys[0], ys[1], alphas, betas, xs)) == 0
+
+
+def test_mxu_linear_cipher_compiled():
+    """The MXU-linear cipher formulation (benchmarks/micro_mxu.py, the
+    round-4 pricing probe) is bit-identical to the shipped v3 cipher AS
+    COMPILED Mosaic programs — whatever the pricing verdict, the probe
+    must measure a correct program."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from functools import partial
+
+    from benchmarks.micro_mxu import _cipher_kernel, linear_layer_matrices
+    from dcf_tpu.ops.aes_bitsliced import round_key_masks_bitmajor
+
+    m, m_final = linear_layer_matrices()
+    rk = jnp.asarray(round_key_masks_bitmajor(bytes(range(32))))
+    m_bf = jnp.asarray(m, jnp.bfloat16)
+    mf_bf = jnp.asarray(m_final, jnp.bfloat16)
+    nprng = np.random.default_rng(79)
+    st = jnp.asarray(nprng.integers(-(2 ** 31), 2 ** 31, (128, 128),
+                                    dtype=np.int64).astype(np.int32))
+    out = jax.ShapeDtypeStruct((128, 128), jnp.int32)
+    ys = {}
+    for variant in ("v3", "mxu"):
+        f = jax.jit(lambda *a, v=variant: pl.pallas_call(
+            partial(_cipher_kernel, iters=3, variant=v), out_shape=out)(*a))
+        ys[variant] = np.asarray(f(rk, m_bf, mf_bf, st))
+    assert np.array_equal(ys["v3"], ys["mxu"])
