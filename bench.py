@@ -194,12 +194,9 @@ def main() -> None:
     # round-trip is the DEV TUNNEL's latency, not chip work (ROOFLINE.md
     # "sync-starved timing"); it is measured bare here and subtracted
     # once per sample so the metric is the chip rate. ---
-    rtts = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        sync(staged["x_mask"])  # already materialized: bare RTT
-        rtts.append(time.perf_counter() - t0)
-    rtt = float(np.median(rtts))
+    from dcf_tpu.utils.benchtime import measure_sync_rtt
+
+    rtt = measure_sync_rtt(staged["x_mask"], reps=5)
     log(f"bare sync RTT: {rtt * 1e3:.0f} ms "
         "(tunnel artifact; subtracted once per sample)")
     times = []

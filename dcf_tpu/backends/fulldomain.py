@@ -77,6 +77,21 @@ def _finalize_np(bundle: KeyBundle, s, v, t):
     return v ^ s ^ bundle.cw_np1[0] * t[:, None]
 
 
+def leaf_mismatch_count(y0, y1, beta_mask, inside):
+    """Count leaves whose XOR reconstruction differs from the expected
+    ``beta if inside else 0``.  y0/y1: leaf-share planes [128, W];
+    beta_mask: [128, 1]; inside: bool [32*W] per-leaf expectation.
+    Shared by the unsharded and mesh-sharded verifiers so the counting
+    contract cannot diverge between them."""
+    bits = inside.astype(jnp.uint32).reshape(-1, 32)
+    ltw = jax.lax.bitcast_convert_type(
+        jnp.sum(bits << jnp.arange(32, dtype=jnp.uint32), axis=-1,
+                dtype=jnp.uint32), jnp.int32)[None, :]  # [1, W]
+    diff = jnp.bitwise_or.reduce(y0 ^ y1 ^ (beta_mask & ltw), axis=0)
+    return jnp.sum(jax.lax.population_count(
+        jax.lax.bitcast_convert_type(diff, jnp.uint32)).astype(jnp.int32))
+
+
 @partial(jax.jit, static_argnames=("n", "gt"))
 def _tree_mismatch(y0, y1, beta_mask, alpha, n: int, *, gt: bool):
     """Mismatching-leaf count for bitrev-order y planes [128, 2^n / 32]."""
@@ -86,13 +101,7 @@ def _tree_mismatch(y0, y1, beta_mask, alpha, n: int, *, gt: bool):
     for k in range(n):  # domain value = bitreverse_n(position)
         value = value | (((pos >> k) & 1) << (n - 1 - k))
     inside = (value > alpha) if gt else (value < alpha)
-    bits = inside.astype(jnp.uint32).reshape(-1, 32)
-    ltw = jax.lax.bitcast_convert_type(
-        jnp.sum(bits << jnp.arange(32, dtype=jnp.uint32), axis=-1,
-                dtype=jnp.uint32), jnp.int32)[None, :]  # [1, W]
-    diff = jnp.bitwise_or.reduce(y0 ^ y1 ^ (beta_mask & ltw), axis=0)
-    return jnp.sum(jax.lax.population_count(
-        jax.lax.bitcast_convert_type(diff, jnp.uint32)).astype(jnp.int32))
+    return leaf_mismatch_count(y0, y1, beta_mask, inside)
 
 
 class TreeFullDomain:

@@ -25,4 +25,5 @@ from dcf_tpu.parallel.mesh import (  # noqa: F401
 from dcf_tpu.parallel.pallas_sharded import (  # noqa: F401
     ShardedKeyLanesBackend,
     ShardedPallasBackend,
+    ShardedTreeFullDomain,
 )

@@ -17,6 +17,12 @@ existed solely as single-chip programs.  This module runs them under
   (``ops.pallas_keylanes``, the config-5 secure-ReLU path): the packed
   key-word axis shards over ``keys``, the shared-point axis over
   ``points``.
+* ``ShardedTreeFullDomain`` — the GGM tree expand kernel
+  (``ops.pallas_tree``, the config-3 full-domain path): the level-k0
+  frontier shards over ALL mesh devices (the tree is single-key, so both
+  axes gang up on nodes); each device expands its disjoint sub-frontier
+  to the leaves and verifies them locally with a shard-aware
+  position->domain-value map, returning one counter per shard.
 
 Both are testable without hardware: construct with ``interpret=True`` on a
 virtual CPU mesh (tests/test_sharding.py) — the Pallas interpreter lowers
@@ -40,12 +46,16 @@ from dcf_tpu.backends.pallas_backend import (
     _from_planes_jit,
     _stage_xs,
 )
+from dcf_tpu.backends.fulldomain import TreeFullDomain, leaf_mismatch_count
 from dcf_tpu.backends.pallas_keylanes import KeyLanesPallasBackend
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.pallas_eval import DEFAULT_TILE_WORDS, dcf_eval_pallas
 from dcf_tpu.ops.pallas_keylanes import dcf_eval_keylanes_pallas
+from dcf_tpu.ops.pallas_tree import tree_expand_device
+from dcf_tpu.utils.bits import bitmajor_plane_masks
 
-__all__ = ["ShardedPallasBackend", "ShardedKeyLanesBackend"]
+__all__ = ["ShardedPallasBackend", "ShardedKeyLanesBackend",
+           "ShardedTreeFullDomain"]
 
 
 class ShardedPallasBackend(PallasBackend):
@@ -153,6 +163,125 @@ class ShardedPallasBackend(PallasBackend):
         x_mask = self._stage_sharded(xs, xs.shape[0] == 1)
         y = self.eval_staged(b, {"x_mask": x_mask, "m": m, "wt": wt})
         return self.staged_to_bytes(y, m)
+
+
+class ShardedTreeFullDomain(TreeFullDomain):
+    """Full-domain tree evaluation/verification sharded over a mesh.
+
+    The GGM tree is single-key, so the frontier at level k0 (2^k0 nodes,
+    bitreverse_k0 order) shards over ALL devices of the (keys, points)
+    mesh: device q takes the contiguous frontier slice
+    [q*2^k0/P, (q+1)*2^k0/P) and expands it to depth n independently —
+    disjoint subtrees, no collectives (the exact structure the reference
+    would get from rayon over subtrees).  Verification happens inside
+    each shard: the local leaf at index l = e*2^c + fl (c frontier-local
+    bits, e the device-level direction bits) has global walk directions
+    (fl bits, then q bits, then e bits) and therefore domain value
+    sum(d_i * 2^(n-1-i)); each device counts its own mismatches and the
+    caller sums the P counters.
+
+    ``host_levels`` must give every device at least one 32-node lane
+    word: k0 >= 5 + log2(P) (the default raises the base class's 6 as
+    needed).
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes], mesh: Mesh,
+                 host_levels: int | None = None, interpret: bool = False):
+        p_total = 1
+        for ax in mesh.axis_names:
+            p_total *= mesh.shape[ax]
+        if p_total & (p_total - 1):
+            raise ValueError(f"device count {p_total} must be a power of 2")
+        self._log2p = p_total.bit_length() - 1
+        min_k0 = 5 + self._log2p
+        if host_levels is None:
+            host_levels = max(6, min_k0)
+        if host_levels < min_k0:
+            raise ValueError(
+                f"host_levels={host_levels} gives some device less than "
+                f"one lane word of frontier; need >= {min_k0} for "
+                f"{p_total} devices")
+        super().__init__(lam, cipher_keys, host_levels=host_levels,
+                         interpret=interpret)
+        self.mesh = mesh
+        self._ptotal = p_total
+        self._axes = tuple(mesh.axis_names)
+        self._spec_nodes = P(None, self._axes)  # [128|1, W] frontier/leaves
+        self._fns: dict = {}
+
+    def _put_nodes(self, arr) -> jax.Array:
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, self._spec_nodes))
+
+    def _check_fn(self, n_bits: int, gt: bool):
+        key = (n_bits, gt)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        k0 = min(self.host_levels, n_bits)
+        c = k0 - self._log2p  # frontier-local node bits per shard
+        kaxis = self._axes[0]
+        psize = self.mesh.shape[self._axes[1]]
+        interp = self.interpret
+        log2p = self._log2p
+
+        def shard(rk, cw_s, cw_v, cw_t, cw_np1, s0, v0, t0, s1, v1, t1,
+                  beta_mask, alpha):
+            ys = [tree_expand_device(rk, cw_s, cw_v, cw_t, cw_np1, s, v, t,
+                                     k0=k0, n=n_bits, interpret=interp)
+                  for (s, v, t) in ((s0, v0, t0), (s1, v1, t1))]
+            q = jax.lax.axis_index(kaxis) * psize + jax.lax.axis_index(
+                self._axes[1])
+            m_local = 32 * ys[0].shape[1]
+            pos = jnp.arange(m_local, dtype=jnp.uint32)
+            fl = pos & jnp.uint32((1 << c) - 1)
+            e = pos >> c
+            value = jnp.zeros(m_local, dtype=jnp.uint32)
+            for i in range(c):  # frontier-local direction bits
+                value = value | (((fl >> i) & 1) << (n_bits - 1 - i))
+            for i in range(log2p):  # shard-index direction bits
+                qbit = ((q.astype(jnp.uint32) >> i) & 1).astype(jnp.uint32)
+                value = value | (qbit << (n_bits - 1 - c - i))
+            for j in range(n_bits - k0):  # device-level direction bits
+                value = value | (((e >> j) & 1) << (n_bits - 1 - k0 - j))
+            inside = (value > alpha) if gt else (value < alpha)
+            return leaf_mismatch_count(
+                ys[0], ys[1], beta_mask, inside).reshape(1, 1)
+
+        fn = jax.jit(
+            jax.shard_map(
+                shard, mesh=self.mesh,
+                in_specs=(P(), P(), P(), P(), P(),
+                          *([self._spec_nodes] * 6), P(), P()),
+                out_specs=P(*self._axes),  # [K, P] per-shard counters
+                check_vma=False,  # disjoint subtrees, no collectives
+            ))
+        self._fns[key] = fn
+        return fn
+
+    def check_device(self, bundle: KeyBundle, alpha: int, beta: bytes,
+                     n_bits: int, gt: bool = False) -> jax.Array:
+        """Two-party full-domain reconstruction vs the plain comparison,
+        sharded over the mesh; returns the TOTAL mismatch count as a
+        device scalar (sum of the per-shard counters)."""
+        if n_bits < self.host_levels:
+            raise ValueError(
+                f"n_bits={n_bits} smaller than the {self.host_levels} "
+                "host levels the mesh frontier needs; use the unsharded "
+                "TreeFullDomain")
+        if bundle.n_bits != n_bits:
+            raise ValueError("bundle depth mismatch")
+        staged_cw, fronts, _parts = self._staged_for(bundle, n_bits)
+        beta_mask = jnp.asarray(bitmajor_plane_masks(
+            np.frombuffer(beta, dtype=np.uint8))[:, None])
+        fn = self._check_fn(n_bits, gt)
+        counts = fn(self.rk, *staged_cw, *fronts[0], *fronts[1],
+                    beta_mask, jnp.uint32(alpha))
+        return jnp.sum(counts)
+
+    def _frontier(self, bundle: KeyBundle, b: int, k0: int):
+        s, v, t = super()._frontier(bundle, b, k0)
+        return self._put_nodes(s), self._put_nodes(v), self._put_nodes(t)
 
 
 class ShardedKeyLanesBackend(KeyLanesPallasBackend):

@@ -11,7 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["DISPATCHES_PER_SAMPLE", "DISPATCHES_PER_SAMPLE_SLOW",
-           "device_sync"]
+           "device_sync", "measure_sync_rtt"]
 
 # ~1.2ms of amortized sync against ~100ms per dispatch at the flagship
 # shape (measured 2026-07-31: 16 dispatches under-reported the chip by
@@ -30,3 +30,18 @@ def device_sync(y) -> None:
     import jax.numpy as jnp
 
     np.asarray(jnp.max(y.reshape(-1)[-8:].astype(jnp.int32)))
+
+
+def measure_sync_rtt(y, reps: int = 3) -> float:
+    """Median bare round-trip of one ``device_sync`` on an already
+    MATERIALIZED array: the tunnel-latency share a timed sample carries
+    per sync, measured so benches can subtract it from the chip metric.
+    The caller must have synced ``y`` already."""
+    import time
+
+    rtts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        device_sync(y)
+        rtts.append(time.perf_counter() - t0)
+    return float(np.median(rtts))

@@ -242,6 +242,22 @@ def test_sharded_keylanes_1chip_mesh_compiled():
     assert int(be.relu_mismatch_count(ys[0], ys[1], alphas, betas, xs)) == 0
 
 
+def test_sharded_tree_1chip_mesh_compiled():
+    """The shard_map-wrapped tree expand kernel + in-shard verification
+    on a real 1-device TPU mesh, both bounds, with a negative control."""
+    from dcf_tpu.parallel import ShardedTreeFullDomain, make_mesh
+
+    n_bits = 16
+    ck, prg, alphas, betas, bundle, _xs = _workload(80, 1, 2, 1)
+    fd = ShardedTreeFullDomain(16, ck, make_mesh(shape=(1, 1)))
+    assert not fd.interpret
+    alpha = int.from_bytes(alphas[0].tobytes(), "big")
+    beta = betas[0].tobytes()
+    assert fd.check(bundle, alpha, beta, n_bits) == 0
+    wrong = bytes(b ^ 1 for b in beta)
+    assert fd.check(bundle, alpha, wrong, n_bits) == alpha
+
+
 def test_mxu_linear_cipher_compiled():
     """The MXU-linear cipher formulation (benchmarks/micro_mxu.py, the
     round-4 pricing probe) is bit-identical to the shipped v3 cipher AS
