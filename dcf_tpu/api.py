@@ -29,18 +29,20 @@ the mesh equivalent should be just as transparent:
     >>> dcf = Dcf(16, 16, keys, mesh=make_mesh(shape=(4, 2)))
     >>> dcf.eval(0, bundle, xs)       # ShardedPallasBackend underneath
 
-    auto       sharded pallas walk kernel (lam=16), sharded bitsliced
-               elsewhere
+    auto       sharded pallas walk kernel (lam=16), sharded hybrid
+               (lam >= 48), sharded bitsliced elsewhere
     pallas     parallel.ShardedPallasBackend (flagship walk kernel)
     keylanes   parallel.ShardedKeyLanesBackend (many keys x few points,
                the config-5 shape; both parties share one device image)
+    hybrid     parallel.ShardedLargeLambdaBackend (large lambda: narrow
+               walk + affine wide part, keys+points sharded)
     bitsliced  parallel.ShardedBitslicedBackend
     jax        parallel.ShardedJaxBackend
 
-Key counts must divide the mesh's keys axis for pallas/bitsliced/jax
-(keylanes pads ragged key counts to its shard granule); ship-once key
-caching works exactly as in the single-device case.  ``cpu``/``numpy``/``hybrid`` are
-host/single-device paths and reject a mesh.  ``backend_opts=`` forwards
+Key counts must divide the mesh's keys axis for pallas/hybrid/
+bitsliced/jax (keylanes pads ragged key counts to its shard granule);
+ship-once key caching works exactly as in the single-device case.
+``cpu``/``numpy`` are host paths and reject a mesh.  ``backend_opts=`` forwards
 constructor keywords to the selected backend (e.g. ``tile_words`` for
 pallas, ``m_tile``/``kw_tile``/``level_chunk`` for keylanes).
 
@@ -104,19 +106,21 @@ class Dcf:
         self.mesh = mesh
         self._backend_opts = dict(backend_opts or {})
         if mesh is not None:
-            self.backend_name = (
-                ("pallas" if lam == 16 else "bitsliced")
-                if backend == "auto" else backend)
+            if backend == "auto":
+                self.backend_name = ("pallas" if lam == 16 else
+                                     "hybrid" if lam >= 48 else "bitsliced")
+            else:
+                self.backend_name = backend
             if self.backend_name not in (
-                    "pallas", "keylanes", "bitsliced", "jax"):
+                    "pallas", "keylanes", "bitsliced", "jax", "hybrid"):
                 raise ValueError(
                     f"backend {self.backend_name!r} has no mesh-sharded "
-                    "variant (cpu/numpy/hybrid are host/single-device "
-                    "paths); use pallas, keylanes, bitsliced or jax")
+                    "variant (cpu/numpy are host paths); use pallas, "
+                    "keylanes, hybrid, bitsliced or jax")
             if self.backend_name in ("pallas", "keylanes") and lam != 16:
                 raise ValueError(
                     f"the {self.backend_name} kernels support lam=16 only "
-                    f"(got {lam}); use bitsliced/jax on the mesh")
+                    f"(got {lam}); use hybrid/bitsliced/jax on the mesh")
         else:
             self.backend_name = (
                 _default_backend(lam) if backend == "auto" else backend)
@@ -183,6 +187,12 @@ class Dcf:
                 from dcf_tpu.parallel import ShardedKeyLanesBackend
 
                 return ShardedKeyLanesBackend(
+                    self.lam, self.cipher_keys, self.mesh,
+                    interpret=interp, **opts)
+            if name == "hybrid":
+                from dcf_tpu.parallel import ShardedLargeLambdaBackend
+
+                return ShardedLargeLambdaBackend(
                     self.lam, self.cipher_keys, self.mesh,
                     interpret=interp, **opts)
             if name == "bitsliced":

@@ -104,9 +104,23 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
         return (lambda b, bundle, xs: eval_batch_np(prg, b, bundle, xs),
                 None)
     if backend == "hybrid":
-        from dcf_tpu.backends.large_lambda import LargeLambdaBackend
+        if args is not None and getattr(args, "mesh", ""):
+            import jax
 
-        be = LargeLambdaBackend(lam, cipher_keys)
+            from dcf_tpu.parallel import (
+                ShardedLargeLambdaBackend,
+                make_mesh,
+            )
+
+            mesh = make_mesh(shape=_parse_mesh(args.mesh))
+            log(f"mesh: {dict(mesh.shape)}")
+            be = ShardedLargeLambdaBackend(
+                lam, cipher_keys, mesh,
+                interpret=jax.devices()[0].platform != "tpu")
+        else:
+            from dcf_tpu.backends.large_lambda import LargeLambdaBackend
+
+            be = LargeLambdaBackend(lam, cipher_keys)
     elif backend == "jax":
         from dcf_tpu.backends.jax_backend import JaxBackend
 
@@ -690,9 +704,13 @@ def bench_baseline(args) -> None:
                                   keys=full_keys,
                                   points=args.points or 1_024)),
     ]
+    if args.mesh:
+        log("baseline is the single-chip report; ignoring --mesh "
+            "(bench the sharded backends individually)")
     for cfg, name, over in specs:
         log(f"--- BASELINE config {cfg}: {name} {over} ---")
         a = copy.copy(args)
+        a.mesh = ""
         for key, val in over.items():
             setattr(a, key, val)
         BENCHES[name](a)
@@ -748,7 +766,10 @@ def main(argv=None) -> None:
     p.add_argument("--check", action="store_true",
                    help="verify parity vs the C++ core before timing")
     p.add_argument("--mesh", default="",
-                   help="mesh shape KxP for --backend=sharded (e.g. 4x2)")
+                   help="mesh shape KxP (e.g. 4x2) for the sharded "
+                        "backends; with --backend=hybrid or "
+                        "--backend=tree it switches to their mesh-sharded "
+                        "variants")
     p.add_argument("--profile", default="",
                    help="write a jax.profiler trace of the timed region")
     p.add_argument("--n-bits", type=int, default=0,

@@ -24,6 +24,7 @@ from dcf_tpu.parallel.mesh import (  # noqa: F401
 )
 from dcf_tpu.parallel.pallas_sharded import (  # noqa: F401
     ShardedKeyLanesBackend,
+    ShardedLargeLambdaBackend,
     ShardedPallasBackend,
     ShardedTreeFullDomain,
 )

@@ -17,6 +17,10 @@ existed solely as single-chip programs.  This module runs them under
   (``ops.pallas_keylanes``, the config-5 secure-ReLU path): the packed
   key-word axis shards over ``keys``, the shared-point axis over
   ``points``.
+* ``ShardedLargeLambdaBackend`` — the large-lambda hybrid
+  (``backends.large_lambda``, the config-4 path): keys shard the narrow
+  plane image and the affine (const, W) decomposition, points shard the
+  xs batch; the wide MXU matmul runs per key-shard.
 * ``ShardedTreeFullDomain`` — the GGM tree expand kernel
   (``ops.pallas_tree``, the config-3 full-domain path): the level-k0
   frontier shards over ALL mesh devices (the tree is single-key, so both
@@ -47,6 +51,10 @@ from dcf_tpu.backends.pallas_backend import (
     _stage_xs,
 )
 from dcf_tpu.backends.fulldomain import TreeFullDomain, leaf_mismatch_count
+from dcf_tpu.backends.large_lambda import (
+    LargeLambdaBackend,
+    _hybrid_eval_pallas,
+)
 from dcf_tpu.backends.pallas_keylanes import KeyLanesPallasBackend
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.pallas_eval import DEFAULT_TILE_WORDS, dcf_eval_pallas
@@ -55,7 +63,7 @@ from dcf_tpu.ops.pallas_tree import tree_expand_device
 from dcf_tpu.utils.bits import bitmajor_plane_masks
 
 __all__ = ["ShardedPallasBackend", "ShardedKeyLanesBackend",
-           "ShardedTreeFullDomain"]
+           "ShardedTreeFullDomain", "ShardedLargeLambdaBackend"]
 
 
 class ShardedPallasBackend(PallasBackend):
@@ -282,6 +290,99 @@ class ShardedTreeFullDomain(TreeFullDomain):
     def _frontier(self, bundle: KeyBundle, b: int, k0: int):
         s, v, t = super()._frontier(bundle, b, k0)
         return self._put_nodes(s), self._put_nodes(v), self._put_nodes(t)
+
+
+class ShardedLargeLambdaBackend(LargeLambdaBackend):
+    """The large-lambda hybrid (narrow Pallas walk + GF(2) affine wide
+    part) under shard_map: keys shard the narrow plane image AND the
+    affine decomposition (const, W); points shard the shared xs batch.
+    Pure map per (key-shard, point-shard) block — the narrow walk grids
+    over local keys and the wide part runs its batched MXU matmul on the
+    local key slice, so the reference's one large-lambda workload
+    (benches/dcf_large_lambda.rs) scales out with zero collectives.
+
+    Always uses the Pallas narrow walk (Mosaic on TPU meshes, the
+    interpreter on virtual CPU meshes); the XLA-narrow layout stores keys
+    on the trailing axis and is not wired for sharding.
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes], mesh: Mesh,
+                 col_chunk: int = 1 << 15, interpret: bool = False):
+        super().__init__(lam, cipher_keys, col_chunk=col_chunk,
+                         narrow="pallas", interpret=interpret)
+        self.mesh = mesh
+        kaxis, paxis = mesh.axis_names
+        self._ksize = mesh.shape[kaxis]
+        self._psize = mesh.shape[paxis]
+        self._spec_keyed = P(kaxis)              # [K, ...] bundle arrays
+        self._spec_xs = P(None, paxis, None)     # [1, M, nb]
+        self._spec_y = P(kaxis, paxis, None)     # [K, M, lam]
+        self._fns: dict = {}
+
+    def put_bundle(self, bundle: KeyBundle) -> None:
+        if bundle.num_keys % self._ksize:
+            raise ValueError(
+                f"num_keys={bundle.num_keys} not divisible by keys-axis "
+                f"size {self._ksize}")
+        super().put_bundle(bundle)
+        sh = NamedSharding(self.mesh, self._spec_keyed)
+        self._dev = {k: jax.device_put(v, sh) for k, v in self._dev.items()}
+
+    def _wide_staged(self):
+        if self._wide is None:
+            super()._wide_staged()
+            sh = NamedSharding(self.mesh, self._spec_keyed)
+            self._wide = tuple(jax.device_put(a, sh) for a in self._wide)
+        return self._wide
+
+    def stage(self, xs: np.ndarray) -> dict:
+        if self._dev is None:
+            raise ValueError("no key bundle on device; call put_bundle first")
+        if xs.ndim != 2:
+            raise ValueError("LargeLambdaBackend wants shared points [M, nb]")
+        m = xs.shape[0]
+        # Per-SHARD batches beyond one 4096-point tile must be whole tiles.
+        local = -(-m // self._psize)
+        granule = self._psize * (4096 if local > 4096 else 32)
+        m_pad = -(-m // granule) * granule
+        if m_pad != m:
+            xs = np.pad(xs, [(0, m_pad - m), (0, 0)])
+        xs_dev = jax.device_put(
+            np.ascontiguousarray(xs)[None],
+            NamedSharding(self.mesh, self._spec_xs))
+        return {"xs": xs_dev, "m": m}
+
+    def eval_staged(self, b: int, staged: dict) -> jax.Array:
+        const, w8 = self._wide_staged()
+        dev = self._dev
+        cc = self._col_chunk_for(self._bundle.num_keys // self._ksize)
+        # cc is baked into the shard closure, so it must key the cache:
+        # a later put_bundle with a different key count gets a fresh fn
+        # (the unsharded base re-specializes via a jit static arg).
+        fn = self._fns.get((int(b), cc))
+        if fn is None:
+            interp = self.interpret
+
+            def shard(rk2, s0a, s0b, cs0, cs1, cv0, cv1, np1a, np1b,
+                      cw_t, inv_perm, const_, w8_, xs):
+                return _hybrid_eval_pallas(
+                    rk2, s0a, s0b, cs0, cs1, cv0, cv1, np1a, np1b, cw_t,
+                    inv_perm, const_, w8_, xs, b=int(b), col_chunk=cc,
+                    interpret=interp)
+
+            fn = jax.jit(
+                jax.shard_map(
+                    shard, mesh=self.mesh,
+                    in_specs=(P(), *([self._spec_keyed] * 9), P(),
+                              self._spec_keyed, self._spec_keyed,
+                              self._spec_xs),
+                    out_specs=self._spec_y,
+                    check_vma=False,  # pure map, no collectives
+                ))
+            self._fns[(int(b), cc)] = fn
+        return fn(self.rk2, dev["s0a"], dev["s0b"], dev["cs0"], dev["cs1"],
+                  dev["cv0"], dev["cv1"], dev["np1a"], dev["np1b"],
+                  dev["cw_t"], self._inv_perm, const, w8, staged["xs"])
 
 
 class ShardedKeyLanesBackend(KeyLanesPallasBackend):

@@ -204,10 +204,12 @@ def test_facade_mesh_validation():
         Dcf(2, 16, ck[:2], backend="cpu", mesh=mesh)
     with pytest.raises(ValueError, match="lam=16 only"):
         Dcf(2, 64, ck, backend="keylanes", mesh=mesh)
-    # auto at lam != 16 routes to the XLA-sharded fallback.
+    # auto at lam >= 48 routes to the sharded hybrid; 16 < lam < 48 (no
+    # hybrid, no lam=16 kernel) to the XLA-sharded fallback.
     with warnings.catch_warnings():
         warnings.simplefilter("ignore", ReferenceContractWarning)
-        assert Dcf(2, 64, ck, mesh=mesh).backend_name == "bitsliced"
+        assert Dcf(2, 64, ck, mesh=mesh).backend_name == "hybrid"
+        assert Dcf(2, 32, ck, mesh=mesh).backend_name == "bitsliced"
     with pytest.raises(ValueError, match="backend_opts"):
         Dcf(2, 16, ck[:2], backend="cpu",
             backend_opts=dict(tile_words=64))

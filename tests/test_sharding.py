@@ -225,6 +225,61 @@ def test_sharded_tree_fulldomain(gt):
     assert got == want == inside
 
 
+def test_sharded_large_lambda_matches_numpy():
+    """The large-lambda hybrid under shard_map on the 8-device mesh:
+    parity with the numpy oracle, both parties, both bounds, ragged m."""
+    from dcf_tpu.parallel import ShardedLargeLambdaBackend, make_mesh
+
+    lam = 64
+    rng = random.Random(39)
+    cipher_keys = [rand_bytes(rng, 32) for _ in range(18)]  # index 17
+    prg_np = HirosePrgNp(lam, cipher_keys)
+    nprng = np.random.default_rng(13)
+    k_num, n_bytes, m = 4, 2, 37  # K divides keys=4; ragged m pads
+    alphas = nprng.integers(0, 256, (k_num, n_bytes), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k_num, lam), dtype=np.uint8)
+    mesh = make_mesh(8)  # keys=4 x points=2
+    for bound in (spec.Bound.LT_BETA, spec.Bound.GT_BETA):
+        bundle = gen_batch(prg_np, alphas, betas,
+                           random_s0s(k_num, lam, nprng), bound)
+        xs = nprng.integers(0, 256, (m, n_bytes), dtype=np.uint8)
+        xs[0] = alphas[0]
+        be = ShardedLargeLambdaBackend(lam, cipher_keys, mesh,
+                                       interpret=True)
+        for b in (0, 1):
+            kb = bundle.for_party(b)
+            got = be.eval(b, xs, bundle=kb)
+            want = eval_batch_np(prg_np, b, kb, xs)
+            assert np.array_equal(got, want), f"party {b} {bound}"
+
+
+def test_facade_mesh_hybrid_auto():
+    """Dcf(..., lam>=48, mesh=...) auto-routes to the sharded hybrid."""
+    import warnings as _warnings
+
+    from dcf_tpu import Dcf, ReferenceContractWarning
+    from dcf_tpu.parallel import ShardedLargeLambdaBackend, make_mesh
+
+    rng = random.Random(40)
+    cipher_keys = [rand_bytes(rng, 32) for _ in range(18)]
+    nprng = np.random.default_rng(14)
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore", ReferenceContractWarning)
+        dcf = Dcf(2, 64, cipher_keys, mesh=make_mesh(8))
+    assert dcf.backend_name == "hybrid"
+    alphas = nprng.integers(0, 256, (4, 2), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (4, 64), dtype=np.uint8)
+    bundle = dcf.gen(alphas, betas, rng=nprng)
+    xs = nprng.integers(0, 256, (6, 2), dtype=np.uint8)
+    recon = dcf.eval(0, bundle, xs) ^ dcf.eval(1, bundle, xs)
+    assert isinstance(dcf._eval_backends[0], ShardedLargeLambdaBackend)
+    for i in range(4):
+        a = alphas[i].tobytes()
+        for j in range(6):
+            want = betas[i].tobytes() if xs[j].tobytes() < a else bytes(64)
+            assert recon[i, j].tobytes() == want
+
+
 def test_sharded_tree_validation():
     from dcf_tpu.parallel import ShardedTreeFullDomain, make_mesh
 

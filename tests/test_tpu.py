@@ -258,6 +258,22 @@ def test_sharded_tree_1chip_mesh_compiled():
     assert fd.check(bundle, alpha, wrong, n_bits) == alpha
 
 
+def test_sharded_hybrid_1chip_mesh_compiled():
+    """The large-lambda hybrid under shard_map on a real 1-device TPU
+    mesh (compiled narrow Mosaic walk + per-shard MXU wide matmul)."""
+    from dcf_tpu.parallel import ShardedLargeLambdaBackend, make_mesh
+
+    ck, prg, _a, _b, bundle, xs = _workload(81, 2, 2, 9, lam=144)
+    mesh = make_mesh(shape=(1, 1))
+    be = ShardedLargeLambdaBackend(144, ck, mesh)
+    assert not be.interpret
+    for b in (0, 1):
+        kb = bundle.for_party(b)
+        got = be.eval(b, xs, bundle=kb)
+        want = eval_batch_np(prg, b, kb, xs)
+        assert np.array_equal(got, want), f"party {b}"
+
+
 def test_mxu_linear_cipher_compiled():
     """The MXU-linear cipher formulation (benchmarks/micro_mxu.py, the
     round-4 pricing probe) is bit-identical to the shipped v3 cipher AS
