@@ -224,40 +224,16 @@ def main() -> None:
     if not np.array_equal(y_host[0, :M_PARITY], y_cpu[0, :M_PARITY]):
         raise SystemExit("staged-path parity check failed")
 
-    # --- overlapped end-to-end: the batch split in two UNIFORM halves so
-    # the half-shape programs compile once (warmed untimed below — the
-    # round-3 8-chunk variant recompiled inside the timed region and
-    # measured 7.7x WORSE than the single-shot fetch); both computes and
-    # conversions dispatch async, and copy_to_host_async() starts half 0's
-    # d2h while half 1 is still computing, bounding delivery by
-    # max(compute, transfer) instead of their sum.  Pallas staged layout
-    # only — the bitsliced fallback lane (no "wt" granule) skips it and
-    # the single-shot number above stands alone. ---
-    w_total = staged["x_mask"].shape[-1]
-    wt = staged.get("wt", 0)
-    if wt and (w_total // wt) % 2 == 0 and hasattr(backend, "convert_staged"):
-        x_mask = staged["x_mask"]
-        half = w_total // 2
-
-        def half_pass(lo, hi):
-            y_c = backend.eval_staged(
-                0, {"x_mask": x_mask[..., lo:hi], "wt": wt,
-                    "m": 32 * (hi - lo)})
-            y_b = backend.convert_staged(y_c)
-            y_b.copy_to_host_async()
-            return y_b
-
-        sync(half_pass(0, half))  # untimed: compile the half-shape programs
-        t0 = time.perf_counter()
-        pending = [half_pass(0, half), half_pass(half, w_total)]
-        parts = [np.asarray(p) for p in pending]
-        e2e_s = time.perf_counter() - t0
-        y_ov = np.concatenate(parts, axis=1)[:, :M_TPU]
-        log(f"overlapped end-to-end (2-half pipelined d2h): {e2e_s:.2f}s "
-            f"-> {M_TPU / e2e_s:,.0f} evals/s "
-            f"(single-shot: {M_TPU / (med + d2h_s):,.0f})")
-        if not np.array_equal(y_ov[0], y_host[0]):
-            raise SystemExit("overlapped-path parity check failed")
+    # No overlapped/pipelined delivery variant: measured both ways on the
+    # dev tunnel and retired.  A 2-half double-buffer with untimed warmup
+    # and copy_to_host_async beat the single-shot fetch on a degraded
+    # 3.4 MB/s tunnel day (4.53 s vs 4.73 s) but lost 2.1x on an
+    # 8.5 MB/s day (4.01 s vs 1.87 s) — the tunnel's d2h does not
+    # pipeline reliably, so the "overlap" tracks tunnel weather, not the
+    # chip.  The honest end-to-end delivery number is the single-shot
+    # line above; on a real host NIC (where transfer is cheap and
+    # pipelinable) overlap is the obvious deployment pattern but is not
+    # measurable through this environment.
 
     print(
         json.dumps(
