@@ -205,7 +205,18 @@ def main() -> None:
         for _ in range(ITERS):
             y = backend.eval_staged(0, staged)
         sync(y)
-        times.append((time.perf_counter() - t0 - rtt) / ITERS)
+        # Clamp: the RTT was measured once before the loop and swings
+        # 85-155ms day to day, so a sample whose actual sync share was
+        # smaller must not go negative (same floor cli.py's staged paths
+        # use).  A fired clamp means the correction dominated the sample —
+        # that sample is meaningless, so say so instead of silently
+        # reporting an absurd rate.
+        raw = time.perf_counter() - t0 - rtt
+        if raw <= 0:
+            log(f"WARNING: sample {i}: measured RTT ({rtt * 1e3:.0f} ms) "
+                "exceeded the whole sample; clamped — treat this sample "
+                "(and the run, if repeated) as unreliable")
+        times.append(max(raw, 1e-9) / ITERS)
     times_a = np.array(times)
     med = float(np.median(times_a))
     mad = float(np.median(np.abs(times_a - med)))

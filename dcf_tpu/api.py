@@ -19,6 +19,9 @@ Backends (selected at construction, ``backend=``):
     jax        byte-level lax.scan walk
     bitsliced  XLA bit-plane walk
     pallas     fused VMEM walk kernel (lam=16)
+    keylanes   keys-in-lanes walk kernel (many keys x few points, the
+               config-5 shape; lam=16; wants the full two-party bundle —
+               its CW image is shared between parties)
     hybrid     narrow walk + GF(2)-affine wide part (lam >= 48)
 
 Passing ``mesh=parallel.make_mesh(...)`` makes the same facade run the
@@ -39,7 +42,7 @@ the mesh equivalent should be just as transparent:
     bitsliced  parallel.ShardedBitslicedBackend
     jax        parallel.ShardedJaxBackend
 
-Key counts must divide the mesh's keys axis for pallas/hybrid/
+Key counts must be divisible by the mesh's keys-axis size for pallas/hybrid/
 bitsliced/jax (keylanes pads ragged key counts to its shard granule);
 ship-once key caching works exactly as in the single-device case.
 ``cpu``/``numpy`` are host paths and reject a mesh.  ``backend_opts=`` forwards
@@ -49,10 +52,12 @@ pallas, ``m_tile``/``kw_tile``/``level_chunk`` for keylanes).
 Key generation runs on the C++ core when available, else numpy.  Two
 subsystems stay explicit constructor-level choices rather than facade
 backends (their APIs are pipeline-shaped, not gen/eval-shaped): the
-device-resident keygen pipeline ``backends.device_gen.DeviceKeyGen`` (+
-``backends.pallas_keylanes``, the config-5 path) and full-domain
-evaluation ``backends.fulldomain.TreeFullDomain`` (domain expansion, not
-point evaluation).
+device-resident keygen pipeline ``backends.device_gen.DeviceKeyGen``
+and full-domain evaluation ``backends.fulldomain.TreeFullDomain``
+(domain expansion, not point evaluation).  The keylanes *eval* kernel,
+by contrast, IS a facade backend (``backend="keylanes"``, with or
+without a mesh); only the device-keygen half of the config-5 pipeline
+stays constructor-level.
 """
 
 from __future__ import annotations
@@ -125,8 +130,13 @@ class Dcf:
             self.backend_name = (
                 _default_backend(lam) if backend == "auto" else backend)
             if self.backend_name not in (
-                    "cpu", "numpy", "jax", "bitsliced", "pallas", "hybrid"):
+                    "cpu", "numpy", "jax", "bitsliced", "pallas", "hybrid",
+                    "keylanes"):
                 raise ValueError(f"unknown backend {self.backend_name!r}")
+            if self.backend_name == "keylanes" and lam != 16:
+                raise ValueError(
+                    f"the keylanes kernel supports lam=16 only (got {lam}); "
+                    "use bitsliced or hybrid")
         # Fail fast on backend/shape incompatibility (the backends repeat
         # these checks, but construction is where the user should hear it).
         if mesh is None and self.backend_name == "pallas" and lam != 16:
@@ -218,6 +228,16 @@ class Dcf:
             from dcf_tpu.backends.pallas_backend import PallasBackend
 
             return PallasBackend(self.lam, self.cipher_keys, **opts)
+        if name == "keylanes":
+            import jax
+
+            from dcf_tpu.backends.pallas_keylanes import KeyLanesPallasBackend
+
+            # Mosaic is TPU-only; the interpreter keeps the facade usable
+            # in CPU tests, same rule the mesh branch applies.
+            return KeyLanesPallasBackend(
+                self.lam, self.cipher_keys,
+                interpret=jax.devices()[0].platform != "tpu", **opts)
         if name == "hybrid":
             from dcf_tpu.backends.large_lambda import LargeLambdaBackend
 
@@ -247,6 +267,26 @@ class Dcf:
             return self._gen_native.gen_batch(alphas, betas, s0s, bound)
         return gen_batch(self._prg, alphas, betas, s0s, bound)
 
+    def eval_backend(self, b: int = 0):
+        """The live backend instance serving party ``b`` (the shared
+        two-party instance for keylanes), constructed if absent.
+
+        The escape hatch to backend-specific staged APIs
+        (``stage``/``eval_staged``/``staged_to_bytes``) once facade
+        ``eval`` calls have shipped the key image — benches use it to keep
+        results HBM-resident without re-staging keys.  Host backends
+        (cpu/numpy) dispatch directly in ``eval`` and return ``None``.
+        """
+        slot = "kl" if self.backend_name == "keylanes" else int(b)
+        be = self._eval_backends.get(slot)
+        if be is None:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", ReferenceContractWarning)
+                be = self._make_backend(self.backend_name)
+            if be is not None:
+                self._eval_backends[slot] = be
+        return be
+
     # -- eval (reference eval, src/lib.rs:163-204) --------------------------
 
     def eval(self, b: int, bundle: KeyBundle, xs: np.ndarray) -> np.ndarray:
@@ -268,12 +308,7 @@ class Dcf:
                 raise ValueError(
                     "the keylanes backend wants the full two-party bundle "
                     "(its CW image is shared between parties)")
-            be = self._eval_backends.get("kl")
-            if be is None:
-                with warnings.catch_warnings():
-                    warnings.simplefilter("ignore", ReferenceContractWarning)
-                    be = self._make_backend(self.backend_name)
-                self._eval_backends["kl"] = be
+            be = self.eval_backend(b)
             if self._shipped_bundle.get("kl") is not bundle:
                 be.put_bundle(bundle)
                 self._shipped_bundle["kl"] = bundle
@@ -292,13 +327,7 @@ class Dcf:
         # like for_party(b) would false-hit when the allocator reuses the
         # address of a freed bundle.
         slot = int(b)
-        be = self._eval_backends.get(slot)
-        if be is None:
-            # Shape warnings already fired once at construction.
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore", ReferenceContractWarning)
-                be = self._make_backend(self.backend_name)
-            self._eval_backends[slot] = be
+        be = self.eval_backend(b)
         if self._shipped_bundle.get(slot) is not bundle:
             be.put_bundle(kb)
             self._shipped_bundle[slot] = bundle

@@ -194,6 +194,55 @@ def test_facade_mesh_keylanes():
         dcf.eval(0, bundle.for_party(0), xs)
 
 
+def test_facade_keylanes_no_mesh():
+    """backend='keylanes' WITHOUT a mesh routes to the single-device
+    KeyLanesPallasBackend — the shape cli.py secure_relu benches must be
+    facade-reachable on one chip, with the same shared two-party-image
+    contract as the mesh variant."""
+    import unittest.mock as mock
+
+    from dcf_tpu.backends.pallas_keylanes import KeyLanesPallasBackend
+
+    rng = random.Random(91)
+    ck = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    dcf = Dcf(n_bytes=2, lam=16, cipher_keys=ck, backend="keylanes",
+              backend_opts=dict(m_tile=2, kw_tile=1, level_chunk=4))
+    nprng = np.random.default_rng(91)
+    k = 40  # ragged vs the 32-key word granule
+    alphas = nprng.integers(0, 256, (k, 2), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k, 16), dtype=np.uint8)
+    bundle = dcf.gen(alphas, betas, rng=nprng)
+    xs = nprng.integers(0, 256, (6, 2), dtype=np.uint8)
+    xs[0] = alphas[0]
+
+    ships = []
+    orig = KeyLanesPallasBackend.put_bundle
+
+    def counting_put(self, kb):
+        ships.append(True)
+        return orig(self, kb)
+
+    with mock.patch.object(KeyLanesPallasBackend, "put_bundle",
+                           counting_put):
+        for _ in range(2):
+            y0 = dcf.eval(0, bundle, xs)
+            y1 = dcf.eval(1, bundle, xs)
+    assert len(ships) == 1, \
+        f"the two-party image should ship once, shipped {len(ships)}x"
+    assert isinstance(dcf._eval_backends["kl"], KeyLanesPallasBackend)
+    recon = y0 ^ y1
+    for i in range(k):
+        a = alphas[i].tobytes()
+        for j in range(6):
+            want = betas[i].tobytes() if xs[j].tobytes() < a else bytes(16)
+            assert recon[i, j].tobytes() == want
+    with pytest.raises(ValueError, match="two-party"):
+        dcf.eval(0, bundle.for_party(0), xs)
+    with pytest.raises(ValueError, match="lam=16 only"):
+        Dcf(2, 64, [rand_bytes(rng, 32) for _ in range(18)],
+            backend="keylanes")
+
+
 def test_facade_mesh_validation():
     from dcf_tpu.parallel import make_mesh
 
