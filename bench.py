@@ -200,6 +200,7 @@ def main() -> None:
     log(f"bare sync RTT: {rtt * 1e3:.0f} ms "
         "(tunnel artifact; subtracted once per sample)")
     times = []
+    clamped = 0
     for i in range(SAMPLES):
         t0 = time.perf_counter()
         for _ in range(ITERS):
@@ -209,13 +210,14 @@ def main() -> None:
         # 85-155ms day to day, so a sample whose actual sync share was
         # smaller must not go negative (same floor cli.py's staged paths
         # use).  A fired clamp means the correction dominated the sample —
-        # that sample is meaningless, so say so instead of silently
-        # reporting an absurd rate.
+        # that sample is meaningless; it is counted and disclosed in the
+        # JSON (machine-readable), not just logged.
         raw = time.perf_counter() - t0 - rtt
         if raw <= 0:
+            clamped += 1
             log(f"WARNING: sample {i}: measured RTT ({rtt * 1e3:.0f} ms) "
                 "exceeded the whole sample; clamped — treat this sample "
-                "(and the run, if repeated) as unreliable")
+                "as unreliable")
         times.append(max(raw, 1e-9) / ITERS)
     times_a = np.array(times)
     med = float(np.median(times_a))
@@ -266,6 +268,9 @@ def main() -> None:
                     f"C++ {M_PARITY}-pt anchor"
                 ),
                 "tpu_tests": tpu_tests,
+                # 0 in a healthy run; nonzero means the RTT correction
+                # dominated that many samples and the rate is unreliable.
+                "clamped_samples": clamped,
             }
         )
     )

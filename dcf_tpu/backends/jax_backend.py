@@ -83,6 +83,7 @@ def eval_core(
     xs: jnp.ndarray,  # uint8 [K, M, n_bytes] or [M, n_bytes] (shared by keys)
     b: int,
     lam: int,
+    prg_fn=prg_gen_jax,
 ) -> jnp.ndarray:
     """Evaluate party ``b`` on all (key, point) pairs -> uint8 [K, M, lam].
 
@@ -90,6 +91,12 @@ def eval_core(
     ``eval_scan`` (the jitted wrapper) for single-device calls.  A 2D ``xs``
     is broadcast across keys on device (free in XLA — avoids materializing K
     copies on the host).
+
+    ``prg_fn`` is the Prg seam (reference ``trait Prg``, src/lib.rs:52-58):
+    any ``(round_keys, lam, seeds) -> (s_l, v_l, t_l, s_r, v_r, t_r)``
+    satisfying the protocol in ``dcf_tpu.ops.prg`` — the walk itself is
+    generic over the construction (tests wire a non-cryptographic mock
+    through here to prove it).
     """
     k_num = s0.shape[0]
     if xs.ndim == 2:
@@ -108,7 +115,7 @@ def eval_core(
     def body(carry, level):
         s, t, v = carry
         cw_s_i, cw_v_i, cw_t_i, xbit = level
-        s_l, v_l, t_l, s_r, v_r, t_r = prg_gen_jax(round_keys, lam, s)
+        s_l, v_l, t_l, s_r, v_r, t_r = prg_fn(round_keys, lam, s)
         t_mask = t[..., None]
         cs = cw_s_i[:, None, :] * t_mask  # [K,1,lam] gated per (key,point)
         s_l = s_l ^ cs
@@ -125,7 +132,7 @@ def eval_core(
     return v ^ s ^ cw_np1[:, None, :] * t[..., None]
 
 
-eval_scan = partial(jax.jit, static_argnames=("b", "lam"))(eval_core)
+eval_scan = partial(jax.jit, static_argnames=("b", "lam", "prg_fn"))(eval_core)
 
 
 class JaxBackend:
@@ -135,12 +142,17 @@ class JaxBackend:
     device so repeated evals pay the host->HBM key transfer once.
     """
 
-    def __init__(self, lam: int, cipher_keys: Sequence[bytes]):
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes], prg_fn=None):
         used = hirose_used_cipher_indices(lam, len(cipher_keys))
         self.lam = lam
         self.round_keys = tuple(
             jnp.asarray(expand_key_np(cipher_keys[i])) for i in used
         )
+        # The Prg seam: default Hirose/AES-256; any callable satisfying the
+        # dcf_tpu.ops.prg protocol swaps the construction without touching
+        # the walk (must be a stable module-level function — it is a jit
+        # static argument).
+        self.prg_fn = prg_fn or prg_gen_jax
         self._bundle_dev = None
 
     def put_bundle(self, bundle: KeyBundle) -> None:
@@ -172,5 +184,6 @@ class JaxBackend:
             jnp.asarray(xs),
             b=int(b),
             lam=self.lam,
+            prg_fn=self.prg_fn,
         )
         return np.asarray(y)

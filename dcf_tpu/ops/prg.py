@@ -1,8 +1,43 @@
-"""Batched Hirose PRG over numpy uint8 arrays.
+"""Batched Hirose PRG over numpy uint8 arrays — and the ``Prg`` protocol.
 
 Bit-exact with ``dcf_tpu.spec.HirosePrgSpec`` (reference src/prg.rs:42-73),
 vectorized over an arbitrary leading batch shape.  One ``gen`` call expands a
 batch of seeds into left/right child ``(s, v, t)`` triples.
+
+The Prg protocol (reference ``trait Prg``, src/lib.rs:52-58)
+------------------------------------------------------------
+
+The reference's most important architectural seam is its PRG trait: the GGM
+walk (gen and eval) is generic over any length-doubling PRG.  Here the seam
+is a structural protocol rather than a nominal type, at three levels, all
+expressing the same contract:
+
+* **spec level** (bytes): an object with ``.lam`` and
+  ``.gen(seed: bytes[lam]) -> [(s_l, v_l, t_l), (s_r, v_r, t_r)]`` where
+  s/v are ``bytes[lam]`` and t is ``bool`` — consumed by ``spec.gen`` /
+  ``spec.eval_point``.
+* **batched host level** (numpy): an object with ``.lam`` and
+  ``.gen(seeds: uint8[..., lam]) -> PrgOut`` (this module's dataclass; t
+  fields are uint8 in {0, 1}) — consumed by ``dcf_tpu.gen.gen_batch`` and
+  ``backends.numpy_backend.eval_batch_np``.
+* **device level** (jax): a module-level function
+  ``(round_keys, lam, seeds uint8[..., lam]) -> (s_l, v_l, t_l, s_r, v_r,
+  t_r)`` — consumed by ``backends.jax_backend.eval_core`` (``prg_fn=``).
+
+Requirements on an implementation: pure/deterministic in the seed; the four
+s/v outputs are ``lam`` bytes each; the two t-bits may depend on the seed
+arbitrarily.  Everything else (child selection, correction words, the
+two-party invariant) is the walk's job and works for ANY such PRG — proven
+by ``tests/mock_prg.py``, a trivially-fast non-cryptographic implementation
+wired through spec gen/eval, ``gen_batch``, ``eval_batch_np`` and
+``JaxBackend`` in ``tests/test_prg_seam.py``.
+
+What is NOT behind the seam: the compiled hot paths (the Pallas kernels,
+the bitsliced XLA backend, the C++ core) specialize the Hirose AES-256
+construction at the bit-plane level for performance, exactly as the
+reference's only shipped PRG is that construction; their outputs are
+checked bit-identical against the generic paths above, so the seam plus
+the parity matrix covers them transitively.
 """
 
 from __future__ import annotations

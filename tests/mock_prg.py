@@ -1,0 +1,143 @@
+"""A trivially-fast, non-cryptographic Prg implementation.
+
+Proves the Prg seam (dcf_tpu/ops/prg.py module docstring; reference
+``trait Prg``, /root/reference/src/lib.rs:52-58): the GGM walk is generic
+over the PRG construction, so the whole gen/eval protocol logic must work
+unchanged with THIS construction substituted for Hirose/AES-256 — and the
+spec / numpy / jax twins of it must stay bit-identical to each other.
+
+The mock keeps the Hirose *dataflow* (truncated block loop, feed-forward
+into both halves, t-bits sourced from half 0 before masking, 8*lam-1-bit
+mask) but replaces the AES-256 block cipher with a 3-operation byte mix:
+
+    mix(block)[i] = ((block[(i + 3) % 16] * 5 + 17 * i) & 0xFF) ^ 0xA5
+
+so a spec-level PRG call costs ~100 byte ops instead of ~10k (14 AES
+rounds in pure Python) — protocol-logic parity tests that don't test the
+cipher itself run two orders of magnitude faster through it.  It needs no
+cipher keys; the jax twin accepts and ignores ``round_keys`` to satisfy
+the device-level protocol signature.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from dcf_tpu.ops.prg import PrgOut
+
+__all__ = ["MockPrgSpec", "MockPrgNp", "mock_prg_gen_jax"]
+
+_ROT = 3
+_MUL = 5
+_ADD = 17
+_XOR = 0xA5
+
+
+def _mix_bytes(block: bytes) -> bytes:
+    return bytes(
+        ((block[(i + _ROT) % 16] * _MUL + _ADD * i) & 0xFF) ^ _XOR
+        for i in range(16)
+    )
+
+
+class MockPrgSpec:
+    """Bytes-level twin (the ``spec.HirosePrgSpec`` interface)."""
+
+    def __init__(self, lam: int):
+        assert lam % 16 == 0
+        self.lam = lam
+
+    def gen(self, seed: bytes) -> list[tuple[bytes, bytes, bool]]:
+        lam = self.lam
+        assert len(seed) == lam
+        seed_p = bytes(b ^ 0xFF for b in seed)
+        buf0 = [bytearray(lam), bytearray(lam)]
+        buf1 = [bytearray(lam), bytearray(lam)]
+        for k in range(min(2, lam // 16)):
+            lo, hi = 16 * k, 16 * (k + 1)
+            buf0[k][lo:hi] = _mix_bytes(seed[lo:hi])
+            buf1[k][lo:hi] = _mix_bytes(seed_p[lo:hi])
+        for k in range(2):
+            buf0[k] = bytearray(a ^ b for a, b in zip(buf0[k], seed))
+            buf1[k] = bytearray(a ^ b for a, b in zip(buf1[k], seed_p))
+        bit0 = bool(buf0[0][0] & 1)
+        bit1 = bool(buf1[0][0] & 1)
+        for buf in (buf0[0], buf0[1], buf1[0], buf1[1]):
+            buf[lam - 1] &= 0xFE
+        return [
+            (bytes(buf0[0]), bytes(buf1[0]), bit0),
+            (bytes(buf0[1]), bytes(buf1[1]), bit1),
+        ]
+
+
+def _mix_np(blocks: np.ndarray) -> np.ndarray:
+    """uint8 [..., 16] -> uint8 [..., 16] (wrapping uint8 arithmetic)."""
+    idx = np.arange(16, dtype=np.uint8)
+    rolled = blocks[..., (idx + _ROT) % 16]
+    return (rolled * np.uint8(_MUL) + idx * np.uint8(_ADD)) ^ np.uint8(_XOR)
+
+
+class MockPrgNp:
+    """Batched numpy twin (the ``HirosePrgNp`` interface)."""
+
+    def __init__(self, lam: int, mask: bool = True):
+        assert lam % 16 == 0
+        self.lam = lam
+        self.mask = mask
+
+    def gen(self, seeds: np.ndarray) -> PrgOut:
+        lam = self.lam
+        assert seeds.dtype == np.uint8 and seeds.shape[-1] == lam
+        seed_p = seeds ^ np.uint8(0xFF)
+        batch = seeds.shape[:-1]
+        buf0 = np.zeros((*batch, 2, lam), dtype=np.uint8)
+        buf1 = np.zeros((*batch, 2, lam), dtype=np.uint8)
+        for k in range(min(2, lam // 16)):
+            lo, hi = 16 * k, 16 * (k + 1)
+            buf0[..., k, lo:hi] = _mix_np(seeds[..., lo:hi])
+            buf1[..., k, lo:hi] = _mix_np(seed_p[..., lo:hi])
+        buf0 ^= seeds[..., None, :]
+        buf1 ^= seed_p[..., None, :]
+        t_l = buf0[..., 0, 0] & np.uint8(1)
+        t_r = buf1[..., 0, 0] & np.uint8(1)
+        if self.mask:
+            buf0[..., lam - 1] &= np.uint8(0xFE)
+            buf1[..., lam - 1] &= np.uint8(0xFE)
+        return PrgOut(
+            s_l=buf0[..., 0, :], v_l=buf1[..., 0, :], t_l=t_l,
+            s_r=buf0[..., 1, :], v_r=buf1[..., 1, :], t_r=t_r,
+        )
+
+
+def mock_prg_gen_jax(round_keys, lam: int, seeds: jnp.ndarray):
+    """Device-level twin (the ``eval_core`` ``prg_fn`` signature).
+
+    ``round_keys`` is accepted and ignored — the mock is keyless.
+    """
+    seed_p = seeds ^ jnp.uint8(0xFF)
+    batch = seeds.shape[:-1]
+    idx = jnp.arange(16, dtype=jnp.uint8)
+    perm = (idx + _ROT) % 16
+
+    def mix(blocks):
+        return (blocks[..., perm] * jnp.uint8(_MUL)
+                + idx * jnp.uint8(_ADD)) ^ jnp.uint8(_XOR)
+
+    n_enc = min(2, lam // 16)
+
+    def assemble(src, which):
+        out = jnp.zeros((*batch, lam), dtype=jnp.uint8)
+        if which < n_enc:
+            lo = 16 * which
+            out = out.at[..., lo:lo + 16].set(mix(src[..., lo:lo + 16]))
+        return out
+
+    buf0 = [assemble(seeds, 0) ^ seeds, assemble(seeds, 1) ^ seeds]
+    buf1 = [assemble(seed_p, 0) ^ seed_p, assemble(seed_p, 1) ^ seed_p]
+    t_l = buf0[0][..., 0] & jnp.uint8(1)
+    t_r = buf1[0][..., 0] & jnp.uint8(1)
+    mask = jnp.full((lam,), 0xFF, dtype=jnp.uint8).at[lam - 1].set(0xFE)
+    buf0 = [b & mask for b in buf0]
+    buf1 = [b & mask for b in buf1]
+    return buf0[0], buf1[0], t_l, buf0[1], buf1[1], t_r
