@@ -18,8 +18,12 @@ Methodology (criterion analog, `dcf_batch_eval.rs:35-39`):
     are an artifact of this environment, not of the chip, and are
     reported separately on stderr.
 
-Backend: the fused Pallas walk kernel (ops.pallas_eval); falls back to
-the XLA bitsliced path with a logged warning if Mosaic compilation fails.
+Backend: the prefix-shared Pallas evaluator (backends.pallas_prefix —
+the top-20 walk levels expanded once per key as a cached tree frontier,
+per-point carries gathered, 108 levels walked; measured +11% over the
+from-root walk kernel at this shape); falls back to the from-root Pallas
+walk kernel, then the XLA bitsliced path, with a logged warning if
+Mosaic compilation fails at any stage.
 
 Baseline: the single-core C++ eval rate measured in-process (the stand-in
 for single-core Rust per BASELINE.md — same AES-NI instruction path the
@@ -123,7 +127,11 @@ def main() -> None:
         f"[{baseline_src}]; in-run drift check (median of 3): "
         f"{inrun_rate:,.0f} ({inrun_rate / cpu_rate - 1:+.1%})")
 
-    # --- accelerator backend: Pallas kernel, XLA bitsliced fallback ---
+    # --- accelerator backend: prefix-shared Pallas evaluator with
+    # from-root-walk and XLA-bitsliced fallbacks ---
+    from dcf_tpu.utils.provision import enable_compile_cache
+
+    enable_compile_cache()
     import jax
 
     from dcf_tpu.utils.benchtime import DISPATCHES_PER_SAMPLE as ITERS
@@ -173,20 +181,24 @@ def main() -> None:
             raise SystemExit("full on-device parity check failed")
         return backend, staged
 
-    try:
-        from dcf_tpu.backends.pallas_backend import PallasBackend
+    from dcf_tpu.backends.jax_bitsliced import BitslicedBackend
+    from dcf_tpu.backends.pallas_backend import PallasBackend
+    from dcf_tpu.backends.pallas_prefix import PrefixPallasBackend
 
-        backend, staged = bring_up(PallasBackend)
-        name = "pallas"
-    except SystemExit:
-        raise
-    except Exception as e:  # Mosaic lowering / hardware issues
-        log(f"WARNING: Pallas backend failed ({type(e).__name__}: {e}); "
-            "falling back to XLA bitsliced")
-        from dcf_tpu.backends.jax_bitsliced import BitslicedBackend
-
-        backend, staged = bring_up(BitslicedBackend)
-        name = "bitsliced"
+    candidates = (("prefix", PrefixPallasBackend),
+                  ("pallas", PallasBackend),
+                  ("bitsliced", BitslicedBackend))
+    for pos, (name, cls) in enumerate(candidates):
+        try:
+            backend, staged = bring_up(cls)
+            break
+        except SystemExit:  # a failed parity gate is final, not a fallback
+            raise
+        except Exception as e:  # Mosaic lowering / hardware issues
+            if pos == len(candidates) - 1:
+                raise
+            log(f"WARNING: {name} backend failed ({type(e).__name__}: "
+                f"{e}); falling back to {candidates[pos + 1][0]}")
     log(f"backend: {name}")
 
     # --- timed samples (ITERS dispatches per sample, criterion-style).

@@ -1,0 +1,140 @@
+"""Frontier gather/repack probes — the cost structure behind the
+prefix-sharing evaluator (backends.pallas_prefix, ROOFLINE.md round 5).
+
+A batch of M random points shares the top k ~ log2(M) GGM walk levels;
+expanding them once as a tree and walking only n-k levels saves
+(k-2)/n of the walk work IF each point can fetch its (s, v, t) carry
+from the 2^k-node frontier cheaply.  These probes price that fetch on
+the real chip and record why the shipped design looks the way it does:
+
+  take_rows8[k]   jnp.take of [2^k, 8]-int32 rows (s||v fused, 32 B) with
+                  2^20 random indices.  ~3.5 ms for k <= 20, ~4x CLIFF
+                  above 2^20 nodes -> prefix_levels is clamped to 20.
+  take_rows9      the same with 36 B rows: ~2x slower (non-power-of-2
+                  row width) -> the t-bit is NOT a 9th column; it rides
+                  in s's structurally-zero masked bit (plane 15, the
+                  Hirose 8*lam-1 mask) at no gather cost.
+  take_col        a single int32 column: ~7 ms — per-index cost
+                  dominates, so SPLITTING the gather is the wrong move.
+  xla_pack        best-of-breed XLA repack of gathered rows into the
+                  walk kernel's bit-major planes: ~4.4 ms PER TABLE ->
+                  the repack lives INSIDE the walk kernel instead
+                  (ops.pallas_prefix.rows_to_state_planes: 5-step
+                  butterfly bit transpose, ~0.5 ms/table, fused).
+  relayout        the XLA [M, 8] -> [8, 32(rev), W] tile relayout that
+                  remains outside the kernel: ~1 ms.
+
+Net shipped cost at M = 2^20: gather+relayout ~4.6 ms ~= 6 walk levels
+— the floor that caps config 2 (n=32, k=20) at ~73 M evals/s (1.71x the
+from-root walk) instead of the ideal 32/12 = 2.67x, and the flagship
+(n=128) at +11%.
+
+Usage: python -m benchmarks.micro_gather [--logm 20]
+Prints one JSON line per probe.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcf_tpu.utils.benchtime import device_sync, measure_sync_rtt
+
+WALK_MS_PER_LEVEL = 0.757  # RESULTS_r04 config-2: 24.3 ms / 32 levels
+
+
+def xla_pack(rows_i32):
+    """Best XLA-side repack found (of 6 formulations tried): transpose
+    the tiny axis first, replicate, per-row shift, minor-axis reduce.
+    Kept as the measured justification for doing this in-kernel."""
+    m = rows_i32.shape[0]
+    u = jax.lax.bitcast_convert_type(rows_i32, jnp.uint32).T  # [4, M]
+    rep = jnp.take(u, jnp.arange(128) // 32, axis=0)  # [128, M]
+    sh = (jnp.arange(128, dtype=jnp.uint32) % 32)[:, None]
+    bits = ((rep >> sh) & jnp.uint32(1)).astype(jnp.uint8)
+    return jnp.sum(bits.reshape(128, m // 32, 32).astype(jnp.uint32)
+                   << jnp.arange(32, dtype=jnp.uint32)[None, None, :],
+                   axis=-1, dtype=jnp.uint32)
+
+
+def _timed(fn, args, label, dispatches=32, reps=5):
+    out = fn(*args)
+    jax.tree_util.tree_map(device_sync, out)
+    rtt = measure_sync_rtt(jax.tree_util.tree_leaves(out)[0])
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(dispatches):
+            out = fn(*args)
+        jax.tree_util.tree_map(device_sync, out)
+        samples.append(
+            max(time.perf_counter() - t0 - rtt, 1e-9) / dispatches)
+    med = float(np.median(samples))
+    mad = float(np.median(np.abs(np.array(samples) - med)))
+    print(json.dumps({"probe": label, "ms": round(med * 1e3, 3),
+                      "mad_ms": round(mad * 1e3, 3)}))
+    return med
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--logm", type=int, default=20)
+    ap.add_argument("--dispatches", type=int, default=32)
+    args = ap.parse_args()
+    m = 1 << args.logm
+
+    rng = np.random.default_rng(7)
+    dev = jax.devices()[0]
+    print(json.dumps({"device": f"{dev.platform} "
+                      f"{getattr(dev, 'device_kind', '')}", "m": m}))
+
+    take = jax.jit(lambda t, i: jnp.take(t, i, axis=0))
+    for logk in (16, 20, 22):
+        k = 1 << logk
+        tbl = jnp.asarray(rng.integers(-(2**31), 2**31, (k, 8),
+                                       dtype=np.int64).astype(np.int32))
+        idx = jnp.asarray(rng.integers(0, k, (m,)).astype(np.int32))
+        _timed(take, (tbl, idx), f"take_rows8_k{logk}", args.dispatches)
+
+    k = 1 << min(args.logm, 20)
+    idx = jnp.asarray(rng.integers(0, k, (m,)).astype(np.int32))
+    tbl9 = jnp.asarray(rng.integers(-(2**31), 2**31, (k, 9),
+                                    dtype=np.int64).astype(np.int32))
+    _timed(take, (tbl9, idx), "take_rows9_k20", args.dispatches)
+    col = jnp.asarray(rng.integers(-(2**31), 2**31, (k,),
+                                   dtype=np.int64).astype(np.int32))
+    _timed(jax.jit(lambda t, i: jnp.take(t, i)), (col, idx),
+           "take_col_k20", args.dispatches)
+
+    rows4 = jnp.asarray(rng.integers(-(2**31), 2**31, (m, 4),
+                                     dtype=np.int64).astype(np.int32))
+    t_pack = _timed(jax.jit(xla_pack), (rows4,), "xla_pack_one_table",
+                    args.dispatches)
+
+    tbl8 = jnp.asarray(rng.integers(-(2**31), 2**31, (k, 8),
+                                    dtype=np.int64).astype(np.int32))
+
+    def gather_relayout(t, i):
+        rows = jnp.take(t, i, axis=0)
+        return rows.T.reshape(8, m // 32, 32).transpose(0, 2, 1)[:, 31::-1]
+
+    t_gr = _timed(jax.jit(gather_relayout), (tbl8, idx),
+                  "gather_relayout_shipped", args.dispatches)
+    print(json.dumps({
+        "probe": "verdict",
+        "shipped_gather_relayout_ms": round(t_gr * 1e3, 3),
+        "xla_pack_per_table_ms": round(t_pack * 1e3, 3),
+        "walk_levels_equivalent": round(t_gr * 1e3 / WALK_MS_PER_LEVEL, 1),
+        "note": ("gather+relayout ~= 6 walk levels: the floor that caps "
+                 "config-2 prefix sharing at ~1.7x instead of 2.67x; "
+                 "repack rides in-kernel (ops.pallas_prefix)"),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,237 @@
+"""PrefixPallasBackend — batch eval with the shared top-of-tree expanded once.
+
+Same contract as PallasBackend (put_bundle / stage / eval_staged /
+points_mismatch_count / eval; lam = 16, single key), but the top
+``prefix_levels`` (k) of the GGM walk are expanded once per (key, party)
+as a tree frontier (ops.pallas_tree.tree_expand_raw) and cached on device
+with the key image; each eval gathers every point's (s, v, t) carry from
+the frontier and walks only the remaining n - k levels
+(ops.pallas_prefix).  Work per batch drops from M*n to M*(n-k) + 2^{k+1}
+PRG calls — the frontier is key material (xs-independent), so it ships
+once like the CW image, while the per-point gather is xs-dependent and
+stays on the eval clock.
+
+Reference workload this accelerates: benches/dcf_batch_eval.rs:17-39
+(random-point batch eval; the reference walks all n levels per point,
+src/lib.rs:163-204).
+
+Cost structure measured on v5e (benchmarks/micro_gather.py): the gather
+is ~3.7 ms per 2^20 points for k <= 20 and cliffs 4x above 2^20 nodes,
+so k is clamped to <= 20; the bit-plane repack rides inside the walk
+kernel (~0.5 ms/table).  At the config-2 shape (n = 32, M = 2^20) the
+gather+relayout floor (~5 ms ~ 7 walk levels) caps the speedup below the
+ideal n/(n-k).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcf_tpu.backends.fulldomain import tree_expand_np
+from dcf_tpu.backends.pallas_backend import PallasBackend, _stage_xs
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.ops.pallas_prefix import dcf_eval_prefix_pallas
+from dcf_tpu.ops.pallas_tree import tree_expand_raw
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.spec import ReferenceContractWarning
+from dcf_tpu.utils.bits import bitmajor_perm, byte_bits_lsb, pack_lanes
+
+__all__ = ["PrefixPallasBackend"]
+
+# Gather cliff measured at > 2^20 frontier nodes (micro_gather.py).
+MAX_PREFIX_LEVELS = 20
+
+_PERM16 = bitmajor_perm(16)
+
+# Row (i*32 + b) of the int32-column view <- bit-major plane index.
+_PERM_I32 = np.array(
+    [(b % 8) * 16 + i * 4 + b // 8 for i in range(4) for b in range(32)],
+    dtype=np.int32)
+
+
+@jax.jit
+def _planes_to_rows(planes, perm_i32):
+    """int32 bit-major planes [128, W] -> int32 rows [32*W, 4].
+
+    Inverse of the in-kernel transpose: row m's int32 column i, bit b =
+    plane (b%8)*16 + i*4 + b//8, word m//32, bit m%32.  Runs once per
+    (key, party) at frontier-build time — off the eval clock.
+    """
+    w = planes.shape[1]
+    pp = jax.lax.bitcast_convert_type(
+        jnp.take(planes, perm_i32, axis=0), jnp.uint32)  # [128(i,b), W]
+    bits = (pp[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)) \
+        & jnp.uint32(1)  # [128, W, 32(j)]
+    bits = bits.reshape(4, 32, w, 32)  # [i, b, w, j]
+    rows = jnp.sum(bits << jnp.arange(32, dtype=jnp.uint32)[None, :, None,
+                                                            None],
+                   axis=1, dtype=jnp.uint32)  # [i, w, j]
+    return jax.lax.bitcast_convert_type(
+        rows.transpose(1, 2, 0).reshape(32 * w, 4), jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _stage_prefix_idx(xs, k: int):
+    """uint8 xs [M, nb] -> frontier positions uint32 [M].
+
+    Frontier node order is bitreverse: position = sum_i dir_i * 2^i over
+    the MSB-first walk directions dir_i = bit i of x (i < k).
+    """
+    nb = xs.shape[1]
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = ((xs[:, :, None] >> shifts) & jnp.uint8(1)).reshape(
+        xs.shape[0], nb * 8)  # MSB-first walk bits
+    return jnp.sum(bits[:, :k].astype(jnp.uint32)
+                   << jnp.arange(k, dtype=jnp.uint32)[None, :], axis=1)
+
+
+@partial(jax.jit, static_argnames=("tile_words", "interpret"))
+def _eval_prefix_staged(rk, table, idx, cw_s_r, cw_v_r, cw_np1, cw_t_r,
+                        x_mask_rem, tile_words: int, interpret: bool):
+    """The timed prefix eval: gather rows, relayout, walk n-k levels."""
+    m = idx.shape[0]
+    rows = jnp.take(table, idx, axis=0)  # [M, 8] int32 (s||t, v)
+    # -> [8, 32, W] with the j (point-within-word) axis reversed, the
+    # layout the kernel's butterfly transpose expects.
+    blk = rows.T.reshape(8, m // 32, 32).transpose(0, 2, 1)[:, 31::-1, :]
+    srows = blk[None, :4]
+    vrows = blk[None, 4:]
+    return dcf_eval_prefix_pallas(
+        rk, srows, vrows, cw_s_r, cw_v_r, cw_np1, cw_t_r, x_mask_rem,
+        tile_words=tile_words, interpret=interpret)
+
+
+class PrefixPallasBackend(PallasBackend):
+    """Prefix-shared DCF evaluator (lam = 16, single key).
+
+    ``prefix_levels`` picks k (clamped to n-8 and the measured gather
+    cliff at 20); the frontier for each party is built lazily on first
+    ``eval_staged(b, ...)`` and cached with the key image.
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes],
+                 prefix_levels: int = MAX_PREFIX_LEVELS,
+                 tile_words: int = 128, interpret: bool = False,
+                 host_levels: int = 6):
+        super().__init__(lam, cipher_keys, tile_words=tile_words,
+                         interpret=interpret)
+        if prefix_levels < host_levels:
+            raise ValueError(
+                f"prefix_levels must be >= host_levels={host_levels}")
+        if host_levels < 5:
+            raise ValueError("need at least 5 host levels (one lane word)")
+        self.prefix_levels = min(prefix_levels, MAX_PREFIX_LEVELS)
+        self.host_levels = host_levels
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", ReferenceContractWarning)
+            self._prg = HirosePrgNp(lam, cipher_keys)
+        self._perm_i32 = jnp.asarray(_PERM_I32)
+        self._frontier: dict = {}
+        self._bundle_host = None
+
+    def _k(self) -> int:
+        """Effective prefix depth for the on-device bundle: leave at
+        least 8 walked levels so the kernel's fori_loop has real work and
+        the t-stash invariant (>= 1 PRG application) always holds."""
+        _, n = self._dims()
+        return max(min(self.prefix_levels, n - 8), 0)
+
+    def put_bundle(self, bundle: KeyBundle) -> None:
+        if bundle.num_keys != 1:
+            raise ValueError(
+                "PrefixPallasBackend is single-key (the bench shape); "
+                "use PallasBackend for multi-key batches")
+        if 8 * bundle.n_bytes < self.host_levels + 8:
+            raise ValueError(
+                f"domain of {8 * bundle.n_bytes} levels is too shallow "
+                "for prefix sharing; use PallasBackend")
+        super().put_bundle(bundle)
+        self._frontier = {}  # new key image invalidates cached frontiers
+        self._bundle_host = bundle
+
+    def _frontier_tables(self, b: int):
+        """The party-b frontier gather table int32 [2^k, 8]: columns 0-3 =
+        s (t stashed in the masked bit -> plane 15), 4-7 = v.  Built once
+        per (bundle, party) on device, cached like the CW image."""
+        tbl = self._frontier.get(int(b))
+        if tbl is not None:
+            return tbl
+        k = self._k()
+        k0 = min(self.host_levels, k)
+        s, v, t = tree_expand_np(self._prg, self._bundle_host, int(b), k0)
+
+        def planes(a):  # [N, 16] -> int32 [128, N/32]
+            bits = byte_bits_lsb(a)[:, _PERM16]
+            return jnp.asarray(pack_lanes(
+                np.ascontiguousarray(bits.T)).view(np.int32))
+
+        t_pm = jnp.asarray(pack_lanes(t[None, :]).view(np.int32))
+        dev = self._bundle_dev
+        s_p, v_p, t_p = tree_expand_raw(
+            self.rk, dev["cw_s"][0], dev["cw_v"][0], dev["cw_t"][0],
+            planes(s), planes(v), t_pm,
+            k0=k0, k1=k, interpret=self.interpret)
+        # Stash t in plane 15 of s: structurally zero there (the Hirose
+        # 8*lam-1 mask clears it in every PRG output, and cw_s XORs of
+        # masked outputs preserve that; k >= 1 guarantees at least one
+        # PRG application).  Guarded: a nonzero plane 15 would corrupt
+        # seeds silently.
+        if int(jnp.any(s_p[15] != 0)):
+            raise AssertionError(
+                "frontier s plane 15 not zero — t-stash invariant broken")
+        s_p = s_p.at[15:16].set(t_p)
+        tbl = jnp.concatenate(
+            [_planes_to_rows(s_p, self._perm_i32),
+             _planes_to_rows(v_p, self._perm_i32)], axis=1)  # [2^k, 8]
+        self._frontier[int(b)] = tbl
+        return tbl
+
+    def stage(self, xs: np.ndarray) -> dict:
+        """Stage xs as walk-order masks (full depth, for the parity
+        counter) + frontier positions; slices the remaining-level masks
+        the kernel consumes.  All xs-only preprocessing — untimed, like
+        the criterion setup."""
+        xs, m, wt = self._prepare(xs)
+        if m == 0:
+            raise ValueError("cannot stage an empty batch")
+        if xs.shape[0] != 1:
+            raise ValueError("PrefixPallasBackend wants shared points "
+                             "[M, nb] (single key)")
+        k = self._k()
+        xj = jnp.asarray(xs)
+        x_mask = _stage_xs(xj)
+        return {"x_mask": x_mask, "x_mask_rem": x_mask[:, k:],
+                "idx": _stage_prefix_idx(xj[0], k=k), "m": m, "wt": wt}
+
+    def eval_staged(self, b: int, staged: dict) -> jax.Array:
+        if "idx" not in staged:
+            raise ValueError("staged dict is not from PrefixPallasBackend"
+                             ".stage")
+        k = self._k()
+        dev = self._bundle_dev
+        tbl = self._frontier_tables(b)
+        return _eval_prefix_staged(
+            self.rk, tbl, staged["idx"],
+            dev["cw_s"][:, k:], dev["cw_v"][:, k:], dev["cw_np1"],
+            dev["cw_t"][:, k:], staged["x_mask_rem"],
+            tile_words=staged["wt"], interpret=self.interpret)
+
+    def eval(self, b: int, xs: np.ndarray,
+             bundle: KeyBundle | None = None) -> np.ndarray:
+        """Bytes-in/bytes-out convenience path."""
+        if bundle is not None:
+            self.put_bundle(bundle)
+        if xs.ndim == 3:
+            if xs.shape[0] != 1:
+                raise ValueError("PrefixPallasBackend is single-key")
+            xs = xs[0]
+        staged = self.stage(xs)
+        return self.staged_to_bytes(self.eval_staged(b, staged),
+                                    staged["m"])

@@ -29,7 +29,10 @@ points only (mesh 1xN); the multi-key mesh factorizations (8x1 / 4x2 /
 Backends: ``cpu`` (C++ core, all threads), ``cpu1`` (C++ single thread —
 the stand-in for the reference's serial feature matrix), ``numpy``,
 ``jax`` (XLA scan/vmap), ``bitsliced`` (XLA bit-planes), ``pallas``
-(fused TPU kernel, lam=16 only), ``sharded`` (the XLA bit-plane core
+(fused TPU kernel, lam=16 only), ``prefix`` (the prefix-shared walk:
+top-k tree frontier cached per key + per-point gather + n-k walked
+levels; single-key random-batch shapes — the fastest config-2/flagship
+path), ``sharded`` (the XLA bit-plane core
 under shard_map over a device mesh; ``--mesh=KxP`` picks the
 factorization), ``sharded-pallas`` (the Pallas kernels under shard_map:
 the flagship walk kernel for dcf_batch_eval, the keys-in-lanes kernel
@@ -56,8 +59,8 @@ from dcf_tpu.gen import random_s0s
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.spec import Bound
 
-BACKENDS = ("cpu", "cpu1", "numpy", "jax", "bitsliced", "pallas", "sharded",
-            "sharded-pallas")
+BACKENDS = ("cpu", "cpu1", "numpy", "jax", "bitsliced", "pallas", "prefix",
+            "sharded", "sharded-pallas")
 
 
 def log(msg: str) -> None:
@@ -133,6 +136,21 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
         from dcf_tpu.backends.pallas_backend import PallasBackend
 
         be = PallasBackend(lam, cipher_keys)
+    elif backend == "prefix":
+        # Prefix-shared walk: top-k tree expansion cached per (key, party),
+        # per-point frontier gather, n-k walked levels (single key; the
+        # config-2 / flagship random-batch shape).  k tracks the batch
+        # size: a frontier deeper than ~log2(M) adds nodes faster than it
+        # removes walk levels (and would be absurd for smoke runs).
+        import jax
+
+        from dcf_tpu.backends.pallas_prefix import PrefixPallasBackend
+
+        pts = (getattr(args, "points", 0) or 100_000) if args else 100_000
+        be = PrefixPallasBackend(
+            lam, cipher_keys,
+            prefix_levels=max(6, min(20, pts.bit_length() - 1)),
+            interpret=jax.devices()[0].platform != "tpu")
     elif backend in ("sharded", "sharded-pallas"):
         import jax
 
@@ -757,6 +775,11 @@ def _maybe_force_cpu_devices() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # CPU-mode CLI runs recompile the same interpret-mode Pallas graphs
+    # every invocation; share the suite's machine-local compile cache.
+    from dcf_tpu.utils.provision import enable_compile_cache
+
+    enable_compile_cache()
     log(f"forced {n} virtual CPU devices")
 
 

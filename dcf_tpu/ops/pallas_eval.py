@@ -42,7 +42,7 @@ from dcf_tpu.ops.aes_bitsliced import (
     prep_rk_bitmajor_v3,
 )
 
-__all__ = ["dcf_eval_pallas", "DEFAULT_TILE_WORDS"]
+__all__ = ["dcf_eval_pallas", "DEFAULT_TILE_WORDS", "make_aes", "walk_levels"]
 
 # 4096 points per grid step.  128 is the Mosaic lane-granule minimum and
 # measured fastest on v5e with the v3 cipher (124 ms vs 195/215 ms for
@@ -52,33 +52,31 @@ __all__ = ["dcf_eval_pallas", "DEFAULT_TILE_WORDS"]
 DEFAULT_TILE_WORDS = 128
 
 
-def _kernel(rk_ref, s0_ref, cw_s_ref, cw_v_ref, cw_np1_ref, cw_t_ref, xm_ref,
-            y_ref, *, b: int, n: int, interpret: bool):
-    # The conjugated-ShiftRows cipher (v3) lowers ~2.5x faster under Mosaic
-    # but its unrolled slice-concat graph makes the CPU interpreter crawl;
-    # the two are bit-identical (tests/test_bitsliced.py), so interpret mode
-    # keeps the compact v1 graph.
-    wt = xm_ref.shape[3]
+def make_aes(rk, interpret: bool):
+    """The per-grid-step AES closure: the conjugated-ShiftRows cipher (v3)
+    lowers ~2.5x faster under Mosaic but its unrolled slice-concat graph
+    makes the CPU interpreter crawl; the two are bit-identical
+    (tests/test_bitsliced.py), so interpret mode keeps the compact v1
+    graph."""
     ones = jnp.int32(-1)
-    rk = rk_ref[:]
     if interpret:
         def aes(state):
             return aes256_encrypt_planes_bitmajor(jnp, rk, state, ones)
-    else:
-        rk_p = prep_rk_bitmajor_v3(jnp, rk)  # hoisted: once per grid step
+        return aes
+    rk_p = prep_rk_bitmajor_v3(jnp, rk)  # hoisted: once per grid step
 
-        def aes(state):
-            return aes_walk_cipher_v3(jnp, rk_p, state, ones)
+    def aes(state):
+        return aes_walk_cipher_v3(jnp, rk_p, state, ones)
+    return aes
 
-    # PRG mask: output bit 8*lam-1 is cleared (reference src/prg.rs:65-68);
-    # for lam=16 that is byte 15 bit 0 -> bit-major plane 15.
-    plane_idx = jax.lax.broadcasted_iota(jnp.int32, (128, 1), 0)
-    lbm = jnp.where(plane_idx == 15, jnp.int32(0), ones)
 
-    # (broadcast via ^0: jnp.broadcast_to doesn't lower in Mosaic)
-    s0 = s0_ref[0] ^ jnp.zeros((128, wt), jnp.int32)
-    t0 = jnp.full((1, wt), ones if b else jnp.int32(0), jnp.int32)
-    v0 = jnp.zeros((128, wt), jnp.int32)
+def walk_levels(aes, lbm, s0, t0, v0, cw_s_ref, cw_v_ref, cw_t_ref, xm_ref,
+                n: int):
+    """The n-level GGM walk loop on packed planes, shared by the from-root
+    kernel below and the prefix-shared kernel (ops.pallas_prefix).  The
+    cw/xm refs are indexed [0, i] per level i in 0..n-1."""
+    ones = jnp.int32(-1)
+    wt = s0.shape[1]
 
     def level(i, carry):
         s, t, v = carry
@@ -114,7 +112,27 @@ def _kernel(rk_ref, s0_ref, cw_s_ref, cw_v_ref, cw_np1_ref, cw_t_ref, xm_ref,
         t = (t_r & xm) | (t_l & nxm)
         return (s, t, v)
 
-    s, t, v = jax.lax.fori_loop(0, n, level, (s0, t0, v0))
+    return jax.lax.fori_loop(0, n, level, (s0, t0, v0))
+
+
+def _kernel(rk_ref, s0_ref, cw_s_ref, cw_v_ref, cw_np1_ref, cw_t_ref, xm_ref,
+            y_ref, *, b: int, n: int, interpret: bool):
+    wt = xm_ref.shape[3]
+    ones = jnp.int32(-1)
+    aes = make_aes(rk_ref[:], interpret)
+
+    # PRG mask: output bit 8*lam-1 is cleared (reference src/prg.rs:65-68);
+    # for lam=16 that is byte 15 bit 0 -> bit-major plane 15.
+    plane_idx = jax.lax.broadcasted_iota(jnp.int32, (128, 1), 0)
+    lbm = jnp.where(plane_idx == 15, jnp.int32(0), ones)
+
+    # (broadcast via ^0: jnp.broadcast_to doesn't lower in Mosaic)
+    s0 = s0_ref[0] ^ jnp.zeros((128, wt), jnp.int32)
+    t0 = jnp.full((1, wt), ones if b else jnp.int32(0), jnp.int32)
+    v0 = jnp.zeros((128, wt), jnp.int32)
+
+    s, t, v = walk_levels(aes, lbm, s0, t0, v0, cw_s_ref, cw_v_ref,
+                          cw_t_ref, xm_ref, n)
     y_ref[0] = v ^ s ^ (cw_np1_ref[0] & t)
 
 

@@ -1,0 +1,160 @@
+"""Prefix-shared DCF batch evaluation kernel.
+
+A batch of M random points shares the top k ~ log2(M) levels of the GGM
+walk.  The from-root walk kernel (ops.pallas_eval) pays M PRG calls per
+level for all n levels; here the top k levels are expanded ONCE as a tree
+(ops.pallas_tree.tree_expand_raw, ~2^{k+1} PRG calls — key-only material,
+cached per key like the CW image), each point GATHERS its (s, v, t) carry
+from the 2^k-node frontier, and this kernel walks only the remaining
+n - k levels.  Work per batch: M*(n-k) + 2^{k+1} PRG calls instead of
+M*n.  (Reference workload: the reference walks every level per point,
+/root/reference/src/lib.rs:163-204, benches/dcf_batch_eval.rs:17-39.)
+
+Measured cost structure on v5e (benchmarks/micro_gather.py): the XLA row
+gather costs ~3.7 ms per 2^20 points at k <= 20 ([2^k, 8]-int32 rows;
+4x cliff above 2^20 nodes, and 2x for non-power-of-2 row widths), and
+repacking gathered byte rows into the kernel's bit-major plane layout in
+XLA costs ~4.4 ms per table — so the repack runs INSIDE this kernel
+instead as 32x32 bit transposes (5 butterfly steps of static sublane
+slice/concats, Hacker's Delight 7-3): ~0.5 ms per table at M = 2^20,
+fused into the walk dispatch.
+
+The t-bit rides in the s rows: every frontier seed has bit-major plane 15
+(byte 15, bit 0) cleared by the Hirose 8*lam-1 output mask (reference
+src/prg.rs:65-68) — the one bit of s that is structurally ZERO after
+level >= 1 — so the gather stays at the fast power-of-2 row width with no
+separate t gather.  The kernel extracts plane 15 as the packed t lane
+words and re-clears it.
+
+Input row layout per tile (prepared by one XLA transpose of the gathered
+rows): [4, 32, wt] int32 where element (i, j, w) = int32 column i of the
+row gathered for point 32*(tile base + w) + (31 - j) — the j-reversal and
+the output-row reversal of the butterfly network are both absorbed into
+static index maps, costing nothing at runtime.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dcf_tpu.ops.pallas_eval import DEFAULT_TILE_WORDS, make_aes, walk_levels
+
+__all__ = ["dcf_eval_prefix_pallas", "rows_to_state_planes"]
+
+_MASKS = (0x0000FFFF, 0x00FF00FF, 0x0F0F0F0F, 0x33333333, 0x55555555)
+
+
+def _transpose32_raw(xp, x):
+    """[32, L] int32 butterfly bit transpose: out row r bit j =
+    in row 31-j bit 31-r (per lane).  Both reversals are the caller's to
+    absorb (static layouts)."""
+    k = 16
+    for m_val in _MASKS:
+        m = jnp.int32(m_val)
+        parts = []
+        for base in range(0, 32, 2 * k):
+            a = x[base:base + k]
+            b = x[base + k:base + 2 * k]
+            b_shr = jax.lax.bitcast_convert_type(
+                jax.lax.bitcast_convert_type(b, jnp.uint32) >> k, jnp.int32)
+            t = (a ^ b_shr) & m
+            parts.append(a ^ t)
+            parts.append(b ^ (t << k))
+        x = xp.concatenate(parts, axis=0)
+        k //= 2
+    return x
+
+
+def rows_to_state_planes(xp, rows):
+    """[4, 32, wt] j-reversed row block -> [128, wt] bit-major planes.
+
+    Plane order p' = bit*16 + byte (ops.pallas_eval layout); lane word w
+    bit j = point 32*w + j.
+    """
+    planes = [None] * 128
+    for i in range(4):
+        tr = _transpose32_raw(xp, rows[i])
+        for r in range(32):
+            b = 31 - r  # true bit index within int32 column i
+            byte, bit = i * 4 + b // 8, b % 8
+            planes[bit * 16 + byte] = tr[r:r + 1]
+    return xp.concatenate(planes, axis=0)
+
+
+def _kernel(rk_ref, srows_ref, vrows_ref, cw_s_ref, cw_v_ref, cw_np1_ref,
+            cw_t_ref, xm_ref, y_ref, *, n_rem: int, interpret: bool):
+    wt = xm_ref.shape[3]
+    ones = jnp.int32(-1)
+    aes = make_aes(rk_ref[:], interpret)
+
+    plane_idx = jax.lax.broadcasted_iota(jnp.int32, (128, 1), 0)
+    lbm = jnp.where(plane_idx == 15, jnp.int32(0), ones)
+
+    s_planes = rows_to_state_planes(jnp, srows_ref[0])
+    v0 = rows_to_state_planes(jnp, vrows_ref[0])
+    # t rides in plane 15 of the s rows (structurally zero in a real
+    # frontier seed — the Hirose 8*lam-1 mask); extract and re-clear.
+    t0 = s_planes[15:16]
+    s0 = s_planes & lbm
+
+    s, t, v = walk_levels(aes, lbm, s0, t0, v0, cw_s_ref, cw_v_ref,
+                          cw_t_ref, xm_ref, n_rem)
+    y_ref[0] = v ^ s ^ (cw_np1_ref[0] & t)
+
+
+def dcf_eval_prefix_pallas(
+    rk,        # int32 [15, 128, 1]     bit-major round-key masks
+    srows,     # int32 [K, 4, 32, W]    gathered s rows (t in plane 15),
+               #                        j-reversed tile layout (see module
+               #                        docstring)
+    vrows,     # int32 [K, 4, 32, W]    gathered v rows
+    cw_s_t,    # int32 [K, n_rem, 128, 1]  CW planes for levels k..n-1
+    cw_v_t,    # int32 [K, n_rem, 128, 1]
+    cw_np1_t,  # int32 [K, 128, 1]
+    cw_t,      # int32 [K, n_rem, 2]
+    x_mask,    # int32 [Kx, n_rem, 1, W]   lane masks for levels k..n-1
+    *,
+    tile_words: int = DEFAULT_TILE_WORDS,
+    interpret: bool = False,
+):
+    """Walk the remaining n-k levels from gathered frontier carries.
+
+    Party is implicit: the frontier rows were expanded from the party's
+    key share (its s0 and t=b entered at level 0 of the tree).  Returns y
+    planes int32 [K, 128, W], same layout as ``dcf_eval_pallas``.
+    """
+    k_num = srows.shape[0]
+    n_rem = cw_s_t.shape[1]
+    kx, _, _, w = x_mask.shape
+    wt = min(tile_words, w)
+    if w % wt != 0:
+        raise ValueError(f"point words {w} not a multiple of tile {wt}")
+    shared = kx == 1
+
+    grid = (k_num, w // wt)
+    rows_spec = pl.BlockSpec((1, 4, 32, wt), lambda k, j: (k, 0, 0, j))
+    return pl.pallas_call(
+        partial(_kernel, n_rem=n_rem, interpret=interpret),
+        out_shape=jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((15, 128, 1), lambda k, j: (0, 0, 0)),
+            rows_spec,
+            rows_spec,
+            pl.BlockSpec((1, n_rem, 128, 1), lambda k, j: (k, 0, 0, 0)),
+            pl.BlockSpec((1, n_rem, 128, 1), lambda k, j: (k, 0, 0, 0)),
+            pl.BlockSpec((1, 128, 1), lambda k, j: (k, 0, 0)),
+            pl.BlockSpec((1, n_rem, 2), lambda k, j: (k, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, n_rem, 1, wt),
+                         (lambda k, j: (0, 0, 0, j)) if shared
+                         else (lambda k, j: (k, 0, 0, j))),
+        ],
+        out_specs=pl.BlockSpec((1, 128, wt), lambda k, j: (k, 0, j)),
+        interpret=interpret,
+    )(rk, srows, vrows, cw_s_t, cw_v_t, cw_np1_t, cw_t, x_mask)

@@ -38,7 +38,7 @@ from dcf_tpu.ops.aes_bitsliced import (
     prep_rk_bitmajor_v3,
 )
 
-__all__ = ["tree_expand_device"]
+__all__ = ["tree_expand_device", "tree_expand_raw"]
 
 
 def _expand_kernel(rk_ref, cs_ref, cv_ref, ct_ref, s_ref, v_ref, t_ref,
@@ -108,6 +108,29 @@ def _expand_level(rk, cs, cv, ct, s, v, t, *, interpret: bool):
     )(rk, cs, cv, ct, s, v, t)
 
 
+@partial(jax.jit, static_argnames=("k0", "k1", "interpret"))
+def tree_expand_raw(rk, cw_s_t, cw_v_t, cw_t_pm, s, v, t,
+                    k0: int, k1: int, interpret: bool = False):
+    """Expand levels k0..k1-1 WITHOUT finalizing: returns the raw
+    (s, v, t) node planes at level k1 (int32 [128, 2^k1 / 32] x2 +
+    [1, 2^k1 / 32]), leaf order bitreverse_k1.
+
+    This is the frontier the prefix-sharing evaluator
+    (ops.pallas_prefix / backends.pallas_prefix) gathers per-point
+    carries from: a batch of M random points shares the top ~log2(M)
+    walk levels, so expanding them once as a tree (~2 PRG calls per
+    node) replaces M per-point PRG calls per level.
+    """
+    for i in range(k0, k1):
+        s_l, v_l, t_l, s_r, v_r, t_r = _expand_level(
+            rk, cw_s_t[i], cw_v_t[i], cw_t_pm[i], s, v, t,
+            interpret=interpret)
+        s = jnp.concatenate([s_l, s_r], axis=1)
+        v = jnp.concatenate([v_l, v_r], axis=1)
+        t = jnp.concatenate([t_l, t_r], axis=1)
+    return s, v, t
+
+
 @partial(jax.jit, static_argnames=("k0", "n", "interpret"))
 def tree_expand_device(rk, cw_s_t, cw_v_t, cw_t_pm, cw_np1_t, s, v, t,
                        k0: int, n: int, interpret: bool = False):
@@ -119,11 +142,6 @@ def tree_expand_device(rk, cw_s_t, cw_v_t, cw_t_pm, cw_np1_t, s, v, t,
     state in leaf order (position = bitreverse of the k0-bit prefix).
     Returns y planes int32 [128, 2^n / 32], leaf order bitreverse_n.
     """
-    for i in range(k0, n):
-        s_l, v_l, t_l, s_r, v_r, t_r = _expand_level(
-            rk, cw_s_t[i], cw_v_t[i], cw_t_pm[i], s, v, t,
-            interpret=interpret)
-        s = jnp.concatenate([s_l, s_r], axis=1)
-        v = jnp.concatenate([v_l, v_r], axis=1)
-        t = jnp.concatenate([t_l, t_r], axis=1)
+    s, v, t = tree_expand_raw(rk, cw_s_t, cw_v_t, cw_t_pm, s, v, t,
+                              k0=k0, k1=n, interpret=interpret)
     return v ^ s ^ (cw_np1_t & t)

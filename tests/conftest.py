@@ -28,3 +28,11 @@ if not ON_TPU_LANE:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+# Persistent compile cache (both lanes): the CPU lane's interpret-mode
+# Pallas graphs cost minutes of XLA compile per run; caching them cuts
+# repeat suite runs by ~15-20 min on this host.  Machine-local by design
+# (.jax_cache/ is gitignored) — see provision.enable_compile_cache.
+from dcf_tpu.utils.provision import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
