@@ -181,20 +181,24 @@ def main() -> None:
             raise SystemExit("full on-device parity check failed")
         return backend, staged
 
-    from dcf_tpu.backends.jax_bitsliced import BitslicedBackend
-    from dcf_tpu.backends.pallas_backend import PallasBackend
-    from dcf_tpu.backends.pallas_prefix import PrefixPallasBackend
-
-    candidates = (("prefix", PrefixPallasBackend),
-                  ("pallas", PallasBackend),
-                  ("bitsliced", BitslicedBackend))
-    for pos, (name, cls) in enumerate(candidates):
+    # Imported INSIDE the guard: a host whose jax build lacks the Pallas
+    # TPU modules must fall back at import time too, not abort benchless.
+    candidates = (("prefix", "dcf_tpu.backends.pallas_prefix",
+                   "PrefixPallasBackend"),
+                  ("pallas", "dcf_tpu.backends.pallas_backend",
+                   "PallasBackend"),
+                  ("bitsliced", "dcf_tpu.backends.jax_bitsliced",
+                   "BitslicedBackend"))
+    for pos, (name, mod, clsname) in enumerate(candidates):
         try:
+            import importlib
+
+            cls = getattr(importlib.import_module(mod), clsname)
             backend, staged = bring_up(cls)
             break
         except SystemExit:  # a failed parity gate is final, not a fallback
             raise
-        except Exception as e:  # Mosaic lowering / hardware issues
+        except Exception as e:  # imports / Mosaic lowering / hardware
             if pos == len(candidates) - 1:
                 raise
             log(f"WARNING: {name} backend failed ({type(e).__name__}: "

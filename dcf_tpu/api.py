@@ -19,6 +19,9 @@ Backends (selected at construction, ``backend=``):
     jax        byte-level lax.scan walk
     bitsliced  XLA bit-plane walk
     pallas     fused VMEM walk kernel (lam=16)
+    prefix     prefix-shared walk: top-k tree frontier cached per
+               (key, party) + per-point gather + n-k walked levels
+               (lam=16, single key — the fastest random-batch path)
     keylanes   keys-in-lanes walk kernel (many keys x few points, the
                config-5 shape; lam=16; wants the full two-party bundle —
                its CW image is shared between parties)
@@ -131,12 +134,12 @@ class Dcf:
                 _default_backend(lam) if backend == "auto" else backend)
             if self.backend_name not in (
                     "cpu", "numpy", "jax", "bitsliced", "pallas", "hybrid",
-                    "keylanes"):
+                    "keylanes", "prefix"):
                 raise ValueError(f"unknown backend {self.backend_name!r}")
-            if self.backend_name == "keylanes" and lam != 16:
+            if self.backend_name in ("keylanes", "prefix") and lam != 16:
                 raise ValueError(
-                    f"the keylanes kernel supports lam=16 only (got {lam}); "
-                    "use bitsliced or hybrid")
+                    f"the {self.backend_name} kernel supports lam=16 only "
+                    f"(got {lam}); use bitsliced or hybrid")
         # Fail fast on backend/shape incompatibility (the backends repeat
         # these checks, but construction is where the user should hear it).
         if mesh is None and self.backend_name == "pallas" and lam != 16:
@@ -236,6 +239,14 @@ class Dcf:
             # Mosaic is TPU-only; the interpreter keeps the facade usable
             # in CPU tests, same rule the mesh branch applies.
             return KeyLanesPallasBackend(
+                self.lam, self.cipher_keys,
+                interpret=jax.devices()[0].platform != "tpu", **opts)
+        if name == "prefix":
+            import jax
+
+            from dcf_tpu.backends.pallas_prefix import PrefixPallasBackend
+
+            return PrefixPallasBackend(
                 self.lam, self.cipher_keys,
                 interpret=jax.devices()[0].platform != "tpu", **opts)
         if name == "hybrid":

@@ -155,6 +155,12 @@ class PrefixPallasBackend(PallasBackend):
         super().put_bundle(bundle)
         self._frontier = {}  # new key image invalidates cached frontiers
         self._bundle_host = bundle
+        # The remaining-level CW views are bundle constants: sliced once
+        # here (off the eval clock) instead of per eval_staged dispatch.
+        k = self._k()
+        dev = self._bundle_dev
+        self._cw_rem = (dev["cw_s"][:, k:], dev["cw_v"][:, k:],
+                        dev["cw_t"][:, k:])
 
     def _frontier_tables(self, b: int):
         """The party-b frontier gather table int32 [2^k, 8]: columns 0-3 =
@@ -214,13 +220,12 @@ class PrefixPallasBackend(PallasBackend):
         if "idx" not in staged:
             raise ValueError("staged dict is not from PrefixPallasBackend"
                              ".stage")
-        k = self._k()
-        dev = self._bundle_dev
+        cw_s_r, cw_v_r, cw_t_r = self._cw_rem
         tbl = self._frontier_tables(b)
         return _eval_prefix_staged(
             self.rk, tbl, staged["idx"],
-            dev["cw_s"][:, k:], dev["cw_v"][:, k:], dev["cw_np1"],
-            dev["cw_t"][:, k:], staged["x_mask_rem"],
+            cw_s_r, cw_v_r, self._bundle_dev["cw_np1"],
+            cw_t_r, staged["x_mask_rem"],
             tile_words=staged["wt"], interpret=self.interpret)
 
     def eval(self, b: int, xs: np.ndarray,

@@ -264,13 +264,40 @@ def _timed(fn, reps: int, profile: str = ""):
     return med, mad, samples
 
 
+def _pinned_ratio(nb: int, k: int, rate: float) -> dict:
+    """vs_baseline against the pinned per-shape single-core CPU anchor
+    (benchmarks/cpu_baseline.json, CPU_BASELINE.md protocol), when one
+    exists for this shape — the flagship N=16 pin or the config-2
+    literal n=32 entry.  Empty otherwise (no silent in-run fallback)."""
+    import os
+
+    if k != 1:
+        return {}
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks", "cpu_baseline.json")
+    try:
+        with open(path) as f:
+            pinned = json.load(f)
+    except OSError:
+        return {}
+    entry, tag = ((pinned, "flagship") if nb == 16 else
+                  (pinned.get("shapes", {}).get("n32"), "n32")
+                  if nb == 4 else (None, ""))
+    if not entry:
+        return {}
+    return {"vs_baseline": round(rate / entry["evals_per_sec"], 2),
+            "baseline": f"pinned single-core {tag} "
+                        f"({entry['evals_per_sec']:,.0f} evals/s, "
+                        "CPU_BASELINE.md protocol)"}
+
+
 def _emit(name: str, backend: str, metric: str, value: float, unit: str,
           med_s: float | None = None, mad_s: float | None = None,
-          samples: int | None = None):
-    extra = {}
+          samples: int | None = None, extra_fields: dict | None = None):
+    extra = dict(extra_fields or {})
     if med_s is not None:
         extra = {"median_s": round(med_s, 6), "mad_s": round(mad_s or 0, 6),
-                 "samples": samples}
+                 "samples": samples, **extra}
         log(f"{name}[{backend}]: {value:,.1f} {unit} "
             f"(median {med_s * 1e3:.3f} ms +- MAD {(mad_s or 0) * 1e3:.3f} ms, "
             f"{samples} samples)")
@@ -361,21 +388,24 @@ def bench_batch(args) -> None:
     lam = 16
     nb = args.domain_bytes or 16
     m = args.points or 100_000
+    k = args.keys or 1  # the reference bench is K=1; K>1 records the
+    # walk kernel's key-axis grid scaling (shared point batch)
     rng = np.random.default_rng(args.seed)
     ck = _cipher_keys(lam, rng)
     native = NativeDcf(lam, ck)
-    alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
-    betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
+    alphas = rng.integers(0, 256, (k, nb), dtype=np.uint8)
+    betas = rng.integers(0, 256, (k, lam), dtype=np.uint8)
     bundle = native.gen_batch(
-        alphas, betas, random_s0s(1, lam, rng), Bound.LT_BETA)
+        alphas, betas, random_s0s(k, lam, rng), Bound.LT_BETA)
     xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
     run, be = _make_evaluator(args.backend, lam, ck, native, args)
     k0 = bundle.for_party(0)
     y = run(0, k0, xs)  # warmup / compile
     if args.check:
         want = native.eval(0, bundle, xs[:2048])
-        assert np.array_equal(y[0, :2048], want[0]), "parity mismatch vs C++"
-        log("parity vs C++ core: OK (first 2048 pts)")
+        assert np.array_equal(y[:, :2048], want), \
+            "parity mismatch vs C++"  # every key's shares, not just key 0
+        log(f"parity vs C++ core: OK ({k} keys x first 2048 pts)")
         _full_device_parity(args, be, lam, ck, native, bundle,
                             alphas, betas, xs)
     if be is not None and hasattr(be, "stage"):
@@ -387,8 +417,10 @@ def bench_batch(args) -> None:
     else:
         dt, mad, ss = _timed(lambda: run(0, k0, xs), args.reps, args.profile)
         unit = "evals/s"
-    _emit("dcf_batch_eval", args.backend, "evals_per_sec",
-          m / dt, unit, dt, mad, len(ss))
+    name = args.backend if k == 1 else f"{args.backend} (K={k})"
+    _emit("dcf_batch_eval", name, "evals_per_sec",
+          k * m / dt, unit, dt, mad, len(ss),
+          extra_fields=_pinned_ratio(nb, k, k * m / dt))
 
 
 def bench_large_lambda(args) -> None:
@@ -623,7 +655,7 @@ def bench_full_domain(args) -> None:
         # _timed_staged does.  With --mesh the frontier shards over the
         # mesh and each device expands+verifies its disjoint subtree.
         from dcf_tpu.utils.benchtime import (
-            DISPATCHES_PER_SAMPLE_SLOW,
+            DISPATCHES_PER_SAMPLE_TREE,
             measure_sync_rtt,
         )
 
@@ -643,7 +675,7 @@ def bench_full_domain(args) -> None:
             from dcf_tpu.backends.fulldomain import TreeFullDomain
 
             fd = TreeFullDomain(lam, ck)
-        per_run_checks = DISPATCHES_PER_SAMPLE_SLOW
+        per_run_checks = DISPATCHES_PER_SAMPLE_TREE
         from dcf_tpu.utils.benchtime import device_sync
 
         probe = jnp.zeros(8, jnp.int32)
@@ -708,10 +740,10 @@ def bench_baseline(args) -> None:
     ``--full`` runs config 5 at its literal 10^6-key scale (the whole
     report then takes ~20 minutes, dominated by three timed 10^6-key
     pipelines); without it secure_relu uses 2^18 keys to keep the report
-    minutes-long.  The round-4 headline artifact is regenerated by
+    minutes-long.  The round-5 headline artifact is regenerated by
     exactly::
 
-        python -m dcf_tpu.cli baseline --full > BASELINE_REPORT_r04.jsonl
+        python -m dcf_tpu.cli baseline --full > BASELINE_REPORT_r05.jsonl
     """
     import copy
 
@@ -719,13 +751,18 @@ def bench_baseline(args) -> None:
     full_keys = args.keys or (1_000_000 if args.full else 1 << 18)
     specs = [
         ("1", "dcf", dict(backend="cpu")),
+        # Round 5: the prefix-shared evaluator is the measured winner for
+        # both random-batch shapes (1.71x config 2, +11% flagship vs the
+        # from-root walk — ROOFLINE.md round 5).
+        # keys=1 pinned explicitly: an outer --keys (meant for config 5)
+        # must not leak into the single-key prefix shapes.
         ("2 (flagship n=128 scale-up)", "dcf_batch_eval",
-         dict(backend="pallas", points=1 << 20)),
+         dict(backend="prefix", points=1 << 20, keys=1)),
         # BASELINE.json config 2's literal "n=32" wording (4-byte domain),
         # same 2^20-point batch — the n=128 line above is the scaled-up
         # headline shape.
         ("2 (literal n=32)", "dcf_batch_eval",
-         dict(backend="pallas", points=1 << 20, domain_bytes=4)),
+         dict(backend="prefix", points=1 << 20, domain_bytes=4, keys=1)),
         ("3", "full_domain", dict(backend="tree", n_bits=24)),
         # Config 4 twice: the lambda=16384 shape of the reference bench it
         # cites (benches/dcf_large_lambda.rs:8-43) and the literal
@@ -775,16 +812,17 @@ def _maybe_force_cpu_devices() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    # CPU-mode CLI runs recompile the same interpret-mode Pallas graphs
-    # every invocation; share the suite's machine-local compile cache.
-    from dcf_tpu.utils.provision import enable_compile_cache
-
-    enable_compile_cache()
     log(f"forced {n} virtual CPU devices")
 
 
 def main(argv=None) -> None:
     _maybe_force_cpu_devices()
+    # Every CLI mode recompiles the same graphs each invocation (Mosaic
+    # kernels on TPU, interpret-mode Pallas graphs on CPU); share the
+    # machine-local compile cache (provision.enable_compile_cache).
+    from dcf_tpu.utils.provision import enable_compile_cache
+
+    enable_compile_cache()
     p = argparse.ArgumentParser(
         prog="python -m dcf_tpu.cli",
         description="DCF benchmark CLI (reference criterion-bench analogs)",

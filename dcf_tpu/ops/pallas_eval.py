@@ -159,10 +159,16 @@ def dcf_eval_pallas(
     shared = kx == 1
 
     grid = (k_num, w // wt)
+    # The flagship K=1 shape sits exactly at the 16 MB scoped-vmem
+    # default; a multi-key grid's extra block buffering tips it over by
+    # ~256 KB (measured at K=8, n=128, wt=128), so the limit is raised
+    # explicitly — same remedy as the narrow kernel.
     return pl.pallas_call(
         partial(_kernel, b=b, n=n, interpret=interpret),
         out_shape=jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
         grid=grid,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
         in_specs=[
             pl.BlockSpec((15, 128, 1), lambda k, j: (0, 0, 0)),
             pl.BlockSpec((1, 128, 1), lambda k, j: (k, 0, 0)),

@@ -11,16 +11,23 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["DISPATCHES_PER_SAMPLE", "DISPATCHES_PER_SAMPLE_SLOW",
-           "device_sync", "measure_sync_rtt"]
+           "DISPATCHES_PER_SAMPLE_TREE", "device_sync", "measure_sync_rtt"]
 
 # ~1.2ms of amortized sync against ~100ms per dispatch at the flagship
 # shape (measured 2026-07-31: 16 dispatches under-reported the chip by
 # ~6% once the tunnel RTT grew to ~155ms).
 DISPATCHES_PER_SAMPLE = 128
 
-# For benches whose single dispatch is >= ~0.3s (full-domain tree): the
+# For benches whose single dispatch is >= ~0.3s (large-lambda hybrid): the
 # sync share is already < 3% at 16, and 128 would take minutes per sample.
 DISPATCHES_PER_SAMPLE_SLOW = 16
+
+# The full-domain tree dispatch is ~35 ms, fast enough that 16 dispatches
+# left its median exposed to dispatch-submission jitter (round 4 quoted a
+# 35% band, MAD/median ~ 0.25, the only headline that was a range instead
+# of a number); 64 dispatches ~ 2.2 s/sample averages the jitter out while
+# keeping a 5-sample run under 15 s.
+DISPATCHES_PER_SAMPLE_TREE = 64
 
 
 def device_sync(y) -> None:

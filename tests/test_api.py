@@ -243,6 +243,31 @@ def test_facade_keylanes_no_mesh():
             backend="keylanes")
 
 
+def test_facade_prefix_no_mesh():
+    """backend='prefix' routes to PrefixPallasBackend (single key) with
+    the standard per-party ship-once contract."""
+    from dcf_tpu.backends.pallas_prefix import PrefixPallasBackend
+
+    rng = random.Random(90)
+    ck = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    dcf = Dcf(n_bytes=2, lam=16, cipher_keys=ck, backend="prefix")
+    nprng = np.random.default_rng(90)
+    alphas = nprng.integers(0, 256, (1, 2), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (1, 16), dtype=np.uint8)
+    bundle = dcf.gen(alphas, betas, rng=nprng)
+    xs = nprng.integers(0, 256, (7, 2), dtype=np.uint8)
+    xs[0] = alphas[0]
+    recon = dcf.eval(0, bundle, xs) ^ dcf.eval(1, bundle, xs)
+    assert isinstance(dcf._eval_backends[0], PrefixPallasBackend)
+    a = alphas[0].tobytes()
+    for j in range(7):
+        want = betas[0].tobytes() if xs[j].tobytes() < a else bytes(16)
+        assert recon[0, j].tobytes() == want
+    with pytest.raises(ValueError, match="lam=16 only"):
+        Dcf(2, 64, [rand_bytes(rng, 32) for _ in range(18)],
+            backend="prefix")
+
+
 def test_facade_mesh_validation():
     from dcf_tpu.parallel import make_mesh
 
