@@ -38,6 +38,8 @@ the mesh equivalent should be just as transparent:
     auto       sharded pallas walk kernel (lam=16), sharded hybrid
                (lam >= 48), sharded bitsliced elsewhere
     pallas     parallel.ShardedPallasBackend (flagship walk kernel)
+    prefix     parallel.ShardedPrefixBackend (prefix-shared walk;
+               single key, 1xN points mesh)
     keylanes   parallel.ShardedKeyLanesBackend (many keys x few points,
                the config-5 shape; both parties share one device image)
     hybrid     parallel.ShardedLargeLambdaBackend (large lambda: narrow
@@ -120,12 +122,14 @@ class Dcf:
             else:
                 self.backend_name = backend
             if self.backend_name not in (
-                    "pallas", "keylanes", "bitsliced", "jax", "hybrid"):
+                    "pallas", "keylanes", "bitsliced", "jax", "hybrid",
+                    "prefix"):
                 raise ValueError(
                     f"backend {self.backend_name!r} has no mesh-sharded "
                     "variant (cpu/numpy are host paths); use pallas, "
-                    "keylanes, hybrid, bitsliced or jax")
-            if self.backend_name in ("pallas", "keylanes") and lam != 16:
+                    "prefix, keylanes, hybrid, bitsliced or jax")
+            if self.backend_name in ("pallas", "keylanes", "prefix") \
+                    and lam != 16:
                 raise ValueError(
                     f"the {self.backend_name} kernels support lam=16 only "
                     f"(got {lam}); use hybrid/bitsliced/jax on the mesh")
@@ -206,6 +210,12 @@ class Dcf:
                 from dcf_tpu.parallel import ShardedLargeLambdaBackend
 
                 return ShardedLargeLambdaBackend(
+                    self.lam, self.cipher_keys, self.mesh,
+                    interpret=interp, **opts)
+            if name == "prefix":
+                from dcf_tpu.parallel import ShardedPrefixBackend
+
+                return ShardedPrefixBackend(
                     self.lam, self.cipher_keys, self.mesh,
                     interpret=interp, **opts)
             if name == "bitsliced":

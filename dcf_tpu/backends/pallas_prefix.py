@@ -41,7 +41,7 @@ from dcf_tpu.ops.prg import HirosePrgNp
 from dcf_tpu.spec import ReferenceContractWarning
 from dcf_tpu.utils.bits import bitmajor_perm, byte_bits_lsb, pack_lanes
 
-__all__ = ["PrefixPallasBackend"]
+__all__ = ["PrefixPallasBackend", "gather_and_walk"]
 
 # Gather cliff measured at > 2^20 frontier nodes (micro_gather.py).
 MAX_PREFIX_LEVELS = 20
@@ -90,10 +90,12 @@ def _stage_prefix_idx(xs, k: int):
                    << jnp.arange(k, dtype=jnp.uint32)[None, :], axis=1)
 
 
-@partial(jax.jit, static_argnames=("tile_words", "interpret"))
-def _eval_prefix_staged(rk, table, idx, cw_s_r, cw_v_r, cw_np1, cw_t_r,
-                        x_mask_rem, tile_words: int, interpret: bool):
-    """The timed prefix eval: gather rows, relayout, walk n-k levels."""
+def gather_and_walk(rk, table, idx, cw_s_r, cw_v_r, cw_np1, cw_t_r,
+                    x_mask_rem, *, tile_words: int, interpret: bool):
+    """Gather rows, relayout, walk n-k levels — unjitted so
+    ``parallel.ShardedPrefixBackend`` can wrap it in ``shard_map`` (the
+    gather is a pure per-point map against the replicated frontier
+    table, so points shard with no collectives)."""
     m = idx.shape[0]
     rows = jnp.take(table, idx, axis=0)  # [M, 8] int32 (s||t, v)
     # -> [8, 32, W] with the j (point-within-word) axis reversed, the
@@ -104,6 +106,10 @@ def _eval_prefix_staged(rk, table, idx, cw_s_r, cw_v_r, cw_np1, cw_t_r,
     return dcf_eval_prefix_pallas(
         rk, srows, vrows, cw_s_r, cw_v_r, cw_np1, cw_t_r, x_mask_rem,
         tile_words=tile_words, interpret=interpret)
+
+
+_eval_prefix_staged = partial(
+    jax.jit, static_argnames=("tile_words", "interpret"))(gather_and_walk)
 
 
 class PrefixPallasBackend(PallasBackend):

@@ -141,16 +141,26 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
         # per-point frontier gather, n-k walked levels (single key; the
         # config-2 / flagship random-batch shape).  k tracks the batch
         # size: a frontier deeper than ~log2(M) adds nodes faster than it
-        # removes walk levels (and would be absurd for smoke runs).
+        # removes walk levels (and would be absurd for smoke runs).  With
+        # --mesh the same evaluator runs under shard_map (single key ->
+        # 1xN points mesh).
         import jax
 
-        from dcf_tpu.backends.pallas_prefix import PrefixPallasBackend
-
         pts = (getattr(args, "points", 0) or 100_000) if args else 100_000
-        be = PrefixPallasBackend(
-            lam, cipher_keys,
-            prefix_levels=max(6, min(20, pts.bit_length() - 1)),
-            interpret=jax.devices()[0].platform != "tpu")
+        klev = max(6, min(20, pts.bit_length() - 1))
+        interp = jax.devices()[0].platform != "tpu"
+        if args is not None and getattr(args, "mesh", ""):
+            from dcf_tpu.parallel import ShardedPrefixBackend, make_mesh
+
+            mesh = make_mesh(shape=_parse_mesh(args.mesh))
+            log(f"mesh: {dict(mesh.shape)}")
+            be = ShardedPrefixBackend(lam, cipher_keys, mesh,
+                                      prefix_levels=klev, interpret=interp)
+        else:
+            from dcf_tpu.backends.pallas_prefix import PrefixPallasBackend
+
+            be = PrefixPallasBackend(lam, cipher_keys, prefix_levels=klev,
+                                     interpret=interp)
     elif backend in ("sharded", "sharded-pallas"):
         import jax
 
@@ -264,14 +274,18 @@ def _timed(fn, reps: int, profile: str = ""):
     return med, mad, samples
 
 
-def _pinned_ratio(nb: int, k: int, rate: float) -> dict:
+def _pinned_ratio(nb: int, k: int, rate: float,
+                  interpreted: bool = False) -> dict:
     """vs_baseline against the pinned per-shape single-core CPU anchor
     (benchmarks/cpu_baseline.json, CPU_BASELINE.md protocol), when one
     exists for this shape — the flagship N=16 pin or the config-2
-    literal n=32 entry.  Empty otherwise (no silent in-run fallback)."""
+    literal n=32 entry.  Empty otherwise (no silent in-run fallback),
+    and empty for ``interpreted`` runs: a Pallas-interpreter smoke run's
+    ratio against a real CPU pin is meaningless noise (host backends and
+    compiled device runs keep theirs)."""
     import os
 
-    if k != 1:
+    if k != 1 or interpreted:
         return {}
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "benchmarks", "cpu_baseline.json")
@@ -418,9 +432,13 @@ def bench_batch(args) -> None:
         dt, mad, ss = _timed(lambda: run(0, k0, xs), args.reps, args.profile)
         unit = "evals/s"
     name = args.backend if k == 1 else f"{args.backend} (K={k})"
+    if getattr(args, "mesh", ""):
+        name += f" --mesh={args.mesh}"  # a sharded run must say so
     _emit("dcf_batch_eval", name, "evals_per_sec",
           k * m / dt, unit, dt, mad, len(ss),
-          extra_fields=_pinned_ratio(nb, k, k * m / dt))
+          extra_fields=_pinned_ratio(
+              nb, k, k * m / dt,
+              interpreted=bool(getattr(be, "interpret", False))))
 
 
 def bench_large_lambda(args) -> None:

@@ -26,5 +26,6 @@ from dcf_tpu.parallel.pallas_sharded import (  # noqa: F401
     ShardedKeyLanesBackend,
     ShardedLargeLambdaBackend,
     ShardedPallasBackend,
+    ShardedPrefixBackend,
     ShardedTreeFullDomain,
 )

@@ -56,6 +56,10 @@ from dcf_tpu.backends.large_lambda import (
     _hybrid_eval_pallas,
 )
 from dcf_tpu.backends.pallas_keylanes import KeyLanesPallasBackend
+from dcf_tpu.backends.pallas_prefix import (
+    PrefixPallasBackend,
+    gather_and_walk,
+)
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.pallas_eval import DEFAULT_TILE_WORDS, dcf_eval_pallas
 from dcf_tpu.ops.pallas_keylanes import dcf_eval_keylanes_pallas
@@ -63,7 +67,8 @@ from dcf_tpu.ops.pallas_tree import tree_expand_device
 from dcf_tpu.utils.bits import bitmajor_plane_masks
 
 __all__ = ["ShardedPallasBackend", "ShardedKeyLanesBackend",
-           "ShardedTreeFullDomain", "ShardedLargeLambdaBackend"]
+           "ShardedTreeFullDomain", "ShardedLargeLambdaBackend",
+           "ShardedPrefixBackend"]
 
 
 class ShardedPallasBackend(PallasBackend):
@@ -460,3 +465,98 @@ class ShardedKeyLanesBackend(KeyLanesPallasBackend):
         return fn(self.rk, dev["s0"][b], dev["cw_s"], dev["cw_v"],
                   dev["cw_tl"], dev["cw_tr"], dev["cw_np1"],
                   staged["x_mask"])
+
+
+class ShardedPrefixBackend(PrefixPallasBackend):
+    """The prefix-shared evaluator (backends.pallas_prefix, round 5 — the
+    fastest single-key random-batch path) under shard_map.
+
+    The workload is single-key, so the mesh's keys axis must be 1 (the
+    CLI's auto factorization for the criterion benches, mesh 1xN) and all
+    devices gang up on points.  The frontier gather table is key material
+    and REPLICATES across point-shards — each device's points index the
+    whole 2^k-node frontier, so a sharded table would turn the pure
+    per-point map into an all-gather; at <= 33 MB (k = 20) replication is
+    the right trade.  CW planes replicate likewise; the per-point gather
+    + remaining-level walk is then a collective-free map, exactly like
+    the from-root ShardedPallasBackend.
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes], mesh: Mesh,
+                 prefix_levels: int = 20,
+                 tile_words: int = DEFAULT_TILE_WORDS,
+                 interpret: bool = False, host_levels: int = 6):
+        super().__init__(lam, cipher_keys, prefix_levels=prefix_levels,
+                         tile_words=tile_words, interpret=interpret,
+                         host_levels=host_levels)
+        kaxis, paxis = mesh.axis_names
+        if mesh.shape[kaxis] != 1:
+            raise ValueError(
+                "ShardedPrefixBackend is single-key: use a 1xN mesh "
+                f"(got keys axis {mesh.shape[kaxis]})")
+        self.mesh = mesh
+        self._psize = mesh.shape[paxis]
+        self._spec_idx = P(paxis)
+        self._spec_xmask_rem = P(None, None, None, paxis)
+        self._spec_y = P(None, None, paxis)
+        self._sfns: dict = {}
+
+    def _put_plane(self, name: str, arr: np.ndarray) -> jax.Array:
+        """All key material is REPLICATED here (single-key workload):
+        placed across the mesh once at put_bundle, not re-broadcast from
+        device 0 inside every timed dispatch (the trap the 1x1-mesh
+        overhead measurement alone would never catch)."""
+        return jax.device_put(arr, NamedSharding(self.mesh, P()))
+
+    def _frontier_tables(self, b: int):
+        tbl = super()._frontier_tables(b)
+        if not isinstance(tbl.sharding, NamedSharding):
+            tbl = jax.device_put(tbl, NamedSharding(self.mesh, P()))
+            self._frontier[int(b)] = tbl  # cache the placed copy
+        return tbl
+
+    def _plan_tiles(self, m: int) -> tuple[int, int]:
+        """Per-shard tile plan (each point-shard gets whole tiles)."""
+        m_local = -(-m // self._psize) if m else 0
+        wt, w_local = super()._plan_tiles(m_local)
+        return wt, w_local * self._psize
+
+    def stage(self, xs: np.ndarray) -> dict:
+        staged = super().stage(xs)
+        # Re-place the per-point arrays across the mesh's point axis (the
+        # host staging above produced single-device arrays).
+        staged["idx"] = jax.device_put(
+            staged["idx"], NamedSharding(self.mesh, self._spec_idx))
+        staged["x_mask_rem"] = jax.device_put(
+            staged["x_mask_rem"],
+            NamedSharding(self.mesh, self._spec_xmask_rem))
+        return staged
+
+    def eval_staged(self, b: int, staged: dict) -> jax.Array:
+        if "idx" not in staged:
+            raise ValueError("staged dict is not from a prefix backend's "
+                             "stage")
+        wt = staged["wt"]
+        fn = self._sfns.get(wt)
+        if fn is None:
+            fn = jax.jit(
+                jax.shard_map(
+                    partial(gather_and_walk, tile_words=wt,
+                            interpret=self.interpret),
+                    mesh=self.mesh,
+                    in_specs=(
+                        P(),              # rk (replicated)
+                        P(),              # frontier table (replicated)
+                        self._spec_idx,   # per-point frontier positions
+                        P(), P(), P(), P(),  # CW slices + cw_np1
+                        self._spec_xmask_rem,
+                    ),
+                    out_specs=self._spec_y,
+                    check_vma=False,  # pure map, no collectives
+                )
+            )
+            self._sfns[wt] = fn
+        cw_s_r, cw_v_r, cw_t_r = self._cw_rem
+        return fn(self.rk, self._frontier_tables(b), staged["idx"],
+                  cw_s_r, cw_v_r, self._bundle_dev["cw_np1"], cw_t_r,
+                  staged["x_mask_rem"])

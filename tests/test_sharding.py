@@ -253,6 +253,47 @@ def test_sharded_large_lambda_matches_numpy():
             assert np.array_equal(got, want), f"party {b} {bound}"
 
 
+@pytest.mark.parametrize("bound", [spec.Bound.LT_BETA, spec.Bound.GT_BETA])
+def test_sharded_prefix_matches_numpy(bound):
+    """The prefix-shared evaluator under shard_map on a 1x8 points mesh
+    (interpreter mode): parity with the numpy oracle, both parties, both
+    bounds, ragged m, staged roundtrip + device counter."""
+    from dcf_tpu.parallel import ShardedPrefixBackend, make_mesh
+
+    rng = random.Random(41)
+    cipher_keys = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    prg_np = HirosePrgNp(16, cipher_keys)
+    nprng = np.random.default_rng(18)
+    n_bytes, m = 2, 37  # ragged m pads per shard
+    alphas = nprng.integers(0, 256, (1, n_bytes), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (1, 16), dtype=np.uint8)
+    bundle = gen_batch(prg_np, alphas, betas, random_s0s(1, 16, nprng),
+                       bound)
+    xs = nprng.integers(0, 256, (m, n_bytes), dtype=np.uint8)
+    xs[0] = alphas[0]
+
+    mesh = make_mesh(shape=(1, 8))
+    bes = {b: ShardedPrefixBackend(16, cipher_keys, mesh, interpret=True,
+                                   tile_words=2) for b in (0, 1)}
+    ys = {}
+    staged = None
+    for b in (0, 1):
+        bes[b].put_bundle(bundle.for_party(b))
+        if staged is None:
+            staged = bes[b].stage(xs)
+        y = bes[b].eval_staged(b, staged)
+        ys[b] = y
+        got = bes[b].staged_to_bytes(y, staged["m"])
+        want = eval_batch_np(prg_np, b, bundle.for_party(b), xs)
+        assert np.array_equal(got, want), f"party {b} {bound}"
+    assert int(bes[0].points_mismatch_count(
+        ys[0], ys[1], alphas[0].tobytes(), betas[0].tobytes(), staged,
+        gt=bound is spec.Bound.GT_BETA)) == 0
+    # keys axis must be 1
+    with pytest.raises(ValueError, match="single-key"):
+        ShardedPrefixBackend(16, cipher_keys, make_mesh(8), interpret=True)
+
+
 def test_facade_mesh_hybrid_auto():
     """Dcf(..., lam>=48, mesh=...) auto-routes to the sharded hybrid."""
     import warnings as _warnings

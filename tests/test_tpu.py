@@ -248,6 +248,32 @@ def test_sharded_pallas_1chip_mesh_compiled():
         assert np.array_equal(got, want), f"party {b}"
 
 
+def test_sharded_prefix_1chip_mesh_compiled():
+    """The shard_map-wrapped prefix evaluator on a real 1-device TPU mesh
+    (compiled tree frontier + gather + walk), vs the oracle."""
+    from dcf_tpu.parallel import ShardedPrefixBackend, make_mesh
+
+    ck, prg, alphas, betas, bundle, xs = _workload(83, 1, 16, 37)
+    mesh = make_mesh(shape=(1, 1))
+    ys = {}
+    staged = None
+    for b in (0, 1):
+        be = ShardedPrefixBackend(16, ck, mesh, prefix_levels=12)
+        assert not be.interpret
+        be.put_bundle(bundle.for_party(b))
+        if staged is None:
+            staged = be.stage(xs)
+            be0 = be
+        y = be.eval_staged(b, staged)
+        ys[b] = y
+        got = be.staged_to_bytes(y, staged["m"])
+        want = eval_batch_np(prg, b, bundle.for_party(b), xs)
+        assert np.array_equal(got, want), f"party {b}"
+    assert int(be0.points_mismatch_count(
+        ys[0], ys[1], alphas[0].tobytes(), betas[0].tobytes(),
+        staged)) == 0
+
+
 def test_sharded_keylanes_1chip_mesh_compiled():
     """The shard_map-wrapped keylanes kernel on a real 1-device TPU mesh
     (the config-5 pod path's compiled-plumbing proof), incl. the
