@@ -19,9 +19,9 @@ Backends (selected at construction, ``backend=``):
     jax        byte-level lax.scan walk
     bitsliced  XLA bit-plane walk
     pallas     fused VMEM walk kernel (lam=16)
-    prefix     prefix-shared walk: top-k tree frontier cached per
-               (key, party) + per-point gather + n-k walked levels
-               (lam=16, single key — the fastest random-batch path)
+    prefix     prefix-shared walk: per-key top-k tree frontiers cached
+               + per-point gather + n-k walked levels (lam=16, shared
+               points, K >= 1 — the fastest random-batch path)
     keylanes   keys-in-lanes walk kernel (many keys x few points, the
                config-5 shape; lam=16; wants the full two-party bundle —
                its CW image is shared between parties)

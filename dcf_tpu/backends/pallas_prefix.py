@@ -91,33 +91,49 @@ def _stage_prefix_idx(xs, k: int):
 
 
 def gather_and_walk(rk, table, idx, cw_s_r, cw_v_r, cw_np1, cw_t_r,
-                    x_mask_rem, *, tile_words: int, interpret: bool):
+                    x_mask_rem, *, tile_words: int, interpret: bool,
+                    k_num: int = 1, frontier_size: int = 0):
     """Gather rows, relayout, walk n-k levels — unjitted so
     ``parallel.ShardedPrefixBackend`` can wrap it in ``shard_map`` (the
     gather is a pure per-point map against the replicated frontier
-    table, so points shard with no collectives)."""
+    table, so points shard with no collectives).
+
+    Multi-key: ``table`` stacks K per-key frontiers [K * 2^k, 8]
+    (``frontier_size`` = 2^k) and the shared ``idx`` is offset per key —
+    one flat gather of K*M rows, then the kernel grids over keys exactly
+    as the from-root walk does."""
     m = idx.shape[0]
-    rows = jnp.take(table, idx, axis=0)  # [M, 8] int32 (s||t, v)
-    # -> [8, 32, W] with the j (point-within-word) axis reversed, the
+    if k_num == 1:
+        flat = idx
+    else:
+        flat = (jnp.arange(k_num, dtype=jnp.uint32)[:, None]
+                * jnp.uint32(frontier_size) + idx[None, :]).reshape(-1)
+    rows = jnp.take(table, flat, axis=0).reshape(k_num, m, 8)
+    # -> [K, 8, 32, W] with the j (point-within-word) axis reversed, the
     # layout the kernel's butterfly transpose expects.
-    blk = rows.T.reshape(8, m // 32, 32).transpose(0, 2, 1)[:, 31::-1, :]
-    srows = blk[None, :4]
-    vrows = blk[None, 4:]
+    blk = (rows.transpose(0, 2, 1).reshape(k_num, 8, m // 32, 32)
+           .transpose(0, 1, 3, 2)[:, :, 31::-1, :])
+    srows = blk[:, :4]
+    vrows = blk[:, 4:]
     return dcf_eval_prefix_pallas(
         rk, srows, vrows, cw_s_r, cw_v_r, cw_np1, cw_t_r, x_mask_rem,
         tile_words=tile_words, interpret=interpret)
 
 
 _eval_prefix_staged = partial(
-    jax.jit, static_argnames=("tile_words", "interpret"))(gather_and_walk)
+    jax.jit, static_argnames=("tile_words", "interpret", "k_num",
+                              "frontier_size"))(gather_and_walk)
 
 
 class PrefixPallasBackend(PallasBackend):
-    """Prefix-shared DCF evaluator (lam = 16, single key).
+    """Prefix-shared DCF evaluator (lam = 16, shared points).
 
     ``prefix_levels`` picks k (clamped to n-8 and the measured gather
     cliff at 20); the frontier for each party is built lazily on first
-    ``eval_staged(b, ...)`` and cached with the key image.
+    ``eval_staged(b, ...)`` and cached with the key image.  Multi-key
+    bundles stack per-key frontiers and offset the shared prefix
+    indices per key (one flat gather); per-key POINT batches have no
+    shared staging to exploit and stay on PallasBackend.
     """
 
     def __init__(self, lam: int, cipher_keys: Sequence[bytes],
@@ -150,10 +166,6 @@ class PrefixPallasBackend(PallasBackend):
         return max(min(self.prefix_levels, n - 8), 0)
 
     def put_bundle(self, bundle: KeyBundle) -> None:
-        if bundle.num_keys != 1:
-            raise ValueError(
-                "PrefixPallasBackend is single-key (the bench shape); "
-                "use PallasBackend for multi-key batches")
         if 8 * bundle.n_bytes < self.host_levels + 8:
             raise ValueError(
                 f"domain of {8 * bundle.n_bytes} levels is too shallow "
@@ -168,16 +180,15 @@ class PrefixPallasBackend(PallasBackend):
         self._cw_rem = (dev["cw_s"][:, k:], dev["cw_v"][:, k:],
                         dev["cw_t"][:, k:])
 
-    def _frontier_tables(self, b: int):
-        """The party-b frontier gather table int32 [2^k, 8]: columns 0-3 =
-        s (t stashed in the masked bit -> plane 15), 4-7 = v.  Built once
-        per (bundle, party) on device, cached like the CW image."""
-        tbl = self._frontier.get(int(b))
-        if tbl is not None:
-            return tbl
-        k = self._k()
-        k0 = min(self.host_levels, k)
-        s, v, t = tree_expand_np(self._prg, self._bundle_host, int(b), k0)
+    def _one_key_table(self, b: int, key: int, k: int, k0: int):
+        """One key's frontier rows int32 [2^k, 8]: columns 0-3 = s (t
+        stashed in the masked bit -> plane 15), 4-7 = v."""
+        kb = self._bundle_host
+        per_key = KeyBundle(
+            s0s=kb.s0s[key:key + 1], cw_s=kb.cw_s[key:key + 1],
+            cw_v=kb.cw_v[key:key + 1], cw_t=kb.cw_t[key:key + 1],
+            cw_np1=kb.cw_np1[key:key + 1])
+        s, v, t = tree_expand_np(self._prg, per_key, int(b), k0)
 
         def planes(a):  # [N, 16] -> int32 [128, N/32]
             bits = byte_bits_lsb(a)[:, _PERM16]
@@ -187,7 +198,7 @@ class PrefixPallasBackend(PallasBackend):
         t_pm = jnp.asarray(pack_lanes(t[None, :]).view(np.int32))
         dev = self._bundle_dev
         s_p, v_p, t_p = tree_expand_raw(
-            self.rk, dev["cw_s"][0], dev["cw_v"][0], dev["cw_t"][0],
+            self.rk, dev["cw_s"][key], dev["cw_v"][key], dev["cw_t"][key],
             planes(s), planes(v), t_pm,
             k0=k0, k1=k, interpret=self.interpret)
         # Stash t in plane 15 of s: structurally zero there (the Hirose
@@ -199,9 +210,23 @@ class PrefixPallasBackend(PallasBackend):
             raise AssertionError(
                 "frontier s plane 15 not zero — t-stash invariant broken")
         s_p = s_p.at[15:16].set(t_p)
-        tbl = jnp.concatenate(
+        return jnp.concatenate(
             [_planes_to_rows(s_p, self._perm_i32),
              _planes_to_rows(v_p, self._perm_i32)], axis=1)  # [2^k, 8]
+
+    def _frontier_tables(self, b: int):
+        """The party-b frontier gather table int32 [K * 2^k, 8] (per-key
+        tables stacked).  Built once per (bundle, party) on device,
+        cached like the CW image."""
+        tbl = self._frontier.get(int(b))
+        if tbl is not None:
+            return tbl
+        k = self._k()
+        k0 = min(self.host_levels, k)
+        k_num = self._dims()[0]
+        tbl = jnp.concatenate(
+            [self._one_key_table(b, key, k, k0) for key in range(k_num)],
+            axis=0)
         self._frontier[int(b)] = tbl
         return tbl
 
@@ -214,8 +239,10 @@ class PrefixPallasBackend(PallasBackend):
         if m == 0:
             raise ValueError("cannot stage an empty batch")
         if xs.shape[0] != 1:
-            raise ValueError("PrefixPallasBackend wants shared points "
-                             "[M, nb] (single key)")
+            raise ValueError(
+                "PrefixPallasBackend wants shared points [M, nb] (the "
+                "prefix indices are computed once and offset per key); "
+                "use PallasBackend for per-key point batches")
         k = self._k()
         xj = jnp.asarray(xs)
         x_mask = _stage_xs(xj)
@@ -232,16 +259,19 @@ class PrefixPallasBackend(PallasBackend):
             self.rk, tbl, staged["idx"],
             cw_s_r, cw_v_r, self._bundle_dev["cw_np1"],
             cw_t_r, staged["x_mask_rem"],
-            tile_words=staged["wt"], interpret=self.interpret)
+            tile_words=staged["wt"], interpret=self.interpret,
+            k_num=self._dims()[0], frontier_size=1 << self._k())
 
     def eval(self, b: int, xs: np.ndarray,
              bundle: KeyBundle | None = None) -> np.ndarray:
-        """Bytes-in/bytes-out convenience path."""
+        """Bytes-in/bytes-out convenience path (shared points)."""
         if bundle is not None:
             self.put_bundle(bundle)
         if xs.ndim == 3:
             if xs.shape[0] != 1:
-                raise ValueError("PrefixPallasBackend is single-key")
+                raise ValueError(
+                    "PrefixPallasBackend wants shared points; use "
+                    "PallasBackend for per-key point batches")
             xs = xs[0]
         staged = self.stage(xs)
         return self.staged_to_bytes(self.eval_staged(b, staged),

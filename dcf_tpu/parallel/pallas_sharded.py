@@ -537,12 +537,19 @@ class ShardedPrefixBackend(PrefixPallasBackend):
             raise ValueError("staged dict is not from a prefix backend's "
                              "stage")
         wt = staged["wt"]
-        fn = self._sfns.get(wt)
+        # Multi-key bundles ride the SAME mesh contract (keys axis 1 ->
+        # every device walks all K keys on its point shard); k_num and
+        # frontier_size must reach the shard body or it would silently
+        # evaluate only key 0's frontier.
+        k_num = self._dims()[0]
+        fsize = 1 << self._k()
+        fn = self._sfns.get((wt, k_num, fsize))
         if fn is None:
             fn = jax.jit(
                 jax.shard_map(
                     partial(gather_and_walk, tile_words=wt,
-                            interpret=self.interpret),
+                            interpret=self.interpret,
+                            k_num=k_num, frontier_size=fsize),
                     mesh=self.mesh,
                     in_specs=(
                         P(),              # rk (replicated)
@@ -555,7 +562,7 @@ class ShardedPrefixBackend(PrefixPallasBackend):
                     check_vma=False,  # pure map, no collectives
                 )
             )
-            self._sfns[wt] = fn
+            self._sfns[(wt, k_num, fsize)] = fn
         cw_s_r, cw_v_r, cw_t_r = self._cw_rem
         return fn(self.rk, self._frontier_tables(b), staged["idx"],
                   cw_s_r, cw_v_r, self._bundle_dev["cw_np1"], cw_t_r,

@@ -99,6 +99,43 @@ def test_prefix_staged_roundtrip_and_counter():
     assert np.array_equal(yb, want)
 
 
+@pytest.mark.parametrize("bound", [spec.Bound.LT_BETA, spec.Bound.GT_BETA])
+def test_prefix_multikey_matches_numpy(bound):
+    """K=3 keys over shared points: per-key frontiers stacked, shared
+    prefix indices offset per key, one flat gather — bit-exact vs the
+    oracle for every key."""
+    from dcf_tpu.backends.pallas_prefix import PrefixPallasBackend
+
+    rng = random.Random(54)
+    cipher_keys = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
+    prg_np = HirosePrgNp(16, cipher_keys)
+    nprng = np.random.default_rng(19)
+    k_num, n_bytes, m = 3, 2, 21
+    alphas = nprng.integers(0, 256, (k_num, n_bytes), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k_num, 16), dtype=np.uint8)
+    bundle = gen_batch(prg_np, alphas, betas,
+                       random_s0s(k_num, 16, nprng), bound)
+    xs = nprng.integers(0, 256, (m, n_bytes), dtype=np.uint8)
+    xs[0] = alphas[0]
+
+    be = PrefixPallasBackend(16, cipher_keys, interpret=True, tile_words=2)
+    ys = {}
+    for b in (0, 1):
+        kb = bundle.for_party(b)
+        got = be.eval(b, xs, bundle=kb)
+        want = eval_batch_np(prg_np, b, kb, xs)
+        assert np.array_equal(got, want), f"party {b} {bound}"
+        ys[b] = got
+    recon = ys[0] ^ ys[1]
+    for i in range(k_num):
+        a = alphas[i].tobytes()
+        for j in range(m):
+            x = xs[j].tobytes()
+            hit = x < a if bound is spec.Bound.LT_BETA else x > a
+            want_y = betas[i].tobytes() if hit else bytes(16)
+            assert recon[i, j].tobytes() == want_y
+
+
 def test_prefix_validation():
     from dcf_tpu.backends.pallas_prefix import PrefixPallasBackend
 
@@ -107,13 +144,14 @@ def test_prefix_validation():
     prg_np = HirosePrgNp(16, cipher_keys)
     nprng = np.random.default_rng(17)
     be = PrefixPallasBackend(16, cipher_keys, interpret=True)
-    # Multi-key bundles are PallasBackend's job.
+    # Per-key POINT batches have no shared prefixes to exploit.
     b2 = gen_batch(prg_np,
                    nprng.integers(0, 256, (2, 2), dtype=np.uint8),
                    nprng.integers(0, 256, (2, 16), dtype=np.uint8),
                    random_s0s(2, 16, nprng), spec.Bound.LT_BETA)
-    with pytest.raises(ValueError, match="single-key"):
-        be.put_bundle(b2.for_party(0))
+    be.put_bundle(b2.for_party(0))
+    with pytest.raises(ValueError, match="shared points"):
+        be.eval(0, nprng.integers(0, 256, (2, 5, 2), dtype=np.uint8))
     # Too-shallow domains have no prefix to share.
     b1 = gen_batch(prg_np,
                    nprng.integers(0, 256, (1, 1), dtype=np.uint8),
