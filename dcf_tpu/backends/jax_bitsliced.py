@@ -248,29 +248,30 @@ def _fd_mismatch_bytemajor(y0, y1, beta_mask, start, alpha, *, gt: bool):
     return jnp.sum(jax.lax.population_count(diff).astype(jnp.int32))
 
 
-def walk_inside_mask(x_of, alpha_bits: tuple, w: int, dtype, gt: bool):
-    """Lexicographic compare on walk-order lane masks, the shared core of
-    the random-points parity counters: returns the ``inside`` word mask
-    [1, W] — all-ones in lanes where x < alpha (x > alpha for gt).
+def walk_inside_mask(x_of, alpha_of, n: int, zero, gt: bool):
+    """Lexicographic compare on walk-order lane masks, the SINGLE source
+    of the bound semantics for every random-points parity counter:
+    returns the ``inside`` mask (shaped like ``zero``) — all-ones in
+    lanes where x < alpha (x > alpha for gt).
 
-    ``x_of(i)`` yields walk-bit i's lane mask [1, W] (0 / all-ones);
-    ``alpha_bits`` is alpha MSB-first (static, so the n-step compare
-    unrolls to plain word ops).  Used by both the bit-major (Pallas) and
-    byte-major (bitsliced) counters so the bound semantics cannot
-    desynchronize between the two bench parity gates.
+    ``x_of(i)`` / ``alpha_of(i)`` yield walk-bit i's masks (0 /
+    all-ones), broadcast-compatible with ``zero``; alphas may be static
+    python constants wrapped as masks (XLA folds the all-ones/zero ANDs
+    back to the specialized form) or per-key DATA arrays (the multi-key
+    counter).  Shared by the bit-major (Pallas) single- and multi-key
+    counters and the byte-major (bitsliced) counter so the bound
+    semantics cannot desynchronize between the bench parity gates.
     """
-    inside = jnp.zeros((1, w), dtype)
-    eq = ~inside  # all-ones
-    for i, ai in enumerate(alpha_bits):  # static unroll: n word-ops
+    inside = zero
+    eq = ~zero  # all-ones
+    for i in range(n):  # static unroll: a few word-ops per level
         xi = x_of(i)
-        if ai and not gt:
-            inside = inside | (eq & ~xi)
-            eq = eq & xi
-        elif not ai and gt:
-            inside = inside | (eq & xi)
-            eq = eq & ~xi
-        else:  # the walk bit cannot move x past alpha in this direction
-            eq = eq & (xi if ai else ~xi)
+        ai = alpha_of(i)
+        if gt:
+            inside = inside | (eq & xi & ~ai)
+        else:
+            inside = inside | (eq & ~xi & ai)
+        eq = eq & ~(xi ^ ai)
     return inside
 
 
@@ -286,7 +287,9 @@ def _points_mismatch_bytemajor(y0, y1, beta_mask, x_mask, *,
     self-verify."""
     w = y0.shape[-1]
     inside = walk_inside_mask(
-        lambda i: x_mask[i], alpha_bits, w, jnp.uint32, gt)
+        lambda i: x_mask[i],
+        lambda i: jnp.uint32(0xFFFFFFFF if alpha_bits[i] else 0),
+        len(alpha_bits), jnp.zeros((1, w), jnp.uint32), gt)
     expect = beta_mask[:, None, None] & inside[None, :, :]
     diff = jnp.bitwise_or.reduce(y0 ^ y1 ^ expect, axis=0)  # [1, W]
     return jnp.sum(jax.lax.population_count(diff).astype(jnp.int32))

@@ -77,9 +77,35 @@ def _points_mismatch_bitmajor(y0, y1, beta_mask, x_mask, *,
     """
     w = y0.shape[-1]
     inside = walk_inside_mask(
-        lambda i: x_mask[0, i, 0][None, :], alpha_bits, w, jnp.int32, gt)
+        lambda i: x_mask[0, i, 0][None, :],
+        lambda i: jnp.int32(-1 if alpha_bits[i] else 0),
+        len(alpha_bits), jnp.zeros((1, w), jnp.int32), gt)
     expect = beta_mask[None, :, :] & inside[:, None, :]  # [1, 128, W]
     diff = jnp.bitwise_or.reduce(y0 ^ y1 ^ expect, axis=1)
+    return jnp.sum(jax.lax.population_count(
+        jax.lax.bitcast_convert_type(diff, jnp.uint32)).astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("gt",))
+def _points_mismatch_bitmajor_multikey(y0, y1, beta_mask_k, x_mask,
+                                       alpha_pm, *, gt: bool):
+    """Multi-key variant of the staged random-points counter: the
+    lexicographic compare (walk_inside_mask — the one source of the
+    bound semantics) runs with per-key alpha bits as DATA (int32 lane
+    masks [K, n] in {0, -1}) instead of a jit-static tuple, so one
+    compile covers any K and the K>1 bench lines get the same full
+    on-device two-party parity as the single-key flagship.
+
+    y0/y1: int32 [K, 128, W]; beta_mask_k: int32 [K, 128, 1];
+    x_mask: int32 [1 or K, n, 1, W] (shared points broadcast over keys).
+    """
+    k_num, _, w = y0.shape
+    inside = walk_inside_mask(
+        lambda i: x_mask[:, i],                    # [1|K, 1, W]
+        lambda i: alpha_pm[:, i][:, None, None],   # [K, 1, 1]
+        x_mask.shape[1], jnp.zeros((k_num, 1, w), jnp.int32), gt)
+    expect = beta_mask_k & inside            # [K, 128, W]
+    diff = jnp.bitwise_or.reduce(y0 ^ y1 ^ expect, axis=1)  # [K, W]
     return jnp.sum(jax.lax.population_count(
         jax.lax.bitcast_convert_type(diff, jnp.uint32)).astype(jnp.int32))
 
@@ -240,21 +266,43 @@ class PallasBackend:
         return _fd_mismatch_bitmajor(
             y0, y1, beta_mask, jnp.uint32(start), jnp.uint32(alpha), gt=gt)
 
-    def points_mismatch_count(self, y0, y1, alpha: bytes, beta: bytes,
+    # _full_device_parity's capability flag: multi-key bundles get the
+    # same full on-device parity gate as single-key ones.
+    points_mismatch_multikey = True
+
+    def points_mismatch_count(self, y0, y1, alpha, beta,
                               staged: dict, gt: bool = False) -> jax.Array:
         """Full on-device two-party verification for staged RANDOM points
-        (the bench parity gate): count of points whose XOR reconstruction
-        differs from ``beta if x < alpha else 0`` (``> `` for gt).  y0/y1:
-        ``eval_staged`` outputs of the two parties over the SAME staged
-        batch (the x image is party-independent).  Single key.  Returns a
-        DEVICE int32 scalar."""
-        if y0.shape[0] != 1:
-            raise ValueError("points_mismatch_count is single-key")
-        beta_mask = jnp.asarray(bitmajor_plane_masks(
-            np.frombuffer(beta, dtype=np.uint8))[:, None])
-        return _points_mismatch_bitmajor(
-            y0, y1, beta_mask, staged["x_mask"],
-            alpha_bits=alpha_walk_bits(alpha), gt=gt)
+        (the bench parity gate): count of (key, point) pairs whose XOR
+        reconstruction differs from ``beta if x < alpha else 0`` (``>``
+        for gt).  y0/y1: ``eval_staged`` outputs of the two parties over
+        the SAME staged batch (the x image is party-independent).
+
+        Single-key form: ``alpha``/``beta`` as bytes.  Multi-key form:
+        uint8 arrays [K, n_bytes] / [K, lam] (per-key alphas become data
+        lane masks, one compile for any K).  Returns a DEVICE int32
+        scalar."""
+        if isinstance(alpha, (bytes, bytearray)):
+            if y0.shape[0] != 1:
+                raise ValueError(
+                    "bytes alpha/beta is the single-key form; pass "
+                    "[K, n_bytes]/[K, lam] arrays for multi-key bundles")
+            beta_mask = jnp.asarray(bitmajor_plane_masks(
+                np.frombuffer(beta, dtype=np.uint8))[:, None])
+            return _points_mismatch_bitmajor(
+                y0, y1, beta_mask, staged["x_mask"],
+                alpha_bits=alpha_walk_bits(alpha), gt=gt)
+        alphas = np.asarray(alpha, dtype=np.uint8)
+        betas = np.asarray(beta, dtype=np.uint8)
+        if alphas.shape[0] != y0.shape[0] or betas.shape[0] != y0.shape[0]:
+            raise ValueError(
+                f"{alphas.shape[0]} alphas / {betas.shape[0]} betas for "
+                f"{y0.shape[0]}-key outputs")
+        alpha_pm = jnp.asarray(
+            np.unpackbits(alphas, axis=1).astype(np.int32) * -1)  # [K, n]
+        beta_mask_k = jnp.asarray(bitmajor_plane_masks(betas)[:, :, None])
+        return _points_mismatch_bitmajor_multikey(
+            y0, y1, beta_mask_k, staged["x_mask"], alpha_pm, gt=gt)
 
     def eval_staged(self, b: int, staged: dict) -> jax.Array:
         """Party ``b`` eval on staged points; returns DEVICE-resident y planes

@@ -110,7 +110,10 @@ def test_prefix_multikey_matches_numpy(bound):
     cipher_keys = [rand_bytes(rng, 32), rand_bytes(rng, 32)]
     prg_np = HirosePrgNp(16, cipher_keys)
     nprng = np.random.default_rng(19)
-    k_num, n_bytes, m = 3, 2, 21
+    # m = 32 exactly fills one lane word: the wrong-beta control below
+    # counts every point, and pad points (genuine x=0 evals) would
+    # otherwise land inside an LT bound and pollute the expected count.
+    k_num, n_bytes, m = 3, 2, 32
     alphas = nprng.integers(0, 256, (k_num, n_bytes), dtype=np.uint8)
     betas = nprng.integers(0, 256, (k_num, 16), dtype=np.uint8)
     bundle = gen_batch(prg_np, alphas, betas,
@@ -119,11 +122,16 @@ def test_prefix_multikey_matches_numpy(bound):
     xs[0] = alphas[0]
 
     be = PrefixPallasBackend(16, cipher_keys, interpret=True, tile_words=2)
+    be1 = PrefixPallasBackend(16, cipher_keys, interpret=True,
+                              tile_words=2)
+    be.put_bundle(bundle.for_party(0))
+    be1.put_bundle(bundle.for_party(1))
+    staged = be.stage(xs)
+    ys_dev = {0: be.eval_staged(0, staged), 1: be1.eval_staged(1, staged)}
     ys = {}
-    for b in (0, 1):
-        kb = bundle.for_party(b)
-        got = be.eval(b, xs, bundle=kb)
-        want = eval_batch_np(prg_np, b, kb, xs)
+    for b, bk in ((0, be), (1, be1)):
+        got = bk.staged_to_bytes(ys_dev[b], staged["m"])
+        want = eval_batch_np(prg_np, b, bundle.for_party(b), xs)
         assert np.array_equal(got, want), f"party {b} {bound}"
         ys[b] = got
     recon = ys[0] ^ ys[1]
@@ -134,6 +142,19 @@ def test_prefix_multikey_matches_numpy(bound):
             hit = x < a if bound is spec.Bound.LT_BETA else x > a
             want_y = betas[i].tobytes() if hit else bytes(16)
             assert recon[i, j].tobytes() == want_y
+    # The MULTI-KEY device counter (per-key alphas as data): zero on
+    # clean shares, and the exact per-key inside-count on a wrong beta.
+    gt = bound is spec.Bound.GT_BETA
+    assert int(be.points_mismatch_count(
+        ys_dev[0], ys_dev[1], alphas, betas, staged, gt=gt)) == 0
+    wrong = betas ^ np.uint8(1)
+    n_inside = sum(
+        (xs[j].tobytes() < alphas[i].tobytes()) != gt and
+        xs[j].tobytes() != alphas[i].tobytes()
+        for i in range(k_num) for j in range(m))
+    got_mism = int(be.points_mismatch_count(
+        ys_dev[0], ys_dev[1], alphas, wrong, staged, gt=gt))
+    assert got_mism == n_inside
 
 
 def test_prefix_validation():
