@@ -19,8 +19,8 @@ Methodology (criterion analog, `dcf_batch_eval.rs:35-39`):
     reported separately on stderr.
 
 Backend: the prefix-shared Pallas evaluator (backends.pallas_prefix —
-the top-20 walk levels expanded once per key as a cached tree frontier,
-per-point carries gathered, 108 levels walked; measured +11% over the
+the top-21 walk levels expanded once per key as a cached tree frontier,
+per-point carries gathered, 107 levels walked; measured +13% over the
 from-root walk kernel at this shape); falls back to the from-root Pallas
 walk kernel, then the XLA bitsliced path, with a logged warning if
 Mosaic compilation fails at any stage.

@@ -8,8 +8,9 @@ from the 2^k-node frontier cheaply.  These probes price that fetch on
 the real chip and record why the shipped design looks the way it does:
 
   take_rows8[k]   jnp.take of [2^k, 8]-int32 rows (s||v fused, 32 B) with
-                  2^20 random indices.  ~3.5 ms for k <= 20, ~4x CLIFF
-                  above 2^20 nodes -> prefix_levels is clamped to 20.
+                  2^20 random indices.  ~3.4-3.7 ms for k <= 21, ~4x
+                  CLIFF at 2^22 rows (the 128 MB table) ->
+                  prefix_levels is clamped to 21.
   take_rows9      the same with 36 B rows: ~2x slower (non-power-of-2
                   row width) -> the t-bit is NOT a 9th column; it rides
                   in s's structurally-zero masked bit (plane 15, the
@@ -24,10 +25,10 @@ the real chip and record why the shipped design looks the way it does:
   relayout        the XLA [M, 8] -> [8, 32(rev), W] tile relayout that
                   remains outside the kernel: ~1 ms.
 
-Net shipped cost at M = 2^20: gather+relayout ~4.6 ms ~= 6 walk levels
-— the floor that caps config 2 (n=32, k=20) at ~73 M evals/s (1.71x the
-from-root walk) instead of the ideal 32/12 = 2.67x, and the flagship
-(n=128) at +11%.
+Net shipped cost at M = 2^20: gather+relayout ~4.4 ms ~= 6 walk levels
+— the floor that caps config 2 (n=32, k=21) at ~80 M evals/s (1.86x the
+from-root walk) instead of the ideal 32/11 = 2.9x, and the flagship
+(n=128) at +13%.
 
 Usage: python -m benchmarks.micro_gather [--logm 20]
 Prints one JSON line per probe.
@@ -94,7 +95,7 @@ def main() -> None:
                       f"{getattr(dev, 'device_kind', '')}", "m": m}))
 
     take = jax.jit(lambda t, i: jnp.take(t, i, axis=0))
-    for logk in (16, 20, 22):
+    for logk in (16, 20, 21, 22):
         k = 1 << logk
         tbl = jnp.asarray(rng.integers(-(2**31), 2**31, (k, 8),
                                        dtype=np.int64).astype(np.int32))
@@ -131,7 +132,7 @@ def main() -> None:
         "xla_pack_per_table_ms": round(t_pack * 1e3, 3),
         "walk_levels_equivalent": round(t_gr * 1e3 / WALK_MS_PER_LEVEL, 1),
         "note": ("gather+relayout ~= 6 walk levels: the floor that caps "
-                 "config-2 prefix sharing at ~1.7x instead of 2.67x; "
+                 "config-2 prefix sharing at ~1.86x instead of 2.9x; "
                  "repack rides in-kernel (ops.pallas_prefix)"),
     }))
 

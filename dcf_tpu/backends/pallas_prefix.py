@@ -16,11 +16,12 @@ Reference workload this accelerates: benches/dcf_batch_eval.rs:17-39
 src/lib.rs:163-204).
 
 Cost structure measured on v5e (benchmarks/micro_gather.py): the gather
-is ~3.7 ms per 2^20 points for k <= 20 and cliffs 4x above 2^20 nodes,
-so k is clamped to <= 20; the bit-plane repack rides inside the walk
-kernel (~0.5 ms/table).  At the config-2 shape (n = 32, M = 2^20) the
-gather+relayout floor (~5 ms ~ 7 walk levels) caps the speedup below the
-ideal n/(n-k).
+is ~3.4-3.7 ms per 2^20 points for k <= 21 and cliffs 4x at 2^22
+frontier rows (the 128 MB table), so k is clamped to <= 21; the
+bit-plane repack rides inside the walk kernel (~0.5 ms/table).  At the
+config-2 shape (n = 32, M = 2^20, k = 21 -> 11 walked levels) the
+gather+relayout floor (~4.4 ms ~ 6 walk levels) caps the speedup at
+1.86x instead of the ideal 32/11 = 2.9x.
 """
 
 from __future__ import annotations
@@ -43,8 +44,11 @@ from dcf_tpu.utils.bits import bitmajor_perm, byte_bits_lsb, pack_lanes
 
 __all__ = ["PrefixPallasBackend", "gather_and_walk"]
 
-# Gather cliff measured at > 2^20 frontier nodes (micro_gather.py).
-MAX_PREFIX_LEVELS = 20
+# Gather cliff measured at >= 2^22 frontier nodes (micro_gather.py:
+# 3.4-3.7 ms for k <= 21, 13.8 ms at k = 22 — the 128 MB table is the
+# break point).  The frontier is untimed key material, so k beyond
+# log2(M) still wins on the eval clock as long as the gather stays fast.
+MAX_PREFIX_LEVELS = 21
 
 _PERM16 = bitmajor_perm(16)
 
@@ -129,7 +133,7 @@ class PrefixPallasBackend(PallasBackend):
     """Prefix-shared DCF evaluator (lam = 16, shared points).
 
     ``prefix_levels`` picks k (clamped to n-8 and the measured gather
-    cliff at 20); the frontier for each party is built lazily on first
+    cliff at 21); the frontier for each party is built lazily on first
     ``eval_staged(b, ...)`` and cached with the key image.  Multi-key
     bundles stack per-key frontiers and offset the shared prefix
     indices per key (one flat gather); per-key POINT batches have no
@@ -161,9 +165,16 @@ class PrefixPallasBackend(PallasBackend):
     def _k(self) -> int:
         """Effective prefix depth for the on-device bundle: leave at
         least 8 walked levels so the kernel's fori_loop has real work and
-        the t-stash invariant (>= 1 PRG application) always holds."""
-        _, n = self._dims()
-        return max(min(self.prefix_levels, n - 8), 0)
+        the t-stash invariant (>= 1 PRG application) always holds.
+
+        The gather cliff is on TOTAL stacked table rows (K * 2^k >= 2^22
+        is the measured break), so multi-key bundles shrink k by
+        ceil(log2 K); floored at 5 (one lane word of frontier — beyond
+        K = 2^16 keys the stacked table crosses the cliff regardless and
+        the keylanes backend is the right tool)."""
+        k_num, n = self._dims()
+        k_cap = MAX_PREFIX_LEVELS - (k_num - 1).bit_length()
+        return max(min(self.prefix_levels, n - 8, k_cap), 5)
 
     def put_bundle(self, bundle: KeyBundle) -> None:
         if 8 * bundle.n_bytes < self.host_levels + 8:

@@ -140,14 +140,18 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
         # Prefix-shared walk: top-k tree expansion cached per (key, party),
         # per-point frontier gather, n-k walked levels (single key; the
         # config-2 / flagship random-batch shape).  k tracks the batch
-        # size: a frontier deeper than ~log2(M) adds nodes faster than it
-        # removes walk levels (and would be absurd for smoke runs).  With
-        # --mesh the same evaluator runs under shard_map (single key ->
-        # 1xN points mesh).
+        # size: the frontier is untimed key material, so one level past
+        # log2(M) still wins on the eval clock (the measured optimum; a
+        # frontier far deeper would be absurd for smoke runs), capped at
+        # the 2^22-total-row gather cliff — the backend further shrinks k
+        # by ceil(log2 K) for multi-key bundles.  With --mesh the same
+        # evaluator runs under shard_map (single key -> 1xN points mesh).
         import jax
 
+        from dcf_tpu.backends.pallas_prefix import MAX_PREFIX_LEVELS
+
         pts = (getattr(args, "points", 0) or 100_000) if args else 100_000
-        klev = max(6, min(20, pts.bit_length() - 1))
+        klev = max(6, min(MAX_PREFIX_LEVELS, pts.bit_length()))
         interp = jax.devices()[0].platform != "tpu"
         if args is not None and getattr(args, "mesh", ""):
             from dcf_tpu.parallel import ShardedPrefixBackend, make_mesh

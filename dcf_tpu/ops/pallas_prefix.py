@@ -11,8 +11,9 @@ M*n.  (Reference workload: the reference walks every level per point,
 /root/reference/src/lib.rs:163-204, benches/dcf_batch_eval.rs:17-39.)
 
 Measured cost structure on v5e (benchmarks/micro_gather.py): the XLA row
-gather costs ~3.7 ms per 2^20 points at k <= 20 ([2^k, 8]-int32 rows;
-4x cliff above 2^20 nodes, and 2x for non-power-of-2 row widths), and
+gather costs ~3.4-3.7 ms per 2^20 points at k <= 21 ([2^k, 8]-int32
+rows; 4x cliff at 2^22 TOTAL stacked rows — the 128 MB table — and 2x
+for non-power-of-2 row widths), and
 repacking gathered byte rows into the kernel's bit-major plane layout in
 XLA costs ~4.4 ms per table — so the repack runs INSIDE this kernel
 instead as 32x32 bit transposes (5 butterfly steps of static sublane
@@ -138,10 +139,15 @@ def dcf_eval_prefix_pallas(
 
     grid = (k_num, w // wt)
     rows_spec = pl.BlockSpec((1, 4, 32, wt), lambda k, j: (k, 0, 0, j))
+    # Same scoped-vmem headroom as the from-root walk kernel: a multi-key
+    # grid's block buffering exceeds the 16 MB default (measured 28 MB at
+    # K=8, n_rem=110, wt=128).
     return pl.pallas_call(
         partial(_kernel, n_rem=n_rem, interpret=interpret),
         out_shape=jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
         grid=grid,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
         in_specs=[
             pl.BlockSpec((15, 128, 1), lambda k, j: (0, 0, 0)),
             rows_spec,

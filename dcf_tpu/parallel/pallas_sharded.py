@@ -57,6 +57,7 @@ from dcf_tpu.backends.large_lambda import (
 )
 from dcf_tpu.backends.pallas_keylanes import KeyLanesPallasBackend
 from dcf_tpu.backends.pallas_prefix import (
+    MAX_PREFIX_LEVELS,
     PrefixPallasBackend,
     gather_and_walk,
 )
@@ -476,14 +477,14 @@ class ShardedPrefixBackend(PrefixPallasBackend):
     devices gang up on points.  The frontier gather table is key material
     and REPLICATES across point-shards — each device's points index the
     whole 2^k-node frontier, so a sharded table would turn the pure
-    per-point map into an all-gather; at <= 33 MB (k = 20) replication is
+    per-point map into an all-gather; at <= 67 MB (k = 21) replication is
     the right trade.  CW planes replicate likewise; the per-point gather
     + remaining-level walk is then a collective-free map, exactly like
     the from-root ShardedPallasBackend.
     """
 
     def __init__(self, lam: int, cipher_keys: Sequence[bytes], mesh: Mesh,
-                 prefix_levels: int = 20,
+                 prefix_levels: int = MAX_PREFIX_LEVELS,
                  tile_words: int = DEFAULT_TILE_WORDS,
                  interpret: bool = False, host_levels: int = 6):
         super().__init__(lam, cipher_keys, prefix_levels=prefix_levels,
