@@ -232,9 +232,15 @@ def main() -> None:
         if raw <= 0:
             clamped += 1
             log(f"WARNING: sample {i}: measured RTT ({rtt * 1e3:.0f} ms) "
-                "exceeded the whole sample; clamped — treat this sample "
+                "exceeded the whole sample; dropped — treat this sample "
                 "as unreliable")
-        times.append(max(raw, 1e-9) / ITERS)
+            continue  # corrupted sample: disclosed via clamped_samples,
+            # excluded from the headline median/MAD
+        times.append(raw / ITERS)
+    if not times:
+        raise SystemExit(
+            f"all {SAMPLES} samples clamped by the RTT correction; the "
+            "tunnel is too noisy for a meaningful rate — rerun")
     times_a = np.array(times)
     med = float(np.median(times_a))
     mad = float(np.median(np.abs(times_a - med)))
@@ -271,7 +277,7 @@ def main() -> None:
                 "value": round(dev_rate, 1),
                 "unit": (
                     "evals/s (n=128, lam=16B, 1 key x 2^20 points, party 0, "
-                    f"{name} kernel, median of {SAMPLES})"
+                    f"{name} kernel, median of {len(times)}/{SAMPLES})"
                 ),
                 "vs_baseline": round(dev_rate / cpu_rate, 2),
                 "vs_baseline_band": [

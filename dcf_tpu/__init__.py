@@ -7,9 +7,21 @@ AES-256 Hirose PRG, key serialization), redesigned for TPU:
 - ``dcf_tpu.spec`` — pure-Python bit-exact golden model (see the package
   modules' own docstrings for the full map as they land: keys, gen, backends,
   ops, parallel).
+- ``dcf_tpu.errors`` — the typed failure taxonomy (``DcfError`` family) and
+  the ``BackendFallbackWarning`` degradation signal; see ``api``'s
+  fault-tolerance docstring section.
 """
 
-from dcf_tpu.api import Dcf  # noqa: F401
+from dcf_tpu.api import Dcf, reset_backend_health  # noqa: F401
+from dcf_tpu.errors import (  # noqa: F401
+    BackendFallbackWarning,
+    BackendUnavailableError,
+    DcfError,
+    KeyFormatError,
+    NativeBuildError,
+    ShapeError,
+    StaleStateError,
+)
 from dcf_tpu.spec import Bound, CmpFn, ReferenceContractWarning  # noqa: F401
 
 __version__ = "0.1.0"

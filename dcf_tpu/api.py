@@ -63,6 +63,36 @@ and full-domain evaluation ``backends.fulldomain.TreeFullDomain``
 by contrast, IS a facade backend (``backend="keylanes"``, with or
 without a mesh); only the device-keygen half of the config-5 pipeline
 stays constructor-level.
+
+Fault tolerance (the ``dcf_tpu.errors`` taxonomy)
+-------------------------------------------------
+
+Failures surface as typed ``errors.DcfError`` subclasses instead of
+opaque ``RuntimeError``/``struct.error``/XLA tracebacks:
+
+    KeyFormatError           corrupt/truncated/alien DCFK frame (the v2
+                             wire format carries a CRC32 trailer; v1
+                             frames are still read)
+    ShapeError               array shape/dtype contract violations
+    BackendUnavailableError  the auto fallback chain exhausted, or
+                             device/mesh provisioning failed
+    StaleStateError          a staged-points dict outlived the bundle it
+                             was staged against (prefix backend)
+    NativeBuildError         the C++ core failed to build/load after
+                             bounded retries
+
+``backend="auto"`` (single-device) is self-healing: the selected backend
+must first pass a tiny spec-checked canary eval (1 key x 2 points, both
+parties reconstructed bit-exactly against the comparison function).  On
+any canary failure — Mosaic lowering error, broken XLA install, missing
+toolchain — selection degrades pallas -> bitsliced -> jax -> numpy,
+emitting one ``errors.BackendFallbackWarning`` per skipped backend; only
+when the whole chain fails does construction raise
+``BackendUnavailableError``.  Canary verdicts are cached per
+(backend, lam) for the process (``reset_backend_health()`` forgets
+them).  Explicitly named backends stay strict: no canary, no silent
+substitution.  The native keygen core degrades AES-NI -> portable S-box
+the same way (``native.load``), warning instead of crashing.
 """
 
 from __future__ import annotations
@@ -73,6 +103,11 @@ import numpy as np
 
 import warnings
 
+from dcf_tpu.errors import (
+    BackendFallbackWarning,
+    BackendUnavailableError,
+    ShapeError,
+)
 from dcf_tpu.gen import gen_batch, random_s0s
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.prg import HirosePrgNp
@@ -82,7 +117,7 @@ from dcf_tpu.spec import (
     hirose_used_cipher_indices,
 )
 
-__all__ = ["Dcf"]
+__all__ = ["Dcf", "reset_backend_health"]
 
 
 def _default_backend(lam: int) -> str:
@@ -92,10 +127,34 @@ def _default_backend(lam: int) -> str:
 
             if jax.devices()[0].platform == "tpu":  # Mosaic is TPU-only
                 return "pallas"
-        except Exception:
+        except Exception:  # fallback-ok: no usable jax -> host bitsliced
             pass
         return "bitsliced"
     return "hybrid" if lam >= 48 else "bitsliced"
+
+
+# Auto-selection fallback order (fastest first, numpy the always-works
+# floor); _auto_chain starts at the selected backend and appends the
+# remaining tail.  Canary verdicts cache per (backend, lam, opts) for the
+# process so repeated Dcf(...) constructions don't re-run tiny compiles —
+# opts are part of the key because the canary instance is built WITH them,
+# so a verdict for one opts set says nothing about another.
+_FALLBACK_CHAIN = ("pallas", "bitsliced", "jax", "numpy")
+_HEALTHY: set = set()
+_UNHEALTHY: dict = {}  # health key -> first failure; skips re-running a
+# failing canary (seconds of doomed compile) on every construction
+
+
+def reset_backend_health() -> None:
+    """Forget cached canary verdicts (tests; a recovered driver/toolchain)."""
+    _HEALTHY.clear()
+    _UNHEALTHY.clear()
+
+
+class _BackendMisuse(Exception):
+    """Canary-internal marker: the backend constructor rejected its
+    arguments (a programmer error, e.g. a typo'd backend_opts key) —
+    must surface as TypeError, not count as environment ill-health."""
 
 
 class Dcf:
@@ -173,8 +232,14 @@ class Dcf:
                 from dcf_tpu.native import NativeDcf
 
                 self._gen_native = NativeDcf(lam, self.cipher_keys)
-            except Exception:  # no toolchain: numpy keygen still works
+            except Exception:  # fallback-ok: no toolchain -> numpy keygen
                 pass
+        # Self-healing auto selection (single-device): the chosen backend
+        # must pass the canary before it may serve; otherwise degrade down
+        # the chain with a structured warning.  Explicit backend names and
+        # mesh variants stay strict — no silent substitution.
+        if mesh is None and backend == "auto":
+            self.backend_name = self._select_healthy(self.backend_name)
         if self.backend_name == "cpu" and self._gen_native is None:
             raise ValueError("cpu backend needs the native core")
         # One backend slot per party, created lazily on first eval(b, ...):
@@ -185,6 +250,120 @@ class Dcf:
         # never constructs the other party's backend.
         self._eval_backends: dict = {}
         self._shipped_bundle: dict = {}
+
+    def _auto_chain(self, name: str) -> list[str]:
+        """Fallback candidates for auto selection, starting at ``name``."""
+        tail = [c for c in _FALLBACK_CHAIN[1:] if c != name]
+        return [name] + tail
+
+    def _health_key(self, name: str) -> tuple:
+        return (name, self.lam, repr(sorted(self._backend_opts.items())))
+
+    def _canary(self, name: str) -> None:
+        """Prove backend ``name`` end-to-end on a tiny spec-checked eval.
+
+        1 key x 2 points on a 2-byte canary domain: gen through the numpy
+        reference PRG (deterministic seeds), both parties evaluated on a
+        throwaway backend instance, XOR reconstruction compared bit-exactly
+        against ``beta if x < alpha else 0``.  Raises on any failure —
+        compile, lowering, or a silently-wrong result (worse than a crash
+        in a two-party protocol).
+        """
+        lam = self.lam
+        alphas = np.array([[0x80, 0x00]], dtype=np.uint8)
+        betas = (np.arange(lam) % 255 + 1).astype(np.uint8)[None, :]
+        s0s = random_s0s(1, lam, np.random.default_rng(0xDCF))
+        bundle = gen_batch(self._prg, alphas, betas, s0s, Bound.LT_BETA)
+        xs = np.array([[0x00, 0x00], [0xFF, 0x00]], dtype=np.uint8)
+        if name == "numpy":
+            from dcf_tpu.backends.numpy_backend import eval_batch_np
+
+            ys = [eval_batch_np(self._prg, b, bundle.for_party(b), xs)
+                  for b in (0, 1)]
+        else:
+            try:
+                be = self._make_backend(name)
+            except TypeError as e:
+                raise _BackendMisuse(name, e) from e
+            ys = [np.asarray(be.eval(b, xs, bundle.for_party(b)))
+                  for b in (0, 1)]
+        expect = np.stack([betas[0], np.zeros(lam, dtype=np.uint8)])
+        if not np.array_equal((ys[0] ^ ys[1])[0], expect):
+            raise BackendUnavailableError(
+                f"canary spec check failed on backend {name!r}: 2-point "
+                "two-party reconstruction does not match the comparison "
+                "function")
+
+    def _try_candidate(self, cand: str) -> Exception | None:
+        """Run (or recall) the canary for one candidate; returns None on
+        health, the failure otherwise.  Verdicts cache both ways — a
+        failing compile is seconds of doomed work per construction."""
+        key = self._health_key(cand)
+        if key in _HEALTHY:
+            return None
+        if key in _UNHEALTHY:
+            return _UNHEALTHY[key]
+        try:
+            self._canary(cand)
+        except _BackendMisuse:
+            raise  # programmer error: _select_healthy decides, not a verdict
+        except Exception as e:  # fallback-ok: ANY environment failure
+            # (Mosaic lowering, XLA, driver) must degrade to the next
+            # correct backend, not take construction down.
+            e.__traceback__ = None  # don't pin canary frames (throwaway
+            # backend, jit caches) process-wide via the verdict cache
+            _UNHEALTHY[key] = e
+            return e
+        _HEALTHY.add(key)
+        return None
+
+    def _select_healthy(self, name: str) -> str:
+        """First backend in the auto chain that passes the canary."""
+        failures: list[tuple[str, Exception]] = []
+        chosen = None
+        opts_dropped: list | None = None
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", ReferenceContractWarning)
+            for cand in self._auto_chain(name):
+                try:
+                    err = self._try_candidate(cand)
+                except _BackendMisuse as e:
+                    if cand == name:
+                        # The SELECTED backend rejecting its arguments is
+                        # a programmer error — surface it, don't degrade.
+                        raise TypeError(
+                            f"backend_opts {sorted(self._backend_opts)} "
+                            f"are invalid for backend {e.args[0]!r}: "
+                            f"{e.args[1]}") from e.args[1]
+                    # A FALLBACK candidate rejecting opts meant for the
+                    # selected backend is expected (opts are
+                    # backend-specific): degrade without them — the real
+                    # eval backend is built with the same opts, so
+                    # keeping them would just defer the TypeError.
+                    opts_dropped = sorted(self._backend_opts)
+                    self._backend_opts = {}
+                    err = self._try_candidate(cand)
+                if err is not None:
+                    failures.append((cand, err))
+                    continue
+                chosen = cand
+                break
+        # Emitted outside the catch_warnings block so callers see them.
+        if chosen is not None:
+            for cand, e in failures:
+                warnings.warn(BackendFallbackWarning(cand, chosen, e),
+                              stacklevel=3)
+            if opts_dropped:
+                warnings.warn(
+                    f"backend_opts {opts_dropped} were set for {name!r} "
+                    f"and do not apply to fallback backend {chosen!r}; "
+                    "ignored", UserWarning, stacklevel=3)
+            return chosen
+        raise BackendUnavailableError(
+            "auto backend selection exhausted the fallback chain "
+            + " -> ".join(self._auto_chain(name)) + "; causes: "
+            + "; ".join(f"{c}: {type(e).__name__}: {e}"
+                        for c, e in failures))
 
     def _make_backend(self, name: str):
         opts = self._backend_opts
@@ -279,7 +458,7 @@ class Dcf:
         alphas = np.asarray(alphas, dtype=np.uint8)
         betas = np.asarray(betas, dtype=np.uint8)
         if alphas.ndim != 2 or alphas.shape[1] != self.n_bytes:
-            raise ValueError(f"alphas must be [K, {self.n_bytes}]")
+            raise ShapeError(f"alphas must be [K, {self.n_bytes}]")
         if s0s is None:
             s0s = random_s0s(
                 alphas.shape[0], self.lam,

@@ -305,7 +305,7 @@ class LargeLambdaBackend:
 
                 narrow = ("pallas" if interpret
                           or _jax.devices()[0].platform == "tpu" else "xla")
-            except Exception:
+            except Exception:  # fallback-ok: no usable jax -> XLA narrow
                 narrow = "xla"
         if narrow not in ("pallas", "xla"):
             raise ValueError(f"narrow must be pallas/xla/auto, got {narrow}")

@@ -28,6 +28,7 @@ from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes_bitsliced import round_key_masks_bitmajor
 from dcf_tpu.ops.pallas_eval import DEFAULT_TILE_WORDS, dcf_eval_pallas
 from dcf_tpu.spec import hirose_used_cipher_indices
+from dcf_tpu.testing.faults import fire
 from dcf_tpu.utils.bits import (
     alpha_walk_bits,
     bitmajor_perm,
@@ -308,6 +309,7 @@ class PallasBackend:
         """Party ``b`` eval on staged points; returns DEVICE-resident y planes
         (int32 [K, 128, W], bit-major).  Dispatch is async — force completion
         with a fetch.  Use ``eval`` for the bytes-in/bytes-out path."""
+        fire("pallas.lowering")  # fault seam: deterministic Mosaic failure
         dev = self._bundle_dev
         return _eval_staged(
             self.rk, dev["s0"], dev["cw_s"], dev["cw_v"], dev["cw_np1"],
@@ -333,6 +335,7 @@ class PallasBackend:
         Returns uint8 [K, M, lam].  Points are padded internally to whole
         lane-tiles (pad lanes computed and discarded).
         """
+        fire("pallas.lowering")  # fault seam: deterministic Mosaic failure
         if bundle is not None:
             self.put_bundle(bundle)
         xs, m, wt = self._prepare(xs)

@@ -35,11 +35,13 @@ import numpy as np
 
 from dcf_tpu.backends.fulldomain import tree_expand_np
 from dcf_tpu.backends.pallas_backend import PallasBackend, _stage_xs
+from dcf_tpu.errors import StaleStateError
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.pallas_prefix import dcf_eval_prefix_pallas
 from dcf_tpu.ops.pallas_tree import tree_expand_raw
 from dcf_tpu.ops.prg import HirosePrgNp
 from dcf_tpu.spec import ReferenceContractWarning
+from dcf_tpu.testing.faults import fire
 from dcf_tpu.utils.bits import bitmajor_perm, byte_bits_lsb, pack_lanes
 
 __all__ = ["PrefixPallasBackend", "gather_and_walk"]
@@ -258,12 +260,36 @@ class PrefixPallasBackend(PallasBackend):
         xj = jnp.asarray(xs)
         x_mask = _stage_xs(xj)
         return {"x_mask": x_mask, "x_mask_rem": x_mask[:, k:],
-                "idx": _stage_prefix_idx(xj[0], k=k), "m": m, "wt": wt}
+                "idx": _stage_prefix_idx(xj[0], k=k), "m": m, "wt": wt,
+                "k": k, "n": 8 * xs.shape[-1]}
+
+    def _check_staged_fresh(self, staged: dict) -> None:
+        """Reject a staged dict cut for a bundle geometry this backend no
+        longer holds.  The staged arrays are pure functions of (xs, k, n)
+        — idx and x_mask_rem are sliced at the prefix depth k of the
+        bundle shipped at stage() time — so a dict staged against one
+        geometry is still VALID for any later bundle with the same
+        (k, n), including on another party's backend instance (the
+        documented cross-party staging pattern).  What must be rejected
+        is geometry drift: put_bundle changing _k() (key count shifts the
+        gather-cliff cap) or the domain depth pairs new CW slices with
+        masks cut at the old k — at best an opaque Pallas shape error, at
+        worst a silently-wrong share (ADVICE.md finding 3)."""
+        if "idx" not in staged:
+            raise ValueError("staged dict is not from a prefix backend's "
+                             "stage")
+        k_now, n_now = self._k(), self._dims()[1]
+        if staged.get("k") != k_now or staged.get("n") != n_now:
+            raise StaleStateError(
+                f"staged points are stale: staged at prefix depth "
+                f"k={staged.get('k')} over an n={staged.get('n')}-level "
+                f"domain, but the backend now holds a bundle with "
+                f"k={k_now}, n={n_now}; re-stage the points after "
+                "put_bundle")
 
     def eval_staged(self, b: int, staged: dict) -> jax.Array:
-        if "idx" not in staged:
-            raise ValueError("staged dict is not from PrefixPallasBackend"
-                             ".stage")
+        fire("pallas.lowering")  # fault seam: deterministic Mosaic failure
+        self._check_staged_fresh(staged)
         cw_s_r, cw_v_r, cw_t_r = self._cw_rem
         tbl = self._frontier_tables(b)
         return _eval_prefix_staged(

@@ -296,7 +296,9 @@ def _pinned_ratio(nb: int, k: int, rate: float,
     try:
         with open(path) as f:
             pinned = json.load(f)
-    except OSError:
+    except (OSError, ValueError):
+        # ValueError covers json.JSONDecodeError: a corrupt baseline file
+        # omits vs_baseline instead of aborting the whole bench run.
         return {}
     entry, tag = ((pinned, "flagship") if nb == 16 else
                   (pinned.get("shapes", {}).get("n32"), "n32")

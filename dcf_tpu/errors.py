@@ -1,0 +1,77 @@
+"""Typed error taxonomy for the framework's fault-tolerance layer.
+
+Every failure the facade can surface is a ``DcfError`` subclass, so callers
+can catch the whole family (``except DcfError``) or a specific failure mode.
+Each class also inherits the builtin exception the pre-taxonomy code raised
+(``ValueError`` / ``RuntimeError``), so existing ``except ValueError``
+call sites keep working — the taxonomy refines, it does not break.
+
+    DcfError
+      +-- KeyFormatError         (ValueError)  corrupt/truncated/alien DCFK
+      +-- ShapeError             (ValueError)  array shape/dtype contract
+      +-- BackendUnavailableError(RuntimeError) no backend could serve
+      +-- StaleStateError        (RuntimeError) staged state outlived bundle
+      +-- NativeBuildError       (RuntimeError) C++ core build/load failed
+
+Recovery is signalled, not silent: whenever the framework degrades to a
+slower-but-correct path (auto backend fallback, AES-NI -> portable native
+core) it emits a ``BackendFallbackWarning`` carrying what failed, why, and
+what now serves instead.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "DcfError",
+    "KeyFormatError",
+    "ShapeError",
+    "BackendUnavailableError",
+    "StaleStateError",
+    "NativeBuildError",
+    "BackendFallbackWarning",
+]
+
+
+class DcfError(Exception):
+    """Base class of every typed framework error."""
+
+
+class KeyFormatError(DcfError, ValueError):
+    """A serialized key bundle failed validation (bad magic, unsupported
+    version, truncated/oversized frame, CRC mismatch).  The message names
+    the offending field."""
+
+
+class ShapeError(DcfError, ValueError):
+    """An array violated the bundle/batch shape or dtype contract."""
+
+
+class BackendUnavailableError(DcfError, RuntimeError):
+    """No execution backend could serve the request — the auto fallback
+    chain was exhausted, or provisioning (devices/mesh) failed."""
+
+
+class StaleStateError(DcfError, RuntimeError):
+    """Staged device state (a staged-points dict, a cached frontier) was
+    built against a key bundle the backend no longer holds; re-stage."""
+
+
+class NativeBuildError(DcfError, RuntimeError):
+    """The C++ host core failed to build or load (after bounded retries)."""
+
+
+class BackendFallbackWarning(UserWarning):
+    """The framework degraded to a slower-but-correct path.
+
+    Structured: ``failed`` (what was tried), ``fallback`` (what now
+    serves), ``cause`` (the triggering exception, possibly None).
+    """
+
+    def __init__(self, failed: str, fallback: str, cause: BaseException | None = None):
+        self.failed = failed
+        self.fallback = fallback
+        self.cause = cause
+        detail = f" ({type(cause).__name__}: {cause})" if cause is not None else ""
+        super().__init__(
+            f"backend {failed!r} unavailable{detail}; falling back to {fallback!r}"
+        )

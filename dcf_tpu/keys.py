@@ -16,13 +16,13 @@ the HBM upload image for the eval kernels:
 flat framed binary (``DCFK`` magic) that is the documented wire format the
 reference's unused bincode/serde deps gesture at (SURVEY.md §3.5).
 
-DCFK bytes on the wire (frozen; this is also the HBM upload image — the
-device backends consume these exact arrays, reinterpreted, without any
-re-serialization):
+DCFK bytes on the wire (the section layout is frozen; this is also the HBM
+upload image — the device backends consume these exact arrays,
+reinterpreted, without any re-serialization):
 
     offset  size            field
     0       4               magic ``b"DCFK"``
-    4       2               version (uint16 LE, currently 1)
+    4       2               version (uint16 LE, currently 2)
     6       2               P — parties stored (2 full bundle, 1 per-party)
     8       4               K — number of keys (uint32 LE)
     12      4               n — tree depth in bits = 8 * n_bytes (uint32 LE)
@@ -32,23 +32,37 @@ re-serialization):
     ...     K*n*lam         cw_v
     ...     K*n*2           cw_t (tl, tr per level)
     ...     K*lam           cw_np1
+    end-4   4               crc32 (uint32 LE, zlib.crc32 of all prior bytes;
+                            version >= 2 only)
 
-No padding or alignment between sections; total size must match exactly.
+No padding or alignment between sections.  Version 2 (current writer)
+appends the CRC32 integrity trailer; version-1 frames (no trailer) are
+still read for compatibility.  Decoding is strict either way: the header
+is bounds-checked field by field, every section must fit, the total size
+must match exactly, and any violation raises
+``errors.KeyFormatError`` naming the offending field — a two-party FSS
+evaluation over silently-corrupt key material is worse than a crash.
 """
 
 from __future__ import annotations
 
+import math
 import struct
+import zlib
 from dataclasses import dataclass
 
 import numpy as np
 
 from dcf_tpu import spec
+from dcf_tpu.errors import KeyFormatError, ShapeError
 
 __all__ = ["KeyBundle"]
 
 _MAGIC = b"DCFK"
-_VERSION = 1
+_VERSION = 2
+_HEADER = "<HHIIH"  # version, P, K, n, lam (after the 4-byte magic)
+_HEADER_SIZE = 4 + struct.calcsize(_HEADER)
+_CRC_SIZE = 4
 
 
 @dataclass(frozen=True)
@@ -64,18 +78,18 @@ class KeyBundle:
     def __post_init__(self):
         k, n, lam = self.cw_s.shape
         if self.s0s.shape[0] != k or self.s0s.shape[2] != lam:
-            raise ValueError("s0s shape mismatch")
+            raise ShapeError("s0s shape mismatch")
         if self.s0s.shape[1] not in (1, 2):
-            raise ValueError("s0s party dimension must be 1 or 2")
+            raise ShapeError("s0s party dimension must be 1 or 2")
         if self.cw_v.shape != (k, n, lam) or self.cw_t.shape != (k, n, 2):
-            raise ValueError("cw shape mismatch")
+            raise ShapeError("cw shape mismatch")
         if self.cw_np1.shape != (k, lam):
-            raise ValueError("cw_np1 shape mismatch")
+            raise ShapeError("cw_np1 shape mismatch")
         if n % 8 != 0:
-            raise ValueError("n must be a multiple of 8 bits")
+            raise ShapeError("n must be a multiple of 8 bits")
         for a in (self.s0s, self.cw_s, self.cw_v, self.cw_t, self.cw_np1):
             if a.dtype != np.uint8:
-                raise ValueError("all bundle arrays must be uint8")
+                raise ShapeError("all bundle arrays must be uint8")
 
     @property
     def num_keys(self) -> int:
@@ -172,12 +186,12 @@ class KeyBundle:
     # -- codecs -------------------------------------------------------------
 
     def to_bytes(self) -> bytes:
-        """Flat framed binary: header + raw SoA arrays in a fixed order."""
+        """Flat framed binary: header + raw SoA arrays + CRC32 trailer (v2)."""
         k, p = self.s0s.shape[0], self.s0s.shape[1]
         header = _MAGIC + struct.pack(
-            "<HHIIH", _VERSION, p, k, self.n_bits, self.lam
+            _HEADER, _VERSION, p, k, self.n_bits, self.lam
         )
-        return b"".join(
+        body = b"".join(
             [
                 header,
                 self.s0s.tobytes(),
@@ -187,34 +201,83 @@ class KeyBundle:
                 self.cw_np1.tobytes(),
             ]
         )
+        return body + struct.pack("<I", zlib.crc32(body))
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "KeyBundle":
-        if data[:4] != _MAGIC:
-            raise ValueError("not a DCFK key bundle")
-        try:
-            version, p, k, n, lam = struct.unpack_from("<HHIIH", data, 4)
-        except struct.error as e:
-            raise ValueError(f"truncated key bundle header: {e}") from e
-        if version != _VERSION:
-            raise ValueError(f"unsupported key bundle version {version}")
-        off = 4 + struct.calcsize("<HHIIH")
+        """Strict bounds-checked DCFK decode.
+
+        Accepts version 2 (CRC32 trailer, the current writer) and version 1
+        (no trailer, legacy frames).  Rejects truncated, oversized or
+        corrupt frames with ``KeyFormatError`` naming the offending field.
+        """
+        if len(data) < 4 or data[:4] != _MAGIC:
+            raise KeyFormatError(
+                f"bad magic: expected {_MAGIC!r}, got {bytes(data[:4])!r} "
+                "(not a DCFK key bundle)")
+        if len(data) < _HEADER_SIZE:
+            raise KeyFormatError(
+                f"truncated header: frame is {len(data)} bytes, the DCFK "
+                f"header needs {_HEADER_SIZE}")
+        version, p, k, n, lam = struct.unpack_from(_HEADER, data, 4)
+        if version not in (1, _VERSION):
+            raise KeyFormatError(
+                f"unsupported version {version} (this reader handles "
+                f"1..{_VERSION})")
+        if p not in (1, 2):
+            raise KeyFormatError(f"parties field must be 1 or 2, got {p}")
+        if n == 0 or n % 8:
+            raise KeyFormatError(
+                f"n field must be a positive multiple of 8 bits, got {n}")
+        if lam == 0:
+            raise KeyFormatError("lam field must be positive, got 0")
+        sections = (
+            ("s0s", (k, p, lam)),
+            ("cw_s", (k, n, lam)),
+            ("cw_v", (k, n, lam)),
+            ("cw_t", (k, n, 2)),
+            ("cw_np1", (k, lam)),
+        )
+        crc_size = _CRC_SIZE if version >= 2 else 0
+        payload_end = len(data) - crc_size
+        # Bounds-check every section against the frame BEFORE touching the
+        # payload, so a truncated frame names the field where it ran out
+        # instead of surfacing a numpy buffer error (or worse, reading the
+        # CRC trailer as key material).
+        off = _HEADER_SIZE
+        for name, shape in sections:
+            size = math.prod(shape)  # python ints: immune to header-claimed
+            if off + size > payload_end:  # sizes overflowing fixed-width math
+                raise KeyFormatError(
+                    f"truncated frame: section {name!r} needs bytes "
+                    f"[{off}, {off + size}) but the payload ends at "
+                    f"{payload_end} (header claims K={k}, P={p}, n={n}, "
+                    f"lam={lam})")
+            off += size
+        if off != payload_end:
+            raise KeyFormatError(
+                f"oversized frame: {payload_end - off} trailing bytes after "
+                "section 'cw_np1' (corrupt header or concatenated frames)")
+        if crc_size:
+            (crc_stored,) = struct.unpack_from("<I", data, payload_end)
+            # memoryview: hash in place — a bytes slice would transiently
+            # double the footprint of a multi-GB key image.
+            crc_actual = zlib.crc32(memoryview(data)[:payload_end])
+            if crc_stored != crc_actual:
+                raise KeyFormatError(
+                    f"crc32 mismatch: trailer records {crc_stored:#010x}, "
+                    f"frame hashes to {crc_actual:#010x} — key material is "
+                    "corrupt")
+        off = _HEADER_SIZE
 
         def take(shape):
             nonlocal off
-            size = int(np.prod(shape))
+            size = math.prod(shape)
             arr = np.frombuffer(data, dtype=np.uint8, count=size, offset=off)
             off += size
             return arr.reshape(shape).copy()
 
-        s0s = take((k, p, lam))
-        cw_s = take((k, n, lam))
-        cw_v = take((k, n, lam))
-        cw_t = take((k, n, 2))
-        cw_np1 = take((k, lam))
-        if off != len(data):
-            raise ValueError("trailing bytes in key bundle")
-        return cls(s0s, cw_s, cw_v, cw_t, cw_np1)
+        return cls(*(take(shape) for _, shape in sections))
 
     def save(self, path: str) -> None:
         if path.endswith(".npz"):

@@ -12,43 +12,100 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import warnings
 from typing import Sequence
 
 import numpy as np
 
+from dcf_tpu.errors import (
+    BackendFallbackWarning,
+    BackendUnavailableError,
+    NativeBuildError,
+)
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.spec import Bound, hirose_used_cipher_indices
+from dcf_tpu.testing.faults import InjectedFault, fire
 
 __all__ = ["NativeDcf", "build", "load"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIBS: dict = {}  # portable-flag -> loaded CDLL (each variant opened once)
+_FAILED: set = set()  # portable-flags whose build/load failed this process:
+# without this negative cache every Dcf() on a toolchain-less host would
+# re-spawn up to 4 failing `make` subprocesses and re-warn.
+_BUILD_ATTEMPTS = 2  # bounded retry: transient toolchain hiccups, not loops
 
 
 def build(portable: bool = False) -> str:
-    """Compile the shared library if needed; returns its path."""
+    """Compile the shared library if needed; returns its path.
+
+    ``make`` is retried once (a transient failure — interrupted parallel
+    build, filesystem race — should not take the native core down); a
+    persistent failure raises ``NativeBuildError`` with the captured
+    stderr.  Fault seam: ``faults.fire("native.build", portable)``.
+    """
     target = "libdcf_portable.so" if portable else "libdcf.so"
     path = os.path.join(_DIR, target)
     src = os.path.join(_DIR, "dcf_core.cpp")
-    if not os.path.exists(path) or os.path.getmtime(path) < os.path.getmtime(src):
-        proc = subprocess.run(
-            ["make", "-C", _DIR, target], capture_output=True, text=True
-        )
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"native build failed (exit {proc.returncode}):\n{proc.stderr}"
+    rc, err = 0, ""
+    for _attempt in range(_BUILD_ATTEMPTS):
+        try:
+            fire("native.build", portable)
+            if os.path.exists(path) \
+                    and os.path.getmtime(path) >= os.path.getmtime(src):
+                return path
+            proc = subprocess.run(
+                ["make", "-C", _DIR, target], capture_output=True, text=True
             )
-    return path
+            rc, err = proc.returncode, proc.stderr
+        except (OSError, InjectedFault) as e:  # make/fs missing or injected
+            rc, err = -1, f"{type(e).__name__}: {e}"
+        if rc == 0 and os.path.exists(path):
+            return path
+    raise NativeBuildError(
+        f"native build of {target} failed after {_BUILD_ATTEMPTS} attempts "
+        f"(exit {rc}):\n{err}"
+    )
 
 
 def load(portable: bool = False) -> ctypes.CDLL:
+    """Load (building if needed) the native core.
+
+    The AES-NI build degrades to the portable S-box build on any
+    build/load failure (bit-exact either way, slower cipher), with a
+    ``BackendFallbackWarning``; a portable failure is final and raises
+    ``NativeBuildError``/``BackendUnavailableError``.  Fault seam:
+    ``faults.fire("native.load", portable)``.
+    """
     lib = _LIBS.get(portable)
-    if lib is None:
-        lib = ctypes.CDLL(build(portable))
-        lib.dcf_prg_sizeof.restype = ctypes.c_uint32
-        lib.dcf_has_aesni.restype = ctypes.c_int
-        lib.dcf_prg_init.restype = ctypes.c_int
-        _LIBS[portable] = lib
+    if lib is not None:
+        return lib
+    if portable in _FAILED:  # negative cache: warned once already
+        if not portable:
+            return load(portable=True)
+        raise NativeBuildError(
+            "portable native core unavailable (cached verdict from an "
+            "earlier failure this process; see the prior warning)")
+    try:
+        path = build(portable)
+        fire("native.load", portable)
+        lib = ctypes.CDLL(path)
+    except (NativeBuildError, OSError, InjectedFault) as e:
+        _FAILED.add(portable)
+        if not portable:
+            warnings.warn(
+                BackendFallbackWarning("native (AES-NI)",
+                                       "native (portable S-box)", e),
+                stacklevel=2)
+            return load(portable=True)
+        if isinstance(e, NativeBuildError):
+            raise
+        raise BackendUnavailableError(
+            f"portable native core failed to load: {e}") from e
+    lib.dcf_prg_sizeof.restype = ctypes.c_uint32
+    lib.dcf_has_aesni.restype = ctypes.c_int
+    lib.dcf_prg_init.restype = ctypes.c_int
+    _LIBS[portable] = lib
     return lib
 
 

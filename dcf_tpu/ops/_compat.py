@@ -1,0 +1,16 @@
+"""jax version-skew shims for the Pallas kernels.
+
+``pallas.tpu`` renamed ``TPUCompilerParams`` -> ``CompilerParams``;
+resolve whichever this jax ships so the kernels survive version skew
+instead of dying on AttributeError (the sharded-layer counterpart lives
+in ``dcf_tpu.parallel._compat``).
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["CompilerParams"]
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams

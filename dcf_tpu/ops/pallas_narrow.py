@@ -32,7 +32,9 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+from dcf_tpu.ops._compat import CompilerParams as _CompilerParams
 
 from dcf_tpu.ops.aes_bitsliced import (
     aes256_encrypt_planes_bitmajor,
@@ -145,7 +147,7 @@ def dcf_narrow_walk_pallas(
     # the default 16MB scoped-vmem budget even though each grid step's
     # blocks are tiny; raise the limit toward the chip's physical VMEM.
     params = (dict() if interpret else dict(
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024)))
     return pl.pallas_call(
         partial(_kernel, b=b, n=n, interpret=interpret),

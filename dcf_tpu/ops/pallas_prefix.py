@@ -41,7 +41,9 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+from dcf_tpu.ops._compat import CompilerParams as _CompilerParams
 
 from dcf_tpu.ops.pallas_eval import DEFAULT_TILE_WORDS, make_aes, walk_levels
 
@@ -146,7 +148,7 @@ def dcf_eval_prefix_pallas(
         partial(_kernel, n_rem=n_rem, interpret=interpret),
         out_shape=jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
         grid=grid,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024),
         in_specs=[
             pl.BlockSpec((15, 128, 1), lambda k, j: (0, 0, 0)),

@@ -1,0 +1,32 @@
+"""jax version-skew shims for the sharded backends.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the ``jax``
+namespace, and its replication-check kwarg was renamed ``check_rep`` ->
+``check_vma`` along the way.  The sharded backends are written against
+the current API; this shim keeps them importable and runnable on older
+jax instead of dying on ``AttributeError``/``TypeError`` — the same
+degrade-don't-crash rule the rest of the fault-tolerance layer follows.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+_sm = getattr(jax, "shard_map", None)
+if _sm is None:  # pre-move jax: the experimental location
+    from jax.experimental.shard_map import shard_map as _sm
+
+_CHECK_KW = (
+    "check_vma" if "check_vma" in inspect.signature(_sm).parameters
+    else "check_rep"
+)
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the kwarg spelling this jax understands."""
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               **{_CHECK_KW: check_vma})

@@ -17,9 +17,12 @@ from dcf_tpu.backends.jax_bitsliced import (
     bundle_plane_arrays,
 )
 from dcf_tpu.backends._common import prepare_batch
+from dcf_tpu.parallel._compat import shard_map
+from dcf_tpu.errors import BackendUnavailableError
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes import expand_key_np
 from dcf_tpu.spec import hirose_used_cipher_indices
+from dcf_tpu.testing.faults import fire
 
 __all__ = ["make_mesh", "ShardedJaxBackend", "ShardedBitslicedBackend"]
 
@@ -36,8 +39,18 @@ def make_mesh(
     ``--mesh``).  Without it, the keys axis gets the larger factor: key
     sharding is what divides the HBM-resident key image, while point
     sharding only divides transient state.
+
+    Device enumeration failure (no runtime, dead TPU driver) raises a
+    typed ``BackendUnavailableError`` instead of an opaque runtime
+    traceback.  Fault seam: ``faults.fire("mesh.provision")``.
     """
-    devs = jax.devices()
+    try:
+        fire("mesh.provision")
+        devs = jax.devices()
+    except Exception as e:  # fallback-ok: typed re-raise, any runtime error
+        raise BackendUnavailableError(
+            f"mesh provisioning failed: could not enumerate devices "
+            f"({type(e).__name__}: {e})") from e
     if shape is not None:
         keys_dim, points = shape
         if n_devices is not None and keys_dim * points != n_devices:
@@ -91,7 +104,7 @@ class ShardedJaxBackend:
         # varying after level 1) buys nothing: check_vma=False.
         self._fn = {
             (b, shared): jax.jit(
-                jax.shard_map(
+                shard_map(
                     partial(eval_core, b=b, lam=lam),
                     mesh=mesh,
                     in_specs=(
@@ -192,7 +205,7 @@ class ShardedBitslicedBackend(_BitslicedBase):
         )
         self._fn = {
             (b, shared): jax.jit(
-                jax.shard_map(
+                shard_map(
                     partial(_eval_bytes, b=b, lam=lam),
                     mesh=mesh,
                     in_specs=(

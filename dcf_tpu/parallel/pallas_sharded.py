@@ -45,6 +45,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dcf_tpu.backends._common import prepare_batch
+from dcf_tpu.parallel._compat import shard_map
 from dcf_tpu.backends.pallas_backend import (
     PallasBackend,
     _from_planes_jit,
@@ -99,7 +100,7 @@ class ShardedPallasBackend(PallasBackend):
         fn = self._fns.get(key)
         if fn is None:
             fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     partial(dcf_eval_pallas, b=b, tile_words=wt,
                             interpret=self.interpret),
                     mesh=self.mesh,
@@ -263,7 +264,7 @@ class ShardedTreeFullDomain(TreeFullDomain):
                 ys[0], ys[1], beta_mask, inside).reshape(1, 1)
 
         fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 shard, mesh=self.mesh,
                 in_specs=(P(), P(), P(), P(), P(),
                           *([self._spec_nodes] * 6), P(), P()),
@@ -377,7 +378,7 @@ class ShardedLargeLambdaBackend(LargeLambdaBackend):
                     interpret=interp)
 
             fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     shard, mesh=self.mesh,
                     in_specs=(P(), *([self._spec_keyed] * 9), P(),
                               self._spec_keyed, self._spec_keyed,
@@ -442,7 +443,7 @@ class ShardedKeyLanesBackend(KeyLanesPallasBackend):
         fn = self._fns.get(int(b))
         if fn is None:
             fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     partial(dcf_eval_keylanes_pallas, b=int(b),
                             m_tile=self.m_tile, kw_tile=self.kw_tile,
                             level_chunk=self.level_chunk,
@@ -534,9 +535,7 @@ class ShardedPrefixBackend(PrefixPallasBackend):
         return staged
 
     def eval_staged(self, b: int, staged: dict) -> jax.Array:
-        if "idx" not in staged:
-            raise ValueError("staged dict is not from a prefix backend's "
-                             "stage")
+        self._check_staged_fresh(staged)  # StaleStateError on old bundles
         wt = staged["wt"]
         # Multi-key bundles ride the SAME mesh contract (keys axis 1 ->
         # every device walks all K keys on its point shard); k_num and
@@ -547,7 +546,7 @@ class ShardedPrefixBackend(PrefixPallasBackend):
         fn = self._sfns.get((wt, k_num, fsize))
         if fn is None:
             fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     partial(gather_and_walk, tile_words=wt,
                             interpret=self.interpret,
                             k_num=k_num, frontier_size=fsize),

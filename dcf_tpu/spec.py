@@ -28,6 +28,7 @@ Everything here operates on ``bytes`` and Python ints; no numpy, no JAX.
 from __future__ import annotations
 
 import os
+import sys
 import warnings
 from dataclasses import dataclass
 from enum import Enum
@@ -170,7 +171,15 @@ class ReferenceContractWarning(UserWarning):
 # Warning attribution skips package-internal frames so every API edge
 # (facade, backend constructors, the PRG classes) points the user at THEIR
 # call site, and warning dedup keys on distinct user locations.
+# ``skip_file_prefixes`` is Python 3.12+; on older interpreters the warning
+# still fires, just attributed to the immediate caller (stacklevel=2) —
+# passing the kwarg unconditionally made every extension-band shape CRASH
+# with TypeError on 3.10/3.11 instead of warning.
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_WARN_KWARGS = (
+    {"skip_file_prefixes": (_PKG_DIR,)}
+    if sys.version_info >= (3, 12) else {}
+)
 
 
 def hirose_used_cipher_indices(
@@ -203,7 +212,7 @@ def hirose_used_cipher_indices(
             "(src/prg.rs:17-18,51); this framework runs it as an extension",
             ReferenceContractWarning,
             stacklevel=2,
-            skip_file_prefixes=(_PKG_DIR,),
+            **_WARN_KWARGS,
         )
     elif num_keys < 2 * (lam // 16):
         idx = "/".join(str(i) for i in used)
@@ -214,7 +223,7 @@ def hirose_used_cipher_indices(
             f"({idx}) affect outputs, which are unchanged",
             ReferenceContractWarning,
             stacklevel=2,
-            skip_file_prefixes=(_PKG_DIR,),
+            **_WARN_KWARGS,
         )
     return used
 

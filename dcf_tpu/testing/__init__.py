@@ -1,0 +1,5 @@
+"""Deterministic test instrumentation (fault injection).  Not part of the
+serving API surface; production code paths only touch ``faults.fire``,
+which is a dict lookup returning immediately when nothing is armed."""
+
+from dcf_tpu.testing import faults  # noqa: F401

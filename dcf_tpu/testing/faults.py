@@ -1,0 +1,121 @@
+"""Fault-injection harness: named failure points, armed per-test.
+
+Production seams call ``fire(point, *args)`` at the exact spot where the
+real failure would surface (a ``make`` exit != 0, a ``CDLL`` load error, a
+Mosaic lowering exception, a device-provisioning error).  Unarmed, ``fire``
+is a dict lookup and a return — zero cost on the serving path.  Armed via
+the ``inject`` context manager, it runs the test's handler, which raises —
+so every fallback edge and every typed error in ``dcf_tpu.errors`` can be
+exercised deterministically under ``JAX_PLATFORMS=cpu``, no real toolchain
+or accelerator failure required.
+
+    from dcf_tpu.testing import faults
+
+    with faults.inject("pallas.lowering"):
+        Dcf(16, 16, keys, backend="auto")   # canary fails -> bitsliced
+
+Handlers receive ``fire``'s positional args (e.g. the ``portable`` flag at
+the native seams) and may raise conditionally:
+
+    with faults.inject("native.build",
+                       handler=faults.fail_unless(lambda portable: portable)):
+        native.load()                        # AES-NI build fails, portable OK
+
+``corrupt`` is the canonical DCFK byte-mutation helper for key-ingestion
+tests (flip one byte, let the CRC catch it).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Callable
+
+__all__ = [
+    "POINTS",
+    "InjectedFault",
+    "fire",
+    "is_armed",
+    "inject",
+    "fail_unless",
+    "corrupt",
+]
+
+
+class InjectedFault(Exception):
+    """The default exception raised by an armed fault point."""
+
+
+#: The named seams production code exposes.  ``inject`` rejects unknown
+#: names so a typo in a test fails loudly instead of silently not arming.
+POINTS = (
+    "native.build",     # make exit != 0            (native/__init__.build)
+    "native.load",      # ctypes.CDLL load failure  (native/__init__.load)
+    "pallas.lowering",  # Mosaic compile/lowering   (pallas backends' eval)
+    "mesh.provision",   # device/mesh provisioning  (parallel.mesh.make_mesh)
+)
+
+_ACTIVE: dict[str, Callable] = {}
+
+
+def fire(point: str, *args) -> None:
+    """Production seam: run the armed handler for ``point``, if any."""
+    handler = _ACTIVE.get(point)
+    if handler is not None:
+        handler(*args)
+
+
+def is_armed(point: str) -> bool:
+    return point in _ACTIVE
+
+
+def fail_unless(ok: Callable[..., bool],
+                exc: BaseException | None = None) -> Callable:
+    """Handler factory: raise unless ``ok(*fire_args)`` is true."""
+
+    def handler(*args):
+        if not ok(*args):
+            raise exc if exc is not None else InjectedFault(
+                f"injected fault (args={args!r})")
+
+    return handler
+
+
+@contextmanager
+def inject(point: str, exc: BaseException | None = None,
+           handler: Callable | None = None):
+    """Arm ``point`` for the duration of the block.
+
+    Default behaviour raises ``InjectedFault`` (or ``exc``) on every fire;
+    pass ``handler`` for conditional failures.  Nested injections restore
+    the previous handler on exit.
+    """
+    if point not in POINTS:
+        raise ValueError(
+            f"unknown fault point {point!r}; known points: {POINTS}")
+    if handler is None:
+        e = exc if exc is not None else InjectedFault(
+            f"injected fault at {point!r}")
+
+        def handler(*_args):
+            raise e
+
+    prev = _ACTIVE.get(point)
+    _ACTIVE[point] = handler
+    try:
+        yield
+    finally:
+        if prev is None:
+            _ACTIVE.pop(point, None)
+        else:
+            _ACTIVE[point] = prev
+
+
+def corrupt(data: bytes, offset: int, xor: int = 0x01) -> bytes:
+    """Flip bit(s) of one byte — the canonical DCFK corruption mutator."""
+    if not 0 <= offset < len(data):
+        raise ValueError(f"offset {offset} outside frame of {len(data)} bytes")
+    if not 1 <= xor <= 0xFF:
+        raise ValueError("xor must flip at least one bit (1..255)")
+    buf = bytearray(data)
+    buf[offset] ^= xor
+    return bytes(buf)

@@ -1,0 +1,351 @@
+"""Fault-injection suite: every typed error and every fallback edge.
+
+Exercises the ``dcf_tpu.errors`` taxonomy deterministically under
+``JAX_PLATFORMS=cpu`` via the ``dcf_tpu.testing.faults`` seams — no real
+toolchain breakage, dead accelerator, or corrupted artifact required:
+
+* DCFK ingestion: truncated / wrong-magic / bad-version / bit-flipped
+  (CRC) / oversized frames each rejected with ``KeyFormatError`` naming
+  the offending field; v1 frames still read.
+* Auto backend selection: a forced Pallas failure degrades to bitsliced
+  with a ``BackendFallbackWarning`` and bit-exact spec parity.
+* Staged-prefix staleness: a staged dict that outlives its bundle raises
+  ``StaleStateError`` instead of an opaque Pallas shape error.
+* Native core: build exit != 0 and CDLL load failure degrade AES-NI ->
+  portable (warned); persistent failure raises ``NativeBuildError``.
+* Mesh provisioning failure raises ``BackendUnavailableError``.
+* The exception-hygiene static gate (tools/check_exception_hygiene.py).
+"""
+
+import struct
+import subprocess
+import sys
+import warnings
+import zlib
+
+import numpy as np
+import pytest
+
+from dcf_tpu import errors, spec
+from dcf_tpu.gen import gen_batch, random_s0s
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.testing import faults
+
+pytestmark = pytest.mark.faults
+
+KEYS = [bytes(range(32)), bytes(range(1, 33))]
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    prg = HirosePrgNp(16, KEYS)
+    rng = np.random.default_rng(7)
+    alphas = rng.integers(0, 256, (2, 2), dtype=np.uint8)
+    betas = rng.integers(0, 256, (2, 16), dtype=np.uint8)
+    return gen_batch(prg, alphas, betas, random_s0s(2, 16, rng),
+                     spec.Bound.LT_BETA)
+
+
+# -- taxonomy ---------------------------------------------------------------
+
+
+def test_error_taxonomy():
+    """Every typed error is a DcfError AND the builtin its pre-taxonomy
+    call sites raised, so old `except ValueError` handlers keep working."""
+    for cls in (errors.KeyFormatError, errors.ShapeError):
+        assert issubclass(cls, errors.DcfError)
+        assert issubclass(cls, ValueError)
+    for cls in (errors.BackendUnavailableError, errors.StaleStateError,
+                errors.NativeBuildError):
+        assert issubclass(cls, errors.DcfError)
+        assert issubclass(cls, RuntimeError)
+    w = errors.BackendFallbackWarning("a", "b", OSError("x"))
+    assert w.failed == "a" and w.fallback == "b"
+    assert "falling back" in str(w)
+
+
+def test_facade_shape_error(bundle):
+    from dcf_tpu import Dcf
+
+    dcf = Dcf(2, 16, KEYS, backend="numpy")
+    with pytest.raises(errors.ShapeError, match="alphas"):
+        dcf.gen(np.zeros((1, 3), dtype=np.uint8),
+                np.zeros((1, 16), dtype=np.uint8))
+
+
+# -- DCFK ingestion ---------------------------------------------------------
+
+
+def test_dcfk_roundtrip_and_v1_compat(bundle):
+    data = bundle.to_bytes()
+    rt = KeyBundle.from_bytes(data)
+    for name in ("s0s", "cw_s", "cw_v", "cw_t", "cw_np1"):
+        assert np.array_equal(getattr(rt, name), getattr(bundle, name))
+    # v1 frame: no CRC trailer, version field 1 — still readable.
+    v1 = bytearray(data[:-4])
+    struct.pack_into("<H", v1, 4, 1)
+    rt1 = KeyBundle.from_bytes(bytes(v1))
+    assert np.array_equal(rt1.cw_np1, bundle.cw_np1)
+
+
+def _oversized(data: bytes) -> bytes:
+    # Junk between the last section and the trailer, CRC recomputed so the
+    # size check (not the CRC) is what must catch it.
+    body = data[:-4] + b"\x00\x00"
+    return body + struct.pack("<I", zlib.crc32(body))
+
+
+@pytest.mark.parametrize(
+    "mutate, field",
+    [
+        (lambda d: b"XXXK" + d[4:], "magic"),
+        (lambda d: d[:12], "header"),
+        (lambda d: faults.corrupt(d, 4, 0x7F), "version"),
+        (lambda d: d[: len(d) // 2], "truncated frame"),
+        (lambda d: faults.corrupt(d, 40), "crc32"),  # payload bit flip
+        (lambda d: faults.corrupt(d, len(d) - 1), "crc32"),  # trailer flip
+        (_oversized, "oversized"),
+    ],
+    ids=["magic", "header", "version", "truncated", "payload-flip",
+         "trailer-flip", "oversized"],
+)
+def test_dcfk_corruption_rejected(bundle, mutate, field):
+    data = bundle.to_bytes()
+    with pytest.raises(errors.KeyFormatError, match=field):
+        KeyBundle.from_bytes(mutate(data))
+
+
+def test_dcfk_truncation_names_section(bundle):
+    """A frame cut mid-payload names the section where it ran out."""
+    data = bundle.to_bytes()
+    with pytest.raises(errors.KeyFormatError, match="cw_np1"):
+        KeyBundle.from_bytes(data[:-24])  # inside the last section
+
+
+# -- auto backend fallback chain --------------------------------------------
+
+
+def test_canary_fallback_pallas_to_bitsliced(monkeypatch):
+    """Forced Pallas failure at Dcf(backend='auto') degrades to bitsliced
+    with a structured warning, and the fallen-back facade is bit-exact
+    against the spec."""
+    import dcf_tpu.api as api
+    from dcf_tpu import Dcf
+
+    monkeypatch.setattr(api, "_default_backend", lambda lam: "pallas")
+    api.reset_backend_health()
+    with faults.inject("pallas.lowering"):
+        with pytest.warns(errors.BackendFallbackWarning) as rec:
+            dcf = Dcf(2, 16, KEYS, backend="auto")
+    assert dcf.backend_name == "bitsliced"
+    fb = [r.message for r in rec
+          if isinstance(r.message, errors.BackendFallbackWarning)]
+    assert fb and fb[0].failed == "pallas" and fb[0].fallback == "bitsliced"
+    assert isinstance(fb[0].cause, faults.InjectedFault)
+    # Spec parity through the degraded facade.
+    rng = np.random.default_rng(9)
+    alphas = rng.integers(0, 256, (1, 2), dtype=np.uint8)
+    betas = rng.integers(0, 256, (1, 16), dtype=np.uint8)
+    kb = dcf.gen(alphas, betas, rng=rng)
+    xs = rng.integers(0, 256, (6, 2), dtype=np.uint8)
+    xs[0] = alphas[0]
+    recon = dcf.eval(0, kb, xs) ^ dcf.eval(1, kb, xs)
+    a = alphas[0].tobytes()
+    for j in range(6):
+        want = betas[0].tobytes() if xs[j].tobytes() < a else bytes(16)
+        assert recon[0, j].tobytes() == want
+
+
+def test_canary_verdict_cached(monkeypatch):
+    """A passed canary is cached per (backend, lam): the second auto
+    construction must not re-run it (no second fallback warning storm)."""
+    import dcf_tpu.api as api
+    from dcf_tpu import Dcf
+
+    dcf0 = Dcf(2, 16, KEYS, backend="auto")
+    assert dcf0.backend_name == "bitsliced"
+    assert dcf0._health_key("bitsliced") in api._HEALTHY
+    canary_calls = []
+    monkeypatch.setattr(
+        Dcf, "_canary",
+        lambda self, name: canary_calls.append(name))
+    assert Dcf(2, 16, KEYS, backend="auto").backend_name == "bitsliced"
+    assert canary_calls == []
+
+
+def test_explicit_backend_no_canary():
+    """Explicitly named backends stay strict: no canary, and a Pallas
+    failure surfaces instead of silently substituting a backend."""
+    from dcf_tpu import Dcf
+    from dcf_tpu.backends.pallas_backend import PallasBackend
+
+    with faults.inject("pallas.lowering"):
+        dcf = Dcf(2, 16, KEYS, backend="pallas")  # construction is lazy
+        assert dcf.backend_name == "pallas"
+        be = PallasBackend(16, KEYS)
+        with pytest.raises(faults.InjectedFault):
+            be.eval(0, np.zeros((2, 2), dtype=np.uint8))
+
+
+# -- staged-prefix staleness -------------------------------------------------
+
+
+def test_stale_prefix_staged_dict(bundle):
+    """A staged dict cut at prefix depth k over an n-level domain must be
+    rejected once put_bundle ships a bundle with different geometry
+    (ADVICE.md finding 3) — BEFORE any kernel dispatch can hit an opaque
+    shape error.  A new bundle with the SAME (k, n) keeps old staged
+    dicts valid (they are pure functions of xs, k and n)."""
+    from dcf_tpu.backends.pallas_prefix import PrefixPallasBackend
+
+    rng = np.random.default_rng(11)
+    one_key = KeyBundle(
+        s0s=bundle.s0s[:1], cw_s=bundle.cw_s[:1], cw_v=bundle.cw_v[:1],
+        cw_t=bundle.cw_t[:1], cw_np1=bundle.cw_np1[:1])
+    be = PrefixPallasBackend(16, KEYS, interpret=True, tile_words=2)
+    be.put_bundle(one_key.for_party(0))
+    xs = rng.integers(0, 256, (32, 2), dtype=np.uint8)
+    staged = be.stage(xs)
+    assert staged["k"] == be._k() and staged["n"] == 16
+    # Same geometry, more keys: staged dict stays valid (k unchanged).
+    be.put_bundle(bundle.for_party(0))
+    be._check_staged_fresh(staged)  # must not raise
+    # Geometry drift: a deeper domain changes _k() (8 -> 16 here); the
+    # old dict's idx/x_mask_rem were cut at k=8 and must be rejected.
+    prg = HirosePrgNp(16, KEYS)
+    alphas3 = rng.integers(0, 256, (1, 3), dtype=np.uint8)
+    betas3 = rng.integers(0, 256, (1, 16), dtype=np.uint8)
+    deep = gen_batch(prg, alphas3, betas3, random_s0s(1, 16, rng),
+                     spec.Bound.LT_BETA)
+    be.put_bundle(deep.for_party(0))
+    with pytest.raises(errors.StaleStateError, match="k=8"):
+        be.eval_staged(0, staged)
+    # Re-staging against the live bundle passes the freshness check
+    # (kernel-level parity of the staged path is test_prefix.py's job).
+    staged2 = be.stage(rng.integers(0, 256, (32, 3), dtype=np.uint8))
+    assert staged2["k"] == 16 and staged2["n"] == 24
+    be._check_staged_fresh(staged2)  # must not raise
+
+
+def test_prefix_cross_instance_staging_still_works(bundle):
+    """The party-0/party-1 pattern (stage once, eval on both parties'
+    backends) stays valid — even when the instances' put_bundle counts
+    differ — because freshness is geometry, not instance history."""
+    from dcf_tpu.backends.pallas_prefix import PrefixPallasBackend
+
+    one_key = KeyBundle(
+        s0s=bundle.s0s[:1], cw_s=bundle.cw_s[:1], cw_v=bundle.cw_v[:1],
+        cw_t=bundle.cw_t[:1], cw_np1=bundle.cw_np1[:1])
+    rng = np.random.default_rng(12)
+    bes = {}
+    for b in (0, 1):
+        bes[b] = PrefixPallasBackend(16, KEYS, interpret=True, tile_words=2)
+        bes[b].put_bundle(one_key.for_party(b))
+    bes[0].put_bundle(one_key.for_party(0))  # asymmetric ship counts
+    staged = bes[0].stage(rng.integers(0, 256, (32, 2), dtype=np.uint8))
+    bes[1]._check_staged_fresh(staged)  # must not raise
+
+
+# -- native core fallback ----------------------------------------------------
+
+
+def test_native_build_failure_raises_typed():
+    from dcf_tpu import native
+
+    with faults.inject("native.build"):
+        with pytest.raises(errors.NativeBuildError, match="2 attempts"):
+            native.build(portable=False)
+
+
+def test_native_build_failure_falls_back_portable(monkeypatch):
+    from dcf_tpu import native
+
+    monkeypatch.setattr(native, "_LIBS", {})
+    monkeypatch.setattr(native, "_FAILED", set())
+    aesni_only = faults.fail_unless(lambda portable: portable)
+    with faults.inject("native.build", handler=aesni_only):
+        with pytest.warns(errors.BackendFallbackWarning, match="portable"):
+            lib = native.load(portable=False)
+    assert lib is native._LIBS[True]  # the portable core now serves
+    assert False not in native._LIBS  # not cached as the AES-NI build
+    # Negative cache: the next load(False) goes straight to portable —
+    # no second warning storm, no re-spawned make subprocesses.
+    assert False in native._FAILED
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", errors.BackendFallbackWarning)
+        assert native.load(portable=False) is lib
+
+
+def test_native_cdll_failure_falls_back_portable(monkeypatch):
+    from dcf_tpu import native
+
+    monkeypatch.setattr(native, "_LIBS", {})
+    monkeypatch.setattr(native, "_FAILED", set())
+    aesni_only = faults.fail_unless(lambda portable: portable)
+    with faults.inject("native.load", handler=aesni_only):
+        with pytest.warns(errors.BackendFallbackWarning, match="portable"):
+            lib = native.load(portable=False)
+    assert lib is native._LIBS[True]
+    assert lib.dcf_prg_sizeof() > 0  # the degraded core is live
+
+
+def test_native_portable_failure_is_final(monkeypatch):
+    from dcf_tpu import native
+
+    monkeypatch.setattr(native, "_LIBS", {})
+    monkeypatch.setattr(native, "_FAILED", set())
+    with faults.inject("native.load"):
+        with pytest.warns(errors.BackendFallbackWarning):
+            with pytest.raises(
+                    (errors.NativeBuildError,
+                     errors.BackendUnavailableError)):
+                native.load(portable=False)
+
+
+# -- mesh provisioning -------------------------------------------------------
+
+
+def test_mesh_provision_failure_typed():
+    from dcf_tpu.parallel import make_mesh
+
+    with faults.inject("mesh.provision",
+                       exc=RuntimeError("TPU driver gone")):
+        with pytest.raises(errors.BackendUnavailableError,
+                           match="mesh provisioning failed"):
+            make_mesh(8)
+
+
+# -- harness hygiene ---------------------------------------------------------
+
+
+def test_inject_rejects_unknown_point():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        with faults.inject("no.such.seam"):
+            pass
+
+
+def test_fire_is_noop_when_unarmed():
+    faults.fire("pallas.lowering")  # must not raise
+    assert not faults.is_armed("pallas.lowering")
+
+
+def test_corrupt_helper_bounds(bundle):
+    data = bundle.to_bytes()
+    assert faults.corrupt(data, 0) != data
+    with pytest.raises(ValueError):
+        faults.corrupt(data, len(data))
+    with pytest.raises(ValueError):
+        faults.corrupt(data, 0, 0)
+
+
+def test_exception_hygiene_gate():
+    """No blanket handlers in dcf_tpu/ outside marked fallback sites."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools",
+                                      "check_exception_hygiene.py")],
+        capture_output=True, text=True, cwd=root)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

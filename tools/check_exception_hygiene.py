@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Static check: no blanket exception handlers in dcf_tpu/ outside the
+fallback chain.
+
+A blanket handler is a bare ``except:`` or an ``except Exception`` (alone
+or in a tuple).  Swallowing arbitrary failures is how a two-party FSS
+deployment ends up serving silently-wrong shares; the only legitimate
+sites are the fallback chain itself (auto backend canary, native
+portable degradation, TPU-presence probes), and each of those must carry
+a ``# fallback-ok: <reason>`` marker on the ``except`` line so the
+allowance is visible in the diff that introduces it.
+
+Exit 0 when clean; exit 1 listing every unmarked blanket handler.
+
+Usage: python tools/check_exception_hygiene.py [package_dir]
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+MARKER = "fallback-ok"
+
+
+def _is_blanket(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def check(pkg_dir: pathlib.Path) -> list[str]:
+    offenders = []
+    for path in sorted(pkg_dir.rglob("*.py")):
+        src = path.read_text()
+        lines = src.splitlines()
+        try:
+            tree = ast.parse(src, filename=str(path))
+        except SyntaxError as e:
+            offenders.append(f"{path}: does not parse: {e}")
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_blanket(node):
+                continue
+            line = lines[node.lineno - 1]
+            if MARKER in line:
+                continue
+            offenders.append(
+                f"{path}:{node.lineno}: blanket handler "
+                f"({line.strip()!r}) without '# {MARKER}: <reason>'")
+    return offenders
+
+
+def main() -> int:
+    root = pathlib.Path(__file__).resolve().parent.parent
+    pkg = pathlib.Path(sys.argv[1]) if len(sys.argv) > 1 else root / "dcf_tpu"
+    offenders = check(pkg)
+    for line in offenders:
+        print(line)
+    if offenders:
+        print(f"\n{len(offenders)} unmarked blanket handler(s); narrow the "
+              "except or mark the line with '# fallback-ok: <reason>'")
+        return 1
+    print(f"exception hygiene OK under {pkg}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
