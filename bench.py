@@ -54,6 +54,27 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def rtt_corrected_times(raw_samples, rtt_s, iters):
+    """Apply the once-per-sample sync-RTT correction; returns
+    (per-dispatch times, clamped count).
+
+    A sample whose whole duration is below the measured RTT means the
+    correction dominated it — that sample is meaningless, so it is
+    EXCLUDED from the headline median/MAD (and disclosed via the
+    ``clamped_samples`` JSON field), never floored into a fake
+    near-zero time (ADVICE.md finding 1; regression-locked by
+    tests/test_cli.py).
+    """
+    times, clamped = [], 0
+    for raw in raw_samples:
+        net = raw - rtt_s
+        if net <= 0:
+            clamped += 1
+            continue
+        times.append(net / iters)
+    return times, clamped
+
+
 def run_tpu_suite() -> str:
     """Run the on-hardware test lane (tests/test_tpu.py: all four compiled
     Mosaic kernels + DeviceKeyGen + the sharded wrappers vs the numpy
@@ -215,28 +236,21 @@ def main() -> None:
     rtt = measure_sync_rtt(staged["x_mask"], reps=5)
     log(f"bare sync RTT: {rtt * 1e3:.0f} ms "
         "(tunnel artifact; subtracted once per sample)")
-    times = []
-    clamped = 0
+    raw_samples = []
     for i in range(SAMPLES):
         t0 = time.perf_counter()
         for _ in range(ITERS):
             y = backend.eval_staged(0, staged)
         sync(y)
-        # Clamp: the RTT was measured once before the loop and swings
-        # 85-155ms day to day, so a sample whose actual sync share was
-        # smaller must not go negative (same floor cli.py's staged paths
-        # use).  A fired clamp means the correction dominated the sample —
-        # that sample is meaningless; it is counted and disclosed in the
-        # JSON (machine-readable), not just logged.
-        raw = time.perf_counter() - t0 - rtt
-        if raw <= 0:
-            clamped += 1
-            log(f"WARNING: sample {i}: measured RTT ({rtt * 1e3:.0f} ms) "
-                "exceeded the whole sample; dropped — treat this sample "
-                "as unreliable")
-            continue  # corrupted sample: disclosed via clamped_samples,
-            # excluded from the headline median/MAD
-        times.append(raw / ITERS)
+        raw_samples.append(time.perf_counter() - t0)
+    # The RTT was measured once before the loop and swings 85-155ms day
+    # to day; rtt_corrected_times drops (never floors) any sample the
+    # correction dominates and the count is disclosed in the JSON.
+    times, clamped = rtt_corrected_times(raw_samples, rtt, ITERS)
+    if clamped:
+        log(f"WARNING: measured RTT ({rtt * 1e3:.0f} ms) exceeded "
+            f"{clamped} whole sample(s); dropped from the headline "
+            "median — treat those samples as unreliable")
     if not times:
         raise SystemExit(
             f"all {SAMPLES} samples clamped by the RTT correction; the "

@@ -1,0 +1,115 @@
+"""Measure the prefix backend's frontier build cost (``frontier_build_ms``).
+
+The prefix-shared evaluator expands the top ``k`` GGM levels once per
+(key, party) as a gather table cached with the CW image
+(``backends/pallas_prefix.py``).  That expansion is untimed key-material
+prep — correctly excluded from the eval clock, like criterion's setup —
+but the "ships once, like the CW image" amortization claim needs a
+magnitude attached (VERDICT round 5, item 7).  This probe measures it:
+wall time from a cold ``put_bundle`` to the party-0 frontier table being
+device-ready, per requested key count.
+
+One JSON line per (K, k) config::
+
+    {"bench": "frontier_build", "k_requested": 21, "k_effective": 21,
+     "keys": 1, "frontier_build_ms": ..., "nodes": 2097153,
+     "platform": "tpu", "interpret": false, "repro": "..."}
+
+``k_effective`` can be below ``k_requested``: the backend shrinks k by
+ceil(log2 K) for multi-key bundles (the gather cliff is on total stacked
+rows, K * 2^k) — at K=8 a requested k=21 runs at k=18.  ``interpret``
+discloses a Pallas-interpreter (no-TPU) run; such numbers bound nothing
+about the chip and exist only so the claim is never quoted without an
+environment tag.
+
+Usage::
+
+    python -m benchmarks.frontier_build --k 21 --keys 1,8 [--domain-bytes 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def measure(k: int, keys: int, nb: int, reps: int) -> dict:
+    import jax
+
+    from dcf_tpu.backends.pallas_prefix import PrefixPallasBackend
+    from dcf_tpu.gen import random_s0s
+    from dcf_tpu.native import NativeDcf
+    from dcf_tpu.spec import Bound
+
+    lam = 16
+    rng = np.random.default_rng(2026)
+    ck = [rng.bytes(32), rng.bytes(32)]
+    native = NativeDcf(lam, ck)
+    alphas = rng.integers(0, 256, (keys, nb), dtype=np.uint8)
+    betas = rng.integers(0, 256, (keys, lam), dtype=np.uint8)
+    bundle = native.gen_batch(
+        alphas, betas, random_s0s(keys, lam, rng), Bound.LT_BETA)
+    interp = jax.devices()[0].platform != "tpu"
+    samples = []
+    k_eff = None
+    for _ in range(max(reps, 1)):
+        # Cold build each rep: a fresh backend so neither the frontier
+        # cache nor the shipped CW image carries over; jit caches persist
+        # process-wide, so reps after the first exclude trace/compile --
+        # the median is the steady-state rebuild cost, the first sample
+        # (logged) includes compilation.
+        be = PrefixPallasBackend(lam, ck, prefix_levels=k, interpret=interp)
+        be.put_bundle(bundle.for_party(0))
+        k_eff = be._k()
+        t0 = time.perf_counter()
+        tbl = be._frontier_tables(0)
+        tbl.block_until_ready()
+        samples.append(time.perf_counter() - t0)
+        log(f"  K={keys} k={k_eff}: sample {samples[-1] * 1e3:.1f} ms")
+    med = float(np.median(samples))
+    return {
+        "bench": "frontier_build",
+        "k_requested": k,
+        "k_effective": k_eff,
+        "keys": keys,
+        "frontier_build_ms": round(med * 1e3, 1),
+        "first_sample_ms": round(samples[0] * 1e3, 1),
+        "samples": len(samples),
+        # 2^{k+1} PRG node evaluations per key (levels 1..k plus the root
+        # split), the quantity the build cost scales with.
+        "nodes": keys * (1 << (k_eff + 1)),
+        "domain_bytes": nb,
+        "platform": jax.devices()[0].platform,
+        "interpret": interp,
+        "repro": (f"python -m benchmarks.frontier_build --k {k} "
+                  f"--keys {keys} --domain-bytes {nb} --reps {reps}"),
+    }
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--k", type=int, default=21,
+                   help="requested prefix depth (default 21, the gather "
+                        "cliff cap)")
+    p.add_argument("--keys", default="1,8",
+                   help="comma-separated key counts (default 1,8)")
+    p.add_argument("--domain-bytes", type=int, default=4,
+                   help="domain width in bytes (default 4, the config-2 "
+                        "shape; the frontier cost depends on k, not n)")
+    p.add_argument("--reps", type=int, default=3)
+    args = p.parse_args(argv)
+    for keys in (int(s) for s in args.keys.split(",")):
+        rec = measure(args.k, keys, args.domain_bytes, args.reps)
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

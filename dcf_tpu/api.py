@@ -54,6 +54,33 @@ ship-once key caching works exactly as in the single-device case.
 constructor keywords to the selected backend (e.g. ``tile_words`` for
 pallas, ``m_tile``/``kw_tile``/``level_chunk`` for keylanes).
 
+Measured auto-routing crossover (VERDICT round 5, item 8)
+---------------------------------------------------------
+
+``backend="auto"``'s ``lam >= 48 -> hybrid`` threshold is the measured
+winner at every recorded shape, not a guess.  Rates from
+``benchmarks/RESULTS_r04.jsonl`` / ``RESULTS_r05.jsonl`` (TPU v5 lite,
+criterion-grade median, full two-party device parity on every line;
+asserted by ``tests/test_api.py::test_auto_routing_crossover``):
+
+    lam (bytes)  auto picks  measured rate        vs CPU baseline
+    16           pallas      10.77M evals/s       102x  (pinned 1-core;
+                 (TPU; bitsliced off-TPU)          the explicit prefix
+                                                   backend does 12.18M)
+    48           hybrid      runs end-to-end (extension band,
+                             tests/test_extension_band.py); no recorded
+                             bench line yet
+    128          hybrid      3.19M evals/s        (no pinned denominator)
+    256          hybrid      2.87M evals/s        23.9x (threaded C++,
+                                                   same-run)
+    16384        hybrid      932k  evals/s        546x  (1-core C++)
+
+The bitsliced path serves the 16 < lam < 48 band (hybrid's GF(2) wide
+part needs lam >= 48, a multiple of 16).  The mid-lam valley (128/256,
+the only measured shapes below the 100x bar) is tracked as VERDICT
+round-5 item 1; if a faster mid-lam path ships, these thresholds move
+with the measurements.
+
 Key generation runs on the C++ core when available, else numpy.  Two
 subsystems stay explicit constructor-level choices rather than facade
 backends (their APIs are pipeline-shaped, not gen/eval-shaped): the
@@ -168,6 +195,7 @@ class Dcf:
                  backend: str = "auto", mesh=None,
                  backend_opts: dict | None = None):
         if n_bytes < 1:
+            # api-edge: constructor argument contract
             raise ValueError("n_bytes must be >= 1")
         self.n_bytes = n_bytes
         self.lam = lam
@@ -183,12 +211,15 @@ class Dcf:
             if self.backend_name not in (
                     "pallas", "keylanes", "bitsliced", "jax", "hybrid",
                     "prefix"):
+                # api-edge: documented backend-name contract at the facade edge
                 raise ValueError(
                     f"backend {self.backend_name!r} has no mesh-sharded "
                     "variant (cpu/numpy are host paths); use pallas, "
                     "prefix, keylanes, hybrid, bitsliced or jax")
             if self.backend_name in ("pallas", "keylanes", "prefix") \
                     and lam != 16:
+                # api-edge: documented backend/shape contract at the
+                # facade edge
                 raise ValueError(
                     f"the {self.backend_name} kernels support lam=16 only "
                     f"(got {lam}); use hybrid/bitsliced/jax on the mesh")
@@ -198,22 +229,28 @@ class Dcf:
             if self.backend_name not in (
                     "cpu", "numpy", "jax", "bitsliced", "pallas", "hybrid",
                     "keylanes", "prefix"):
+                # api-edge: documented backend-name contract at the facade edge
                 raise ValueError(f"unknown backend {self.backend_name!r}")
             if self.backend_name in ("keylanes", "prefix") and lam != 16:
+                # api-edge: documented backend/shape contract at the
+                # facade edge
                 raise ValueError(
                     f"the {self.backend_name} kernel supports lam=16 only "
                     f"(got {lam}); use bitsliced or hybrid")
         # Fail fast on backend/shape incompatibility (the backends repeat
         # these checks, but construction is where the user should hear it).
         if mesh is None and self.backend_name == "pallas" and lam != 16:
+            # api-edge: documented backend/shape contract at the facade edge
             raise ValueError(
                 f"the pallas backend supports lam=16 only (got {lam}); "
                 "use bitsliced or hybrid")
         if self.backend_name == "hybrid" and (lam < 48 or lam % 16):
+            # api-edge: documented backend/shape contract at the facade edge
             raise ValueError(
                 "the hybrid (large-lambda) backend wants lam >= 48, a "
                 f"multiple of 16 (got {lam}); use pallas/bitsliced")
         if self._backend_opts and self.backend_name in ("cpu", "numpy"):
+            # api-edge: documented backend_opts contract at the facade edge
             raise ValueError(
                 f"backend_opts {sorted(self._backend_opts)} do not apply "
                 f"to the {self.backend_name} backend")
@@ -241,6 +278,8 @@ class Dcf:
         if mesh is None and backend == "auto":
             self.backend_name = self._select_healthy(self.backend_name)
         if self.backend_name == "cpu" and self._gen_native is None:
+            # api-edge: documented backend availability contract at
+            # construction
             raise ValueError("cpu backend needs the native core")
         # One backend slot per party, created lazily on first eval(b, ...):
         # each slot retains its own shipped key image, so the documented
@@ -284,6 +323,9 @@ class Dcf:
             try:
                 be = self._make_backend(name)
             except TypeError as e:
+                # dcflint: disable=typed-error internal control-flow
+                # marker, always caught inside _select_healthy — never
+                # crosses the API surface
                 raise _BackendMisuse(name, e) from e
             ys = [np.asarray(be.eval(b, xs, bundle.for_party(b)))
                   for b in (0, 1)]
@@ -331,6 +373,8 @@ class Dcf:
                     if cand == name:
                         # The SELECTED backend rejecting its arguments is
                         # a programmer error — surface it, don't degrade.
+                        # api-edge: programmer error — invalid
+                        # backend_opts must surface as TypeError
                         raise TypeError(
                             f"backend_opts {sorted(self._backend_opts)} "
                             f"are invalid for backend {e.args[0]!r}: "
@@ -442,6 +486,7 @@ class Dcf:
             from dcf_tpu.backends.large_lambda import LargeLambdaBackend
 
             return LargeLambdaBackend(self.lam, self.cipher_keys, **opts)
+        # api-edge: documented backend-name contract at the facade edge
         raise ValueError(f"unknown backend {name!r}")
 
     # -- keygen (reference gen, src/lib.rs:86-161) --------------------------
@@ -462,6 +507,8 @@ class Dcf:
         if s0s is None:
             s0s = random_s0s(
                 alphas.shape[0], self.lam,
+                # dcflint: disable=determinism fresh key seeds MUST be
+                # unpredictable (OS entropy); pass rng= to reproduce
                 rng if rng is not None else np.random.default_rng())
         if self._gen_native is not None:
             return self._gen_native.gen_batch(alphas, betas, s0s, bound)
@@ -505,7 +552,7 @@ class Dcf:
             # src/lib.rs:269-272): ONE backend instance and one shipped
             # two-party image serve both parties.
             if bundle.s0s.shape[1] != 2:
-                raise ValueError(
+                raise ShapeError(
                     "the keylanes backend wants the full two-party bundle "
                     "(its CW image is shared between parties)")
             be = self.eval_backend(b)

@@ -8,6 +8,7 @@ here so a fix lands everywhere at once.
 
 from __future__ import annotations
 
+from dcf_tpu.errors import ShapeError
 import numpy as np
 
 __all__ = ["validate_xs", "pad_xs", "prepare_batch"]
@@ -16,13 +17,13 @@ __all__ = ["validate_xs", "pad_xs", "prepare_batch"]
 def validate_xs(xs: np.ndarray, k_num: int, n_bits: int) -> tuple[bool, int]:
     """Check xs against the on-device bundle; returns (shared, num_points)."""
     if xs.ndim not in (2, 3):
-        raise ValueError(f"xs must be 2D or 3D, got {xs.ndim}D")
+        raise ShapeError(f"xs must be 2D or 3D, got {xs.ndim}D")
     shared = xs.ndim == 2
     m = xs.shape[0] if shared else xs.shape[1]
     if xs.shape[-1] * 8 != n_bits:
-        raise ValueError("xs width mismatch with bundle")
+        raise ShapeError("xs width mismatch with bundle")
     if not shared and xs.shape[0] != k_num:
-        raise ValueError(
+        raise ShapeError(
             f"xs has {xs.shape[0]} key rows but bundle has {k_num} keys"
         )
     return shared, m

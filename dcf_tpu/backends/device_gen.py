@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dcf_tpu.errors import ShapeError
 from dcf_tpu.backends.jax_bitsliced import _pack_lanes_dev, prg_planes
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes_bitsliced import round_key_masks
@@ -99,7 +100,7 @@ def _gen_core(rk_masks, last_bit_mask, alpha_mask, beta_pl, s0a_pl, s0b_pl,
     init = (
         s0a_pl, s0b_pl,
         jnp.zeros((wk,), jnp.uint32),   # t^(0)_0 = 0
-        jnp.full((wk,), _ONES),         # t^(0)_1 = 1
+        jnp.full((wk,), _ONES, jnp.uint32),  # t^(0)_1 = 1
         jnp.zeros((8 * lam, wk), jnp.uint32),
     )
     (s_a, s_b, _t_a, _t_b, v_alpha), (cw_s, cw_v, cw_tl, cw_tr) = \
@@ -135,7 +136,7 @@ class DeviceKeyGen:
         internally (pad keys are generated and ignored)."""
         k, n_bytes = alphas.shape
         if betas.shape != (k, self.lam) or s0s.shape != (k, 2, self.lam):
-            raise ValueError("alphas/betas/s0s shape mismatch")
+            raise ShapeError("alphas/betas/s0s shape mismatch")
         k_pad = (k + 31) // 32 * 32
         if k_pad != k:
             pad = [(0, k_pad - k)]

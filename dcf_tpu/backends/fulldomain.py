@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dcf_tpu.errors import ShapeError
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes_bitsliced import round_key_masks_bitmajor
 from dcf_tpu.ops.pallas_tree import tree_expand_device
@@ -110,6 +111,7 @@ class TreeFullDomain:
     def __init__(self, lam: int, cipher_keys: Sequence[bytes],
                  host_levels: int = 6, interpret: bool = False):
         if lam != 16:
+            # api-edge: constructor lam contract
             raise ValueError(f"TreeFullDomain supports lam=16 only, "
                              f"got {lam}")
         used = hirose_used_cipher_indices(lam, len(cipher_keys))
@@ -153,11 +155,12 @@ class TreeFullDomain:
         reuse prior ``_stage_cw``/``_frontier`` results (the CW image is
         party-independent; the frontier is per party)."""
         if bundle.n_bits != n_bits:
-            raise ValueError("bundle depth mismatch")
+            raise ShapeError("bundle depth mismatch")
         if bundle.s0s.shape[1] != 1:
-            raise ValueError("eval_party wants a party-restricted bundle")
+            raise ShapeError("eval_party wants a party-restricted bundle")
         k0 = min(self.host_levels, n_bits)
         if k0 < 5:
+            # api-edge: constructor host_levels contract
             raise ValueError("need at least 5 host levels (one lane word)")
         cw_s_t, cw_v_t, cw_t_pm, cw_np1_t = (
             staged_cw if staged_cw is not None else self._stage_cw(bundle))

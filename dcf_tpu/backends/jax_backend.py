@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dcf_tpu.errors import ShapeError, StaleStateError
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes import expand_key_np
 from dcf_tpu.ops.aes_jax import aes256_encrypt_jax
@@ -158,7 +159,7 @@ class JaxBackend:
     def put_bundle(self, bundle: KeyBundle) -> None:
         """Ship a (party-restricted) key bundle to device, level-major."""
         if bundle.lam != self.lam:
-            raise ValueError("bundle lam mismatch")
+            raise ShapeError("bundle lam mismatch")
         self._bundle_dev = {
             k: jnp.asarray(v) for k, v in bundle.level_major().items()
         }
@@ -172,7 +173,7 @@ class JaxBackend:
         if bundle is not None:
             self.put_bundle(bundle)
         if self._bundle_dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
+            raise StaleStateError("no key bundle on device; call put_bundle first")
         dev = self._bundle_dev
         y = eval_scan(
             self.round_keys,

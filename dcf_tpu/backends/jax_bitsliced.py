@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dcf_tpu.errors import ShapeError, StaleStateError
 from dcf_tpu.backends._common import prepare_batch
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes_bitsliced import aes256_encrypt_planes, round_key_masks
@@ -371,7 +372,7 @@ def bundle_plane_arrays(bundle: KeyBundle) -> dict:
     evaluators consume (s0/cw_np1 [8lam, K]; cw_s/cw_v [n, 8lam, K];
     cw_tl/cw_tr [n, K])."""
     if bundle.s0s.shape[1] != 1:
-        raise ValueError("put_bundle requires a party-restricted bundle")
+        raise ShapeError("put_bundle requires a party-restricted bundle")
 
     def cw_planes(a):  # [K, n, lam] -> [n, 8lam, K]
         bits = byte_bits_lsb(a)
@@ -394,13 +395,13 @@ class BitslicedBackend(_BitslicedBase):
     def _dims(self) -> tuple[int, int]:
         """(k_num, n_bits) of the on-device bundle; raises if absent."""
         if self._bundle_dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
+            raise StaleStateError("no key bundle on device; call put_bundle first")
         return self._bundle_dev["s0"].shape[1], self._bundle_dev["cw_s"].shape[0]
 
     def put_bundle(self, bundle: KeyBundle) -> None:
         """Ship a party-restricted bundle to device as plane masks."""
         if bundle.lam != self.lam:
-            raise ValueError("bundle lam mismatch")
+            raise ShapeError("bundle lam mismatch")
         self._bundle_dev = {
             k: jnp.asarray(v) for k, v in bundle_plane_arrays(bundle).items()
         }
@@ -414,7 +415,7 @@ class BitslicedBackend(_BitslicedBase):
         xs, _, m = prepare_batch(self._dims(), xs,
                                  lambda m: (m + 31) // 32 * 32)
         if m == 0:
-            raise ValueError("cannot stage an empty batch")
+            raise ShapeError("cannot stage an empty batch")
         x_mask = _stage_xs_jit(jnp.asarray(xs))
         return {"x_mask": x_mask, "m": m}
 
@@ -423,9 +424,9 @@ class BitslicedBackend(_BitslicedBase):
         host->device xs transfer: the batch is generated from an iota inside
         the jitted program (full-domain workload, BASELINE config 3)."""
         if self._bundle_dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
+            raise StaleStateError("no key bundle on device; call put_bundle first")
         if count % 32 != 0:
-            raise ValueError(f"count {count} must be a multiple of 32")
+            raise ShapeError(f"count {count} must be a multiple of 32")
         n = self._bundle_dev["cw_s"].shape[0]
         x_mask = _stage_range_mask_jit(
             jnp.uint32(start), m=count, nb=n // 8)
@@ -452,7 +453,7 @@ class BitslicedBackend(_BitslicedBase):
         both parties' ``eval_staged`` outputs over the SAME staged batch.
         Single key.  Returns a DEVICE int32 scalar."""
         if y0.shape[1] != 1:
-            raise ValueError("points_mismatch_count is single-key")
+            raise ShapeError("points_mismatch_count is single-key")
         from dcf_tpu.utils.bits import alpha_walk_bits
 
         beta_mask = jnp.asarray(expand_bits_to_masks(
@@ -522,9 +523,9 @@ class KeyLanesBackend(_BitslicedBase):
     def put_bundle(self, bundle: KeyBundle) -> None:
         """Ship a party-restricted bundle, keys packed 32-per-lane-word."""
         if bundle.lam != self.lam:
-            raise ValueError("bundle lam mismatch")
+            raise ShapeError("bundle lam mismatch")
         if bundle.s0s.shape[1] != 1:
-            raise ValueError("put_bundle requires a party-restricted bundle")
+            raise ShapeError("put_bundle requires a party-restricted bundle")
         k = bundle.num_keys
         k_pad = (k + 31) // 32 * 32
         self._num_keys = k
@@ -557,13 +558,13 @@ class KeyLanesBackend(_BitslicedBase):
         if bundle is not None:
             self.put_bundle(bundle)
         if self._bundle_dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
+            raise StaleStateError("no key bundle on device; call put_bundle first")
         if xs.ndim != 2:
-            raise ValueError("KeyLanesBackend requires shared points [M, n_bytes]")
+            raise ShapeError("KeyLanesBackend requires shared points [M, n_bytes]")
         dev = self._bundle_dev
         n = dev["cw_s"].shape[0]
         if xs.shape[1] * 8 != n:
-            raise ValueError("xs width mismatch with bundle")
+            raise ShapeError("xs width mismatch with bundle")
         y = _eval_keylanes_jit(
             self.rk_masks,
             self._last_bit_mask,

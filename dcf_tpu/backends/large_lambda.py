@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dcf_tpu.errors import ShapeError, StaleStateError
 from dcf_tpu.backends.jax_bitsliced import (
     _pack_lanes_dev,
     _planes_to_bytes_dev,
@@ -84,6 +85,7 @@ def wide_affine_batch_np(bundle: KeyBundle):
     """
     lam, n, k_num = bundle.lam, bundle.n_bits, bundle.num_keys
     if lam <= NARROW:
+        # api-edge: constructor lam contract
         raise ValueError("wide part needs lam > 32")
     wd = lam - NARROW
     s0w = bundle.s0s[:, 0, NARROW:]       # [K, Wd]
@@ -163,9 +165,9 @@ def _narrow_core(rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
 
     s = jnp.broadcast_to(s0_pl[:, :, None], (p, k_num, 1)) ^ jnp.zeros(
         (p, k_num, w), jnp.uint32)
-    t = jnp.full((k_num, w), ones if b else jnp.uint32(0))
+    t = jnp.full((k_num, w), ones if b else jnp.uint32(0), jnp.uint32)
     v = jnp.zeros((p, k_num, w), jnp.uint32)
-    no_mask = jnp.full(p, ones)
+    no_mask = jnp.full(p, ones, jnp.uint32)
 
     def body(carry, level):
         s, t, v = carry
@@ -292,11 +294,11 @@ class LargeLambdaBackend:
                  col_chunk: int = 1 << 15, narrow: str = "auto",
                  interpret: bool = False):
         if lam < 48 or lam % 16:
-            raise ValueError(
+            raise ValueError(  # api-edge: constructor lam contract
                 "LargeLambdaBackend wants lam >= 48 (a multiple of 16); "
                 "use the pallas/bitsliced backends for small lam")
         if col_chunk % 8:
-            raise ValueError(
+            raise ValueError(  # api-edge: constructor col_chunk contract
                 f"col_chunk must be a multiple of 8 (byte packing), "
                 f"got {col_chunk}")
         if narrow == "auto":
@@ -308,6 +310,7 @@ class LargeLambdaBackend:
             except Exception:  # fallback-ok: no usable jax -> XLA narrow
                 narrow = "xla"
         if narrow not in ("pallas", "xla"):
+            # api-edge: constructor narrow-path contract
             raise ValueError(f"narrow must be pallas/xla/auto, got {narrow}")
         used = hirose_used_cipher_indices(lam, len(cipher_keys))
         assert tuple(used) == (0, 17)
@@ -330,9 +333,9 @@ class LargeLambdaBackend:
 
     def put_bundle(self, bundle: KeyBundle) -> None:
         if bundle.lam != self.lam:
-            raise ValueError("bundle lam mismatch")
+            raise ShapeError("bundle lam mismatch")
         if bundle.s0s.shape[1] != 1:
-            raise ValueError(
+            raise ShapeError(
                 "LargeLambdaBackend wants a party-restricted bundle")
         # Only the affine matrix w is party-independent; const depends on
         # this party's wide seed, so (const, w) are re-derived for every
@@ -399,9 +402,9 @@ class LargeLambdaBackend:
     def stage(self, xs: np.ndarray) -> dict:
         """Ship xs (uint8 [M, n_bytes], padded mod 32 internally)."""
         if self._dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
+            raise StaleStateError("no key bundle on device; call put_bundle first")
         if xs.ndim != 2:
-            raise ValueError("LargeLambdaBackend wants shared points [M, nb]")
+            raise ShapeError("LargeLambdaBackend wants shared points [M, nb]")
         m = xs.shape[0]
         # Pallas narrow walk tiles 128 lane words per grid step; batches
         # beyond one tile pad to whole tiles (<= one tile stays exact).
@@ -447,7 +450,7 @@ class LargeLambdaBackend:
 
         alpha_a, beta_a = arr(alpha), arr(beta)
         if alpha_a.shape[0] != y0.shape[0] or beta_a.shape[0] != y0.shape[0]:
-            raise ValueError(
+            raise ShapeError(
                 f"alpha/beta key counts ({alpha_a.shape[0]}/"
                 f"{beta_a.shape[0]}) must match the evaluated bundle's "
                 f"{y0.shape[0]} keys")

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from dcf_tpu.errors import ShapeError
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.prg import HirosePrgNp
 
@@ -32,7 +33,7 @@ def eval_batch_np(
     if xs.ndim == 2:
         xs = np.broadcast_to(xs, (k_num, *xs.shape))
     if xs.shape[0] != k_num or xs.shape[2] * 8 != n:
-        raise ValueError("xs shape mismatch with bundle")
+        raise ShapeError("xs shape mismatch with bundle")
     m = xs.shape[1]
     # MSB-first bit planes: uint8 [K, M, n].
     x_bits = np.unpackbits(xs, axis=2)

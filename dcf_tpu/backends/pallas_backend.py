@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dcf_tpu.errors import ShapeError, StaleStateError
 from dcf_tpu.backends._common import prepare_batch
 from dcf_tpu.backends.jax_bitsliced import (
     _lt_lane_mask_dev,
@@ -148,11 +149,12 @@ class PallasBackend:
                  tile_words: int = DEFAULT_TILE_WORDS,
                  interpret: bool = False):
         if lam != 16:
-            raise ValueError(
+            raise ValueError(  # api-edge: constructor lam contract
                 f"PallasBackend supports lam=16 only (got {lam}); "
                 "use BitslicedBackend for other lam"
             )
         if tile_words < 1:
+            # api-edge: constructor tile_words contract
             raise ValueError(f"tile_words must be >= 1, got {tile_words}")
         used = hirose_used_cipher_indices(lam, len(cipher_keys))
         self.lam = lam
@@ -170,9 +172,9 @@ class PallasBackend:
         its key shard (no full-image transient on one chip).
         """
         if bundle.lam != self.lam:
-            raise ValueError("bundle lam mismatch")
+            raise ShapeError("bundle lam mismatch")
         if bundle.s0s.shape[1] != 1:
-            raise ValueError("put_bundle requires a party-restricted bundle")
+            raise ShapeError("put_bundle requires a party-restricted bundle")
 
         def keyed(a):  # [K, lam] -> [K, 128, 1]
             return bitmajor_plane_masks(a)[:, :, None]
@@ -196,7 +198,7 @@ class PallasBackend:
     def _dims(self) -> tuple[int, int]:
         """(k_num, n_bits) of the on-device bundle; raises if absent."""
         if self._bundle_dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
+            raise StaleStateError("no key bundle on device; call put_bundle first")
         return self._bundle_dev["s0"].shape[0], self._bundle_dev["cw_s"].shape[1]
 
     def _prepare(self, xs: np.ndarray) -> tuple[np.ndarray, int, int]:
@@ -235,7 +237,7 @@ class PallasBackend:
         """
         xs, m, wt = self._prepare(xs)
         if m == 0:
-            raise ValueError("cannot stage an empty batch")
+            raise ShapeError("cannot stage an empty batch")
         x_mask = _stage_xs(jnp.asarray(xs))
         return {"x_mask": x_mask, "m": m, "wt": wt}
 
@@ -244,11 +246,11 @@ class PallasBackend:
         host->device xs transfer: the batch is generated from an iota inside
         the jitted program (full-domain workload, BASELINE config 3)."""
         if self._bundle_dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
+            raise StaleStateError("no key bundle on device; call put_bundle first")
         n = self._bundle_dev["cw_s"].shape[1]
         wt, w_pad = self._plan_tiles(count)
         if 32 * w_pad != count:
-            raise ValueError(
+            raise ShapeError(
                 f"count {count} must be a whole number of {32 * wt}-point "
                 "tiles for the range path")
         x_mask = _stage_range_jit(jnp.uint32(start), m=count, nb=n // 8)
@@ -285,7 +287,7 @@ class PallasBackend:
         scalar."""
         if isinstance(alpha, (bytes, bytearray)):
             if y0.shape[0] != 1:
-                raise ValueError(
+                raise ShapeError(
                     "bytes alpha/beta is the single-key form; pass "
                     "[K, n_bytes]/[K, lam] arrays for multi-key bundles")
             beta_mask = jnp.asarray(bitmajor_plane_masks(
@@ -296,7 +298,7 @@ class PallasBackend:
         alphas = np.asarray(alpha, dtype=np.uint8)
         betas = np.asarray(beta, dtype=np.uint8)
         if alphas.shape[0] != y0.shape[0] or betas.shape[0] != y0.shape[0]:
-            raise ValueError(
+            raise ShapeError(
                 f"{alphas.shape[0]} alphas / {betas.shape[0]} betas for "
                 f"{y0.shape[0]}-key outputs")
         alpha_pm = jnp.asarray(

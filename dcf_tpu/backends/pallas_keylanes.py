@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dcf_tpu.errors import ShapeError, StaleStateError
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes_bitsliced import round_key_masks_bitmajor
 from dcf_tpu.ops.pallas_keylanes import dcf_eval_keylanes_pallas
@@ -100,7 +101,7 @@ class KeyLanesPallasBackend:
                  m_tile: int = 8, kw_tile: int = 128,
                  level_chunk: int = 8, interpret: bool = False):
         if lam != 16:
-            raise ValueError(
+            raise ValueError(  # api-edge: constructor lam contract
                 f"KeyLanesPallasBackend supports lam=16 only (got {lam})")
         used = hirose_used_cipher_indices(lam, len(cipher_keys))
         self.lam = lam
@@ -160,9 +161,9 @@ class KeyLanesPallasBackend:
         """Host-bundle path (tests / interop): pack a full two-party
         KeyBundle into the device layout."""
         if bundle.lam != self.lam:
-            raise ValueError("bundle lam mismatch")
+            raise ShapeError("bundle lam mismatch")
         if bundle.s0s.shape[1] != 2:
-            raise ValueError(
+            raise ShapeError(
                 "KeyLanesPallasBackend wants the full two-party bundle")
         k = bundle.num_keys
         k_pad = (k + 31) // 32 * 32
@@ -204,12 +205,12 @@ class KeyLanesPallasBackend:
         multiple of the point granule; pad points evaluated and
         discarded)."""
         if self._bundle_dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
+            raise StaleStateError("no key bundle on device; call put_bundle first")
         if xs.ndim != 2:
-            raise ValueError("keylanes backends need shared points [M, nb]")
+            raise ShapeError("keylanes backends need shared points [M, nb]")
         n = self._bundle_dev["cw_s"].shape[0]
         if xs.shape[1] * 8 != n:
-            raise ValueError("xs width mismatch with bundle")
+            raise ShapeError("xs width mismatch with bundle")
         m = xs.shape[0]
         gran = self._m_granule()
         m_pad = -(-m // gran) * gran
@@ -255,7 +256,7 @@ class KeyLanesPallasBackend:
         """
         k = alphas.shape[0]
         if k != self._num_keys:
-            raise ValueError(
+            raise ShapeError(
                 f"got {k} alphas for a bundle of {self._num_keys} keys")
         k_pad = y0.shape[-1] * 32
         m_pad = y0.shape[1]

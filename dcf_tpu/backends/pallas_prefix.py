@@ -35,7 +35,7 @@ import numpy as np
 
 from dcf_tpu.backends.fulldomain import tree_expand_np
 from dcf_tpu.backends.pallas_backend import PallasBackend, _stage_xs
-from dcf_tpu.errors import StaleStateError
+from dcf_tpu.errors import DcfError, ShapeError, StaleStateError
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.pallas_prefix import dcf_eval_prefix_pallas
 from dcf_tpu.ops.pallas_tree import tree_expand_raw
@@ -149,9 +149,10 @@ class PrefixPallasBackend(PallasBackend):
         super().__init__(lam, cipher_keys, tile_words=tile_words,
                          interpret=interpret)
         if prefix_levels < host_levels:
-            raise ValueError(
+            raise ValueError(  # api-edge: constructor prefix_levels contract
                 f"prefix_levels must be >= host_levels={host_levels}")
         if host_levels < 5:
+            # api-edge: constructor host_levels contract
             raise ValueError("need at least 5 host levels (one lane word)")
         self.prefix_levels = min(prefix_levels, MAX_PREFIX_LEVELS)
         self.host_levels = host_levels
@@ -180,7 +181,7 @@ class PrefixPallasBackend(PallasBackend):
 
     def put_bundle(self, bundle: KeyBundle) -> None:
         if 8 * bundle.n_bytes < self.host_levels + 8:
-            raise ValueError(
+            raise ShapeError(
                 f"domain of {8 * bundle.n_bytes} levels is too shallow "
                 "for prefix sharing; use PallasBackend")
         super().put_bundle(bundle)
@@ -220,7 +221,9 @@ class PrefixPallasBackend(PallasBackend):
         # PRG application).  Guarded: a nonzero plane 15 would corrupt
         # seeds silently.
         if int(jnp.any(s_p[15] != 0)):
-            raise AssertionError(
+            # A broken stash would corrupt seeds silently — that is key
+            # material, so it surfaces through the typed taxonomy.
+            raise DcfError(
                 "frontier s plane 15 not zero — t-stash invariant broken")
         s_p = s_p.at[15:16].set(t_p)
         return jnp.concatenate(
@@ -250,9 +253,9 @@ class PrefixPallasBackend(PallasBackend):
         the criterion setup."""
         xs, m, wt = self._prepare(xs)
         if m == 0:
-            raise ValueError("cannot stage an empty batch")
+            raise ShapeError("cannot stage an empty batch")
         if xs.shape[0] != 1:
-            raise ValueError(
+            raise ShapeError(
                 "PrefixPallasBackend wants shared points [M, nb] (the "
                 "prefix indices are computed once and offset per key); "
                 "use PallasBackend for per-key point batches")
@@ -276,6 +279,7 @@ class PrefixPallasBackend(PallasBackend):
         masks cut at the old k — at best an opaque Pallas shape error, at
         worst a silently-wrong share (ADVICE.md finding 3)."""
         if "idx" not in staged:
+            # api-edge: documented staged-protocol contract (a non-prefix dict)
             raise ValueError("staged dict is not from a prefix backend's "
                              "stage")
         k_now, n_now = self._k(), self._dims()[1]
@@ -306,7 +310,7 @@ class PrefixPallasBackend(PallasBackend):
             self.put_bundle(bundle)
         if xs.ndim == 3:
             if xs.shape[0] != 1:
-                raise ValueError(
+                raise ShapeError(
                     "PrefixPallasBackend wants shared points; use "
                     "PallasBackend for per-key point batches")
             xs = xs[0]

@@ -189,6 +189,7 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
         else:
             be = ShardedBitslicedBackend(lam, cipher_keys, mesh)
     else:
+        # api-edge: CLI backend-name contract
         raise ValueError(f"unknown backend {backend!r}")
 
     def run(b, bundle, xs):
@@ -279,19 +280,21 @@ def _timed(fn, reps: int, profile: str = ""):
 
 
 def _pinned_ratio(nb: int, k: int, rate: float,
-                  interpreted: bool = False) -> dict:
+                  interpreted: bool = False,
+                  baseline_path: str | None = None) -> dict:
     """vs_baseline against the pinned per-shape single-core CPU anchor
     (benchmarks/cpu_baseline.json, CPU_BASELINE.md protocol), when one
     exists for this shape — the flagship N=16 pin or the config-2
     literal n=32 entry.  Empty otherwise (no silent in-run fallback),
     and empty for ``interpreted`` runs: a Pallas-interpreter smoke run's
     ratio against a real CPU pin is meaningless noise (host backends and
-    compiled device runs keep theirs)."""
+    compiled device runs keep theirs).  ``baseline_path`` overrides the
+    artifact location (tests feed corrupt/absent files through it)."""
     import os
 
     if k != 1 or interpreted:
         return {}
-    path = os.path.join(os.path.dirname(os.path.dirname(
+    path = baseline_path or os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "benchmarks", "cpu_baseline.json")
     try:
         with open(path) as f:

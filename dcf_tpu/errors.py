@@ -6,6 +6,13 @@ Each class also inherits the builtin exception the pre-taxonomy code raised
 (``ValueError`` / ``RuntimeError``), so existing ``except ValueError``
 call sites keep working — the taxonomy refines, it does not break.
 
+One disclosed exception to that compatibility rule (PR 2's typed-error
+sweep): evaluating before ``put_bundle`` ("no key bundle on device") was
+a ``ValueError`` in the backends and is now ``StaleStateError`` (a
+``RuntimeError``) — it is a state fault, not an argument fault, and
+grouping it with geometry staleness is what lets callers write one
+``except StaleStateError: re-ship and re-stage`` recovery path.
+
     DcfError
       +-- KeyFormatError         (ValueError)  corrupt/truncated/alien DCFK
       +-- ShapeError             (ValueError)  array shape/dtype contract
@@ -52,8 +59,10 @@ class BackendUnavailableError(DcfError, RuntimeError):
 
 
 class StaleStateError(DcfError, RuntimeError):
-    """Staged device state (a staged-points dict, a cached frontier) was
-    built against a key bundle the backend no longer holds; re-stage."""
+    """Device state is missing or out of date for the requested eval:
+    staged state (a staged-points dict, a cached frontier) was built
+    against a key bundle the backend no longer holds — re-stage — or no
+    bundle was ever shipped (``eval`` before ``put_bundle``)."""
 
 
 class NativeBuildError(DcfError, RuntimeError):

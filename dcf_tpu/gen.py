@@ -16,6 +16,7 @@ from typing import Sequence
 
 import numpy as np
 
+from dcf_tpu.errors import ShapeError
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.prg import HirosePrgNp
 from dcf_tpu.spec import Bound
@@ -49,7 +50,7 @@ def gen_batch(
     k_num, n_bytes = alphas.shape
     lam = prg.lam
     if betas.shape != (k_num, lam) or s0s.shape != (k_num, 2, lam):
-        raise ValueError("alphas/betas/s0s shape mismatch")
+        raise ShapeError("alphas/betas/s0s shape mismatch")
     n = 8 * n_bytes
     # MSB-first bit planes of alpha: uint8 [K, n] (np.unpackbits is MSB-first,
     # matching the reference's Msb0 bit view at src/lib.rs:106).

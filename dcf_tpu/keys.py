@@ -91,6 +91,25 @@ class KeyBundle:
             if a.dtype != np.uint8:
                 raise ShapeError("all bundle arrays must be uint8")
 
+    def __repr__(self) -> str:
+        """Redacted: shapes/geometry only, never seed or CW bytes.
+
+        The dataclass default repr prints field values — the arrays ARE
+        the key material, so a stray ``f"{bundle}"`` in a log line or
+        traceback would hand the other party the function.  The DCFK
+        header fields (K, n, lam, parties) are exactly the non-secret
+        part of the wire format; byte volume is disclosed as a size, not
+        as contents.
+        """
+        k, n, lam = self.cw_s.shape
+        secret_bytes = sum(
+            a.nbytes
+            for a in (self.s0s, self.cw_s, self.cw_v, self.cw_t,
+                      self.cw_np1))
+        return (f"KeyBundle(K={k}, n_bits={n}, lam={lam}, "
+                f"parties={self.s0s.shape[1]}, "
+                f"<{secret_bytes} key-material bytes redacted>)")
+
     @property
     def num_keys(self) -> int:
         return self.cw_s.shape[0]
@@ -110,8 +129,9 @@ class KeyBundle:
     def for_party(self, b: int) -> "KeyBundle":
         """Restrict to party ``b``'s starting seed (s0s[:, b:b+1])."""
         if self.s0s.shape[1] != 2:
-            raise ValueError("bundle already restricted to one party")
+            raise ShapeError("bundle already restricted to one party")
         if b not in (0, 1):
+            # api-edge: documented party-index contract
             raise ValueError(f"party must be 0 or 1, got {b}")
         return KeyBundle(
             s0s=self.s0s[:, b : b + 1].copy(),
@@ -130,7 +150,7 @@ class KeyBundle:
         ships these arrays as-is.
         """
         if self.s0s.shape[1] != 1:
-            raise ValueError("level_major requires a party-restricted bundle")
+            raise ShapeError("level_major requires a party-restricted bundle")
         return dict(
             s0=np.ascontiguousarray(self.s0s[:, 0, :]),
             cw_s=np.ascontiguousarray(self.cw_s.transpose(1, 0, 2)),

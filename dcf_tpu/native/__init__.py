@@ -21,6 +21,7 @@ from dcf_tpu.errors import (
     BackendFallbackWarning,
     BackendUnavailableError,
     NativeBuildError,
+    ShapeError,
 )
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.spec import Bound, hirose_used_cipher_indices
@@ -29,11 +30,18 @@ from dcf_tpu.testing.faults import InjectedFault, fire
 __all__ = ["NativeDcf", "build", "load"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_LIBS: dict = {}  # portable-flag -> loaded CDLL (each variant opened once)
-_FAILED: set = set()  # portable-flags whose build/load failed this process:
+_LIBS: dict = {}  # variant key -> loaded CDLL (each variant opened once)
+_FAILED: set = set()  # variant keys whose build/load failed this process:
 # without this negative cache every Dcf() on a toolchain-less host would
 # re-spawn up to 4 failing `make` subprocesses and re-warn.
 _BUILD_ATTEMPTS = 2  # bounded retry: transient toolchain hiccups, not loops
+
+
+def _sanitize_requested() -> bool:
+    """DCF_NATIVE_SANITIZE=1 selects the -Wall -Wextra -Werror + UBSan
+    build (``libdcf_sanitize.so``) — the CI ``sanitize`` leg's mode.
+    Read at call time so a test can flip it per-process."""
+    return os.environ.get("DCF_NATIVE_SANITIZE") == "1"
 
 
 def build(portable: bool = False) -> str:
@@ -43,8 +51,14 @@ def build(portable: bool = False) -> str:
     build, filesystem race — should not take the native core down); a
     persistent failure raises ``NativeBuildError`` with the captured
     stderr.  Fault seam: ``faults.fire("native.build", portable)``.
+    Under ``DCF_NATIVE_SANITIZE=1`` the target is the UBSan build
+    regardless of ``portable`` (one instrumented variant; its cipher is
+    AES-NI where the host has it, bit-exact either way).
     """
-    target = "libdcf_portable.so" if portable else "libdcf.so"
+    if _sanitize_requested():
+        target = "libdcf_sanitize.so"
+    else:
+        target = "libdcf_portable.so" if portable else "libdcf.so"
     path = os.path.join(_DIR, target)
     src = os.path.join(_DIR, "dcf_core.cpp")
     rc, err = 0, ""
@@ -74,25 +88,30 @@ def load(portable: bool = False) -> ctypes.CDLL:
     The AES-NI build degrades to the portable S-box build on any
     build/load failure (bit-exact either way, slower cipher), with a
     ``BackendFallbackWarning``; a portable failure is final and raises
-    ``NativeBuildError``/``BackendUnavailableError``.  Fault seam:
+    ``NativeBuildError``/``BackendUnavailableError``.  Under
+    ``DCF_NATIVE_SANITIZE=1`` any failure is final — silently serving an
+    uninstrumented build would defeat the sanitizer leg.  Fault seam:
     ``faults.fire("native.load", portable)``.
     """
-    lib = _LIBS.get(portable)
+    sanitize = _sanitize_requested()
+    key = (portable, sanitize)
+    lib = _LIBS.get(key)
     if lib is not None:
         return lib
-    if portable in _FAILED:  # negative cache: warned once already
-        if not portable:
+    if key in _FAILED:  # negative cache: warned once already
+        if not portable and not sanitize:
             return load(portable=True)
         raise NativeBuildError(
-            "portable native core unavailable (cached verdict from an "
-            "earlier failure this process; see the prior warning)")
+            ("sanitize" if sanitize else "portable") + " native core "
+            "unavailable (cached verdict from an earlier failure this "
+            "process; see the prior warning)")
     try:
         path = build(portable)
         fire("native.load", portable)
         lib = ctypes.CDLL(path)
     except (NativeBuildError, OSError, InjectedFault) as e:
-        _FAILED.add(portable)
-        if not portable:
+        _FAILED.add(key)
+        if not portable and not sanitize:
             warnings.warn(
                 BackendFallbackWarning("native (AES-NI)",
                                        "native (portable S-box)", e),
@@ -101,11 +120,12 @@ def load(portable: bool = False) -> ctypes.CDLL:
         if isinstance(e, NativeBuildError):
             raise
         raise BackendUnavailableError(
-            f"portable native core failed to load: {e}") from e
+            f"{'sanitize' if sanitize else 'portable'} native core "
+            f"failed to load: {e}") from e
     lib.dcf_prg_sizeof.restype = ctypes.c_uint32
     lib.dcf_has_aesni.restype = ctypes.c_int
     lib.dcf_prg_init.restype = ctypes.c_int
-    _LIBS[portable] = lib
+    _LIBS[key] = lib
     return lib
 
 
@@ -131,6 +151,7 @@ class NativeDcf:
     ):
         hirose_used_cipher_indices(lam, len(cipher_keys))
         if any(len(k) != 32 for k in cipher_keys):
+            # api-edge: constructor cipher-key contract
             raise ValueError("all cipher keys must be 32 bytes (AES-256)")
         self.lam = lam
         # Env overrides = the CI feature matrix (serial vs threaded eval,
@@ -150,6 +171,7 @@ class NativeDcf:
             self._prg, ctypes.c_uint32(lam), _ptr(keys_arr), len(cipher_keys)
         )
         if rc != 0:
+            # api-edge: C-core init rejected the (lam, keys) arguments
             raise ValueError(f"dcf_prg_init failed with code {rc}")
 
     @property
@@ -197,9 +219,9 @@ class NativeDcf:
         k_num, n_bytes = alphas.shape
         lam = self.lam
         if betas.shape != (k_num, lam) or s0s.shape != (k_num, 2, lam):
-            raise ValueError("alphas/betas/s0s shape mismatch")
+            raise ShapeError("alphas/betas/s0s shape mismatch")
         if any(a.dtype != np.uint8 for a in (alphas, betas, s0s)):
-            raise ValueError("alphas/betas/s0s must be uint8")
+            raise ShapeError("alphas/betas/s0s must be uint8")
         n = 8 * n_bytes
         cw_s = np.empty((k_num, n, lam), dtype=np.uint8)
         cw_v = np.empty((k_num, n, lam), dtype=np.uint8)
@@ -245,15 +267,15 @@ class NativeDcf:
             bundle = bundle.for_party(b)
         k_num, n, lam = bundle.cw_s.shape
         if lam != self.lam:
-            raise ValueError("bundle lam mismatch")
+            raise ShapeError("bundle lam mismatch")
         if xs.dtype != np.uint8:
-            raise ValueError("xs must be uint8")
+            raise ShapeError("xs must be uint8")
         shared = xs.ndim == 2
         m = xs.shape[0] if shared else xs.shape[1]
         if (shared and xs.shape[1] * 8 != n) or (
             not shared and (xs.shape[0] != k_num or xs.shape[2] * 8 != n)
         ):
-            raise ValueError("xs shape mismatch with bundle")
+            raise ShapeError("xs shape mismatch with bundle")
         ys = np.empty((k_num, m, lam), dtype=np.uint8)
         # Keep contiguous copies alive across the foreign call (see _ptr).
         s0_c = np.ascontiguousarray(bundle.s0s[:, 0, :])

@@ -88,8 +88,12 @@ inline uint8_t xtime(uint8_t a) {
   return static_cast<uint8_t>((a << 1) ^ ((a & 0x80) ? 0x1B : 0));
 }
 
-void aes256_encrypt_portable(const RoundKeys& rk, const uint8_t in[16],
-                             uint8_t out[16]) {
+// [[maybe_unused]]: the AES-NI build keeps the portable cipher compiled
+// (it is the -Werror-checked fallback the portable .so ships) but never
+// calls it.
+[[maybe_unused]] void aes256_encrypt_portable(const RoundKeys& rk,
+                                              const uint8_t in[16],
+                                              uint8_t out[16]) {
   uint8_t s[16];
   for (int i = 0; i < 16; i++) s[i] = static_cast<uint8_t>(in[i] ^ rk.rk[0][i]);
   static constexpr int kShift[16] = {0, 5, 10, 15, 4, 9, 14, 3,

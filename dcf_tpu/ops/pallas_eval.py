@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
+from dcf_tpu.errors import ShapeError
 from dcf_tpu.ops._compat import CompilerParams as _CompilerParams
 
 from dcf_tpu.ops.aes_bitsliced import (
@@ -157,7 +158,7 @@ def dcf_eval_pallas(
     kx, _, _, w = x_mask.shape
     wt = min(tile_words, w)
     if w % wt != 0:
-        raise ValueError(f"point words {w} not a multiple of tile {wt}")
+        raise ShapeError(f"point words {w} not a multiple of tile {wt}")
     shared = kx == 1
 
     grid = (k_num, w // wt)

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from dcf_tpu.errors import ShapeError
 from dcf_tpu.ops.aes_bitsliced import (
     aes256_encrypt_planes_bitmajor,
     aes_walk_cipher_v3,
@@ -132,12 +133,12 @@ def dcf_eval_keylanes_pallas(
     kw_tile = min(kw_tile, kw)
     lc = min(level_chunk, n)
     if m % m_tile or kw % kw_tile or n % lc:
-        raise ValueError(
+        raise ShapeError(
             f"shape ({n} levels, {m} points, {kw} key words) not divisible "
             f"by tiling ({lc}, {m_tile}, {kw_tile})")
 
     s = jnp.broadcast_to(s0_t[:, None, :], (128, m, kw))
-    t = jnp.full((m, kw), jnp.int32(-1 if b else 0))
+    t = jnp.full((m, kw), jnp.int32(-1 if b else 0), jnp.int32)
     v = jnp.zeros((128, m, kw), jnp.int32)
 
     grid = (kw // kw_tile, m // m_tile)

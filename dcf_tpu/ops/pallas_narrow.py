@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
+from dcf_tpu.errors import ShapeError
 from dcf_tpu.ops._compat import CompilerParams as _CompilerParams
 
 from dcf_tpu.ops.aes_bitsliced import (
@@ -137,7 +138,7 @@ def dcf_narrow_walk_pallas(
     w = x_mask.shape[3]
     wt = min(tile_words, w)
     if w % wt != 0:
-        raise ValueError(f"point words {w} not a multiple of tile {wt}")
+        raise ShapeError(f"point words {w} not a multiple of tile {wt}")
 
     grid = (k_num, w // wt)
     keyed = pl.BlockSpec((1, 128, 1), lambda k, j: (k, 0, 0))

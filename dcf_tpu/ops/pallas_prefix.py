@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
+from dcf_tpu.errors import ShapeError
 from dcf_tpu.ops._compat import CompilerParams as _CompilerParams
 
 from dcf_tpu.ops.pallas_eval import DEFAULT_TILE_WORDS, make_aes, walk_levels
@@ -136,7 +137,7 @@ def dcf_eval_prefix_pallas(
     kx, _, _, w = x_mask.shape
     wt = min(tile_words, w)
     if w % wt != 0:
-        raise ValueError(f"point words {w} not a multiple of tile {wt}")
+        raise ShapeError(f"point words {w} not a multiple of tile {wt}")
     shared = kx == 1
 
     grid = (k_num, w // wt)

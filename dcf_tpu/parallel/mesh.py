@@ -18,7 +18,11 @@ from dcf_tpu.backends.jax_bitsliced import (
 )
 from dcf_tpu.backends._common import prepare_batch
 from dcf_tpu.parallel._compat import shard_map
-from dcf_tpu.errors import BackendUnavailableError
+from dcf_tpu.errors import (
+    BackendUnavailableError,
+    ShapeError,
+    StaleStateError,
+)
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes import expand_key_np
 from dcf_tpu.spec import hirose_used_cipher_indices
@@ -54,7 +58,7 @@ def make_mesh(
     if shape is not None:
         keys_dim, points = shape
         if n_devices is not None and keys_dim * points != n_devices:
-            raise ValueError(
+            raise ValueError(  # api-edge: documented mesh-shape contract
                 f"mesh shape {shape} does not cover {n_devices} devices")
     else:
         n = len(devs) if n_devices is None else n_devices
@@ -62,7 +66,7 @@ def make_mesh(
         points = 2 if n % 2 == 0 else 1
         keys_dim = n // points
     if keys_dim * points > len(devs):
-        raise ValueError(
+        raise ValueError(  # api-edge: documented mesh-provisioning contract
             f"requested {keys_dim * points} devices, have {len(devs)}")
     return Mesh(
         np.array(devs[: keys_dim * points]).reshape(keys_dim, points), axis_names
@@ -125,10 +129,10 @@ class ShardedJaxBackend:
     def put_bundle(self, bundle: KeyBundle) -> None:
         """Ship a party-restricted bundle to the mesh, sharded over keys."""
         if bundle.lam != self.lam:
-            raise ValueError("bundle lam mismatch")
+            raise ShapeError("bundle lam mismatch")
         ksize = self.mesh.shape[self.mesh.axis_names[0]]
         if bundle.num_keys % ksize != 0:
-            raise ValueError(
+            raise ShapeError(
                 f"num_keys={bundle.num_keys} not divisible by keys-axis size {ksize}"
             )
         lm = bundle.level_major()
@@ -146,13 +150,13 @@ class ShardedJaxBackend:
         if bundle is not None:
             self.put_bundle(bundle)
         if self._bundle_dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
+            raise StaleStateError("no key bundle on device; call put_bundle first")
         dev = self._bundle_dev
         shared = xs.ndim == 2
         m_axis = 0 if shared else 1
         psize = self.mesh.shape[self.mesh.axis_names[1]]
         if xs.shape[m_axis] % psize != 0:
-            raise ValueError(
+            raise ShapeError(
                 f"num_points={xs.shape[m_axis]} not divisible by points-axis size {psize}"
             )
         xs_dev = self._put(
@@ -226,10 +230,10 @@ class ShardedBitslicedBackend(_BitslicedBase):
     def put_bundle(self, bundle: KeyBundle) -> None:
         """Ship a party-restricted bundle as plane masks, keys sharded."""
         if bundle.lam != self.lam:
-            raise ValueError("bundle lam mismatch")
+            raise ShapeError("bundle lam mismatch")
         ksize = self.mesh.shape[self.mesh.axis_names[0]]
         if bundle.num_keys % ksize != 0:
-            raise ValueError(
+            raise ShapeError(
                 f"num_keys={bundle.num_keys} not divisible by keys-axis "
                 f"size {ksize}")
         self._bundle_dev = {
@@ -248,7 +252,7 @@ class ShardedBitslicedBackend(_BitslicedBase):
         if bundle is not None:
             self.put_bundle(bundle)
         if self._bundle_dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
+            raise StaleStateError("no key bundle on device; call put_bundle first")
         dev = self._bundle_dev
         k_num = dev["s0"].shape[1]
         n = dev["cw_s"].shape[0]

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dcf_tpu.errors import ShapeError, StaleStateError
 from dcf_tpu.backends._common import prepare_batch
 from dcf_tpu.parallel._compat import shard_map
 from dcf_tpu.backends.pallas_backend import (
@@ -123,7 +124,7 @@ class ShardedPallasBackend(PallasBackend):
 
     def put_bundle(self, bundle: KeyBundle) -> None:
         if bundle.num_keys % self._ksize:
-            raise ValueError(
+            raise ShapeError(
                 f"num_keys={bundle.num_keys} not divisible by keys-axis "
                 f"size {self._ksize}")
         super().put_bundle(bundle)
@@ -155,7 +156,7 @@ class ShardedPallasBackend(PallasBackend):
     def stage(self, xs: np.ndarray) -> dict:
         xs, m, wt = self._prepare(xs)
         if m == 0:
-            raise ValueError("cannot stage an empty batch")
+            raise ShapeError("cannot stage an empty batch")
         x_mask = self._stage_sharded(xs, xs.shape[0] == 1)
         return {"x_mask": x_mask, "m": m, "wt": wt}
 
@@ -206,13 +207,14 @@ class ShardedTreeFullDomain(TreeFullDomain):
         for ax in mesh.axis_names:
             p_total *= mesh.shape[ax]
         if p_total & (p_total - 1):
+            # api-edge: documented mesh-size contract
             raise ValueError(f"device count {p_total} must be a power of 2")
         self._log2p = p_total.bit_length() - 1
         min_k0 = 5 + self._log2p
         if host_levels is None:
             host_levels = max(6, min_k0)
         if host_levels < min_k0:
-            raise ValueError(
+            raise ValueError(  # api-edge: constructor host_levels contract
                 f"host_levels={host_levels} gives some device less than "
                 f"one lane word of frontier; need >= {min_k0} for "
                 f"{p_total} devices")
@@ -280,12 +282,12 @@ class ShardedTreeFullDomain(TreeFullDomain):
         sharded over the mesh; returns the TOTAL mismatch count as a
         device scalar (sum of the per-shard counters)."""
         if n_bits < self.host_levels:
-            raise ValueError(
+            raise ShapeError(
                 f"n_bits={n_bits} smaller than the {self.host_levels} "
                 "host levels the mesh frontier needs; use the unsharded "
                 "TreeFullDomain")
         if bundle.n_bits != n_bits:
-            raise ValueError("bundle depth mismatch")
+            raise ShapeError("bundle depth mismatch")
         staged_cw, fronts, _parts = self._staged_for(bundle, n_bits)
         beta_mask = jnp.asarray(bitmajor_plane_masks(
             np.frombuffer(beta, dtype=np.uint8))[:, None])
@@ -328,7 +330,7 @@ class ShardedLargeLambdaBackend(LargeLambdaBackend):
 
     def put_bundle(self, bundle: KeyBundle) -> None:
         if bundle.num_keys % self._ksize:
-            raise ValueError(
+            raise ShapeError(
                 f"num_keys={bundle.num_keys} not divisible by keys-axis "
                 f"size {self._ksize}")
         super().put_bundle(bundle)
@@ -344,9 +346,9 @@ class ShardedLargeLambdaBackend(LargeLambdaBackend):
 
     def stage(self, xs: np.ndarray) -> dict:
         if self._dev is None:
-            raise ValueError("no key bundle on device; call put_bundle first")
+            raise StaleStateError("no key bundle on device; call put_bundle first")
         if xs.ndim != 2:
-            raise ValueError("LargeLambdaBackend wants shared points [M, nb]")
+            raise ShapeError("LargeLambdaBackend wants shared points [M, nb]")
         m = xs.shape[0]
         # Per-SHARD batches beyond one 4096-point tile must be whole tiles.
         local = -(-m // self._psize)
@@ -493,7 +495,7 @@ class ShardedPrefixBackend(PrefixPallasBackend):
                          host_levels=host_levels)
         kaxis, paxis = mesh.axis_names
         if mesh.shape[kaxis] != 1:
-            raise ValueError(
+            raise ShapeError(
                 "ShardedPrefixBackend is single-key: use a 1xN mesh "
                 f"(got keys axis {mesh.shape[kaxis]})")
         self.mesh = mesh

@@ -97,6 +97,7 @@ _RCON = [0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36, 0x6C]
 def aes256_expand_key(key: bytes) -> list[bytes]:
     """Expand a 32-byte AES-256 key into 15 round keys of 16 bytes each."""
     if len(key) != 32:
+        # api-edge: documented AES-256 key contract (reference parity)
         raise ValueError("AES-256 key must be 32 bytes")
     nk, nr = 8, 14
     w = [key[4 * i : 4 * i + 4] for i in range(nk)]
@@ -199,9 +200,11 @@ def hirose_used_cipher_indices(
     i.e. the user's constructor call, whichever API edge it went through.
     """
     if lam % 16 != 0:
+        # api-edge: documented Hirose lam contract (reference parity)
         raise ValueError("lam must be a multiple of 16 bytes")
     used = [17 * k for k in range(min(2, lam // 16))]
     if used and used[-1] >= num_keys:
+        # api-edge: documented cipher-key-count contract (reference parity)
         raise ValueError(f"lam={lam} uses cipher indices {used}; got {num_keys} keys")
     if not warn:
         return used
@@ -312,6 +315,12 @@ class Cw:
     tl: bool
     tr: bool
 
+    def __repr__(self) -> str:
+        """Redacted: the s/v bytes are key material (the secret-hygiene
+        field regex cannot see one-letter names, so this is explicit)."""
+        return (f"Cw(lam={len(self.s)}, tl={self.tl}, tr={self.tr}, "
+                "<s/v bytes redacted>)")
+
 
 @dataclass(frozen=True)
 class Share:
@@ -325,6 +334,12 @@ class Share:
     s0s: tuple[bytes, ...]
     cws: tuple[Cw, ...]
     cw_np1: bytes
+
+    def __repr__(self) -> str:
+        """Redacted: geometry only — the fields are the key material."""
+        lam = len(self.cw_np1)
+        return (f"Share(parties={len(self.s0s)}, n_bits={len(self.cws)}, "
+                f"lam={lam}, <key-material bytes redacted>)")
 
     def for_party(self, b: int) -> "Share":
         return Share(s0s=(self.s0s[b],), cws=self.cws, cw_np1=self.cw_np1)

@@ -13,6 +13,7 @@ All host-side prep is numpy; the packed arrays go to device as-is.
 
 from __future__ import annotations
 
+from dcf_tpu.errors import ShapeError
 import numpy as np
 
 __all__ = [
@@ -48,7 +49,7 @@ def pack_lanes(bits: np.ndarray) -> np.ndarray:
     """
     b = bits.shape[-1]
     if b % 32 != 0:
-        raise ValueError(f"batch {b} not a multiple of 32")
+        raise ShapeError(f"batch {b} not a multiple of 32")
     w = bits.astype(np.uint32).reshape(*bits.shape[:-1], b // 32, 32)
     return np.bitwise_or.reduce(w << _SHIFTS32, axis=-1)
 
@@ -75,7 +76,7 @@ def byte_bits_msb(arr: np.ndarray) -> np.ndarray:
 def planes_to_bytes(planes: np.ndarray, nbytes: int) -> np.ndarray:
     """Packed planes [8*nbytes, ..., W] -> uint8 [..., W*32, nbytes]."""
     if planes.shape[0] != 8 * nbytes:
-        raise ValueError("plane count does not match nbytes")
+        raise ShapeError("plane count does not match nbytes")
     bits = unpack_lanes(planes)  # [8n, ..., B]
     bits = np.moveaxis(bits, 0, -1)  # [..., B, 8n]
     bits = bits.reshape(*bits.shape[:-1], nbytes, 8)
@@ -85,7 +86,7 @@ def planes_to_bytes(planes: np.ndarray, nbytes: int) -> np.ndarray:
 def bits_lsb_to_bytes(bits: np.ndarray) -> np.ndarray:
     """Inverse of byte_bits_lsb: {0,1} [..., 8*nbytes] -> uint8 [..., nbytes]."""
     if bits.shape[-1] % 8 != 0:
-        raise ValueError("bit count not a multiple of 8")
+        raise ShapeError("bit count not a multiple of 8")
     b8 = bits.reshape(*bits.shape[:-1], bits.shape[-1] // 8, 8)
     return np.bitwise_or.reduce(
         b8.astype(np.uint8) << _SHIFTS8, axis=-1).astype(np.uint8)
@@ -103,7 +104,7 @@ def bitmajor_plane_masks(a: np.ndarray) -> np.ndarray:
     LSB-first bit planes, reordered to p' = bit*16 + byte, expanded to
     full/zero lane masks."""
     if a.shape[-1] != 16:
-        raise ValueError("bit-major plane masks are lam=16 only")
+        raise ShapeError("bit-major plane masks are lam=16 only")
     bits = byte_bits_lsb(a)[..., bitmajor_perm(16)]
     return expand_bits_to_masks(bits).view(np.int32)
 

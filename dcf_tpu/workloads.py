@@ -17,6 +17,7 @@ from typing import Callable
 
 import numpy as np
 
+from dcf_tpu.errors import ShapeError
 from dcf_tpu.keys import KeyBundle
 
 __all__ = [
@@ -91,7 +92,7 @@ def full_domain_check_device(
     total = 1 << n_bits
     chunk = min(chunk, total)
     if total % chunk != 0:
-        raise ValueError(f"chunk {chunk} must divide the domain {total}")
+        raise ShapeError(f"chunk {chunk} must divide the domain {total}")
     # Per-chunk counters stay on device and are summed there; the single
     # final fetch keeps the chunk loop free of host round-trips (the dev
     # tunnel costs ~85ms each).

@@ -54,3 +54,45 @@ def test_cli_large_lambda_hybrid_smoke(capsys):
          "--check"],
     )
     assert recs[0]["value"] > 0
+
+
+def test_pinned_ratio_corrupt_baseline(tmp_path):
+    """ADVICE finding 2, regression-locked: a corrupt (or absent)
+    benchmarks/cpu_baseline.json must yield {} — the bench line then
+    simply omits vs_baseline instead of aborting the whole run or
+    silently rationing against garbage."""
+    from dcf_tpu.cli import _pinned_ratio
+
+    corrupt = tmp_path / "cpu_baseline.json"
+    corrupt.write_text("{ not json at all")
+    assert _pinned_ratio(16, 1, 1e6, baseline_path=str(corrupt)) == {}
+    absent = tmp_path / "nope.json"
+    assert _pinned_ratio(16, 1, 1e6, baseline_path=str(absent)) == {}
+    # and a healthy pin still produces the ratio, so the {} above is the
+    # corrupt-file path, not a broken test
+    healthy = tmp_path / "ok.json"
+    healthy.write_text(json.dumps({"evals_per_sec": 5e5, "date": "x"}))
+    rec = _pinned_ratio(16, 1, 1e6, baseline_path=str(healthy))
+    assert rec["vs_baseline"] == 2.0
+
+
+def test_bench_clamped_samples_excluded():
+    """ADVICE finding 1, regression-locked: a sample the sync-RTT
+    correction dominates is EXCLUDED from the headline median (and
+    counted), never floored into a fake near-zero time that would drag
+    the median down."""
+    import statistics
+
+    from bench import rtt_corrected_times
+
+    # one poisoned sample (0.08s < rtt=0.1) among honest ~0.5s samples
+    times, clamped = rtt_corrected_times(
+        [0.5, 0.08, 0.52, 0.54], rtt_s=0.1, iters=2)
+    assert clamped == 1
+    assert len(times) == 3
+    # headline median over the surviving samples only
+    assert statistics.median(times) == (0.52 - 0.1) / 2
+    # all-clamped degenerates to an empty list (bench.py then aborts
+    # rather than print a rate)
+    times, clamped = rtt_corrected_times([0.05, 0.09], rtt_s=0.1, iters=2)
+    assert times == [] and clamped == 2

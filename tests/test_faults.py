@@ -267,11 +267,11 @@ def test_native_build_failure_falls_back_portable(monkeypatch):
     with faults.inject("native.build", handler=aesni_only):
         with pytest.warns(errors.BackendFallbackWarning, match="portable"):
             lib = native.load(portable=False)
-    assert lib is native._LIBS[True]  # the portable core now serves
-    assert False not in native._LIBS  # not cached as the AES-NI build
+    assert lib is native._LIBS[(True, False)]  # the portable core now serves
+    assert (False, False) not in native._LIBS  # not cached as AES-NI
     # Negative cache: the next load(False) goes straight to portable —
     # no second warning storm, no re-spawned make subprocesses.
-    assert False in native._FAILED
+    assert (False, False) in native._FAILED
     with warnings.catch_warnings():
         warnings.simplefilter("error", errors.BackendFallbackWarning)
         assert native.load(portable=False) is lib
@@ -286,7 +286,7 @@ def test_native_cdll_failure_falls_back_portable(monkeypatch):
     with faults.inject("native.load", handler=aesni_only):
         with pytest.warns(errors.BackendFallbackWarning, match="portable"):
             lib = native.load(portable=False)
-    assert lib is native._LIBS[True]
+    assert lib is native._LIBS[(True, False)]
     assert lib.dcf_prg_sizeof() > 0  # the degraded core is live
 
 
@@ -349,3 +349,28 @@ def test_exception_hygiene_gate():
                                       "check_exception_hygiene.py")],
         capture_output=True, text=True, cwd=root)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- secret hygiene: key-class repr redaction --------------------------------
+
+
+def test_key_class_reprs_redact(bundle):
+    """KeyBundle/Share/Cw reprs show shapes/geometry, never seed or CW
+    bytes (the dcflint secret-hygiene pass enforces that the __repr__s
+    EXIST; this proves what they emit).  A dataclass default repr here
+    would hand the other party the function via any log line or
+    traceback that formats a bundle."""
+    r = repr(bundle)
+    assert r == ("KeyBundle(K=2, n_bits=16, lam=16, parties=2, "
+                 "<1184 key-material bytes redacted>)")
+    # no array/bytes content: every byte value of the actual key material
+    # is absent from the repr
+    assert bundle.s0s.tobytes() not in r.encode()
+    assert bundle.cw_s.tobytes()[:8].hex() not in r
+    share = bundle.to_shares()[0]
+    rs = repr(share)
+    assert "redacted" in rs and share.cw_np1 not in rs.encode()
+    rc = repr(share.cws[0])
+    assert "redacted" in rc and share.cws[0].s not in rc.encode()
+    # the restricted (per-party) form discloses its geometry too
+    assert "parties=1" in repr(bundle.for_party(0))

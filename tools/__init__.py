@@ -1,0 +1,1 @@
+"""Repo tooling: static-analysis (dcflint) and maintenance scripts."""

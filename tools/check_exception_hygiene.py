@@ -1,75 +1,42 @@
 #!/usr/bin/env python
-"""Static check: no blanket exception handlers in dcf_tpu/ outside the
-fallback chain.
+"""DEPRECATED shim: the exception-hygiene gate lives in dcflint now.
 
-A blanket handler is a bare ``except:`` or an ``except Exception`` (alone
-or in a tuple).  Swallowing arbitrary failures is how a two-party FSS
-deployment ends up serving silently-wrong shares; the only legitimate
-sites are the fallback chain itself (auto backend canary, native
-portable degradation, TPU-presence probes), and each of those must carry
-a ``# fallback-ok: <reason>`` marker on the ``except`` line so the
-allowance is visible in the diff that introduces it.
+This entrypoint is kept so existing callers (scripts, muscle memory)
+keep working; it runs exactly the ``exception-hygiene`` dcflint pass and
+preserves the original exit-code contract (0 clean, 1 violations).
+Prefer::
 
-Exit 0 when clean; exit 1 listing every unmarked blanket handler.
+    python -m tools.dcflint <package_dir> [--pass exception-hygiene]
+
+which runs the full six-pass suite (or the one named pass).
 
 Usage: python tools/check_exception_hygiene.py [package_dir]
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-MARKER = "fallback-ok"
-
-
-def _is_blanket(handler: ast.ExceptHandler) -> bool:
-    t = handler.type
-    if t is None:  # bare except:
-        return True
-    names = t.elts if isinstance(t, ast.Tuple) else [t]
-    for n in names:
-        if isinstance(n, ast.Name) and n.id in ("Exception", "BaseException"):
-            return True
-    return False
-
-
-def check(pkg_dir: pathlib.Path) -> list[str]:
-    offenders = []
-    for path in sorted(pkg_dir.rglob("*.py")):
-        src = path.read_text()
-        lines = src.splitlines()
-        try:
-            tree = ast.parse(src, filename=str(path))
-        except SyntaxError as e:
-            offenders.append(f"{path}: does not parse: {e}")
-            continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
-            if not _is_blanket(node):
-                continue
-            line = lines[node.lineno - 1]
-            if MARKER in line:
-                continue
-            offenders.append(
-                f"{path}:{node.lineno}: blanket handler "
-                f"({line.strip()!r}) without '# {MARKER}: <reason>'")
-    return offenders
-
 
 def main() -> int:
-    root = pathlib.Path(__file__).resolve().parent.parent
-    pkg = pathlib.Path(sys.argv[1]) if len(sys.argv) > 1 else root / "dcf_tpu"
-    offenders = check(pkg)
-    for line in offenders:
-        print(line)
+    here = pathlib.Path(__file__).resolve().parent
+    sys.path.insert(0, str(here.parent))  # make `tools` importable when
+    # invoked by path from anywhere, as the old script allowed
+    from tools.dcflint import run_path
+
+    pkg = (pathlib.Path(sys.argv[1]) if len(sys.argv) > 1
+           else here.parent / "dcf_tpu")
+    offenders = run_path(pkg, ["exception-hygiene"])
+    for v in offenders:
+        print(v)
     if offenders:
         print(f"\n{len(offenders)} unmarked blanket handler(s); narrow the "
               "except or mark the line with '# fallback-ok: <reason>'")
         return 1
-    print(f"exception hygiene OK under {pkg}")
+    print(f"exception hygiene OK under {pkg} "
+          "(via the dcflint exception-hygiene pass; this entrypoint is "
+          "deprecated — use `python -m tools.dcflint`)")
     return 0
 
 

@@ -1,0 +1,248 @@
+"""dcflint — the repo's static-analysis suite.
+
+The crate's value proposition is *bit-exact* two-party DCF evaluation: a
+silently-wrong share is worse than a crash, so the invariants that
+guarantee parity must hold in every file, not just the ones a reviewer
+happened to read.  dcflint machine-enforces them as small AST passes over
+a shared file walk:
+
+    compat-shim         version-skew-renamed jax APIs only via _compat.py
+    exception-hygiene   no unmarked blanket ``except`` handlers
+    crypto-dtype        integer-only math on the key/CW/value paths
+    typed-error         every raise is a DcfError / NotImplementedError /
+                        marked API-edge ValueError-TypeError
+    secret-hygiene      key material never reaches print/logging; key
+                        classes define a redacting __repr__
+    determinism         no wall-clock/unseeded randomness in library code
+
+Each pass is a ``LintPass`` subclass registered by module import (see
+``tools/dcflint/passes/``); the framework owns the file walk, the
+suppression grammar, and the output/exit-code contract.
+
+Suppressing a finding
+---------------------
+
+A violation line may carry::
+
+    # dcflint: disable=<pass>[,<pass>] <reason>
+
+on the flagged line itself or on a standalone comment line directly
+above it.  The reason is mandatory — an allowance nobody can justify in
+the diff that introduces it is not an allowance.  Two passes also accept
+purpose-built markers that double as documentation: ``# fallback-ok:
+<reason>`` (exception-hygiene, the pre-dcflint spelling) and
+``# api-edge: <reason>`` (typed-error: a ValueError/TypeError that is
+the documented constructor/argument contract at the public API edge).
+
+Exit codes: 0 clean, 1 violations found, 2 usage/internal error.
+Run ``python -m tools.dcflint --help`` for the CLI.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+from dataclasses import asdict, dataclass
+from typing import Iterable, Iterator
+
+__all__ = [
+    "Violation",
+    "FileContext",
+    "LintPass",
+    "register",
+    "all_passes",
+    "run_path",
+    "render_human",
+    "render_json",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dcflint:\s*disable=([A-Za-z0-9_,-]+)(.*)$")
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One finding: ``path:line: [pass] message``."""
+
+    path: str
+    line: int
+    pass_name: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_name}] {self.message}"
+
+
+class LintPass:
+    """One named invariant.  Subclasses set ``name``/``description`` and
+    implement ``check(ctx)`` yielding ``(lineno, message)`` pairs; the
+    framework applies suppressions and builds ``Violation`` records."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterator[tuple[int, str]]:
+        raise NotImplementedError
+
+
+_REGISTRY: dict[str, LintPass] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator: instantiate the pass and add it to the registry."""
+    inst = cls()
+    if not inst.name:
+        raise ValueError(f"pass {cls.__name__} has no name")
+    _REGISTRY[inst.name] = inst
+    return cls
+
+
+def all_passes() -> dict[str, LintPass]:
+    """name -> pass instance, importing the pass modules on first use."""
+    from tools.dcflint import passes  # noqa: F401  (registers on import)
+
+    return dict(_REGISTRY)
+
+
+class FileContext:
+    """One parsed file: source, lines, AST, and its suppression table.
+
+    ``relpath`` is the path relative to the scanned root with ``/``
+    separators — passes use it for scoping (e.g. crypto-dtype applies
+    under ``ops/`` and ``backends/`` only), so fixtures replicate scoping
+    by directory layout, not by repo-absolute paths.
+    """
+
+    def __init__(self, path: pathlib.Path, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=str(path))
+        # lineno -> set of disabled pass names for that line
+        self.suppressions: dict[int, set[str]] = {}
+        self.suppression_errors: list[tuple[int, str]] = []
+        self._parse_suppressions()
+
+    @property
+    def parts(self) -> tuple[str, ...]:
+        return tuple(self.relpath.split("/"))
+
+    @property
+    def basename(self) -> str:
+        return self.parts[-1]
+
+    def _parse_suppressions(self) -> None:
+        known = set(_REGISTRY)
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+            reason = m.group(2).strip()
+            if not reason:
+                self.suppression_errors.append(
+                    (i, "suppression without a reason: write "
+                        "'# dcflint: disable=<pass> <why this is OK>'"))
+                continue
+            unknown = names - known if known else set()
+            if unknown:
+                self.suppression_errors.append(
+                    (i, f"suppression names unknown pass(es) "
+                        f"{sorted(unknown)}; known: {sorted(known)}"))
+                names -= unknown
+            self.suppressions.setdefault(i, set()).update(names)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed(self, lineno: int, pass_name: str) -> bool:
+        """A finding is suppressed by a disable comment on its own line or
+        anywhere in the contiguous standalone-comment block directly above
+        it (multi-line justifications are encouraged)."""
+        if pass_name in self.suppressions.get(lineno, ()):
+            return True
+        i = lineno - 1
+        while i >= 1 and self.line_text(i).strip().startswith("#"):
+            if pass_name in self.suppressions.get(i, ()):
+                return True
+            i -= 1
+        return False
+
+
+def _iter_files(root: pathlib.Path) -> Iterator[pathlib.Path]:
+    if root.is_file():
+        yield root
+        return
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        yield path
+
+
+def run_path(root: str | pathlib.Path,
+             pass_names: Iterable[str] | None = None) -> list[Violation]:
+    """Run the suite (or the named subset) over every ``*.py`` under
+    ``root``; returns the surviving (unsuppressed) violations."""
+    registry = all_passes()
+    if pass_names is None:
+        selected = list(registry.values())
+    else:
+        unknown = sorted(set(pass_names) - set(registry))
+        if unknown:
+            raise KeyError(
+                f"unknown pass(es) {unknown}; known: {sorted(registry)}")
+        selected = [registry[n] for n in pass_names]
+    root = pathlib.Path(root)
+    out: list[Violation] = []
+    for path in _iter_files(root):
+        # Single-file mode keeps the path's own directory segments so the
+        # directory-scoped rules (ops//backends/ inclusion, testing/ and
+        # bench-layer exemptions) behave exactly as in a directory scan —
+        # a bare filename would silently change which passes apply.
+        rel = (root.as_posix() if root.is_file()
+               else path.relative_to(root).as_posix())
+        try:
+            ctx = FileContext(path, rel, path.read_text())
+        except SyntaxError as e:
+            out.append(Violation(str(path), e.lineno or 0, "parse",
+                                 f"does not parse: {e.msg}"))
+            continue
+        # Malformed suppressions are findings themselves (and are not
+        # suppressible — a broken allowance must not hide itself).
+        for lineno, msg in ctx.suppression_errors:
+            out.append(Violation(str(path), lineno, "suppression", msg))
+        for p in selected:
+            for lineno, msg in p.check(ctx):
+                if not ctx.suppressed(lineno, p.name):
+                    out.append(Violation(str(path), lineno, p.name, msg))
+    out.sort(key=lambda v: (v.path, v.line, v.pass_name))
+    return out
+
+
+def render_human(violations: list[Violation], root: str) -> str:
+    lines = [str(v) for v in violations]
+    if violations:
+        per = {}
+        for v in violations:
+            per[v.pass_name] = per.get(v.pass_name, 0) + 1
+        summary = ", ".join(f"{n}: {c}" for n, c in sorted(per.items()))
+        lines.append(f"\n{len(violations)} violation(s) under {root} "
+                     f"({summary})")
+    else:
+        lines.append(f"dcflint OK under {root} "
+                     f"({len(all_passes())} passes)")
+    return "\n".join(lines)
+
+
+def render_json(violations: list[Violation], root: str) -> str:
+    return json.dumps(
+        {"root": str(root),
+         "passes": sorted(all_passes()),
+         "count": len(violations),
+         "violations": [asdict(v) for v in violations]},
+        indent=2)

@@ -1,0 +1,59 @@
+"""CLI: ``python -m tools.dcflint [paths...] [--json] [--pass NAME]``.
+
+Exit 0 when every scanned file is clean, 1 when violations survive
+suppression, 2 on usage errors.  ``--json`` emits a machine-readable
+report for CI annotation; the default output is one ``path:line:
+[pass] message`` line per finding (clickable in editors and CI logs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from tools.dcflint import all_passes, render_human, render_json, run_path
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.dcflint",
+        description="Repo static-analysis suite (see tools/dcflint).")
+    p.add_argument("paths", nargs="*", default=["dcf_tpu"],
+                   help="package directories or files to scan "
+                        "(default: dcf_tpu)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    p.add_argument("--pass", dest="passes", action="append", default=None,
+                   metavar="NAME",
+                   help="run only the named pass (repeatable)")
+    p.add_argument("--list-passes", action="store_true",
+                   help="list registered passes and exit")
+    args = p.parse_args(argv)
+
+    if args.list_passes:
+        for name, inst in sorted(all_passes().items()):
+            print(f"{name}: {inst.description}")
+        return 0
+
+    violations = []
+    for raw in args.paths or ["dcf_tpu"]:
+        root = pathlib.Path(raw)
+        if not root.exists():
+            print(f"error: no such path {raw!r}", file=sys.stderr)
+            return 2
+        try:
+            violations += run_path(root, args.passes)
+        except KeyError as e:
+            print(f"error: {e.args[0]}", file=sys.stderr)
+            return 2
+    label = ", ".join(str(p) for p in args.paths)
+    if args.json:
+        print(render_json(violations, label))
+    else:
+        print(render_human(violations, label))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

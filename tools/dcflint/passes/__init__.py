@@ -1,0 +1,16 @@
+"""Pass modules: importing this package registers every pass.
+
+To add a pass: create a module here defining a ``LintPass`` subclass
+decorated with ``@register``, import it below, and give it a
+seeded-violation fixture in ``tests/test_dcflint.py`` proving detection
+power (a pass nobody has seen fire is a pass nobody can trust).
+"""
+
+from tools.dcflint.passes import (  # noqa: F401
+    compat_shim,
+    crypto_dtype,
+    determinism,
+    exception_hygiene,
+    secret_hygiene,
+    typed_error,
+)

@@ -1,0 +1,84 @@
+"""crypto-dtype: integer-only math on the key/CW/value paths.
+
+Scope: files under ``ops/`` and ``backends/`` — the modules that touch
+seeds, correction words and value shares.  Two rules:
+
+1. No float dtypes.  The GGM walk, the PRG and the CW algebra are
+   GF(2)/integer math; a float anywhere on those paths means a rounding
+   step crept in, and a rounded share is a silently-wrong share.
+2. No dtype-less ``jnp.zeros/ones/arange/array/empty/full``.  Without an
+   explicit dtype these pick up jax's weak-type/promotion defaults,
+   which vary with ``jax_enable_x64`` and version — the result can be a
+   promoted intermediate that truncates differently across platforms.
+   Parity demands the dtype be written down.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.dcflint import FileContext, LintPass, register
+
+_SCOPE_DIRS = ("ops", "backends")
+_JNP_NAMES = ("jnp", "jax.numpy")
+_FLOAT_ATTRS = ("float16", "float32", "float64", "bfloat16", "float_",
+                "double", "half")
+# dtype parameter position (0-based) per constructor: a call with fewer
+# positional args and no dtype= keyword is dtype-less.
+_CTOR_DTYPE_POS = {"zeros": 1, "ones": 1, "empty": 1, "array": 1,
+                   "full": 2, "arange": 3}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+@register
+class CryptoDtypePass(LintPass):
+    name = "crypto-dtype"
+    description = ("no float dtypes or dtype-less jnp constructors in "
+                   "ops/ and backends/")
+
+    def check(self, ctx: FileContext) -> Iterator[tuple[int, str]]:
+        if not any(d in ctx.parts[:-1] for d in _SCOPE_DIRS):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in _FLOAT_ATTRS \
+                    and _dotted(node.value) in ("np", "numpy", *_JNP_NAMES):
+                yield (node.lineno,
+                       f"float dtype {_dotted(node)} on a crypto path: "
+                       "the key/CW/value math is integer-only "
+                       "(a rounded share is a silently-wrong share)")
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                pos = _CTOR_DTYPE_POS.get(func.attr)
+                if pos is None or _dotted(func.value) not in _JNP_NAMES:
+                    continue
+                if any(kw.arg == "dtype" for kw in node.keywords):
+                    continue
+                if len(node.args) > pos:
+                    continue  # dtype passed positionally
+                yield (node.lineno,
+                       f"dtype-less jnp.{func.attr}(...) invokes implicit "
+                       "promotion/weak-type defaults; write the dtype "
+                       "explicitly on key/CW/value paths")
+            elif isinstance(node, ast.keyword) and node.arg == "dtype" \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str) \
+                    and node.value.value.lstrip("<>=").startswith(
+                        ("float", "bfloat", "f2", "f4", "f8")):
+                yield (node.value.lineno,
+                       f"float dtype string {node.value.value!r} on a "
+                       "crypto path: the key/CW/value math is "
+                       "integer-only")

@@ -1,0 +1,86 @@
+"""determinism: no wall-clock or unseeded randomness in library code.
+
+Parity across ten backends only holds if control flow and data are a
+pure function of the inputs; a ``time.time()`` branch or an unseeded RNG
+in the library means two runs of the "same" evaluation can diverge —
+unreproducible by construction, and in a two-party protocol an
+unreproducible share is an undebuggable one.  Flags:
+
+* ``time.time/time_ns/monotonic*/perf_counter*`` calls (timing belongs
+  in the bench layer);
+* any stdlib ``random.*`` call (module-level global RNG, process-seeded);
+* numpy legacy global RNG calls (``np.random.rand/randint/seed/...``)
+  and unseeded ``np.random.default_rng()`` — seeded ``default_rng(x)``
+  and ``Generator`` objects passed by the caller are fine.
+
+Exempt: ``cli.py`` and ``utils/benchtime.py`` (the bench layer is
+*about* wall time) and ``testing/`` (test scaffolding).  Intentional
+entropy — fresh key seeds MUST be unpredictable — is exactly what the
+suppression-with-reason mechanism is for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.dcflint import FileContext, LintPass, register
+
+_TIME_FUNCS = ("time", "time_ns", "monotonic", "monotonic_ns",
+               "perf_counter", "perf_counter_ns")
+_NP_LEGACY = ("rand", "randn", "randint", "random", "random_sample",
+              "ranf", "sample", "seed", "choice", "shuffle", "permutation",
+              "bytes", "uniform", "normal", "standard_normal", "integers")
+_EXEMPT_FILES = ("cli.py", "benchtime.py")
+_EXEMPT_DIRS = ("testing",)
+
+
+def _dotted(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+@register
+class DeterminismPass(LintPass):
+    name = "determinism"
+    description = ("no time.time()/unseeded random/np.random in library "
+                   "code (cli.py, utils/benchtime.py, testing/ exempt)")
+
+    def check(self, ctx: FileContext) -> Iterator[tuple[int, str]]:
+        if ctx.basename in _EXEMPT_FILES \
+                or any(d in ctx.parts[:-1] for d in _EXEMPT_DIRS):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted.startswith("time.") \
+                    and dotted.split(".", 1)[1] in _TIME_FUNCS:
+                yield (node.lineno,
+                       f"{dotted}() in library code: wall-clock reads "
+                       "belong in the bench layer (cli.py / "
+                       "utils/benchtime.py)")
+            elif dotted.startswith("random."):
+                yield (node.lineno,
+                       f"{dotted}() uses the process-seeded stdlib "
+                       "global RNG: take an np.random.Generator from "
+                       "the caller instead")
+            elif dotted in ("np.random.default_rng",
+                            "numpy.random.default_rng"):
+                if not node.args and not node.keywords:
+                    yield (node.lineno,
+                           "unseeded np.random.default_rng() in library "
+                           "code: take the rng (or an explicit seed) "
+                           "from the caller so runs are reproducible")
+            elif dotted.startswith(("np.random.", "numpy.random.")) \
+                    and dotted.rsplit(".", 1)[1] in _NP_LEGACY:
+                yield (node.lineno,
+                       f"{dotted}() is the numpy legacy global RNG "
+                       "(process-wide hidden state): use an "
+                       "np.random.Generator passed by the caller")

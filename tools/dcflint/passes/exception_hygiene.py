@@ -1,0 +1,52 @@
+"""exception-hygiene: no unmarked blanket exception handlers.
+
+A blanket handler is a bare ``except:`` or an ``except Exception``/
+``except BaseException`` (alone or in a tuple).  Swallowing arbitrary
+failures is how a two-party FSS deployment ends up serving
+silently-wrong shares; the only legitimate sites are the fallback chain
+itself (auto backend canary, native portable degradation, TPU-presence
+probes), and each must carry ``# fallback-ok: <reason>`` on the
+``except`` line so the allowance is visible in the diff that introduces
+it.  This is the PR-1 ``tools/check_exception_hygiene.py`` gate, ported
+in as a pass (the standalone script is now a shim over it).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.dcflint import FileContext, LintPass, register
+
+MARKER = "fallback-ok"
+
+
+def _is_blanket(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+@register
+class ExceptionHygienePass(LintPass):
+    name = "exception-hygiene"
+    description = ("blanket except handlers must carry "
+                   "'# fallback-ok: <reason>'")
+
+    def check(self, ctx: FileContext) -> Iterator[tuple[int, str]]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_blanket(node):
+                continue
+            line = ctx.line_text(node.lineno)
+            if MARKER in line:
+                continue
+            yield (node.lineno,
+                   f"blanket handler ({line.strip()!r}) without "
+                   f"'# {MARKER}: <reason>'")

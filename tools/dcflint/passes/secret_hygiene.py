@@ -1,0 +1,104 @@
+"""secret-hygiene: key material never reaches print/logging, and key
+classes redact their __repr__.
+
+In a two-party FSS deployment the seeds and correction words ARE the
+security: a seed in a log line hands the other party the function.  Two
+rules:
+
+1. No ``print``/``logging`` call (including the CLI's ``log`` helper)
+   whose argument expression references a name bound to key material —
+   ``seed*``, ``s0``/``s0s``, ``cw_*``/``cws``/``cw_np1``, ``bundle``/
+   ``kb``/``key_bundle``, ``cipher_keys``.  The check is name-based and
+   deliberately conservative: printing ``bundle.num_keys`` is safe and
+   gets a suppression with a reason, which is exactly the audit trail a
+   reviewer wants at such a site.
+2. Every class holding key-material fields (dataclass or assignment
+   fields matching the same patterns) must define an explicit
+   ``__repr__`` — the dataclass default repr prints field values, so a
+   stray ``f"{bundle}"`` in a traceback or debug line would leak seed
+   and CW bytes.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from tools.dcflint import FileContext, LintPass, register
+
+SECRET_NAME_RE = re.compile(
+    r"^(seed\w*|s0s?|cw(_\w+)?|cws|key_bundle|bundle|kb|key_material"
+    r"|cipher_keys?)$")
+_PRINT_FUNCS = ("print", "log")
+_LOGGING_METHODS = ("debug", "info", "warning", "error", "critical",
+                    "exception", "log")
+
+
+def _secret_refs(node: ast.AST) -> Iterator[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and SECRET_NAME_RE.match(sub.id):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute) \
+                and SECRET_NAME_RE.match(sub.attr):
+            yield sub.attr
+
+
+def _is_sink(func: ast.AST) -> str | None:
+    """'print'/'logging.info'/... when the call is an output sink."""
+    if isinstance(func, ast.Name) and func.id in _PRINT_FUNCS:
+        return func.id
+    if isinstance(func, ast.Attribute) \
+            and func.attr in _LOGGING_METHODS \
+            and isinstance(func.value, ast.Name) \
+            and ("log" in func.value.id.lower()):
+        return f"{func.value.id}.{func.attr}"
+    return None
+
+
+@register
+class SecretHygienePass(LintPass):
+    name = "secret-hygiene"
+    description = ("no key material in print/logging; key classes must "
+                   "define a redacting __repr__")
+
+    def check(self, ctx: FileContext) -> Iterator[tuple[int, str]]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                sink = _is_sink(node.func)
+                if sink is None:
+                    continue
+                refs = sorted({r for a in (*node.args, *node.keywords)
+                               for r in _secret_refs(
+                                   a.value if isinstance(a, ast.keyword)
+                                   else a)})
+                if refs:
+                    yield (node.lineno,
+                           f"{sink}(...) references key-material "
+                           f"name(s) {refs}: a logged seed/CW hands the "
+                           "other party the function")
+            elif isinstance(node, ast.ClassDef):
+                fields = []
+                has_repr = False
+                for stmt in node.body:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        if stmt.name == "__repr__":
+                            has_repr = True
+                        continue
+                    targets = []
+                    if isinstance(stmt, ast.AnnAssign) \
+                            and isinstance(stmt.target, ast.Name):
+                        targets = [stmt.target.id]
+                    elif isinstance(stmt, ast.Assign):
+                        targets = [t.id for t in stmt.targets
+                                   if isinstance(t, ast.Name)]
+                    fields += [t for t in targets
+                               if SECRET_NAME_RE.match(t)]
+                if fields and not has_repr:
+                    yield (node.lineno,
+                           f"class {node.name} holds key-material "
+                           f"field(s) {sorted(set(fields))} but defines "
+                           "no __repr__: the default (dataclass) repr "
+                           "prints field values — define one showing "
+                           "shapes/geometry, never seed or CW bytes")

@@ -1,0 +1,110 @@
+"""typed-error: every raise is typed, so callers can catch the family.
+
+PR 1 introduced the ``dcf_tpu/errors.py`` taxonomy precisely so that no
+failure surfaces as an opaque builtin; a raw ``RuntimeError`` bypassing
+it silently erodes the ``except DcfError`` contract.  Allowed raises:
+
+* a ``DcfError`` subclass (the taxonomy) or ``NotImplementedError``;
+* a bare re-raise (``raise`` / ``raise e`` of a caught name);
+* ``ValueError``/``TypeError`` carrying an ``# api-edge: <reason>``
+  marker — the documented constructor/argument contract at the public
+  API edge, where builtin semantics are what callers expect (the
+  taxonomy's ValueError-derived classes cover the rest);
+* ``SystemExit`` in ``cli.py`` (argparse-style usage errors).
+
+Scope: all of ``dcf_tpu/`` except ``testing/`` (the fault-injection
+harness raises its own ``InjectedFault`` by design).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.dcflint import FileContext, LintPass, register
+
+API_EDGE_MARKER = "api-edge"
+
+# The dcf_tpu.errors taxonomy (kept in sync by tests/test_dcflint.py,
+# which derives the live list from the module and compares).
+DCF_ERRORS = frozenset({
+    "DcfError",
+    "KeyFormatError",
+    "ShapeError",
+    "BackendUnavailableError",
+    "StaleStateError",
+    "NativeBuildError",
+})
+_ALWAYS_OK = DCF_ERRORS | {"NotImplementedError"}
+_MARKED_OK = frozenset({"ValueError", "TypeError"})
+
+
+def _raised_names(exc: ast.AST) -> list[tuple[int, str]]:
+    """(lineno, class name) for every exception an exc expression can
+    instantiate; unknown constructs yield ('', ...) so they get flagged."""
+    if isinstance(exc, ast.IfExp):  # raise A if cond else B
+        return _raised_names(exc.body) + _raised_names(exc.orelse)
+    if isinstance(exc, ast.Call):
+        func = exc.func
+        if isinstance(func, ast.Name):
+            return [(exc.lineno, func.id)]
+        if isinstance(func, ast.Attribute):
+            return [(exc.lineno, func.attr)]
+        return [(exc.lineno, "")]
+    if isinstance(exc, ast.Name):
+        # ``raise e``: a re-raise of a bound name — its type was decided
+        # (and checked) where it was constructed or caught.
+        return []
+    if isinstance(exc, ast.Attribute):
+        return [(exc.lineno, exc.attr)]
+    return [(exc.lineno if hasattr(exc, "lineno") else 0, "")]
+
+
+def _marked(ctx: FileContext, lineno: int) -> bool:
+    """``# api-edge:`` on the flagged line or anywhere in the contiguous
+    standalone-comment block directly above it (mirrors the framework's
+    suppression placement rules, so multi-line reasons wrap freely)."""
+    if f"# {API_EDGE_MARKER}" in ctx.line_text(lineno):
+        return True
+    i = lineno - 1
+    while i >= 1 and ctx.line_text(i).strip().startswith("#"):
+        if f"# {API_EDGE_MARKER}" in ctx.line_text(i):
+            return True
+        i -= 1
+    return False
+
+
+@register
+class TypedErrorPass(LintPass):
+    name = "typed-error"
+    description = ("raises must be DcfError subclasses, "
+                   "NotImplementedError, or marked api-edge "
+                   "ValueError/TypeError")
+
+    def check(self, ctx: FileContext) -> Iterator[tuple[int, str]]:
+        if "testing" in ctx.parts[:-1]:
+            return
+        is_cli = ctx.basename == "cli.py"
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            for lineno, name in _raised_names(node.exc):
+                if name in _ALWAYS_OK:
+                    continue
+                if name == "SystemExit" and is_cli:
+                    continue
+                if name in _MARKED_OK:
+                    if _marked(ctx, lineno):
+                        continue
+                    yield (lineno,
+                           f"raise {name} without '# {API_EDGE_MARKER}: "
+                           "<reason>': either raise the matching "
+                           "DcfError subclass (ShapeError/KeyFormatError "
+                           "cover most contract violations) or mark the "
+                           "site as a documented API edge")
+                    continue
+                yield (lineno,
+                       f"raise {name or 'of a computed expression'} "
+                       "bypasses the dcf_tpu.errors taxonomy; raise a "
+                       "DcfError subclass so 'except DcfError' callers "
+                       "see it")
