@@ -39,14 +39,81 @@ from __future__ import annotations
 import argparse
 import json
 import time
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
+from dcf_tpu.errors import ShapeError
 from dcf_tpu.utils.benchtime import device_sync, measure_sync_rtt
 
 WALK_MS_PER_LEVEL = 0.757  # RESULTS_r04 config-2: 24.3 ms / 32 levels
+
+
+# ---------------------------------------------------------------------------
+# In-kernel gather (round 6): the XLA `take` verdict was declared "priced
+# dead for now" on XLA evidence alone; this is the idiomatic Pallas
+# counter-candidate — scalar-prefetched indices + per-row HBM->VMEM DMAs
+# kept n_flight deep so the gather engine always has copies in flight.
+# ---------------------------------------------------------------------------
+
+
+def _dma_gather_kernel(idx_ref, tbl_ref, out_ref, sems, *,
+                       rows_per_block: int, n_flight: int):
+    """One grid step gathers ``rows_per_block`` rows into its out block:
+    row r's copy starts as soon as slot r % n_flight retires, so up to
+    n_flight row DMAs are in flight at once (double buffering
+    generalized n-deep)."""
+    base = pl.program_id(0) * rows_per_block
+
+    def copy_desc(r):
+        return pltpu.make_async_copy(
+            tbl_ref.at[pl.ds(idx_ref[base + r], 1)],
+            out_ref.at[pl.ds(r, 1)],
+            sems.at[r % n_flight])
+
+    def body(r, carry):
+        @pl.when(r >= n_flight)
+        def _():  # retire this slot's previous copy before reuse
+            copy_desc(r - n_flight).wait()
+        copy_desc(r).start()
+        return carry
+
+    jax.lax.fori_loop(0, rows_per_block, body, 0)
+
+    def drain(j, carry):
+        copy_desc(rows_per_block - n_flight + j).wait()
+        return carry
+
+    jax.lax.fori_loop(0, min(n_flight, rows_per_block), drain, 0)
+
+
+def pallas_dma_gather(tbl, idx, rows_per_block: int = 512,
+                      n_flight: int = 8, interpret: bool = False):
+    """Gather ``tbl[idx]`` ([2^k, 8] int32 rows) with per-row async DMAs
+    from HBM, indices scalar-prefetched to SMEM.  Bit-identical to
+    ``jnp.take(tbl, idx, axis=0)`` (tests/test_hybrid_prefix.py)."""
+    m = idx.shape[0]
+    if m % rows_per_block:
+        raise ShapeError(f"m={m} not a multiple of {rows_per_block}")
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m // rows_per_block,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],  # table in HBM
+        out_specs=pl.BlockSpec((rows_per_block, 8),
+                               lambda i, idx_ref: (i, 0)),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((n_flight,))],
+    )
+    return pl.pallas_call(
+        partial(_dma_gather_kernel, rows_per_block=rows_per_block,
+                n_flight=n_flight),
+        out_shape=jax.ShapeDtypeStruct((m, 8), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(idx, tbl)
 
 
 def xla_pack(rows_i32):
@@ -126,6 +193,49 @@ def main() -> None:
 
     t_gr = _timed(jax.jit(gather_relayout), (tbl8, idx),
                   "gather_relayout_shipped", args.dispatches)
+
+    # Round 6: the Pallas scalar-prefetch / per-row-DMA gather vs the XLA
+    # take — the kernel-level candidate ROOFLINE round 5 left unpriced.
+    # Off-TPU it runs under the interpreter on a reduced batch purely as
+    # a correctness + disclosure record (an interpreter wall time says
+    # nothing about the chip); on TPU it is the real measurement.
+    interp = dev.platform != "tpu"
+    logm_dma = min(args.logm, 12) if interp else args.logm
+    m_dma = 1 << logm_dma
+    idx_dma = jnp.asarray(
+        rng.integers(0, k, (m_dma,)).astype(np.int32))
+    t_dma = None
+    try:
+        fn_dma = jax.jit(partial(pallas_dma_gather, interpret=interp))
+        got = fn_dma(tbl8, idx_dma)
+        ok = bool(np.array_equal(np.asarray(got),
+                                 np.asarray(jnp.take(tbl8, idx_dma,
+                                                     axis=0))))
+        t_dma = _timed(fn_dma, (tbl8, idx_dma),
+                       "pallas_dma_gather_k20"
+                       + ("_interpret" if interp else ""),
+                       dispatches=1 if interp else args.dispatches,
+                       reps=2 if interp else 5)
+        t_take_dma = _timed(take, (tbl8, idx_dma),
+                            "take_rows8_k20_same_batch",
+                            dispatches=1 if interp else args.dispatches,
+                            reps=2 if interp else 5)
+        print(json.dumps({
+            "probe": "pallas_dma_gather_verdict",
+            "m": m_dma, "bit_exact_vs_take": ok,
+            "interpret": interp,
+            "kernel_ms": round(t_dma * 1e3, 3),
+            "take_ms_same_batch": round(t_take_dma * 1e3, 3),
+            "note": ("per-row 32 B HBM DMAs, scalar-prefetched indices, "
+                     "8 in flight; interpreter numbers are a correctness "
+                     "record only — see ROOFLINE round 6 for the "
+                     "structural analysis and the chip repro command"),
+        }))
+    except Exception as e:  # fallback-ok: a Mosaic/interpreter gap must
+        # not kill the XLA probes this file exists to record
+        print(json.dumps({"probe": "pallas_dma_gather_k20",
+                          "error": f"{type(e).__name__}: {e}"}))
+
     print(json.dumps({
         "probe": "verdict",
         "shipped_gather_relayout_ms": round(t_gr * 1e3, 3),

@@ -71,6 +71,7 @@ def _kernel(x_ref, m_ref, y_ref, *, iters: int, style: str):
             ra = jnp.concatenate([s[5:], s[:5]], axis=0)
             rb = jnp.concatenate([s[9:], s[:9]], axis=0)
             return (ra & me) | (rb & mo)
+        # api-edge: probe-harness style-name contract (bench-only CLI)
         raise ValueError(style)
 
     y_ref[:] = jax.lax.fori_loop(0, iters, step, x_ref[:])

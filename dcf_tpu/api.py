@@ -25,7 +25,13 @@ Backends (selected at construction, ``backend=``):
     keylanes   keys-in-lanes walk kernel (many keys x few points, the
                config-5 shape; lam=16; wants the full two-party bundle —
                its CW image is shared between parties)
-    hybrid     narrow walk + GF(2)-affine wide part (lam >= 48)
+    hybrid     narrow walk + GF(2)-affine wide part (lam >= 48).
+               ``backend_opts={"prefix_levels": k}`` switches its narrow
+               walk to the prefix-shared path (round 6,
+               ops.pallas_hybrid_prefix): top-k levels expanded once per
+               (key, party) as a cached gather table, n-k walked levels
+               per point (Pallas-only; the facade applies the same
+               off-TPU interpreter rule as keylanes/prefix)
 
 Passing ``mesh=parallel.make_mesh(...)`` makes the same facade run the
 sharded variants — the reference gets its parallelism transparently from
@@ -43,7 +49,9 @@ the mesh equivalent should be just as transparent:
     keylanes   parallel.ShardedKeyLanesBackend (many keys x few points,
                the config-5 shape; both parties share one device image)
     hybrid     parallel.ShardedLargeLambdaBackend (large lambda: narrow
-               walk + affine wide part, keys+points sharded)
+               walk + affine wide part, keys+points sharded; also takes
+               ``backend_opts={"prefix_levels": k}`` — frontier tables
+               shard with the key image, the gather stays a pure map)
     bitsliced  parallel.ShardedBitslicedBackend
     jax        parallel.ShardedJaxBackend
 
@@ -54,32 +62,40 @@ ship-once key caching works exactly as in the single-device case.
 constructor keywords to the selected backend (e.g. ``tile_words`` for
 pallas, ``m_tile``/``kw_tile``/``level_chunk`` for keylanes).
 
-Measured auto-routing crossover (VERDICT round 5, item 8)
----------------------------------------------------------
+Measured auto-routing crossover (refreshed round 6)
+---------------------------------------------------
 
 ``backend="auto"``'s ``lam >= 48 -> hybrid`` threshold is the measured
 winner at every recorded shape, not a guess.  Rates from
 ``benchmarks/RESULTS_r04.jsonl`` / ``RESULTS_r05.jsonl`` (TPU v5 lite,
-criterion-grade median, full two-party device parity on every line;
-asserted by ``tests/test_api.py::test_auto_routing_crossover``):
+criterion-grade median, full two-party device parity on every line);
+vs_baseline now uses the PINNED per-shape single-core denominators
+(``benchmarks/cpu_baseline.json``, CPU_BASELINE.md protocol — the
+lam-shape pins are round-6 flagship-ratio transfers); asserted by
+``tests/test_api.py::test_auto_routing_crossover`` (+ the slow
+lam=16384 companion):
 
-    lam (bytes)  auto picks  measured rate        vs CPU baseline
-    16           pallas      10.77M evals/s       102x  (pinned 1-core;
-                 (TPU; bitsliced off-TPU)          the explicit prefix
-                                                   backend does 12.18M)
+    lam (bytes)  auto picks  measured rate        vs pinned 1-core CPU
+    16           pallas      10.77M evals/s       102x  (the explicit
+                 (TPU; bitsliced off-TPU)          prefix backend does
+                                                   12.18M = 115.6x)
     48           hybrid      runs end-to-end (extension band,
                              tests/test_extension_band.py); no recorded
                              bench line yet
-    128          hybrid      3.19M evals/s        (no pinned denominator)
-    256          hybrid      2.87M evals/s        23.9x (threaded C++,
-                                                   same-run)
-    16384        hybrid      932k  evals/s        546x  (1-core C++)
+    128          hybrid      3.19M evals/s        26.3x (lam128 pin)
+    256          hybrid      2.87-3.21M evals/s   34.9-39.0x (lam256 pin)
+    16384        hybrid      932k  evals/s        566x  (lam16384 pin)
 
 The bitsliced path serves the 16 < lam < 48 band (hybrid's GF(2) wide
 part needs lam >= 48, a multiple of 16).  The mid-lam valley (128/256,
-the only measured shapes below the 100x bar) is tracked as VERDICT
-round-5 item 1; if a faster mid-lam path ships, these thresholds move
-with the measurements.
+the only measured shapes below the 100x bar) is decomposed and priced
+in benchmarks/ROOFLINE.md round 6: it is the narrow walk itself
+(2x the flagship's cipher work per point at the 512-lane penalty
+point), and the shipped structural lever is the prefix-shared hybrid
+(``backend_opts={"prefix_levels": k}``), expected +13-16% at the bench
+shape with the remaining headroom priced at the cipher floor.  Auto
+keeps the from-root hybrid until a chip session records the
+prefix-enabled crossover; these thresholds move with the measurements.
 
 Key generation runs on the C++ core when available, else numpy.  Two
 subsystems stay explicit constructor-level choices rather than facade
@@ -485,6 +501,15 @@ class Dcf:
         if name == "hybrid":
             from dcf_tpu.backends.large_lambda import LargeLambdaBackend
 
+            if opts.get("prefix_levels") and "interpret" not in opts:
+                # The prefix frontier machinery is Pallas-only; apply the
+                # same interpreter rule as the keylanes/prefix paths so
+                # the facade stays usable in CPU tests.
+                import jax
+
+                opts = dict(
+                    opts,
+                    interpret=jax.devices()[0].platform != "tpu")
             return LargeLambdaBackend(self.lam, self.cipher_keys, **opts)
         # api-edge: documented backend-name contract at the facade edge
         raise ValueError(f"unknown backend {name!r}")

@@ -55,9 +55,16 @@ from dcf_tpu.spec import hirose_used_cipher_indices
 from dcf_tpu.utils.bits import byte_bits_lsb
 
 __all__ = ["LargeLambdaBackend", "wide_affine_np", "wide_affine_batch_np",
-           "narrow_walk_np"]
+           "narrow_walk_np", "hybrid_prefix_gather_walk",
+           "HYBRID_MAX_PREFIX_LEVELS"]
 
 NARROW = 32  # bytes covered by the real (encrypted) blocks
+
+# The hybrid frontier row is 16 int32 columns (sa|sb|va|vb) = 64 B — the
+# measured XLA row gather is data-bound at 32 B and cliffs 4x at the
+# 128 MB table (micro_gather.py: 2^22 x 32 B rows), so 64 B rows hit the
+# same byte budget one level earlier than the lam=16 frontier's 21.
+HYBRID_MAX_PREFIX_LEVELS = 20
 
 
 def _clear_masked(a: np.ndarray, lam: int) -> np.ndarray:
@@ -254,6 +261,19 @@ def _hybrid_eval(rk_masks, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
     return jnp.concatenate([y32, y_wide], axis=2)
 
 
+def _y_blocks_to_bytes(y0, y1, inv_perm):
+    """Narrow-kernel y blocks (bit-major [K, 128, W] each) -> uint8
+    [K, M, 32]: inverse bit-major permutation per block, then the shared
+    plane-to-byte conversion."""
+    yb = jnp.concatenate([
+        jnp.take(jax.lax.bitcast_convert_type(y0, jnp.uint32),
+                 inv_perm, axis=1),
+        jnp.take(jax.lax.bitcast_convert_type(y1, jnp.uint32),
+                 inv_perm, axis=1),
+    ], axis=1).transpose(1, 0, 2)  # byte-major planes [256, K, W]
+    return _planes_to_bytes_dev(yb, NARROW)
+
+
 @partial(jax.jit, static_argnames=("b", "col_chunk", "interpret"))
 def _hybrid_eval_pallas(rk2, s0a, s0b, cs0, cs1, cv0, cv1, np1a, np1b,
                         cw_t_pm, inv_perm, wide_const, wide_w8, xs,
@@ -266,19 +286,115 @@ def _hybrid_eval_pallas(rk2, s0a, s0b, cs0, cs1, cv0, cv1, np1a, np1b,
     y0, y1, traj = dcf_narrow_walk_pallas(
         rk2, s0a, s0b, cs0, cs1, cv0, cv1, np1a, np1b, cw_t_pm, x_mask,
         b=b, interpret=interpret)
-    # bit-major [K, 128, W] per block -> byte-major planes [256, K, W]
-    yb = jnp.concatenate([
-        jnp.take(jax.lax.bitcast_convert_type(y0, jnp.uint32),
-                 inv_perm, axis=1),
-        jnp.take(jax.lax.bitcast_convert_type(y1, jnp.uint32),
-                 inv_perm, axis=1),
-    ], axis=1).transpose(1, 0, 2)
-    y32 = _planes_to_bytes_dev(yb, NARROW)  # [K, M, 32]
+    y32 = _y_blocks_to_bytes(y0, y1, inv_perm)  # [K, M, 32]
     m = y32.shape[1]
     # trajectory [K, n+1, W] -> [n+1, K, W]
     tr = jax.lax.bitcast_convert_type(traj, jnp.uint32).transpose(1, 0, 2)
     y_wide = _wide_tail(tr, wide_const, wide_w8, m, col_chunk)
     return jnp.concatenate([y32, y_wide], axis=2)
+
+
+# ---------------------------------------------------------------------------
+# Prefix-shared narrow walk (ops.pallas_hybrid_prefix): frontier staging
+# and the gather + remaining-level walk + wide-tail device program.
+# ---------------------------------------------------------------------------
+
+
+def _node_prefix_xs(k: int, n_bytes: int) -> np.ndarray:
+    """uint8 [2^k, n_bytes]: node r's input has MSB-first walk bit i =
+    (r >> i) & 1 for i < k, zero beyond — the frontier-position
+    enumeration matching ``ops.pallas_prefix._stage_prefix_idx``, so the
+    depth-k carry of "point" r IS frontier row r."""
+    r = np.arange(1 << k, dtype=np.uint32)
+    bits = np.zeros((1 << k, 8 * n_bytes), dtype=np.uint8)
+    for i in range(k):
+        bits[:, i] = (r >> np.uint32(i)) & np.uint32(1)
+    return np.bitwise_or.reduce(
+        bits.reshape(-1, n_bytes, 8) << np.arange(7, -1, -1,
+                                                  dtype=np.uint8),
+        axis=-1).astype(np.uint8)
+
+
+@jax.jit
+def _traj_words(traj_planes):
+    """Packed gate planes int32 [K, J, W] -> per-node uint32 words
+    [K, 32*W] with bit j = plane j (J = k+1 <= 32: the k prefix gates
+    plus the depth-k carry at bit k).  Runs once per (bundle, party) at
+    frontier-build time — off the eval clock."""
+    kk, j, w = traj_planes.shape
+    bits = (jax.lax.bitcast_convert_type(traj_planes, jnp.uint32)[..., None]
+            >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+    bits = bits.reshape(kk, j, w * 32)  # node = 32*word + bit
+    return jnp.sum(bits << jnp.arange(j, dtype=jnp.uint32)[None, :, None],
+                   axis=1, dtype=jnp.uint32)
+
+
+def _words_to_planes(words, shifts):
+    """Per-point uint32 words [K, M] -> packed lane planes uint32
+    [K, len(shifts), W], plane j selecting bit ``shifts[j]`` of each
+    word (point 32*w + m in bit m — the kernel lane convention)."""
+    kk, m = words.shape
+    bits = (words[:, None, :] >> shifts[None, :, None]) & jnp.uint32(1)
+    bits = bits.reshape(kk, shifts.shape[0], m // 32, 32)
+    return jnp.sum(bits << jnp.arange(32, dtype=jnp.uint32), axis=-1,
+                   dtype=jnp.uint32)
+
+
+def hybrid_prefix_gather_walk(rk2, state_tbl, traj_tbl, idx, cs0r, cs1r,
+                              cv0r, cv1r, np1a, np1b, cw_t_r, x_mask_rem,
+                              inv_perm, wide_const, wide_w8, *,
+                              col_chunk: int, k: int, frontier_size: int,
+                              tile_words: int, interpret: bool):
+    """Gather frontier rows + trajectory words, walk the remaining n-k
+    narrow levels, run the wide tail over the FULL reassembled
+    trajectory — unjitted so ``parallel.ShardedLargeLambdaBackend`` can
+    wrap it in ``shard_map`` (the gather is a pure per-point map against
+    the key-sharded frontier tables, so points shard with no
+    collectives).  Party is implicit in the frontier tables.
+
+    state_tbl int32 [K, 2^k, 16] (sa|sb|va|vb rows), traj_tbl uint32
+    [K, 2^k], idx uint32 [M]; returns uint8 [K, M, lam]."""
+    k_num = state_tbl.shape[0]
+    m = idx.shape[0]
+    if k_num == 1:
+        flat = idx
+    else:
+        flat = (jnp.arange(k_num, dtype=jnp.uint32)[:, None]
+                * jnp.uint32(frontier_size) + idx[None, :]).reshape(-1)
+    rows = jnp.take(state_tbl.reshape(-1, 16), flat, axis=0).reshape(
+        k_num, m, 16)
+    # -> [K, 16, 32, W] with the j (point-within-word) axis reversed,
+    # the layout the kernel's butterfly transpose expects (same relayout
+    # as backends.pallas_prefix.gather_and_walk, 16 columns wide).
+    blk = (rows.transpose(0, 2, 1).reshape(k_num, 16, m // 32, 32)
+           .transpose(0, 1, 3, 2)[:, :, 31::-1, :])
+    tw = jnp.take(traj_tbl.reshape(-1), flat, axis=0).reshape(k_num, m)
+    t0 = jax.lax.bitcast_convert_type(
+        _words_to_planes(tw, jnp.arange(k, k + 1, dtype=jnp.uint32)),
+        jnp.int32)  # [K, 1, W] depth-k carry
+    topk = _words_to_planes(tw, jnp.arange(k, dtype=jnp.uint32))
+
+    from dcf_tpu.ops.pallas_hybrid_prefix import dcf_hybrid_prefix_pallas
+
+    y0, y1, tr_rem = dcf_hybrid_prefix_pallas(
+        rk2, blk, t0, cs0r, cs1r, cv0r, cv1r, np1a, np1b, cw_t_r,
+        x_mask_rem, tile_words=tile_words, interpret=interpret)
+    y32 = _y_blocks_to_bytes(y0, y1, inv_perm)  # [K, M, 32]
+    # Full gate trajectory [n+1, K, W]: gathered top-k gates, then the
+    # walked levels (whose first entry is the depth-k gate == bit k of
+    # the gathered word, and whose last is the final cw_np1 gate).
+    tr_full = jnp.concatenate(
+        [topk.transpose(1, 0, 2),
+         jax.lax.bitcast_convert_type(tr_rem, jnp.uint32)
+         .transpose(1, 0, 2)], axis=0)
+    y_wide = _wide_tail(tr_full, wide_const, wide_w8, m, col_chunk)
+    return jnp.concatenate([y32, y_wide], axis=2)
+
+
+_hybrid_prefix_eval = partial(
+    jax.jit, static_argnames=("col_chunk", "k", "frontier_size",
+                              "tile_words", "interpret"))(
+    hybrid_prefix_gather_walk)
 
 
 class LargeLambdaBackend:
@@ -288,11 +404,20 @@ class LargeLambdaBackend:
     affine wide part runs as one batched int8 MXU matmul (per-chunk
     memory is bounded by scaling the column chunk down with K).
     Bit-exact with the full-width oracle (tests/test_large_lambda.py).
+
+    ``prefix_levels`` > 0 switches the narrow walk to the prefix-shared
+    path (ops.pallas_hybrid_prefix): the top k levels are expanded once
+    per (bundle, party) as a 2^k-row gather table cached with the key
+    image, each eval gathers every point's (sa, sb, va, vb, t,
+    trajectory-prefix) carry and walks only n-k levels.  Requires the
+    Pallas narrow path (``narrow="auto"`` then resolves to pallas; pass
+    ``interpret=True`` off-TPU).
     """
 
     def __init__(self, lam: int, cipher_keys: Sequence[bytes],
                  col_chunk: int = 1 << 15, narrow: str = "auto",
-                 interpret: bool = False):
+                 interpret: bool = False, prefix_levels: int = 0,
+                 host_levels: int | None = None):
         if lam < 48 or lam % 16:
             raise ValueError(  # api-edge: constructor lam contract
                 "LargeLambdaBackend wants lam >= 48 (a multiple of 16); "
@@ -301,23 +426,48 @@ class LargeLambdaBackend:
             raise ValueError(  # api-edge: constructor col_chunk contract
                 f"col_chunk must be a multiple of 8 (byte packing), "
                 f"got {col_chunk}")
+        if host_levels is not None:
+            # The lam=16 prefix backend splits its tree build host/device;
+            # the hybrid frontier is built entirely on device, so the knob
+            # does not exist here.  Rejected by name so a caller porting
+            # PrefixPallasBackend opts does not silently configure nothing.
+            # api-edge: constructor host_levels contract
+            raise ValueError(
+                "the hybrid prefix frontier is built on device; "
+                "host_levels does not apply (use prefix_levels)")
+        if prefix_levels and prefix_levels < 5:
+            # api-edge: constructor prefix_levels contract
+            raise ValueError(
+                "prefix_levels must be 0 (from-root) or >= 5 (one lane "
+                f"word of frontier), got {prefix_levels}")
         if narrow == "auto":
-            try:
-                import jax as _jax
+            if prefix_levels:
+                narrow = "pallas"  # the frontier machinery is plane/kernel
+            else:
+                try:
+                    import jax as _jax
 
-                narrow = ("pallas" if interpret
-                          or _jax.devices()[0].platform == "tpu" else "xla")
-            except Exception:  # fallback-ok: no usable jax -> XLA narrow
-                narrow = "xla"
+                    narrow = ("pallas" if interpret
+                              or _jax.devices()[0].platform == "tpu"
+                              else "xla")
+                except Exception:  # fallback-ok: no usable jax -> XLA narrow
+                    narrow = "xla"
         if narrow not in ("pallas", "xla"):
             # api-edge: constructor narrow-path contract
             raise ValueError(f"narrow must be pallas/xla/auto, got {narrow}")
+        if prefix_levels and narrow != "pallas":
+            # api-edge: constructor prefix/narrow compatibility contract
+            raise ValueError(
+                "prefix_levels needs the Pallas narrow walk (the XLA "
+                "layout stores keys on the trailing axis and has no "
+                "frontier kernel); drop narrow='xla' or prefix_levels")
         used = hirose_used_cipher_indices(lam, len(cipher_keys))
         assert tuple(used) == (0, 17)
         self.lam = lam
         self.col_chunk = col_chunk
         self.narrow = narrow
         self.interpret = interpret
+        self.prefix_levels = min(prefix_levels, HYBRID_MAX_PREFIX_LEVELS)
         self.rk_masks = tuple(
             jnp.asarray(round_key_masks(cipher_keys[i])) for i in used)
         if narrow == "pallas":
@@ -329,7 +479,22 @@ class LargeLambdaBackend:
             from dcf_tpu.utils.bits import bitmajor_perm
 
             self._inv_perm = jnp.asarray(np.argsort(bitmajor_perm(16)))
+        if self.prefix_levels:
+            from dcf_tpu.backends.pallas_prefix import _PERM_I32
+
+            self._perm_i32 = jnp.asarray(_PERM_I32)
+        self._frontier: dict = {}
         self._dev = None
+
+    def _k(self) -> int:
+        """Effective prefix depth for the shipped bundle: leave at least
+        8 walked levels; the gather cliff is on TOTAL stacked table
+        BYTES (K * 2^k 64-byte rows vs the measured 128 MB break), so
+        multi-key bundles shrink k by ceil(log2 K); floored at 5 (one
+        lane word of frontier)."""
+        k_num, n = self._bundle.num_keys, self._bundle.n_bits
+        k_cap = HYBRID_MAX_PREFIX_LEVELS - (k_num - 1).bit_length()
+        return max(min(self.prefix_levels, n - 8, k_cap), 5)
 
     def put_bundle(self, bundle: KeyBundle) -> None:
         if bundle.lam != self.lam:
@@ -337,11 +502,17 @@ class LargeLambdaBackend:
         if bundle.s0s.shape[1] != 1:
             raise ShapeError(
                 "LargeLambdaBackend wants a party-restricted bundle")
+        if self.prefix_levels and bundle.n_bits < 13:
+            raise ShapeError(
+                f"domain of {bundle.n_bits} levels is too shallow for "
+                "prefix sharing (needs >= 5 frontier + 8 walked levels); "
+                "use prefix_levels=0")
         # Only the affine matrix w is party-independent; const depends on
         # this party's wide seed, so (const, w) are re-derived for every
         # put_bundle (staged lazily on first eval) and never reused across
         # parties.
         self._bundle = bundle
+        self._frontier = {}  # new key image invalidates cached frontiers
 
         if self.narrow == "pallas":
             from dcf_tpu.utils.bits import bitmajor_plane_masks
@@ -383,7 +554,57 @@ class LargeLambdaBackend:
                 s0_pl=jnp.asarray(np.ascontiguousarray(
                     masks(bundle.s0s[:, 0, :NARROW]).T)),
             )
+        if self.prefix_levels:
+            self._slice_cw_rem()
         self._wide = None
+
+    def _slice_cw_rem(self) -> None:
+        """Remaining-level CW views are bundle constants: sliced once
+        off the eval clock, not per eval_staged dispatch.  The sharded
+        subclass re-runs this after placing ``_dev`` across the mesh."""
+        k = self._k()
+        dev = self._dev
+        self._cw_rem = (dev["cs0"][:, k:], dev["cs1"][:, k:],
+                        dev["cv0"][:, k:], dev["cv1"][:, k:],
+                        dev["cw_t"][:, k:])
+
+    def _narrow_dev_for_build(self) -> dict:
+        """The narrow plane dict the frontier build walks.  The sharded
+        subclass overrides this with its unsharded host-side copy (an
+        eager pallas_call cannot consume mesh-sharded operands)."""
+        return self._dev
+
+    def _frontier_tables(self, b: int):
+        """The party-b frontier: (state rows int32 [K, 2^k, 16], per-node
+        trajectory words uint32 [K, 2^k]).  Built once per (bundle,
+        party) by walking all 2^k node prefixes k levels on device
+        (``ops.pallas_hybrid_prefix.narrow_state_walk_pallas``) and
+        cached with the key image — key material, off the eval clock."""
+        tbl = self._frontier.get(int(b))
+        if tbl is not None:
+            return tbl
+        from dcf_tpu.backends.pallas_backend import _stage_xs
+        from dcf_tpu.backends.pallas_prefix import _planes_to_rows
+        from dcf_tpu.ops.pallas_hybrid_prefix import narrow_state_walk_pallas
+
+        k = self._k()
+        k_num = self._bundle.num_keys
+        nb = self._bundle.n_bits // 8
+        dev = self._narrow_dev_for_build()
+        x_nodes = jnp.asarray(_node_prefix_xs(k, nb))[None]
+        x_mask_nodes = _stage_xs(x_nodes)[:, :k]
+        sa, sb, va, vb, traj = narrow_state_walk_pallas(
+            self.rk2, dev["s0a"], dev["s0b"],
+            dev["cs0"][:, :k], dev["cs1"][:, :k],
+            dev["cv0"][:, :k], dev["cv1"][:, :k], dev["cw_t"][:, :k],
+            x_mask_nodes, b=int(b), interpret=self.interpret)
+        state_tbl = jnp.concatenate(
+            [jnp.stack([_planes_to_rows(p[key], self._perm_i32)
+                        for key in range(k_num)])
+             for p in (sa, sb, va, vb)], axis=2)  # [K, 2^k, 16]
+        tbl = (state_tbl, _traj_words(traj))
+        self._frontier[int(b)] = tbl
+        return tbl
 
     def _wide_staged(self):
         if self._wide is None:
@@ -400,7 +621,10 @@ class LargeLambdaBackend:
         return max(8, (self.col_chunk // max(1, k_num)) // 8 * 8)
 
     def stage(self, xs: np.ndarray) -> dict:
-        """Ship xs (uint8 [M, n_bytes], padded mod 32 internally)."""
+        """Ship xs (uint8 [M, n_bytes], padded mod 32 internally).  With
+        ``prefix_levels`` the staged dict additionally carries the
+        per-point frontier positions and the remaining-level walk masks
+        — all xs-only preprocessing, untimed like the criterion setup."""
         if self._dev is None:
             raise StaleStateError("no key bundle on device; call put_bundle first")
         if xs.ndim != 2:
@@ -412,13 +636,70 @@ class LargeLambdaBackend:
         m_pad = -(-m // granule) * granule
         if m_pad != m:
             xs = np.pad(xs, [(0, m_pad - m), (0, 0)])
-        return {"xs": jnp.asarray(np.ascontiguousarray(xs))[None], "m": m}
+        staged = {"xs": jnp.asarray(np.ascontiguousarray(xs))[None], "m": m}
+        if self.prefix_levels:
+            staged.update(
+                self._prefix_stage_fields(staged["xs"],
+                                          min(128, m_pad // 32)))
+        return staged
+
+    def _prefix_stage_fields(self, xj, wt: int) -> dict:
+        """The prefix path's xs-only staged fields (per-point frontier
+        positions, remaining-level masks, freshness geometry), shared
+        with the sharded subclass (which re-places the arrays across its
+        mesh).  ``xj``: padded device xs [1, M_pad, nb]."""
+        if xj.shape[1] == 0:
+            raise ShapeError("cannot stage an empty batch")
+        from dcf_tpu.backends.pallas_backend import _stage_xs
+        from dcf_tpu.backends.pallas_prefix import _stage_prefix_idx
+
+        k = self._k()
+        return dict(
+            idx=_stage_prefix_idx(xj[0], k=k),
+            x_mask_rem=_stage_xs(xj)[:, k:],
+            k=k, n=8 * int(xj.shape[-1]), wt=wt)
+
+    def _check_staged_fresh(self, staged: dict) -> None:
+        """Reject a staged dict cut for a bundle geometry this backend no
+        longer holds (the PR-1 freshness contract, same rule as
+        ``PrefixPallasBackend``): idx and x_mask_rem are sliced at the
+        prefix depth k of the bundle shipped at stage() time, so a
+        put_bundle that moves ``_k()`` (key count shifts the cliff cap)
+        or the domain depth would pair new CW slices with masks cut at
+        the old k — at best an opaque Pallas shape error, at worst a
+        silently-wrong share.  Same-geometry re-ships stay valid,
+        including on the other party's backend instance."""
+        if "idx" not in staged:
+            # api-edge: documented staged-protocol contract (a dict from
+            # a from-root hybrid backend's stage has no prefix indices)
+            raise ValueError(
+                "staged dict is not from a prefix-enabled hybrid "
+                "backend's stage")
+        k_now, n_now = self._k(), self._bundle.n_bits
+        if staged.get("k") != k_now or staged.get("n") != n_now:
+            raise StaleStateError(
+                f"staged points are stale: staged at prefix depth "
+                f"k={staged.get('k')} over an n={staged.get('n')}-level "
+                f"domain, but the backend now holds a bundle with "
+                f"k={k_now}, n={n_now}; re-stage the points after "
+                "put_bundle")
 
     def eval_staged(self, b: int, staged: dict) -> jax.Array:
         """Party ``b`` eval; returns DEVICE uint8 [K, M_pad, lam]."""
         const, w8 = self._wide_staged()
         dev = self._dev
         cc = self._col_chunk_for(self._bundle.num_keys)
+        if self.prefix_levels:
+            self._check_staged_fresh(staged)
+            state_tbl, traj_tbl = self._frontier_tables(b)
+            cs0r, cs1r, cv0r, cv1r, cw_t_r = self._cw_rem
+            return _hybrid_prefix_eval(
+                self.rk2, state_tbl, traj_tbl, staged["idx"],
+                cs0r, cs1r, cv0r, cv1r, dev["np1a"], dev["np1b"],
+                cw_t_r, staged["x_mask_rem"], self._inv_perm, const, w8,
+                col_chunk=cc, k=staged["k"],
+                frontier_size=1 << staged["k"],
+                tile_words=staged["wt"], interpret=self.interpret)
         if self.narrow == "pallas":
             return _hybrid_eval_pallas(
                 self.rk2, dev["s0a"], dev["s0b"], dev["cs0"], dev["cs1"],

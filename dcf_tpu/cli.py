@@ -107,6 +107,11 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
         return (lambda b, bundle, xs: eval_batch_np(prg, b, bundle, xs),
                 None)
     if backend == "hybrid":
+        # --prefix-levels=k > 0 switches the narrow walk to the
+        # prefix-shared path (ops.pallas_hybrid_prefix): top-k frontier
+        # expanded once per (key, party) and cached as a gather table,
+        # only n-k levels walked per point.
+        plev = int(getattr(args, "prefix_levels", 0) or 0) if args else 0
         if args is not None and getattr(args, "mesh", ""):
             import jax
 
@@ -118,12 +123,20 @@ def _make_evaluator(backend: str, lam: int, cipher_keys, native, args=None):
             mesh = make_mesh(shape=_parse_mesh(args.mesh))
             log(f"mesh: {dict(mesh.shape)}")
             be = ShardedLargeLambdaBackend(
-                lam, cipher_keys, mesh,
+                lam, cipher_keys, mesh, prefix_levels=plev,
                 interpret=jax.devices()[0].platform != "tpu")
         else:
             from dcf_tpu.backends.large_lambda import LargeLambdaBackend
 
-            be = LargeLambdaBackend(lam, cipher_keys)
+            kw = {}
+            if plev:
+                import jax
+
+                # The frontier machinery is Pallas-only; same
+                # interpreter rule as the facade applies off-TPU.
+                kw = dict(prefix_levels=plev,
+                          interpret=jax.devices()[0].platform != "tpu")
+            be = LargeLambdaBackend(lam, cipher_keys, **kw)
     elif backend == "jax":
         from dcf_tpu.backends.jax_backend import JaxBackend
 
@@ -281,15 +294,17 @@ def _timed(fn, reps: int, profile: str = ""):
 
 def _pinned_ratio(nb: int, k: int, rate: float,
                   interpreted: bool = False,
-                  baseline_path: str | None = None) -> dict:
+                  baseline_path: str | None = None,
+                  lam: int = 16) -> dict:
     """vs_baseline against the pinned per-shape single-core CPU anchor
     (benchmarks/cpu_baseline.json, CPU_BASELINE.md protocol), when one
-    exists for this shape — the flagship N=16 pin or the config-2
-    literal n=32 entry.  Empty otherwise (no silent in-run fallback),
-    and empty for ``interpreted`` runs: a Pallas-interpreter smoke run's
-    ratio against a real CPU pin is meaningless noise (host backends and
-    compiled device runs keep theirs).  ``baseline_path`` overrides the
-    artifact location (tests feed corrupt/absent files through it)."""
+    exists for this shape — the flagship N=16 pin, the config-2 literal
+    n=32 entry, or (round 6) the lam=128/256/16384 large-lambda
+    entries.  Empty otherwise (no silent in-run fallback), and empty for
+    ``interpreted`` runs: a Pallas-interpreter smoke run's ratio against
+    a real CPU pin is meaningless noise (host backends and compiled
+    device runs keep theirs).  ``baseline_path`` overrides the artifact
+    location (tests feed corrupt/absent files through it)."""
     import os
 
     if k != 1 or interpreted:
@@ -303,15 +318,20 @@ def _pinned_ratio(nb: int, k: int, rate: float,
         # ValueError covers json.JSONDecodeError: a corrupt baseline file
         # omits vs_baseline instead of aborting the whole bench run.
         return {}
-    entry, tag = ((pinned, "flagship") if nb == 16 else
-                  (pinned.get("shapes", {}).get("n32"), "n32")
-                  if nb == 4 else (None, ""))
+    if lam != 16:
+        tag = {128: "lam128", 256: "lam256", 16384: "lam16384"}.get(lam, "")
+        entry = pinned.get("shapes", {}).get(tag) if tag else None
+    else:
+        entry, tag = ((pinned, "flagship") if nb == 16 else
+                      (pinned.get("shapes", {}).get("n32"), "n32")
+                      if nb == 4 else (None, ""))
     if not entry:
         return {}
+    note = "; flagship-ratio transferred pin" if entry.get("anchor") else ""
     return {"vs_baseline": round(rate / entry["evals_per_sec"], 2),
             "baseline": f"pinned single-core {tag} "
                         f"({entry['evals_per_sec']:,.0f} evals/s, "
-                        "CPU_BASELINE.md protocol)"}
+                        f"CPU_BASELINE.md protocol{note})"}
 
 
 def _emit(name: str, backend: str, metric: str, value: float, unit: str,
@@ -507,7 +527,10 @@ def bench_large_lambda(args) -> None:
         unit = "evals/s"
     name = args.backend if k == 1 else f"{args.backend} (K={k})"
     _emit("dcf_large_lambda", name, "evals_per_sec",
-          k * m / dt, unit, dt, mad, len(ss))
+          k * m / dt, unit, dt, mad, len(ss),
+          extra_fields=_pinned_ratio(
+              nb, k, k * m / dt, lam=lam,
+              interpreted=bool(getattr(be, "interpret", False))))
 
 
 def bench_secure_relu(args) -> None:
@@ -881,6 +904,11 @@ def main(argv=None) -> None:
     p.add_argument("--lam", type=int, default=0,
                    help="range bytes for dcf_large_lambda (0 = 16384; "
                         "256 = BASELINE config 4)")
+    p.add_argument("--prefix-levels", type=int, default=0,
+                   help="dcf_large_lambda --backend=hybrid: expand the "
+                        "top k narrow-walk levels once per (key, party) "
+                        "as a cached frontier gather table and walk only "
+                        "n-k levels per point (0 = from-root walk)")
     p.add_argument("--domain-bytes", type=int, default=0,
                    help="input width for dcf_batch_eval (0 = 16)")
     p.add_argument("--device-gen", action="store_true",
@@ -899,6 +927,11 @@ def main(argv=None) -> None:
         raise SystemExit(
             "--backend=hybrid is the large-lambda evaluator; it only "
             "applies to the dcf_large_lambda bench (and baseline)")
+    if args.prefix_levels and args.backend != "hybrid":
+        raise SystemExit(
+            "--prefix-levels configures the hybrid's prefix-shared "
+            "narrow walk; use it with --backend=hybrid (the lam=16 "
+            "prefix backend picks its own depth from the batch size)")
     if args.bench == "baseline":
         bench_baseline(args)
         return

@@ -1,0 +1,221 @@
+"""Prefix-shared NARROW walk kernels for the large-lambda hybrid.
+
+The hybrid evaluator (backends.large_lambda) reduces a lam-byte DCF
+evaluation to a 32-byte two-block narrow walk plus a GF(2) affine wide
+part.  That narrow walk is a from-root n-level walk — exactly the shape
+the round-5 prefix-frontier machinery (ops.pallas_prefix) accelerates
+for lam=16: a batch of shared points redundantly recomputes the top
+k ~ log2(M) levels M times, while a 2^k-node frontier expanded ONCE per
+(key, party) turns that into a per-point gather plus n-k walked levels.
+
+This module is that machinery for the narrow walk.  Differences from the
+lam=16 frontier (ops.pallas_prefix):
+
+* the carry is FIVE pieces — (sa, sb, va, vb) block planes plus the
+  t bit — so a frontier row is 16 int32 columns (sa|sb|va|vb, 4 each)
+  instead of 8; the measured XLA gather is data-bound at 32 B
+  (micro_gather.py: 64 B rows cost exactly 2x), so the 64 B row costs
+  ~2x the lam=16 gather per point and the table cliff arrives one level
+  earlier (2^21 rows = the same 128 MB);
+* there is NO structurally-zero plane to stash t in (the narrow walk is
+  unmasked — the big PRG's 8*lam-1 masked bit lives in the WIDE part,
+  reference src/prg.rs:65-68), but the wide part needs the whole t-bit
+  TRAJECTORY anyway, so the per-node trajectory prefix (gate bits
+  0..k-1 plus the depth-k carry t at bit k, k+1 <= 32 bits) rides in a
+  separate one-word-per-node table gathered with the same indices;
+* the frontier is built ON DEVICE by walking all 2^k node prefixes k
+  levels through the shared narrow level loop (``narrow_state_walk``),
+  emitting raw carries instead of y — k*2^k PRG calls, vs the tree
+  kernel's 2^{k+1}; still key material off the eval clock, and a narrow
+  tree-expansion kernel remains the known upgrade if build cost ever
+  matters (it has not: the build is one untimed pass per (key, party)).
+
+The eval kernel gathers each point's row, repacks it with the in-kernel
+32x32 butterfly bit transposes (ops.pallas_prefix.rows_to_state_planes,
+~0.5 ms per table at M = 2^20), walks the remaining n-k levels via the
+SAME level loop as the from-root narrow kernel, and emits the y blocks
+plus the remaining trajectory — the wide matmul then consumes the
+gathered top-k gate planes concatenated with the walked ones.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+from dcf_tpu.errors import ShapeError
+from dcf_tpu.ops._compat import CompilerParams as _CompilerParams
+
+from dcf_tpu.ops.pallas_narrow import make_narrow_aes, narrow_walk_levels
+from dcf_tpu.ops.pallas_prefix import rows_to_state_planes
+
+__all__ = ["narrow_state_walk_pallas", "dcf_hybrid_prefix_pallas"]
+
+
+def _state_kernel(rk2_ref, s0a_ref, s0b_ref, cs0_ref, cs1_ref, cv0_ref,
+                  cv1_ref, cw_t_ref, xm_ref,
+                  sa_ref, sb_ref, va_ref, vb_ref, tr_ref,
+                  *, b: int, n: int, interpret: bool):
+    wt = xm_ref.shape[3]
+    ones = jnp.int32(-1)
+    aes = make_narrow_aes(rk2_ref, wt, interpret)
+
+    z = jnp.zeros((128, wt), jnp.int32)
+    sa = s0a_ref[0] ^ z
+    sb = s0b_ref[0] ^ z
+    t = jnp.full((1, wt), ones if b else jnp.int32(0), jnp.int32)
+
+    sa, sb, t, va, vb = narrow_walk_levels(
+        aes, sa, sb, t, z, z, cs0_ref, cs1_ref, cv0_ref, cv1_ref,
+        cw_t_ref, xm_ref, tr_ref, n)
+    sa_ref[0] = sa
+    sb_ref[0] = sb
+    va_ref[0] = va
+    vb_ref[0] = vb
+
+
+def narrow_state_walk_pallas(
+    rk2,      # int32 [15, 128, 2]   bit-major round keys (ciphers 0, 17)
+    s0a, s0b,  # int32 [K, 128, 1]   seed planes per narrow block
+    cs0, cs1,  # int32 [K, k, 128, 1]  CW seed planes, levels 0..k-1
+    cv0, cv1,  # int32 [K, k, 128, 1]  CW value planes
+    cw_t,     # int32 [K, k, 2]      (tl, tr) 0/-1
+    x_mask,   # int32 [1, k, 1, W]   walk-order bit masks for the 2^k
+              #                      node prefixes (frontier-position
+              #                      enumeration, shared across keys)
+    *,
+    b: int,
+    tile_words: int = 128,
+    interpret: bool = False,
+):
+    """Walk the top k levels for every frontier node prefix, emitting the
+    RAW carry instead of y: returns (sa, sb, va, vb [K, 128, W] planes,
+    trajectory [K, k+1, W]) — the frontier-build half of the hybrid
+    prefix path (key material, off the eval clock)."""
+    k_num = s0a.shape[0]
+    n = cs0.shape[1]
+    w = x_mask.shape[3]
+    wt = min(tile_words, w)
+    if w % wt != 0:
+        raise ShapeError(f"node words {w} not a multiple of tile {wt}")
+
+    grid = (k_num, w // wt)
+    keyed = pl.BlockSpec((1, 128, 1), lambda k, j: (k, 0, 0))
+    level_spec = pl.BlockSpec((1, n, 128, 1), lambda k, j: (k, 0, 0, 0))
+    state_out = pl.BlockSpec((1, 128, wt), lambda k, j: (k, 0, j))
+    params = (dict() if interpret else dict(
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024)))
+    return pl.pallas_call(
+        partial(_state_kernel, b=b, n=n, interpret=interpret),
+        **params,
+        out_shape=(
+            jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((k_num, n + 1, w), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((15, 128, 2), lambda k, j: (0, 0, 0)),
+            keyed, keyed,
+            level_spec, level_spec, level_spec, level_spec,
+            pl.BlockSpec((1, n, 2), lambda k, j: (k, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, n, 1, wt), lambda k, j: (0, 0, 0, j)),
+        ],
+        out_specs=(
+            state_out, state_out, state_out, state_out,
+            pl.BlockSpec((1, n + 1, wt), lambda k, j: (k, 0, j)),
+        ),
+        interpret=interpret,
+    )(rk2, s0a, s0b, cs0, cs1, cv0, cv1, cw_t, x_mask)
+
+
+def _eval_kernel(rk2_ref, rows_ref, t0_ref, cs0_ref, cs1_ref, cv0_ref,
+                 cv1_ref, np1a_ref, np1b_ref, cw_t_ref, xm_ref,
+                 y0_ref, y1_ref, tr_ref, *, n_rem: int, interpret: bool):
+    wt = xm_ref.shape[3]
+    aes = make_narrow_aes(rk2_ref, wt, interpret)
+
+    blk = rows_ref[0]  # [16, 32, wt]: sa|sb|va|vb, 4 int32 columns each
+    sa = rows_to_state_planes(jnp, blk[0:4])
+    sb = rows_to_state_planes(jnp, blk[4:8])
+    va = rows_to_state_planes(jnp, blk[8:12])
+    vb = rows_to_state_planes(jnp, blk[12:16])
+    t = t0_ref[0]  # [1, wt] packed depth-k carry bits
+
+    sa, sb, t, va, vb = narrow_walk_levels(
+        aes, sa, sb, t, va, vb, cs0_ref, cs1_ref, cv0_ref, cv1_ref,
+        cw_t_ref, xm_ref, tr_ref, n_rem)
+    y0_ref[0] = va ^ sa ^ (np1a_ref[0] & t)
+    y1_ref[0] = vb ^ sb ^ (np1b_ref[0] & t)
+
+
+def dcf_hybrid_prefix_pallas(
+    rk2,       # int32 [15, 128, 2]      bit-major round keys (0, 17)
+    rows,      # int32 [K, 16, 32, W]    gathered state rows, j-reversed
+               #                         tile layout (ops.pallas_prefix
+               #                         module docstring); columns
+               #                         0-3 sa, 4-7 sb, 8-11 va, 12-15 vb
+    t0_pm,     # int32 [K, 1, W]         packed depth-k carry t bits
+    cs0, cs1,  # int32 [K, n_rem, 128, 1]  CW planes for levels k..n-1
+    cv0, cv1,  # int32 [K, n_rem, 128, 1]
+    np1a, np1b,  # int32 [K, 128, 1]     final CW planes per block
+    cw_t,      # int32 [K, n_rem, 2]
+    x_mask,    # int32 [1, n_rem, 1, W]  lane masks for levels k..n-1
+    *,
+    tile_words: int = 128,
+    interpret: bool = False,
+):
+    """Walk the remaining n-k narrow levels from gathered frontier
+    carries.  Party is implicit (the frontier rows were expanded from the
+    party's key share).  Returns (y_block0 [K, 128, W], y_block1
+    [K, 128, W], remaining trajectory [K, n_rem+1, W]) — same layouts as
+    ``dcf_narrow_walk_pallas``; the trajectory's first entry is the
+    depth-k gate (== the gathered carry t), its last the final bit."""
+    k_num = rows.shape[0]
+    n_rem = cs0.shape[1]
+    w = x_mask.shape[3]
+    wt = min(tile_words, w)
+    if w % wt != 0:
+        raise ShapeError(f"point words {w} not a multiple of tile {wt}")
+
+    grid = (k_num, w // wt)
+    keyed = pl.BlockSpec((1, 128, 1), lambda k, j: (k, 0, 0))
+    level_spec = pl.BlockSpec((1, n_rem, 128, 1),
+                              lambda k, j: (k, 0, 0, 0))
+    state_out = pl.BlockSpec((1, 128, wt), lambda k, j: (k, 0, j))
+    params = (dict() if interpret else dict(
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024)))
+    return pl.pallas_call(
+        partial(_eval_kernel, n_rem=n_rem, interpret=interpret),
+        **params,
+        out_shape=(
+            jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((k_num, n_rem + 1, w), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((15, 128, 2), lambda k, j: (0, 0, 0)),
+            pl.BlockSpec((1, 16, 32, wt), lambda k, j: (k, 0, 0, j)),
+            pl.BlockSpec((1, 1, wt), lambda k, j: (k, 0, j)),
+            level_spec, level_spec, level_spec, level_spec,
+            keyed, keyed,
+            pl.BlockSpec((1, n_rem, 2), lambda k, j: (k, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, n_rem, 1, wt), lambda k, j: (0, 0, 0, j)),
+        ],
+        out_specs=(
+            state_out, state_out,
+            pl.BlockSpec((1, n_rem + 1, wt), lambda k, j: (k, 0, j)),
+        ),
+        interpret=interpret,
+    )(rk2, rows, t0_pm, cs0, cs1, cv0, cv1, np1a, np1b, cw_t, x_mask)

@@ -43,16 +43,17 @@ from dcf_tpu.ops.aes_bitsliced import (
     prep_rk_bitmajor_v3,
 )
 
-__all__ = ["dcf_narrow_walk_pallas"]
+__all__ = ["dcf_narrow_walk_pallas", "make_narrow_aes",
+           "narrow_walk_levels"]
 
 
-def _kernel(rk2_ref, s0a_ref, s0b_ref, cs0_ref, cs1_ref, cv0_ref, cv1_ref,
-            np1a_ref, np1b_ref, cw_t_ref, xm_ref,
-            y0_ref, y1_ref, tr_ref, *, b: int, n: int, interpret: bool):
-    wt = xm_ref.shape[3]
+def make_narrow_aes(rk2_ref, wt: int, interpret: bool):
+    """The narrow walk's per-grid-step AES closure: ONE cipher application
+    over [128, 4*wt] with lane-dependent round keys (cipher 0 over lanes
+    [0, 2wt), cipher 17 over [2wt, 4wt)).  rk2_ref is [15, 128, 2];
+    expanded once per grid step.  Interpret mode keeps the compact v1
+    graph (same rule as ops.pallas_eval.make_aes)."""
     ones = jnp.int32(-1)
-    # Lane-dependent round keys: cipher 0 over lanes [0, 2wt), cipher 17
-    # over [2wt, 4wt).  rk2_ref is [15, 128, 2]; expand once per grid step.
     z2 = jnp.zeros((15, 128, 2 * wt), jnp.int32)
     rk_wide = jnp.concatenate(
         [rk2_ref[:, :, 0:1] ^ z2, rk2_ref[:, :, 1:2] ^ z2], axis=2)
@@ -61,18 +62,24 @@ def _kernel(rk2_ref, s0a_ref, s0b_ref, cs0_ref, cs1_ref, cv0_ref, cv1_ref,
             # v1 path with per-lane keys: ARK via the wide masks
             return aes256_encrypt_planes_bitmajor(
                 jnp, rk_wide, state, ones)
-    else:
-        rk_p = prep_rk_bitmajor_v3(jnp, rk_wide)
+        return aes
+    rk_p = prep_rk_bitmajor_v3(jnp, rk_wide)
 
-        def aes(state):
-            return aes_walk_cipher_v3(jnp, rk_p, state, ones)
+    def aes(state):
+        return aes_walk_cipher_v3(jnp, rk_p, state, ones)
+    return aes
 
-    z = jnp.zeros((128, wt), jnp.int32)
-    sa = s0a_ref[0] ^ z  # block 0 seed planes
-    sb = s0b_ref[0] ^ z  # block 1
-    t = jnp.full((1, wt), ones if b else jnp.int32(0), jnp.int32)
-    va = z
-    vb = z
+
+def narrow_walk_levels(aes, sa, sb, t, va, vb, cs0_ref, cs1_ref, cv0_ref,
+                       cv1_ref, cw_t_ref, xm_ref, tr_ref, n: int):
+    """The n-level NARROW walk loop on packed two-block planes, shared by
+    the from-root kernel below and the hybrid-prefix kernels
+    (ops.pallas_hybrid_prefix).  The cw/xm refs are indexed [0, i] per
+    level i in 0..n-1; the GATE bit of every level plus the final t are
+    written to ``tr_ref`` (n+1 entries).  Returns the final carry
+    (sa, sb, t, va, vb)."""
+    ones = jnp.int32(-1)
+    wt = xm_ref.shape[3]
 
     def level(i, carry):
         sa, sb, t, va, vb = carry
@@ -112,8 +119,26 @@ def _kernel(rk2_ref, s0a_ref, s0b_ref, cs0_ref, cs1_ref, cv0_ref, cv1_ref,
         t = (t_r & xm) | (t_l & nxm)
         return (sa, sb, t, va, vb)
 
-    sa, sb, t, va, vb = jax.lax.fori_loop(0, n, level, (sa, sb, t, va, vb))
-    tr_ref[0, pl.dslice(n, 1)] = t
+    carry = jax.lax.fori_loop(0, n, level, (sa, sb, t, va, vb))
+    tr_ref[0, pl.dslice(n, 1)] = carry[2]
+    return carry
+
+
+def _kernel(rk2_ref, s0a_ref, s0b_ref, cs0_ref, cs1_ref, cv0_ref, cv1_ref,
+            np1a_ref, np1b_ref, cw_t_ref, xm_ref,
+            y0_ref, y1_ref, tr_ref, *, b: int, n: int, interpret: bool):
+    wt = xm_ref.shape[3]
+    ones = jnp.int32(-1)
+    aes = make_narrow_aes(rk2_ref, wt, interpret)
+
+    z = jnp.zeros((128, wt), jnp.int32)
+    sa = s0a_ref[0] ^ z  # block 0 seed planes
+    sb = s0b_ref[0] ^ z  # block 1
+    t = jnp.full((1, wt), ones if b else jnp.int32(0), jnp.int32)
+
+    sa, sb, t, va, vb = narrow_walk_levels(
+        aes, sa, sb, t, z, z, cs0_ref, cs1_ref, cv0_ref, cv1_ref,
+        cw_t_ref, xm_ref, tr_ref, n)
     y0_ref[0] = va ^ sa ^ (np1a_ref[0] & t)
     y1_ref[0] = vb ^ sb ^ (np1b_ref[0] & t)
 

@@ -56,6 +56,7 @@ from dcf_tpu.backends.fulldomain import TreeFullDomain, leaf_mismatch_count
 from dcf_tpu.backends.large_lambda import (
     LargeLambdaBackend,
     _hybrid_eval_pallas,
+    hybrid_prefix_gather_walk,
 )
 from dcf_tpu.backends.pallas_keylanes import KeyLanesPallasBackend
 from dcf_tpu.backends.pallas_prefix import (
@@ -313,12 +314,21 @@ class ShardedLargeLambdaBackend(LargeLambdaBackend):
     Always uses the Pallas narrow walk (Mosaic on TPU meshes, the
     interpreter on virtual CPU meshes); the XLA-narrow layout stores keys
     on the trailing axis and is not wired for sharding.
+
+    ``prefix_levels`` > 0 runs the prefix-shared narrow walk
+    (ops.pallas_hybrid_prefix): the frontier tables are key material and
+    shard over the KEYS axis with the rest of the bundle image; the
+    per-point gather is a pure map against the local key shard's tables,
+    so points shard with no collectives — same contract as the from-root
+    path.
     """
 
     def __init__(self, lam: int, cipher_keys: Sequence[bytes], mesh: Mesh,
-                 col_chunk: int = 1 << 15, interpret: bool = False):
+                 col_chunk: int = 1 << 15, interpret: bool = False,
+                 prefix_levels: int = 0):
         super().__init__(lam, cipher_keys, col_chunk=col_chunk,
-                         narrow="pallas", interpret=interpret)
+                         narrow="pallas", interpret=interpret,
+                         prefix_levels=prefix_levels)
         self.mesh = mesh
         kaxis, paxis = mesh.axis_names
         self._ksize = mesh.shape[kaxis]
@@ -326,6 +336,8 @@ class ShardedLargeLambdaBackend(LargeLambdaBackend):
         self._spec_keyed = P(kaxis)              # [K, ...] bundle arrays
         self._spec_xs = P(None, paxis, None)     # [1, M, nb]
         self._spec_y = P(kaxis, paxis, None)     # [K, M, lam]
+        self._spec_idx = P(paxis)                # [M] frontier positions
+        self._spec_xmask_rem = P(None, None, None, paxis)
         self._fns: dict = {}
 
     def put_bundle(self, bundle: KeyBundle) -> None:
@@ -334,8 +346,27 @@ class ShardedLargeLambdaBackend(LargeLambdaBackend):
                 f"num_keys={bundle.num_keys} not divisible by keys-axis "
                 f"size {self._ksize}")
         super().put_bundle(bundle)
+        # The frontier build walks an eager pallas_call, which cannot
+        # consume mesh-sharded operands — keep the single-device image
+        # for it (prefix path only; the from-root path has no consumer
+        # and must not pin a duplicate of the plane image).
+        self._dev_host = dict(self._dev) if self.prefix_levels else None
         sh = NamedSharding(self.mesh, self._spec_keyed)
         self._dev = {k: jax.device_put(v, sh) for k, v in self._dev.items()}
+        if self.prefix_levels:
+            self._slice_cw_rem()  # re-slice from the PLACED image
+
+    def _narrow_dev_for_build(self) -> dict:
+        return self._dev_host
+
+    def _frontier_tables(self, b: int):
+        state_tbl, traj_tbl = super()._frontier_tables(b)
+        if not isinstance(state_tbl.sharding, NamedSharding):
+            sh = NamedSharding(self.mesh, self._spec_keyed)
+            state_tbl = jax.device_put(state_tbl, sh)
+            traj_tbl = jax.device_put(traj_tbl, sh)
+            self._frontier[int(b)] = (state_tbl, traj_tbl)  # placed copy
+        return state_tbl, traj_tbl
 
     def _wide_staged(self):
         if self._wide is None:
@@ -359,12 +390,60 @@ class ShardedLargeLambdaBackend(LargeLambdaBackend):
         xs_dev = jax.device_put(
             np.ascontiguousarray(xs)[None],
             NamedSharding(self.mesh, self._spec_xs))
-        return {"xs": xs_dev, "m": m}
+        staged = {"xs": xs_dev, "m": m}
+        if self.prefix_levels:
+            fields = self._prefix_stage_fields(
+                jnp.asarray(xs)[None],
+                min(128, m_pad // 32 // self._psize))
+            fields["idx"] = jax.device_put(
+                fields["idx"], NamedSharding(self.mesh, self._spec_idx))
+            fields["x_mask_rem"] = jax.device_put(
+                fields["x_mask_rem"],
+                NamedSharding(self.mesh, self._spec_xmask_rem))
+            staged.update(fields)
+        return staged
 
     def eval_staged(self, b: int, staged: dict) -> jax.Array:
         const, w8 = self._wide_staged()
         dev = self._dev
         cc = self._col_chunk_for(self._bundle.num_keys // self._ksize)
+        if self.prefix_levels:
+            self._check_staged_fresh(staged)
+            state_tbl, traj_tbl = self._frontier_tables(b)
+            key = ("prefix", staged["k"], staged["wt"], cc)
+            fn = self._fns.get(key)
+            if fn is None:
+                fn = jax.jit(
+                    shard_map(
+                        partial(hybrid_prefix_gather_walk,
+                                col_chunk=cc, k=staged["k"],
+                                frontier_size=1 << staged["k"],
+                                tile_words=staged["wt"],
+                                interpret=self.interpret),
+                        mesh=self.mesh,
+                        in_specs=(
+                            P(),                   # rk2 (replicated)
+                            self._spec_keyed,      # state_tbl
+                            self._spec_keyed,      # traj_tbl
+                            self._spec_idx,        # per-point positions
+                            *([self._spec_keyed] * 4),  # remaining CWs
+                            self._spec_keyed,      # np1a
+                            self._spec_keyed,      # np1b
+                            self._spec_keyed,      # cw_t remainder
+                            self._spec_xmask_rem,
+                            P(),                   # inv_perm
+                            self._spec_keyed,      # wide const
+                            self._spec_keyed,      # wide w8
+                        ),
+                        out_specs=self._spec_y,
+                        check_vma=False,  # pure map, no collectives
+                    ))
+                self._fns[key] = fn
+            cs0r, cs1r, cv0r, cv1r, cw_t_r = self._cw_rem
+            return fn(self.rk2, state_tbl, traj_tbl, staged["idx"],
+                      cs0r, cs1r, cv0r, cv1r, dev["np1a"], dev["np1b"],
+                      cw_t_r, staged["x_mask_rem"], self._inv_perm,
+                      const, w8)
         # cc is baked into the shard closure, so it must key the cache:
         # a later put_bundle with a different key count gets a fresh fn
         # (the unsharded base re-specializes via a jit static arg).

@@ -56,6 +56,26 @@ def test_cli_large_lambda_hybrid_smoke(capsys):
     assert recs[0]["value"] > 0
 
 
+@pytest.mark.slow
+def test_cli_mid_lambda_hybrid_prefix_smoke(capsys):
+    """The mid-lambda hybrid-prefix bench path end to end in the serial
+    CI leg (round-6 valley work): lam=128 through --prefix-levels with
+    the parity gate on, then the flag's hybrid-only contract."""
+    recs = run_cli(
+        capsys,
+        ["dcf_large_lambda", "--backend=hybrid", "--lam=128",
+         "--points=64", "--reps=1", "--prefix-levels=6", "--check"],
+    )
+    assert recs[0]["backend"] == "hybrid"
+    assert recs[0]["value"] > 0
+
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="prefix-levels"):
+        cli.main(["dcf_batch_eval", "--backend=numpy",
+                  "--prefix-levels=6"])
+
+
 def test_pinned_ratio_corrupt_baseline(tmp_path):
     """ADVICE finding 2, regression-locked: a corrupt (or absent)
     benchmarks/cpu_baseline.json must yield {} — the bench line then

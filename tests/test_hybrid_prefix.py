@@ -1,0 +1,213 @@
+"""Prefix-shared hybrid (large-lambda) evaluator parity.
+
+The top-k narrow frontier (device state walk), the 16-column row gather
+with the trajectory-prefix word table, the in-kernel butterfly
+transposes, the remaining-level narrow walk, and the wide tail over the
+REASSEMBLED gate trajectory must compose to exactly the from-root hybrid
+— bit-for-bit against the full-width numpy oracle, both parties, both
+bounds, K = 1 and K = 3.  Plus the PR-1 geometry-freshness contract and
+the round-6 Pallas DMA-gather probe kernel's correctness.
+"""
+
+import random
+import warnings
+
+import numpy as np
+import pytest
+
+from dcf_tpu import spec
+from dcf_tpu.backends.large_lambda import LargeLambdaBackend
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.errors import StaleStateError
+from dcf_tpu.gen import gen_batch, random_s0s
+from dcf_tpu.ops.prg import HirosePrgNp
+
+
+def rand_bytes(rng: random.Random, n: int) -> bytes:
+    return bytes(rng.getrandbits(8) for _ in range(n))
+
+
+def _setup(seed, lam, nb=2, m=9, bound=spec.Bound.LT_BETA, k=1):
+    rng = random.Random(seed)
+    ck = [rand_bytes(rng, 32) for _ in range(max(18, 2 * (lam // 16)))]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", spec.ReferenceContractWarning)
+        prg = HirosePrgNp(lam, ck)
+    nprng = np.random.default_rng(seed)
+    alphas = nprng.integers(0, 256, (k, nb), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k, lam), dtype=np.uint8)
+    bundle = gen_batch(prg, alphas, betas, random_s0s(k, lam, nprng), bound)
+    xs = nprng.integers(0, 256, (m, nb), dtype=np.uint8)
+    xs[0] = alphas[0]  # boundary point
+    if m > 2:
+        xs[1] = 0
+        xs[2] = 255
+    return ck, prg, alphas, betas, bundle, xs
+
+
+@pytest.mark.parametrize("bound", [spec.Bound.LT_BETA, spec.Bound.GT_BETA])
+def test_hybrid_prefix_matches_oracle(bound):
+    """lam=144, ragged 37-point batch (tile padding through the gather),
+    both parties, vs the full-width oracle, plus XOR reconstruction."""
+    ck, prg, alphas, betas, bundle, xs = _setup(61, 144, m=37, bound=bound)
+    be = LargeLambdaBackend(144, ck, prefix_levels=6, interpret=True)
+    ys = {}
+    for b in (0, 1):
+        kb = bundle.for_party(b)
+        got = be.eval(b, xs, bundle=kb)
+        want = eval_batch_np(prg, b, kb, xs)
+        assert np.array_equal(got, want), f"party {b} {bound}"
+        ys[b] = got
+    recon = ys[0][0] ^ ys[1][0]
+    a = alphas[0].tobytes()
+    for j in range(xs.shape[0]):
+        x = xs[j].tobytes()
+        hit = x < a if bound is spec.Bound.LT_BETA else x > a
+        want_y = betas[0].tobytes() if hit else bytes(144)
+        assert recon[j].tobytes() == want_y
+
+
+@pytest.mark.parametrize("bound", [spec.Bound.LT_BETA, spec.Bound.GT_BETA])
+def test_hybrid_prefix_multikey(bound):
+    """K=3 keys over shared points: per-key frontier tables stacked, the
+    shared prefix indices offset per key, one flat 16-column gather —
+    bit-exact per key, both parties, plus the staged device counter and
+    the frontier-cached-per-party invariant."""
+    ck, prg, alphas, betas, bundle, xs = _setup(62, 144, m=32, k=3,
+                                                bound=bound)
+    be0 = LargeLambdaBackend(144, ck, prefix_levels=6, interpret=True)
+    be1 = LargeLambdaBackend(144, ck, prefix_levels=6, interpret=True)
+    be0.put_bundle(bundle.for_party(0))
+    be1.put_bundle(bundle.for_party(1))
+    staged = be0.stage(xs)  # same-geometry dict serves both parties
+    ys_dev = {0: be0.eval_staged(0, staged), 1: be1.eval_staged(1, staged)}
+    for b, bk in ((0, be0), (1, be1)):
+        got = bk.staged_to_bytes(ys_dev[b], staged["m"])
+        want = eval_batch_np(prg, b, bundle.for_party(b), xs)
+        assert np.array_equal(got, want), f"party {b} {bound}"
+    # Frontier built once per (bundle, party) and reused.
+    tbl = be0._frontier[0]
+    y0b = be0.eval_staged(0, staged)
+    assert be0._frontier[0] is tbl
+    assert np.array_equal(np.asarray(ys_dev[0]), np.asarray(y0b))
+    gt = bound is spec.Bound.GT_BETA
+    assert int(be0.points_mismatch_count(
+        ys_dev[0], ys_dev[1], alphas, betas, staged, gt=gt)) == 0
+    wrong = betas ^ np.uint8(1)
+    n_inside = sum(
+        (xs[j].tobytes() < alphas[i].tobytes()) != gt
+        and xs[j].tobytes() != alphas[i].tobytes()
+        for i in range(3) for j in range(xs.shape[0]))
+    assert int(be0.points_mismatch_count(
+        ys_dev[0], ys_dev[1], alphas, wrong, staged, gt=gt)) == n_inside
+
+
+def test_hybrid_prefix_staleness():
+    """The PR-1 geometry-freshness contract: a staged dict cut at one
+    (k, n) geometry is rejected once put_bundle moves it, and a
+    from-root hybrid's staged dict (no prefix indices) is rejected by
+    name."""
+    ck, prg, _a, _b, bundle, xs = _setup(63, 144, nb=2, m=9)
+    be = LargeLambdaBackend(144, ck, prefix_levels=6, interpret=True)
+    be.put_bundle(bundle.for_party(0))
+    staged = be.stage(xs)
+    assert (staged["k"], staged["n"]) == (6, 16)
+    # Same geometry re-ship stays valid.
+    be.put_bundle(bundle.for_party(0))
+    be.eval_staged(0, staged)
+    # Domain-depth drift (n 16 -> 24) must be rejected.
+    _ck3, _prg3, _a3, _b3, bundle3, _xs3 = _setup(64, 144, nb=3, m=9)
+    be.put_bundle(bundle3.for_party(0))
+    with pytest.raises(StaleStateError, match="re-stage"):
+        be.eval_staged(0, staged)
+    # A from-root backend's staged dict has no prefix indices.
+    be_root = LargeLambdaBackend(144, ck, narrow="pallas", interpret=True)
+    be_root.put_bundle(bundle.for_party(0))
+    root_staged = be_root.stage(xs)
+    be.put_bundle(bundle.for_party(0))
+    with pytest.raises(ValueError, match="prefix-enabled"):
+        be.eval_staged(0, root_staged)
+
+
+def test_hybrid_prefix_validation():
+    ck = [rand_bytes(random.Random(65), 32) for _ in range(18)]
+    with pytest.raises(ValueError, match="prefix_levels"):
+        LargeLambdaBackend(144, ck, prefix_levels=3, interpret=True)
+    with pytest.raises(ValueError, match="narrow"):
+        LargeLambdaBackend(144, ck, prefix_levels=6, narrow="xla")
+    with pytest.raises(ValueError, match="host_levels"):
+        LargeLambdaBackend(144, ck, prefix_levels=6, host_levels=6)
+    # Too-shallow domains have no prefix to share (< 5 + 8 levels).
+    ck, prg, _a, _b, bundle, _xs = _setup(66, 144, nb=1)
+    be = LargeLambdaBackend(144, ck, prefix_levels=6, interpret=True)
+    with pytest.raises(ValueError, match="too shallow"):
+        be.put_bundle(bundle.for_party(0))
+
+
+def test_hybrid_prefix_k_clamps():
+    """_k() leaves >= 8 walked levels, shrinks with the key count (the
+    gather-table byte cliff is on TOTAL stacked rows), and floors at 5."""
+    ck, prg, _a, _b, b1, _xs = _setup(67, 144, nb=2, k=1)
+    be = LargeLambdaBackend(144, ck, prefix_levels=20, interpret=True)
+    be.put_bundle(b1.for_party(0))
+    assert be._k() == 8  # n=16 -> n-8
+    _ck, _prg, _a, _b, b9, _xs = _setup(68, 144, nb=4, k=9)
+    be.put_bundle(b9.for_party(0))  # K=9 -> cap 20 - ceil(log2 9) = 16
+    assert be._k() == 16
+
+
+def test_sharded_hybrid_prefix_matches_oracle():
+    """The prefix-shared hybrid under shard_map on a virtual 2x2 mesh:
+    frontier tables key-sharded, points sharded through the gather —
+    bit-exact vs the oracle (collective-free map)."""
+    from dcf_tpu.parallel import ShardedLargeLambdaBackend, make_mesh
+
+    ck, prg, _a, _b, bundle, xs = _setup(69, 144, m=9, k=2)
+    mesh = make_mesh(shape=(2, 2))
+    be = ShardedLargeLambdaBackend(144, ck, mesh, interpret=True,
+                                   prefix_levels=6)
+    for b in (0, 1):
+        kb = bundle.for_party(b)
+        got = be.eval(b, xs, bundle=kb)
+        want = eval_batch_np(prg, b, kb, xs)
+        assert np.array_equal(got, want), f"party {b}"
+
+
+def test_facade_hybrid_prefix():
+    """Dcf(backend="hybrid", backend_opts={"prefix_levels": ...}) routes
+    to the prefix-shared hybrid (interpreter off-TPU, same facade rule
+    as keylanes/prefix) and reconstructs correctly at the lam=48
+    extension edge."""
+    from dcf_tpu import Dcf
+
+    ck, prg, alphas, betas, bundle, xs = _setup(72, 48, m=9)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", spec.ReferenceContractWarning)
+        dcf = Dcf(2, 48, ck, backend="hybrid",
+                  backend_opts={"prefix_levels": 6})
+    assert dcf.eval_backend(0).prefix_levels == 6
+    recon = dcf.eval(0, bundle, xs) ^ dcf.eval(1, bundle, xs)
+    a = alphas[0].tobytes()
+    for j in range(xs.shape[0]):
+        want = betas[0].tobytes() if xs[j].tobytes() < a else bytes(48)
+        assert recon[0, j].tobytes() == want
+
+
+def test_pallas_dma_gather_matches_take():
+    """The round-6 in-kernel gather probe (benchmarks/micro_gather.py):
+    scalar-prefetched indices + per-row HBM DMAs must reproduce
+    jnp.take(tbl, idx, axis=0) bit-exactly (whatever the pricing
+    verdict, the probe must measure a correct program)."""
+    import jax.numpy as jnp
+
+    from benchmarks.micro_gather import pallas_dma_gather
+
+    rng = np.random.default_rng(73)
+    tbl = jnp.asarray(rng.integers(-(2 ** 31), 2 ** 31, (1 << 10, 8),
+                                   dtype=np.int64).astype(np.int32))
+    idx = jnp.asarray(rng.integers(0, 1 << 10, (1 << 11,))
+                      .astype(np.int32))
+    got = pallas_dma_gather(tbl, idx, rows_per_block=256, n_flight=4,
+                            interpret=True)
+    assert np.array_equal(np.asarray(got),
+                          np.asarray(jnp.take(tbl, idx, axis=0)))

@@ -215,6 +215,65 @@ def test_hybrid_multikey_lam16384_compiled():
         assert np.array_equal(got, want), f"party {b}"
 
 
+def test_hybrid_lam128_compiled():
+    """The extension band on hardware: lam=128 (the BASELINE headline's
+    lam reading, reference-inexecutable — 16-key contract cannot cover
+    cipher index 17) through the compiled hybrid, K=2 keys, both
+    parties, C++ anchor + full on-device two-party reconstruction via
+    the staged counter; then the same workload through the compiled
+    PREFIX-shared hybrid (frontier state walk + 16-column gather +
+    remaining-level walk), which must agree bit-for-bit."""
+    import warnings as _warnings
+
+    from dcf_tpu.backends.large_lambda import LargeLambdaBackend
+    from dcf_tpu.native import NativeDcf
+    from dcf_tpu.spec import ReferenceContractWarning
+
+    lam, k_num, m = 128, 2, 96
+    rng = random.Random(84)
+    ck = [rand_bytes(rng, 32) for _ in range(18)]  # index 17 needed
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore", ReferenceContractWarning)
+        native = NativeDcf(lam, ck)
+    nprng = np.random.default_rng(84)
+    alphas = nprng.integers(0, 256, (k_num, 16), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k_num, lam), dtype=np.uint8)
+    bundle = native.gen_batch(alphas, betas, random_s0s(k_num, lam, nprng),
+                              spec.Bound.LT_BETA)
+    xs = nprng.integers(0, 256, (m, 16), dtype=np.uint8)
+    xs[:k_num] = alphas[:, :]  # exact-alpha points
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore", ReferenceContractWarning)
+        be0 = LargeLambdaBackend(lam, ck)
+        be1 = LargeLambdaBackend(lam, ck)
+        bep = {b: LargeLambdaBackend(lam, ck, prefix_levels=12)
+               for b in (0, 1)}
+    assert not be0.interpret
+    be0.put_bundle(bundle.for_party(0))
+    be1.put_bundle(bundle.for_party(1))
+    staged = be0.stage(xs)
+    ys = {0: be0.eval_staged(0, staged), 1: be1.eval_staged(1, staged)}
+    for b, bk in ((0, be0), (1, be1)):
+        got = bk.staged_to_bytes(ys[b], staged["m"])
+        want = native.eval(b, bundle, xs)
+        assert np.array_equal(got, want), f"party {b}"
+    # Full on-device two-party reconstruction (device parity, not just
+    # the host anchor).
+    assert int(be0.points_mismatch_count(
+        ys[0], ys[1], alphas, betas, staged)) == 0
+    # The compiled prefix-shared hybrid agrees bit-for-bit.
+    for b in (0, 1):
+        bep[b].put_bundle(bundle.for_party(b))
+    staged_p = bep[0].stage(xs)
+    ysp = {b: bep[b].eval_staged(b, staged_p) for b in (0, 1)}
+    for b in (0, 1):
+        assert np.array_equal(
+            bep[b].staged_to_bytes(ysp[b], staged_p["m"]),
+            bep[b].staged_to_bytes(ys[b], staged["m"])), f"party {b}"
+    assert int(bep[0].points_mismatch_count(
+        ysp[0], ysp[1], alphas, betas, staged_p)) == 0
+
+
 def test_device_gen_matches_host():
     """On-device keygen produces a bit-identical bundle to the host gen."""
     from dcf_tpu.backends.device_gen import DeviceKeyGen

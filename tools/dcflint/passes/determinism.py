@@ -14,8 +14,11 @@ unreproducible share is an undebuggable one.  Flags:
   and ``Generator`` objects passed by the caller are fine.
 
 Exempt: ``cli.py`` and ``utils/benchtime.py`` (the bench layer is
-*about* wall time) and ``testing/`` (test scaffolding).  Intentional
-entropy — fresh key seeds MUST be unpredictable — is exactly what the
+*about* wall time), ``testing/`` (test scaffolding), and
+``benchmarks/`` (round 6 — the measurement harnesses joined the lint
+run for the OTHER five passes; wall-clock reads and seeded workload
+generation are their whole job).  Intentional entropy — fresh key
+seeds MUST be unpredictable — is exactly what the
 suppression-with-reason mechanism is for.
 """
 
@@ -32,7 +35,7 @@ _NP_LEGACY = ("rand", "randn", "randint", "random", "random_sample",
               "ranf", "sample", "seed", "choice", "shuffle", "permutation",
               "bytes", "uniform", "normal", "standard_normal", "integers")
 _EXEMPT_FILES = ("cli.py", "benchtime.py")
-_EXEMPT_DIRS = ("testing",)
+_EXEMPT_DIRS = ("testing", "benchmarks")
 
 
 def _dotted(node: ast.AST) -> str:
@@ -53,7 +56,17 @@ class DeterminismPass(LintPass):
                    "code (cli.py, utils/benchtime.py, testing/ exempt)")
 
     def check(self, ctx: FileContext) -> Iterator[tuple[int, str]]:
+        # Scoping checks the scan-relative parts AND the scanned root's
+        # own directory name: ``python -m tools.dcflint benchmarks``
+        # hands files whose relpath no longer contains the root dir name
+        # (relpath is relative to the scanned root).  Only that one
+        # on-disk component is consulted — matching arbitrary ancestors
+        # (ctx.path.parts) would silently disable the pass for a repo
+        # that happens to live under a dir named "benchmarks"/"testing".
+        root_parts = ctx.path.parts[:len(ctx.path.parts) - len(ctx.parts)]
+        scan_root = root_parts[-1] if root_parts else ""
         if ctx.basename in _EXEMPT_FILES \
+                or scan_root in _EXEMPT_DIRS \
                 or any(d in ctx.parts[:-1] for d in _EXEMPT_DIRS):
             return
         for node in ast.walk(ctx.tree):
