@@ -10,6 +10,9 @@ AES-256 Hirose PRG, key serialization), redesigned for TPU:
 - ``dcf_tpu.errors`` — the typed failure taxonomy (``DcfError`` family) and
   the ``BackendFallbackWarning`` degradation signal; see ``api``'s
   fault-tolerance docstring section.
+- ``dcf_tpu.serve`` — the online evaluation service (micro-batching,
+  device-resident key cache, admission control, metrics); entry point
+  ``Dcf.serve(...)``, README "Serving" section.
 """
 
 from dcf_tpu.api import Dcf, reset_backend_health  # noqa: F401
@@ -17,8 +20,10 @@ from dcf_tpu.errors import (  # noqa: F401
     BackendFallbackWarning,
     BackendUnavailableError,
     DcfError,
+    DeadlineExceededError,
     KeyFormatError,
     NativeBuildError,
+    QueueFullError,
     ShapeError,
     StaleStateError,
 )

@@ -124,6 +124,13 @@ opaque ``RuntimeError``/``struct.error``/XLA tracebacks:
     NativeBuildError         the C++ core failed to build/load after
                              bounded retries
 
+``Dcf.reset_backend_health()`` (or the module-level function — one
+shared invalidation path) wipes the process verdict cache AND notifies
+every registered holder of backend-derived state: live facades drop
+their constructed backends/shipped bundles (an ``auto`` facade
+re-selects lazily on its next eval) and serving registries
+(``dcf_tpu.serve``) evict their device-resident key images.
+
 ``backend="auto"`` (single-device) is self-healing: the selected backend
 must first pass a tiny spec-checked canary eval (1 key x 2 points, both
 parties reconstructed bit-exactly against the comparison function).  On
@@ -136,9 +143,23 @@ when the whole chain fails does construction raise
 them).  Explicitly named backends stay strict: no canary, no silent
 substitution.  The native keygen core degrades AES-NI -> portable S-box
 the same way (``native.load``), warning instead of crashing.
+
+Online serving (``Dcf.serve`` -> ``dcf_tpu.serve.DcfService``)
+--------------------------------------------------------------
+
+``dcf.serve(**knobs)`` wraps this facade in the online evaluation
+service: named long-lived key bundles, micro-batched ragged requests,
+LRU device residency, admission control, metrics.  The load-bearing
+knobs are ``max_batch`` (throughput / compiled-shape universe),
+``max_delay_ms`` (coalescing latency), ``device_bytes_budget`` (hot key
+working set), ``max_queued_points`` (shed point) and ``retries``
+(fail-over persistence); full semantics in ``dcf_tpu/serve/service.py``
+and the README "Serving" section.
 """
 
 from __future__ import annotations
+
+import weakref
 
 from typing import Sequence
 
@@ -160,7 +181,7 @@ from dcf_tpu.spec import (
     hirose_used_cipher_indices,
 )
 
-__all__ = ["Dcf", "reset_backend_health"]
+__all__ = ["Dcf", "reset_backend_health", "register_reset_listener"]
 
 
 def _default_backend(lam: int) -> str:
@@ -187,11 +208,34 @@ _HEALTHY: set = set()
 _UNHEALTHY: dict = {}  # health key -> first failure; skips re-running a
 # failing canary (seconds of doomed compile) on every construction
 
+# The ONE invalidation path for cached backend state (PR 4): objects
+# holding state derived from a selected backend — every live Dcf (its
+# constructed eval backends + shipped bundles) and every serve-layer
+# KeyRegistry (device-resident key images) — register here, weakly, and
+# get ``_on_backend_health_reset()`` when verdicts are wiped.  Without
+# this, a backend declared dead mid-serve would keep serving from its
+# cached device state while fresh constructions fall back.
+_RESET_LISTENERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_reset_listener(obj) -> None:
+    """Subscribe ``obj`` (held weakly) to backend-health resets; it must
+    define ``_on_backend_health_reset()``, which should drop any cached
+    state tied to a backend selection (staged images, backend instances).
+    ``Dcf`` instances and ``serve.DcfService`` register automatically."""
+    _RESET_LISTENERS.add(obj)
+
 
 def reset_backend_health() -> None:
-    """Forget cached canary verdicts (tests; a recovered driver/toolchain)."""
+    """Forget cached canary verdicts (tests; a recovered driver/toolchain)
+    AND invalidate every registered holder of backend-derived cached
+    state — live facades re-ship/re-select lazily on their next eval, and
+    serve registries evict their device-resident key images.  One path:
+    there is no way to wipe verdicts while stale device state lingers."""
     _HEALTHY.clear()
     _UNHEALTHY.clear()
+    for obj in list(_RESET_LISTENERS):
+        obj._on_backend_health_reset()
 
 
 class _BackendMisuse(Exception):
@@ -305,6 +349,38 @@ class Dcf:
         # never constructs the other party's backend.
         self._eval_backends: dict = {}
         self._shipped_bundle: dict = {}
+        # Shared invalidation wiring: remember what was ASKED for (auto
+        # may re-select after a health reset) and subscribe to resets.
+        self._requested_backend = backend
+        self._needs_reselect = False
+        register_reset_listener(self)
+
+    # -- backend-health invalidation (the ONE shared path) -------------------
+
+    def _on_backend_health_reset(self) -> None:
+        """Drop every backend-derived cache this facade holds.  Called via
+        ``register_reset_listener`` whenever backend health is reset;
+        re-construction/re-selection happens lazily on the next eval so a
+        reset stays cheap for instances that never evaluate again."""
+        self._eval_backends.clear()
+        self._shipped_bundle.clear()
+        if self._requested_backend == "auto" and self.mesh is None:
+            self._needs_reselect = True
+
+    def _maybe_reselect(self) -> None:
+        if self._needs_reselect:
+            self._needs_reselect = False
+            self.backend_name = self._select_healthy(
+                _default_backend(self.lam))
+
+    def reset_backend_health(self) -> None:
+        """Instance spelling of :func:`reset_backend_health` — one shared
+        invalidation path: wipes the process-wide canary verdicts and
+        notifies every registered cache holder (this facade's backend
+        slots, every serve registry's device-resident images).  An
+        ``auto`` facade re-runs selection on its next eval, so a backend
+        that died mid-serve is re-canaried instead of re-entered."""
+        reset_backend_health()
 
     def _auto_chain(self, name: str) -> list[str]:
         """Fallback candidates for auto selection, starting at ``name``."""
@@ -549,6 +625,7 @@ class Dcf:
         results HBM-resident without re-staging keys.  Host backends
         (cpu/numpy) dispatch directly in ``eval`` and return ``None``.
         """
+        self._maybe_reselect()
         slot = "kl" if self.backend_name == "keylanes" else int(b)
         be = self._eval_backends.get(slot)
         if be is None:
@@ -558,6 +635,48 @@ class Dcf:
             if be is not None:
                 self._eval_backends[slot] = be
         return be
+
+    def new_eval_backend(self):
+        """A FRESH backend instance of the current selection, owning its
+        own device key image (``None`` for the cpu/numpy host paths).
+
+        The serve layer's hook: its registry keeps one instance per
+        (key_id, party) so many long-lived keys stay device-resident at
+        once — the facade's own per-party slots (``eval_backend``) hold
+        exactly one shipped bundle each and would thrash.  Health-reset
+        invalidation applies to instances made here exactly as to the
+        facade's: the registry that owns them subscribes via
+        ``register_reset_listener``."""
+        self._maybe_reselect()
+        if self.backend_name in ("cpu", "numpy"):
+            return None
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", ReferenceContractWarning)
+            return self._make_backend(self.backend_name)
+
+    def serve(self, config=None, **knobs):
+        """An online evaluation service over this facade — the serving
+        entry point (``dcf_tpu.serve``):
+
+            >>> svc = dcf.serve(max_batch=1 << 14, max_delay_ms=2.0,
+            ...                 device_bytes_budget=256 << 20)
+            >>> svc.register_key("model/relu-7", bundle)
+            >>> with svc:                       # worker thread
+            ...     fut = svc.submit("model/relu-7", xs, b=0)
+            ...     y0 = fut.result()
+
+        Pass a ``serve.ServeConfig`` or its fields as keywords.  See
+        ``dcf_tpu/serve/service.py`` for the knob semantics (micro-batch
+        coalescing, LRU device residency, admission control, metrics).
+        """
+        from dcf_tpu.serve import DcfService, ServeConfig
+
+        if config is not None and knobs:
+            # api-edge: either a config object or keywords, not both
+            raise ValueError("pass either config= or individual knobs")
+        if config is None:
+            config = ServeConfig(**knobs)
+        return DcfService(self, config)
 
     # -- eval (reference eval, src/lib.rs:163-204) --------------------------
 
@@ -572,6 +691,7 @@ class Dcf:
         already-restricted ``bundle.for_party(b)``.
         """
         xs = np.asarray(xs, dtype=np.uint8)
+        self._maybe_reselect()
         if self.backend_name == "keylanes":
             # The keylanes CW image is shared between parties (reference
             # src/lib.rs:269-272): ONE backend instance and one shipped

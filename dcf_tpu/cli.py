@@ -15,6 +15,14 @@ plus ``full_domain`` — the BASELINE.json config-3 workload (two-party
 reconstruction over the whole 2^n domain, on-device point generation for
 the staged backends).
 
+plus ``serve_bench`` — the online serving layer (``dcf_tpu.serve``)
+under a closed-loop load generator: N client threads each keep one
+ragged request in flight against several registered keys while the
+service micro-batches, and the emitted ``RESULTS_serve`` JSONL line
+records the served closed-loop throughput next to the equivalent
+staged-path batch rate (same backend, same ``--max-batch`` shape) with
+the full metrics snapshot (queue depth, batch occupancy, latencies).
+
 Usage::
 
     python -m dcf_tpu.cli dcf_batch_eval --backend=pallas --points=1048576
@@ -777,6 +785,150 @@ def bench_full_domain(args) -> None:
           2 * (1 << n_bits) / dt, unit, dt, mad, len(ss))
 
 
+def bench_serve(args) -> None:
+    """Closed-loop load test of the online serving layer (ISSUE 4).
+
+    Shape: the flagship N=16/lam=16 domain, ``--bundles`` registered
+    single-key bundles, ``--concurrency`` closed-loop clients submitting
+    ragged requests sized uniformly in [3/8, 1/2] of ``--max-batch`` by
+    default (``--min-req-points``/``--max-req-points`` override; the
+    default range makes coalesced batches exercise padding AND near-full
+    occupancy) for ``--duration`` seconds.  Backend = any facade backend usable at
+    lam=16 (``bitsliced`` is the no-TPU default; explicit ``pallas``
+    stays strict/compiled, per the facade contract).
+
+    The line also records the STAGED-PATH equivalent: the same backend
+    evaluating one staged ``--max-batch`` batch in a bare loop (one
+    dispatch per sample, sync RTT subtracted) — the serving layer's
+    overhead budget is ``serve_vs_staged`` of that rate.  Parity is
+    gated before timing: one sample request per bundle, both parties,
+    XOR reconstruction vs the C++ host core.
+    """
+    from dcf_tpu import Dcf
+    from dcf_tpu.native import NativeDcf
+    from dcf_tpu.serve.loadgen import closed_loop
+    from dcf_tpu.utils.benchtime import device_sync, measure_sync_rtt
+
+    lam, nb = 16, 16
+    if args.backend not in ("numpy", "jax", "bitsliced", "pallas",
+                            "prefix"):
+        raise SystemExit(
+            f"serve_bench serves lam=16 single-device facade backends "
+            f"(numpy/jax/bitsliced/pallas/prefix), got {args.backend!r}")
+    max_batch = args.max_batch or (1 << 17)
+    n_bundles = args.bundles or 3
+    rng = np.random.default_rng(args.seed)
+    ck = _cipher_keys(lam, rng)
+    native = NativeDcf(lam, ck)
+    dcf = Dcf(nb, lam, ck, backend=args.backend)
+    svc = dcf.serve(max_batch=max_batch,
+                    max_delay_ms=args.max_delay_ms,
+                    device_bytes_budget=args.device_bytes_budget)
+    log(f"gen {n_bundles} bundles ...")
+    bundles = {}
+    for i in range(n_bundles):
+        alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
+        betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
+        b = native.gen_batch(alphas, betas, random_s0s(1, lam, rng),
+                             Bound.LT_BETA)
+        bundles[f"key-{i}"] = b
+        svc.register_key(f"key-{i}", b)
+
+    # Parity gate: every bundle, both parties, vs the C++ anchor.
+    xs_check = rng.integers(0, 256, (512, nb), dtype=np.uint8)
+    for name, bundle in bundles.items():
+        y0 = svc.submit(name, xs_check, b=0)
+        y1 = svc.submit(name, xs_check, b=1)
+        svc.pump()
+        want = native.eval(0, bundle, xs_check) ^ \
+            native.eval(1, bundle, xs_check)
+        if not np.array_equal(y0.result() ^ y1.result(), want):
+            raise SystemExit(f"serve parity mismatch vs C++ on {name}")
+    log(f"parity vs C++ core: OK ({n_bundles} bundles x 512 pts, "
+        "two-party)")
+
+    min_req = args.min_req_points or (max_batch * 3 // 8)
+    max_req = args.max_req_points or (max_batch // 2)
+    if not 1 <= min_req <= max_req:
+        raise SystemExit(f"bad request-size range [{min_req}, {max_req}]")
+
+    # Warm every padded batch shape the loop can produce (each distinct
+    # power of two is one XLA compile; a compile inside the timed loop
+    # would be measured as serving time).  Coalescing and splitting can
+    # land remainder batches on ANY power of two from next_pow2(min_req)
+    # up to max_batch, so warm the whole ladder — log2(max_batch) shapes
+    # at most, each one dispatch.
+    from dcf_tpu.serve.batcher import next_pow2
+
+    xs_warm = rng.integers(0, 256, (max_batch, nb), dtype=np.uint8)
+    m = next_pow2(min_req)
+    while m <= max_batch:
+        log(f"warming batch shape {m} ...")
+        svc.submit("key-0", xs_warm[:m])
+        svc.pump()
+        m *= 2
+
+    import jax
+
+    # Disclosure: a no-TPU session serves XLA-CPU (or interpret-mode
+    # Pallas) graphs — the committed line must say so, same policy as
+    # _pinned_ratio's interpreted rule.
+    platform = jax.devices()[0].platform
+    interp = (platform != "tpu"
+              or bool(getattr(dcf.eval_backend(0), "interpret", False)))
+    with svc:
+        res = closed_loop(
+            svc, sorted(bundles), duration_s=float(args.duration),
+            concurrency=args.concurrency,
+            min_points=min_req, max_points=max_req,
+            seed=args.seed)
+    snap = svc.metrics_snapshot()
+
+    # Staged-path equivalent: same backend, one staged max_batch batch,
+    # bare dispatch loop (one dispatch per sample — CPU-mode dispatches
+    # are seconds long, the 128-dispatch sample would take minutes).
+    staged_rate = None
+    be = dcf.new_eval_backend()
+    if be is not None and hasattr(be, "stage"):
+        be.put_bundle(bundles["key-0"].for_party(0))
+        staged = be.stage(xs_warm)
+        y = be.eval_staged(0, staged)
+        device_sync(y)  # warmup/compile
+        rtt = measure_sync_rtt(y)
+
+        def one():
+            device_sync(be.eval_staged(0, staged))
+
+        dt, mad, ss = _timed(one, args.reps)
+        staged_rate = max_batch / max(dt - rtt, 1e-9)
+        log(f"staged-path rate at {max_batch} pts: {staged_rate:,.1f} "
+            f"evals/s (median {dt * 1e3:.1f} ms +- {mad * 1e3:.1f} ms, "
+            f"{len(ss)} samples, sync RTT subtracted)")
+
+    extra = {
+        "duration_s": round(res.duration_s, 3),
+        "concurrency": args.concurrency,
+        "max_batch": max_batch,
+        "req_points": [min_req, max_req],
+        "bundles": n_bundles,
+        "requests_ok": res.requests_ok,
+        "requests_shed": res.requests_shed,
+        "requests_failed": res.requests_failed,
+        **res.latency_quantiles(),
+        "platform": platform,
+        "interpreted": interp,
+        "metrics_snapshot": snap,
+    }
+    if staged_rate is not None:
+        extra["staged_path_evals_per_sec"] = round(staged_rate, 1)
+        extra["serve_vs_staged"] = round(res.throughput / staged_rate, 3)
+    unit = "evals/s (closed-loop served, party 0)"
+    if interp:
+        unit += " [no TPU this session: interpret/CPU mode, disclosed]"
+    _emit("serve_bench", args.backend, "evals_per_sec",
+          res.throughput, unit, extra_fields=extra)
+
+
 def bench_baseline(args) -> None:
     """All five BASELINE.json configs in one run, one JSON line per
     bench invocation (8 lines total: config 1 emits gen + 1-pt eval, and
@@ -843,6 +995,7 @@ BENCHES = {
     "dcf_large_lambda": bench_large_lambda,
     "secure_relu": bench_secure_relu,
     "full_domain": bench_full_domain,
+    "serve_bench": bench_serve,
 }
 
 
@@ -913,6 +1066,26 @@ def main(argv=None) -> None:
                    help="input width for dcf_batch_eval (0 = 16)")
     p.add_argument("--device-gen", action="store_true",
                    help="secure_relu: device keygen + pallas keylanes path")
+    p.add_argument("--duration", type=float, default=30.0,
+                   help="serve_bench: closed-loop load duration, seconds")
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="serve_bench: closed-loop client threads")
+    p.add_argument("--max-batch", type=int, default=0,
+                   help="serve_bench: service micro-batch cap in points "
+                        "(power of two; 0 = 2^17)")
+    p.add_argument("--bundles", type=int, default=0,
+                   help="serve_bench: registered key bundles (0 = 3)")
+    p.add_argument("--max-delay-ms", type=float, default=5.0,
+                   help="serve_bench: micro-batch coalescing delay")
+    p.add_argument("--device-bytes-budget", type=int, default=0,
+                   help="serve_bench: LRU device-residency budget "
+                        "(0 = uncapped)")
+    p.add_argument("--min-req-points", type=int, default=0,
+                   help="serve_bench: request-size range lower bound "
+                        "(0 = 3/8 of --max-batch)")
+    p.add_argument("--max-req-points", type=int, default=0,
+                   help="serve_bench: request-size range upper bound "
+                        "(0 = half of --max-batch)")
     p.add_argument("--full", action="store_true",
                    help="baseline: run config 5 at the literal 10^6-key "
                         "scale (~20 min report)")
@@ -936,6 +1109,10 @@ def main(argv=None) -> None:
         bench_baseline(args)
         return
     for name in BENCHES if args.bench == "all" else [args.bench]:
+        if args.bench == "all" and name == "serve_bench":
+            log("skipping serve_bench (a timed load test, not a "
+                "criterion analog; run it explicitly)")
+            continue
         if args.bench == "all" and name == "dcf_large_lambda" and \
                 args.backend in ("pallas", "sharded", "sharded-pallas"):
             log("skipping dcf_large_lambda (lam=16-only backend)")

@@ -19,6 +19,13 @@ grouping it with geometry staleness is what lets callers write one
       +-- BackendUnavailableError(RuntimeError) no backend could serve
       +-- StaleStateError        (RuntimeError) staged state outlived bundle
       +-- NativeBuildError       (RuntimeError) C++ core build/load failed
+      +-- QueueFullError         (RuntimeError) serve admission bound hit
+      +-- DeadlineExceededError  (TimeoutError) request deadline expired
+
+The last two belong to the online serving layer (``dcf_tpu.serve``):
+admission control sheds load with ``QueueFullError`` at submit time, and
+a request whose deadline passes before its batch is dispatched completes
+with ``DeadlineExceededError`` instead of a stale result.
 
 Recovery is signalled, not silent: whenever the framework degrades to a
 slower-but-correct path (auto backend fallback, AES-NI -> portable native
@@ -35,6 +42,8 @@ __all__ = [
     "BackendUnavailableError",
     "StaleStateError",
     "NativeBuildError",
+    "QueueFullError",
+    "DeadlineExceededError",
     "BackendFallbackWarning",
 ]
 
@@ -67,6 +76,20 @@ class StaleStateError(DcfError, RuntimeError):
 
 class NativeBuildError(DcfError, RuntimeError):
     """The C++ host core failed to build or load (after bounded retries)."""
+
+
+class QueueFullError(DcfError, RuntimeError):
+    """The serving layer's bounded admission queue rejected a request:
+    either the queued-points bound was hit (overload — back off and
+    retry) or the service is draining/closed.  Raised at ``submit``
+    time, never after a request was accepted."""
+
+
+class DeadlineExceededError(DcfError, TimeoutError):
+    """An accepted request's deadline expired before its batch was
+    dispatched; the request was dropped without evaluation (a late share
+    is a useless share in an online 2PC round).  Surfaces through the
+    request's result handle, not at ``submit``."""
 
 
 class BackendFallbackWarning(UserWarning):

@@ -1,0 +1,32 @@
+"""dcf_tpu.serve — the online DCF evaluation service layer.
+
+Everything between "a staged backend that can evaluate one big batch"
+and "a service answering bursty small queries against many long-lived
+keys":
+
+- ``serve.batcher``   pure micro-batch planning: coalesce ragged
+  requests into padded power-of-two device batches, scatter results
+  back per request (property-tested in isolation);
+- ``serve.registry``  named key bundles + LRU device-residency cache
+  under a device-bytes budget, one invalidation path shared with
+  ``Dcf.reset_backend_health``;
+- ``serve.admission`` bounded queue (``QueueFullError`` shedding),
+  deadline propagation (``DeadlineExceededError``), result futures;
+- ``serve.metrics``   dependency-free counters/gauges/histograms with a
+  deterministic snapshot (embedded in RESULTS_serve JSONL lines);
+- ``serve.service``   ``DcfService``: the worker loop tying it together,
+  with a stage-ahead double-buffered dispatch pipeline and the
+  ``serve.stage``/``serve.eval`` fault seams;
+- ``serve.loadgen``   the closed-loop load generator behind the
+  ``serve_bench`` CLI subcommand.
+
+Entry point: ``Dcf.serve(...)`` (see ``dcf_tpu.api``).
+"""
+
+from dcf_tpu.serve.admission import ServeFuture  # noqa: F401
+from dcf_tpu.serve.metrics import Metrics  # noqa: F401
+from dcf_tpu.serve.registry import KeyRegistry  # noqa: F401
+from dcf_tpu.serve.service import DcfService, ServeConfig  # noqa: F401
+
+__all__ = ["DcfService", "ServeConfig", "ServeFuture", "Metrics",
+           "KeyRegistry"]

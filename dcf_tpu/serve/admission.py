@@ -1,0 +1,233 @@
+"""Admission control: bounded request queue, deadlines, result handles.
+
+The service's overload policy is decided HERE, at submit time, not
+discovered later as memory pressure: the queue is bounded in queued
+POINTS (requests are ragged — a bound in requests would let one giant
+request soak the device for seconds while claiming a queue depth of 1),
+and a submit that would exceed the bound is shed immediately with
+``QueueFullError``.  A shed request costs the caller one exception and
+zero device work — the cheapest possible failure in a loaded system.
+
+Deadlines propagate as absolute clock values (the injectable serve clock,
+``utils.benchtime.monotonic`` by default).  They are enforced at batch
+formation: an expired request is completed with ``DeadlineExceededError``
+and never reaches the device.  In-flight batches are never aborted — a
+dispatched batch is at most one ``max_delay + eval`` old, and tearing
+down a device dispatch mid-flight costs more than finishing it.
+
+``ServeFuture`` is the result handle: ``result(timeout)`` blocks on a
+``threading.Event`` (the service's worker thread completes it) and either
+returns the uint8 [K, M, lam] share or raises the typed failure.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import numpy as np
+
+from dcf_tpu.errors import DeadlineExceededError, QueueFullError, ShapeError
+from dcf_tpu.serve.metrics import Metrics
+
+__all__ = ["ServeFuture", "Request", "AdmissionQueue", "expire"]
+
+
+class ServeFuture:
+    """Completion handle for one submitted request."""
+
+    __slots__ = ("_event", "_value", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error: BaseException | None = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def set_result(self, value: np.ndarray) -> None:
+        self._value = value
+        self._event.set()
+
+    def set_exception(self, error: BaseException) -> None:
+        self._error = error
+        self._event.set()
+
+    def result(self, timeout: float | None = None) -> np.ndarray:
+        """The request's uint8 [K, M, lam] share, or its typed failure.
+        Raises ``TimeoutError`` if the service has not completed the
+        request within ``timeout`` seconds (the request stays live)."""
+        if not self._event.wait(timeout):
+            # dcflint: disable=typed-error a result-wait timeout means
+            # "not done yet", not a framework failure: the builtin
+            # TimeoutError is the documented contract (and deliberately
+            # NOT DeadlineExceededError, which means "dropped undone")
+            raise TimeoutError("request not completed yet")
+        error = self._error  # re-raise of the stored completion failure
+        if error is not None:
+            raise error
+        return self._value
+
+
+class Request:
+    """One accepted request: points for one (key_id, party) pair."""
+
+    __slots__ = ("key_id", "b", "xs", "m", "deadline", "enq_t", "future")
+
+    def __init__(self, key_id: str, b: int, xs: np.ndarray,
+                 deadline: float | None, enq_t: float):
+        self.key_id = key_id
+        self.b = int(b)
+        self.xs = xs
+        self.m = int(xs.shape[0])
+        self.deadline = deadline
+        self.enq_t = enq_t
+        self.future = ServeFuture()
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+    def __repr__(self) -> str:  # points are caller data: shapes only
+        return (f"Request(key_id={self.key_id!r}, b={self.b}, m={self.m}, "
+                f"deadline={self.deadline})")
+
+
+class AdmissionQueue:
+    """FIFO bounded-points queue with group extraction for the batcher.
+
+    Thread-safe; ``cond`` is the wakeup signal the worker waits on
+    (notified on every accepted submit and on ``close``).
+    """
+
+    def __init__(self, max_queued_points: int,
+                 metrics: Metrics | None = None):
+        if max_queued_points < 1:
+            # api-edge: constructor bound contract
+            raise ValueError(
+                f"max_queued_points must be >= 1, got {max_queued_points}")
+        self.max_queued_points = int(max_queued_points)
+        self._metrics = metrics if metrics is not None else Metrics()
+        self.cond = threading.Condition()
+        self._reqs: list[Request] = []
+        self._points = 0
+        self._closed = False
+        self._g_depth = self._metrics.gauge("serve_queue_depth")
+        self._g_points = self._metrics.gauge("serve_queue_points")
+        self._c_shed = self._metrics.counter("serve_shed_total")
+        self._c_accepted = self._metrics.counter("serve_requests_total")
+        self._c_accepted_points = self._metrics.counter("serve_points_total")
+
+    def put(self, req: Request) -> None:
+        """Admit or shed ``req`` (QueueFullError on overload/shutdown)."""
+        if req.m > self.max_queued_points:
+            # Not an overload: this request can NEVER be admitted, so a
+            # "back off and retry" QueueFullError would send the caller
+            # into a futile loop — it is a size-contract violation.
+            raise ShapeError(
+                f"request of {req.m} points exceeds the admission bound "
+                f"max_queued_points={self.max_queued_points} outright; "
+                "split the request (or raise the bound)")
+        with self.cond:
+            if self._closed:
+                # Shutdown rejections count as shed too: loadgen counts
+                # them off the same QueueFullError, and the two numbers
+                # land in the same RESULTS_serve line — they must agree.
+                self._c_shed.inc()
+                raise QueueFullError(
+                    "service is draining/closed; no new requests")
+            if self._points + req.m > self.max_queued_points:
+                self._c_shed.inc()
+                raise QueueFullError(
+                    f"admission queue full: {self._points} points queued "
+                    f"+ {req.m} requested > bound "
+                    f"{self.max_queued_points}; back off and retry")
+            self._reqs.append(req)
+            self._points += req.m
+            self._c_accepted.inc()
+            self._c_accepted_points.inc(req.m)
+            self._sync_gauges()
+            self.cond.notify_all()
+
+    def close(self) -> None:
+        """Stop admitting; queued requests remain for draining."""
+        with self.cond:
+            self._closed = True
+            self.cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __len__(self) -> int:
+        return len(self._reqs)
+
+    @property
+    def points(self) -> int:
+        return self._points
+
+    def oldest_enq_t(self) -> float | None:
+        with self.cond:
+            return self._reqs[0].enq_t if self._reqs else None
+
+    def take_expired(self, now: float) -> list[Request]:
+        """Remove and return every queued request whose deadline passed
+        (the caller completes them with ``DeadlineExceededError``)."""
+        with self.cond:
+            expired = [r for r in self._reqs if r.expired(now)]
+            if expired:
+                self._reqs = [r for r in self._reqs if not r.expired(now)]
+                self._points = sum(r.m for r in self._reqs)
+                self._sync_gauges()
+            return expired
+
+    def take_group(self, max_batch_points: int) -> list[Request]:
+        """Remove and return the head request's (key_id, party) group:
+        same-group requests in FIFO order until one does not fit in
+        ``max_batch_points`` — at which point the group CLOSES, so a
+        later-submitted smaller request can never jump an earlier one
+        (per-request latency stays FIFO within a group).  The head
+        request is always taken, however large — the batcher splits it.
+        Other groups keep their order."""
+        with self.cond:
+            if not self._reqs:
+                return []
+            head = self._reqs[0]
+            group, rest, total = [head], [], head.m
+            closed_group = False
+            for r in self._reqs[1:]:
+                if (r.key_id, r.b) == (head.key_id, head.b) \
+                        and not closed_group:
+                    if total + r.m <= max_batch_points:
+                        group.append(r)
+                        total += r.m
+                        continue
+                    closed_group = True  # preserve FIFO within the group
+                rest.append(r)
+            self._reqs = rest
+            self._points = sum(r.m for r in rest)
+            self._sync_gauges()
+            return group
+
+    def fail_all(self, make_error: Callable[[], BaseException]) -> int:
+        """Drop every queued request, completing each with a fresh error
+        (non-drain shutdown).  Returns the count."""
+        with self.cond:
+            reqs, self._reqs, self._points = self._reqs, [], 0
+            self._sync_gauges()
+        for r in reqs:
+            r.future.set_exception(make_error())
+        return len(reqs)
+
+    def _sync_gauges(self) -> None:
+        self._g_depth.set(len(self._reqs))
+        self._g_points.set(self._points)
+
+
+def expire(reqs: list[Request], metrics: Metrics) -> None:
+    """Complete ``reqs`` with DeadlineExceededError (and count them)."""
+    if reqs:
+        metrics.counter("serve_deadline_expired_total").inc(len(reqs))
+    for r in reqs:
+        r.future.set_exception(DeadlineExceededError(
+            f"deadline passed before dispatch ({r!r})"))

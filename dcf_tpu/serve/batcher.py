@@ -1,0 +1,129 @@
+"""Micro-batch planning: coalesce ragged requests into padded device
+batches, and scatter batch results back per request.
+
+Pure host math — no backend, no clock, no device: a plan is a pure
+function of the request sizes and the batch cap, so this layer is
+exhaustively property-testable in isolation (tests/test_serve_batcher.py)
+and the service above it stays thin.
+
+Why pad to a power of two: every distinct staged batch shape costs one
+XLA/Mosaic compile.  Ragged online traffic would otherwise compile a
+fresh program per novel total; snapping totals to powers of two bounds
+the compile universe to ``log2(max_batch)`` shapes, all warmed within the
+first seconds of serving.  Pad rows are zero — a genuine evaluation of
+x=0 whose output the scatter step simply never reads (same policy as the
+backends' own 32-point lane padding).
+
+Out-of-order completion is safe by construction: each request's output
+rows are described by disjoint ``Span``s, so batches may complete in any
+order (the double-buffered pipeline finishes batch N while N+1 is in
+flight) and each span writes its slice into the request's own
+preallocated output.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+from dcf_tpu.errors import ShapeError
+
+__all__ = ["Span", "BatchPlan", "next_pow2", "plan_batches",
+           "gather_batch", "scatter_batch"]
+
+
+@dataclass(frozen=True)
+class Span:
+    """One contiguous run of points: request ``req``'s rows
+    [req_off, req_off+length) live at batch rows
+    [batch_off, batch_off+length)."""
+
+    req: int
+    req_off: int
+    batch_off: int
+    length: int
+
+
+@dataclass(frozen=True)
+class BatchPlan:
+    """One device batch: ``spans`` cover rows [0, m); rows [m, padded_m)
+    are zero padding (evaluated, never scattered)."""
+
+    spans: tuple[Span, ...]
+    m: int
+    padded_m: int
+
+    @property
+    def occupancy(self) -> float:
+        """Useful fraction of the padded batch (the occupancy metric)."""
+        return self.m / self.padded_m if self.padded_m else 0.0
+
+
+def next_pow2(m: int) -> int:
+    """Smallest power of two >= m (>= 1)."""
+    return 1 << max(m - 1, 0).bit_length()
+
+
+def plan_batches(sizes: Sequence[int], max_batch: int) -> list[BatchPlan]:
+    """FIFO-greedy coalescing of request sizes into batches of at most
+    ``max_batch`` points, each padded up to the next power of two.
+
+    Requests fill the current batch in submission order; a request that
+    does not fit in the remaining space is SPLIT across batches (its
+    spans reassemble it — occupancy beats keeping requests whole, and
+    point order within a request is preserved either way).  ``max_batch``
+    must itself be a power of two so padded batches never exceed it.
+    """
+    if max_batch < 1 or max_batch & (max_batch - 1):
+        raise ShapeError(
+            f"max_batch must be a power of two >= 1, got {max_batch}")
+    for i, s in enumerate(sizes):
+        if s < 1:
+            raise ShapeError(f"request {i} has {s} points; requests are "
+                             "non-empty by admission")
+    plans: list[BatchPlan] = []
+    spans: list[Span] = []
+    fill = 0
+    for req, size in enumerate(sizes):
+        done = 0
+        while done < size:
+            take = min(size - done, max_batch - fill)
+            spans.append(Span(req=req, req_off=done, batch_off=fill,
+                              length=take))
+            fill += take
+            done += take
+            if fill == max_batch:
+                plans.append(BatchPlan(tuple(spans), fill, fill))
+                spans, fill = [], 0
+    if spans:
+        plans.append(BatchPlan(tuple(spans), fill, next_pow2(fill)))
+    return plans
+
+
+def gather_batch(xs_list: Sequence[np.ndarray],
+                 plan: BatchPlan, n_bytes: int) -> np.ndarray:
+    """Assemble one padded device batch uint8 [padded_m, n_bytes] from
+    the per-request point arrays (``xs_list[i]`` is request i's full
+    uint8 [m_i, n_bytes]).  Pad rows stay zero."""
+    out = np.zeros((plan.padded_m, n_bytes), dtype=np.uint8)
+    for sp in plan.spans:
+        out[sp.batch_off:sp.batch_off + sp.length] = \
+            xs_list[sp.req][sp.req_off:sp.req_off + sp.length]
+    return out
+
+
+def scatter_batch(outs: Sequence[np.ndarray], plan: BatchPlan,
+                  y: np.ndarray) -> None:
+    """Scatter one completed batch result back into the per-request
+    output buffers.
+
+    ``y``: uint8 [K, padded_m(or m), lam] — the backend's bytes for this
+    batch; ``outs[i]``: request i's preallocated uint8 [K, m_i, lam].
+    Only span rows are read, so pad rows and completion order are
+    irrelevant.
+    """
+    for sp in plan.spans:
+        outs[sp.req][:, sp.req_off:sp.req_off + sp.length, :] = \
+            y[:, sp.batch_off:sp.batch_off + sp.length, :]

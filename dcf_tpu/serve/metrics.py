@@ -1,0 +1,183 @@
+"""Dependency-free serving metrics: counters, gauges, histograms, and a
+deterministic snapshot.
+
+The observability contract of the serving layer (ADR: no prometheus/
+opentelemetry dependency — the container bakes only the jax_graft
+toolchain, and a metrics surface the tests can assert on exactly must be
+deterministic anyway):
+
+* ``Counter``   monotonically increasing float/int (requests, points,
+  evictions, shed load, retries);
+* ``Gauge``     last-written value (queue depth, resident device bytes);
+* ``Histogram`` fixed-bound buckets + sum + count (stage/eval latency,
+  batch occupancy, queue wait) — cumulative bucket counts in the
+  snapshot, prometheus-style, so dashboards can be grafted on later
+  without changing recording sites.
+
+``Metrics.snapshot()`` returns a plain ``{name: value}`` dict with keys
+in sorted order and only JSON-basic values, so a snapshot can be embedded
+verbatim in a ``RESULTS_serve`` JSONL line and two snapshots diff
+cleanly in tests.
+
+Secret hygiene: metric NAMES are static strings and metric values are
+scalars; key ids chosen by callers become label values via ``labeled``
+and must never be derived from key material (the dcflint secret-hygiene
+pass also audits metric-sink call arguments, same rule as print/log).
+
+Thread safety: one lock per ``Metrics`` registry guards every mutation
+and the snapshot; instruments are cheap enough that a shared lock beats
+per-instrument locks at serving rates (the device eval dwarfs both).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Sequence
+
+__all__ = ["Counter", "Gauge", "Histogram", "Metrics", "labeled",
+           "DEFAULT_LATENCY_BOUNDS", "OCCUPANCY_BOUNDS"]
+
+#: Seconds buckets spanning sub-ms batching decisions to multi-second
+#: CPU-mode large-batch evals.
+DEFAULT_LATENCY_BOUNDS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0)
+
+#: Occupancy is a fraction in (0, 1]; padded batches land below 1.
+OCCUPANCY_BOUNDS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+def labeled(name: str, **labels: str) -> str:
+    """Canonical ``name{k=v,...}`` metric-name form for labeled series
+    (labels sorted, so the same label set is always the same series)."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonic counter; ``inc`` with a non-negative amount."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0
+
+    def inc(self, amount: int | float = 1) -> None:
+        if amount < 0:
+            # api-edge: instrument-usage contract (programmer error)
+            raise ValueError(f"counter increment must be >= 0, got {amount}")
+        with self._lock:
+            self.value += amount
+
+
+class Gauge:
+    """Last-written value."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0
+
+    def set(self, value: int | float) -> None:
+        with self._lock:
+            self.value = value
+
+    def add(self, delta: int | float) -> None:
+        with self._lock:
+            self.value += delta
+
+
+class Histogram:
+    """Fixed-bound histogram: per-bucket counts (le semantics), sum,
+    count.  Observations above the last bound land in the +Inf bucket."""
+
+    __slots__ = ("_lock", "bounds", "buckets", "total", "count")
+
+    def __init__(self, lock: threading.Lock,
+                 bounds: Sequence[float] = DEFAULT_LATENCY_BOUNDS):
+        if list(bounds) != sorted(bounds) or len(set(bounds)) != len(bounds):
+            # api-edge: instrument-usage contract (programmer error)
+            raise ValueError("histogram bounds must be strictly increasing")
+        self._lock = lock
+        self.bounds = tuple(float(b) for b in bounds)
+        self.buckets = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        # bisect_left gives prometheus "le" placement: an observation
+        # EQUAL to a bound belongs in that bound's bucket (occupancy 1.0
+        # must land in le=1.0, not +Inf).
+        idx = bisect_left(self.bounds, value)
+        with self._lock:
+            self.buckets[idx] += 1
+            self.total += value
+            self.count += 1
+
+
+class Metrics:
+    """Registry of named instruments with a deterministic snapshot."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: dict = {}
+
+    def _get(self, name: str, factory):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = factory()
+                self._instruments[name] = inst
+            return inst
+
+    def _typed(self, name: str, inst, want: type):
+        if not isinstance(inst, want):
+            # api-edge: instrument-usage contract (programmer error — one
+            # name, one instrument kind)
+            raise ValueError(f"metric {name!r} is already a "
+                             f"{type(inst).__name__}, not a {want.__name__}")
+        return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._typed(name, self._get(
+            name, lambda: Counter(self._lock)), Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._typed(name, self._get(
+            name, lambda: Gauge(self._lock)), Gauge)
+
+    def histogram(self, name: str,
+                  bounds: Sequence[float] = DEFAULT_LATENCY_BOUNDS
+                  ) -> Histogram:
+        return self._typed(name, self._get(
+            name, lambda: Histogram(self._lock, bounds)), Histogram)
+
+    def snapshot(self) -> dict:
+        """Point-in-time ``{name: value}`` with sorted keys and
+        JSON-basic values only.  Counters/gauges map to their scalar;
+        a histogram ``h`` expands to ``h_sum``, ``h_count``, and
+        ``h_buckets`` (cumulative counts per ``h_bounds`` entry plus the
+        trailing +Inf bucket)."""
+        with self._lock:
+            out: dict = {}
+            for name in sorted(self._instruments):
+                inst = self._instruments[name]
+                if isinstance(inst, Histogram):
+                    cum, acc = [], 0
+                    for c in inst.buckets:
+                        acc += c
+                        cum.append(acc)
+                    out[f"{name}_sum"] = round(inst.total, 9)
+                    out[f"{name}_count"] = inst.count
+                    out[f"{name}_bounds"] = list(inst.bounds)
+                    out[f"{name}_buckets"] = cum
+                else:
+                    out[name] = inst.value
+            # Key order is part of the determinism contract: expanded
+            # histogram keys must land sorted too, not grouped.
+            return dict(sorted(out.items()))

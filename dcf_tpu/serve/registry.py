@@ -1,0 +1,271 @@
+"""Key registry + device-residency cache for the serving layer.
+
+Long-lived DCF keys are the defining asset of an online FSS service:
+a bundle is generated once, then answers queries for hours.  The
+registry separates the two lifetimes involved:
+
+* **registration** (host): ``register(key_id, bundle)`` records the
+  host-side ``KeyBundle`` under a caller-chosen name.  Cheap, unbounded
+  by device memory.  Re-registration under a live name is guarded the
+  same way PR 1's staged-geometry freshness check guards re-staging: it
+  is allowed, bumps the key's generation, and atomically evicts every
+  device residency built against the old bundle — serving a share from
+  a superseded key would be the silent-corruption analog of ADVICE.md
+  finding 3.
+* **residency** (device): ``resident(key_id, party)`` lazily constructs
+  a dedicated backend instance for that (key, party) slot and ships the
+  key image via the backend's existing ``put_bundle``; the instance (and
+  with it the staged plane image, frontier tables, etc.) is cached and
+  reused across batches.  Residencies are evicted LRU when the summed
+  device-image bytes exceed ``device_bytes_budget`` — dropping the
+  backend instance releases its device arrays to the allocator.
+
+The keylanes backend's CW image is shared between parties (reference
+src/lib.rs:269-272), so its residency slot is per-key, not per
+(key, party) — same rule the ``Dcf`` facade applies.
+
+LRU order is tracked with a deterministic access counter, not a clock:
+eviction order must be a pure function of the request sequence so tests
+can pin it (and the dcflint determinism pass holds serve code to that).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from dcf_tpu.errors import ShapeError
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.serve.metrics import Metrics
+
+__all__ = ["KeyRegistry", "device_image_bytes"]
+
+# Device-image dict attributes across the backend zoo: ``_bundle_dev``
+# (pallas / bitsliced / keylanes), ``_dev`` (large-lambda hybrid),
+# ``_frontier`` (prefix family's cached gather tables, filled lazily).
+_IMAGE_ATTRS = ("_bundle_dev", "_dev", "_frontier")
+
+
+def device_image_bytes(be) -> int:
+    """Best-effort byte count of a backend instance's device-resident
+    key image (the LRU accounting unit).  Sums ``nbytes`` over the known
+    image dicts; a backend that stages nothing (host paths) counts 0."""
+    total = 0
+    for attr in _IMAGE_ATTRS:
+        d = getattr(be, attr, None)
+        if isinstance(d, dict):
+            for v in d.values():
+                total += int(getattr(v, "nbytes", 0) or 0)
+    return total
+
+
+class _Entry:
+    """One registered key: host bundle + its live device residencies."""
+
+    __slots__ = ("bundle", "generation", "residents")
+
+    def __init__(self, bundle: KeyBundle, generation: int):
+        self.bundle = bundle
+        self.generation = generation
+        self.residents: dict = {}  # slot (party int | "kl") -> _Resident
+
+    def __repr__(self) -> str:  # never the bundle's bytes — shapes only
+        return (f"_Entry(gen={self.generation}, "
+                f"resident_slots={sorted(map(str, self.residents))})")
+
+
+class _Resident:
+    """One (key, slot) device residency: the backend instance owning the
+    shipped image, its byte cost, and its LRU stamp."""
+
+    __slots__ = ("be", "bytes", "stamp", "generation")
+
+    def __init__(self, be, nbytes: int, stamp: int, generation: int):
+        self.be = be
+        self.bytes = nbytes
+        self.stamp = stamp
+        self.generation = generation
+
+    def __repr__(self) -> str:
+        return (f"_Resident(bytes={self.bytes}, stamp={self.stamp}, "
+                f"gen={self.generation})")
+
+
+class KeyRegistry:
+    """Named bundles + LRU device-residency cache (see module docstring).
+
+    ``make_backend``: zero-arg factory returning a fresh eval backend
+    instance (the ``Dcf`` facade's ``new_eval_backend``), or ``None``
+    for host paths — then ``resident`` returns ``None`` backends and the
+    service evaluates through the facade directly.
+    """
+
+    def __init__(self, make_backend, *, shared_image: bool = False,
+                 device_bytes_budget: int = 0,
+                 metrics: Metrics | None = None):
+        self._make_backend = make_backend
+        self._shared_image = shared_image  # keylanes: one slot, both parties
+        self.device_bytes_budget = int(device_bytes_budget)
+        self._metrics = metrics if metrics is not None else Metrics()
+        self._lock = threading.RLock()
+        self._entries: dict[str, _Entry] = {}
+        self._tick = 0
+        self._generation = 0
+        g = self._metrics.gauge
+        self._g_resident_bytes = g("serve_resident_device_bytes")
+        self._g_resident_count = g("serve_resident_images")
+        self._g_registered = g("serve_registered_keys")
+        self._c_evictions = self._metrics.counter("serve_evictions_total")
+        self._c_stagings = self._metrics.counter("serve_key_stagings_total")
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, key_id: str, bundle: KeyBundle) -> None:
+        """Register (or replace) the bundle served under ``key_id``.
+
+        The bundle must be the full two-party bundle: the service serves
+        both parties, and the keylanes image is two-party by design.
+        Replacing a live key evicts its residencies atomically (the
+        staleness guard), so no later batch can pair old device state
+        with the new key.
+        """
+        if bundle.s0s.shape[1] != 2:
+            raise ShapeError(
+                f"register({key_id!r}) wants the full two-party bundle "
+                "(shape [K, 2, lam] s0s); restrict per party at eval, "
+                "not at registration")
+        with self._lock:
+            prev = self._entries.get(key_id)
+            if prev is not None and prev.bundle is bundle:
+                return  # idempotent re-registration: keep the residencies
+            self._generation += 1
+            if prev is not None:
+                self._evict_entry(prev)
+            self._entries[key_id] = _Entry(bundle, self._generation)
+            self._g_registered.set(len(self._entries))
+
+    def unregister(self, key_id: str) -> None:
+        with self._lock:
+            entry = self._entries.pop(key_id, None)
+            if entry is not None:
+                self._evict_entry(entry)
+            self._g_registered.set(len(self._entries))
+
+    def bundle(self, key_id: str) -> KeyBundle:
+        with self._lock:
+            entry = self._entries.get(key_id)
+            if entry is None:
+                # api-edge: unknown-name lookup contract at the serve edge
+                raise ValueError(f"no bundle registered under {key_id!r}")
+            return entry.bundle
+
+    def key_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    # -- residency ----------------------------------------------------------
+
+    def resident(self, key_id: str, b: int):
+        """The backend instance holding ``key_id``'s party-``b`` image on
+        device, staging it (and possibly evicting colder images) if
+        absent.  Returns ``None`` for host-path services."""
+        with self._lock:
+            entry = self._entries.get(key_id)
+            if entry is None:
+                # api-edge: unknown-name lookup contract at the serve edge
+                raise ValueError(f"no bundle registered under {key_id!r}")
+            slot = "kl" if self._shared_image else int(b)
+            res = entry.residents.get(slot)
+            if res is not None:
+                self._tick += 1
+                res.stamp = self._tick
+                return res.be
+            be = self._make_backend()
+            if be is None:
+                return None
+            kb = (entry.bundle if self._shared_image
+                  else entry.bundle.for_party(b))
+            be.put_bundle(kb)
+            self._c_stagings.inc()
+            self._tick += 1
+            res = _Resident(be, device_image_bytes(be), self._tick,
+                            entry.generation)
+            entry.residents[slot] = res
+            self._enforce_budget(keep=res)
+            self._update_gauges()
+            return res.be
+
+    def note_image_growth(self, key_id: str, b: int) -> None:
+        """Re-measure a residency whose image grew after staging (the
+        prefix backends build frontier tables lazily on first eval) and
+        re-apply the budget."""
+        with self._lock:
+            entry = self._entries.get(key_id)
+            if entry is None:
+                return
+            res = entry.residents.get("kl" if self._shared_image else int(b))
+            if res is None:
+                return
+            res.bytes = device_image_bytes(res.be)
+            self._enforce_budget(keep=res)
+            self._update_gauges()
+
+    # -- eviction -----------------------------------------------------------
+
+    def _iter_residents(self):
+        for entry in self._entries.values():
+            for slot, res in list(entry.residents.items()):
+                yield entry, slot, res
+
+    def _enforce_budget(self, keep) -> None:
+        """Evict least-recently-used residencies until the summed image
+        bytes fit the budget.  ``keep`` (the residency being served) is
+        never evicted, so one over-budget key still serves — a budget
+        too small for a single image degrades to stage-per-use, not to
+        an unservable key.  Budget 0 disables the cap."""
+        if not self.device_bytes_budget:
+            return
+        while True:
+            total = sum(r.bytes for _, _, r in self._iter_residents())
+            if total <= self.device_bytes_budget:
+                return
+            victims = [(res.stamp, entry, slot, res)
+                       for entry, slot, res in self._iter_residents()
+                       if res is not keep]
+            if not victims:
+                return
+            _, entry, slot, res = min(victims, key=lambda v: v[0])
+            del entry.residents[slot]
+            self._c_evictions.inc()
+
+    def _evict_entry(self, entry: _Entry) -> None:
+        n = len(entry.residents)
+        entry.residents.clear()
+        if n:
+            self._c_evictions.inc(n)
+        self._update_gauges()
+
+    def evict_key(self, key_id: str) -> None:
+        """Drop one key's device residencies (registration stays).  The
+        serving layer's cheap first-line invalidation after a batch
+        failure — transient faults must not cost every other hot key its
+        staged image."""
+        with self._lock:
+            entry = self._entries.get(key_id)
+            if entry is not None:
+                self._evict_entry(entry)
+
+    def evict_all(self) -> None:
+        """Drop every device residency (the shared invalidation path:
+        ``reset_backend_health`` routes here so a backend declared dead
+        mid-serve never serves again from cached state)."""
+        with self._lock:
+            for entry in self._entries.values():
+                self._evict_entry(entry)
+
+    def _update_gauges(self) -> None:
+        total = n = 0
+        for _, _, res in self._iter_residents():
+            total += res.bytes
+            n += 1
+        self._g_resident_bytes.set(total)
+        self._g_resident_count.set(n)

@@ -1,0 +1,445 @@
+"""DcfService: the online evaluator over the staged backends.
+
+Turns a constructed ``Dcf`` facade into a service: callers ``submit``
+``(key_id, xs)`` requests from any thread and get a ``ServeFuture``;
+a worker coalesces requests into padded power-of-two device batches
+(``serve.batcher``), keeps hot key images device-resident
+(``serve.registry``), sheds overload at admission (``serve.admission``),
+and reports itself through a deterministic metrics surface
+(``serve.metrics``).
+
+Load-bearing knobs (``ServeConfig``):
+
+* ``max_batch`` — device batch cap in points, power of two.  The
+  throughput knob: batches amortize the per-dispatch overhead, and every
+  padded batch shape <= max_batch is one of log2(max_batch) compiled
+  programs.  Raise it until eval latency, not dispatch overhead,
+  dominates.
+* ``max_delay_ms`` — the latency knob: how long an accepted request may
+  wait for co-batching before the worker dispatches whatever is queued.
+  The classic micro-batching latency/occupancy trade.
+* ``device_bytes_budget`` — LRU bound on summed resident key images
+  (0 = uncapped).  The working-set knob: more resident keys means fewer
+  re-stagings; the budget is what stops a long tail of cold keys from
+  evicting the hot set.
+* ``max_queued_points`` — admission bound; beyond it, submits shed with
+  ``QueueFullError`` (see ``serve.admission``).
+* ``retries`` — per-batch retries after a backend failure; each retry
+  first runs the shared invalidation path (``Dcf.reset_backend_health``)
+  so the retry re-stages on a freshly-selected backend instead of
+  re-entering the dead one.
+
+Pipelining: within a batch run, host->device staging of batch N+1
+overlaps the (async) device eval of batch N — the worker dispatches
+batch N, stages and dispatches N+1, and only then fetches N (the same
+dispatch-ahead discipline bench.py uses, minus the RTT bookkeeping,
+which belongs to the measurement layer).
+
+Failure injection: the ``serve.stage`` / ``serve.eval`` seams
+(``dcf_tpu.testing.faults``) fire at the exact points where a real
+staging or dispatch failure would surface, so overload, mid-batch
+backend death, and the retry/invalidation path are all deterministically
+testable without breaking a real device.
+
+Clocking: all waiting/deadline math uses the injectable ``clock``
+(``utils.benchtime.monotonic`` by default) — never ``time.*`` directly;
+the dcflint determinism pass enforces this, and deterministic tests
+drive the service with a fake clock via ``pump()``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+from dcf_tpu.errors import BackendUnavailableError, ShapeError
+from dcf_tpu.serve.admission import AdmissionQueue, Request, ServeFuture, expire
+from dcf_tpu.serve.batcher import (
+    BatchPlan,
+    gather_batch,
+    plan_batches,
+    scatter_batch,
+)
+from dcf_tpu.serve.metrics import Metrics, OCCUPANCY_BOUNDS
+from dcf_tpu.serve.registry import KeyRegistry
+from dcf_tpu.testing.faults import fire
+from dcf_tpu.utils.benchtime import monotonic
+
+__all__ = ["ServeConfig", "DcfService"]
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Serving policy; see the module docstring for which knobs are
+    load-bearing and in which direction."""
+
+    max_batch: int = 4096
+    max_delay_ms: float = 2.0
+    max_queued_points: int = 1 << 20
+    device_bytes_budget: int = 0
+    retries: int = 1
+
+    def __post_init__(self):
+        if self.max_batch < 1 or self.max_batch & (self.max_batch - 1):
+            raise ShapeError(
+                f"max_batch must be a power of two >= 1, "
+                f"got {self.max_batch}")
+        if self.max_delay_ms < 0:
+            # api-edge: config contract
+            raise ValueError("max_delay_ms must be >= 0")
+        if self.retries < 0:
+            # api-edge: config contract
+            raise ValueError("retries must be >= 0")
+        if self.device_bytes_budget < 0:
+            # api-edge: config contract — a negative budget would read
+            # as "always over budget" and silently evict everything
+            raise ValueError(
+                "device_bytes_budget must be >= 0 (0 = uncapped)")
+
+
+class _Batch:
+    """One in-flight batch: its plan and how to fetch its bytes."""
+
+    __slots__ = ("plan", "fetch", "t0")
+
+    def __init__(self, plan: BatchPlan, fetch, t0: float):
+        self.plan = plan
+        self.fetch = fetch
+        self.t0 = t0
+
+
+class DcfService:
+    """Online DCF evaluation service over a ``Dcf`` facade.
+
+    Construct via ``Dcf.serve(...)``.  Two driving modes:
+
+    * ``start()`` spawns the worker thread (production / load tests);
+      ``close(drain=True)`` stops admission, serves what is queued, and
+      joins the worker.  The service is also a context manager.
+    * ``pump()`` serves everything currently queued inline on the
+      calling thread — the deterministic mode unit tests drive with a
+      fake clock (no thread, no real time).
+    """
+
+    def __init__(self, dcf, config: ServeConfig | None = None, *,
+                 metrics: Metrics | None = None, clock=monotonic):
+        from dcf_tpu import api  # facade <-> serve wiring, cycle-free
+
+        self._dcf = dcf
+        self.config = config if config is not None else ServeConfig()
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._clock = clock
+        self.registry = KeyRegistry(
+            dcf.new_eval_backend,
+            shared_image=dcf.backend_name == "keylanes",
+            device_bytes_budget=self.config.device_bytes_budget,
+            metrics=self.metrics)
+        self.queue = AdmissionQueue(self.config.max_queued_points,
+                                    metrics=self.metrics)
+        self._worker: threading.Thread | None = None
+        self._pump_lock = threading.Lock()  # one batch runner at a time
+        m = self.metrics
+        self._c_batches = m.counter("serve_batches_total")
+        self._c_retries = m.counter("serve_retries_total")
+        self._c_failures = m.counter("serve_batch_failures_total")
+        self._h_occupancy = m.histogram("serve_batch_occupancy",
+                                        OCCUPANCY_BOUNDS)
+        self._h_stage = m.histogram("serve_stage_s")
+        self._h_eval = m.histogram("serve_eval_s")
+        self._h_wait = m.histogram("serve_queue_wait_s")
+        # The shared invalidation path: reset_backend_health() (module or
+        # facade method) must evict this service's staged images too, so
+        # a backend declared dead mid-serve cannot serve from cache.
+        api.register_reset_listener(self)
+
+    # -- invalidation -------------------------------------------------------
+
+    def _on_backend_health_reset(self) -> None:
+        self.registry.evict_all()
+
+    # -- key management -----------------------------------------------------
+
+    def register_key(self, key_id: str, bundle) -> None:
+        """Register (or hot-swap) the two-party bundle ``key_id`` serves.
+        Swapping evicts the old device residencies atomically."""
+        if bundle.lam != self._dcf.lam:
+            raise ShapeError(
+                f"bundle lam {bundle.lam} != service lam {self._dcf.lam}")
+        if bundle.n_bits != 8 * self._dcf.n_bytes:
+            raise ShapeError(
+                f"bundle domain {bundle.n_bits} bits != service domain "
+                f"{8 * self._dcf.n_bytes} bits")
+        self.registry.register(key_id, bundle)
+
+    def unregister_key(self, key_id: str) -> None:
+        self.registry.unregister(key_id)
+
+    def key_ids(self) -> list[str]:
+        return self.registry.key_ids()
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, key_id: str, xs: np.ndarray, b: int = 0,
+               deadline_ms: float | None = None) -> ServeFuture:
+        """Submit points for one registered key, party ``b``.
+
+        ``xs``: uint8 [M, n_bytes], M >= 1.  ``deadline_ms`` bounds the
+        time the request may spend QUEUED; expiry completes the future
+        with ``DeadlineExceededError``.  Raises ``QueueFullError`` when
+        shed.  Thread-safe."""
+        if b not in (0, 1):
+            # api-edge: party index contract at the serve edge
+            raise ValueError(f"party b must be 0 or 1, got {b}")
+        xs = np.ascontiguousarray(np.asarray(xs, dtype=np.uint8))
+        if xs.ndim != 2 or xs.shape[1] != self._dcf.n_bytes:
+            raise ShapeError(
+                f"xs must be [M, {self._dcf.n_bytes}], got {xs.shape}")
+        if xs.shape[0] < 1:
+            raise ShapeError("cannot submit an empty request")
+        self.registry.bundle(key_id)  # unknown key_id fails at submit
+        now = self._clock()
+        deadline = None if deadline_ms is None else now + deadline_ms / 1e3
+        req = Request(key_id, b, xs, deadline, now)
+        self.queue.put(req)  # sheds with QueueFullError on overload
+        return req.future
+
+    def evaluate(self, key_id: str, xs: np.ndarray, b: int = 0,
+                 deadline_ms: float | None = None,
+                 timeout: float | None = None) -> np.ndarray:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(key_id, xs, b, deadline_ms).result(timeout)
+
+    # -- serving ------------------------------------------------------------
+
+    def pump(self) -> int:
+        """Serve everything queued right now, inline; returns the number
+        of device batches dispatched.  The deterministic driving mode —
+        also what the worker thread calls after its coalescing wait."""
+        served = 0
+        with self._pump_lock:
+            while True:
+                expire(self.queue.take_expired(self._clock()), self.metrics)
+                group = self.queue.take_group(self.config.max_batch)
+                if not group:
+                    return served
+                try:
+                    served += self._serve_group(group)
+                except Exception as e:  # fallback-ok: the worker must
+                    # outlive ANY per-group failure (e.g. the key was
+                    # unregistered between submit and dispatch) — fail
+                    # the group's futures, keep serving other keys
+                    for r in group:
+                        if not r.future.done():
+                            r.future.set_exception(e)
+
+    def _serve_group(self, group: list[Request]) -> int:
+        """Batch-evaluate one (key_id, party) group of requests."""
+        now = self._clock()
+        for r in group:
+            self._h_wait.observe(max(now - r.enq_t, 0.0))
+        key_id, b = group[0].key_id, group[0].b
+        bundle = self.registry.bundle(key_id)
+        k_num, lam = bundle.num_keys, bundle.lam
+        xs_list = [r.xs for r in group]
+        outs = [np.empty((k_num, r.m, lam), dtype=np.uint8) for r in group]
+        plans = plan_batches([r.m for r in group], self.config.max_batch)
+        errors: dict[int, BaseException] = {}  # req index -> failure
+
+        def finish(batch: _Batch, y: np.ndarray | None,
+                   err: BaseException | None) -> None:
+            if err is not None:
+                self._c_failures.inc()
+                for sp in batch.plan.spans:
+                    errors.setdefault(sp.req, err)
+                return
+            self._h_eval.observe(max(self._clock() - batch.t0, 0.0))
+            self._h_occupancy.observe(batch.plan.occupancy)
+            scatter_batch(outs, batch.plan, y)
+
+        # Dispatch-ahead pipeline: batch N+1 is staged and dispatched
+        # while batch N's result is still in flight; N is fetched after.
+        prev: _Batch | None = None
+        for plan in plans:
+            cur, y, err = self._run_batch(key_id, b, plan, xs_list)
+            if prev is not None:
+                self._complete(prev, key_id, b, xs_list, finish)
+            if err is not None:
+                finish(_Batch(plan, None, 0.0), None, err)
+                prev = None
+            elif y is not None:  # a sync retry already fetched its bytes
+                finish(cur, y, None)
+                prev = None
+            else:
+                prev = cur
+        if prev is not None:
+            self._complete(prev, key_id, b, xs_list, finish)
+
+        for i, r in enumerate(group):
+            if i in errors:
+                r.future.set_exception(errors[i])
+            else:
+                r.future.set_result(outs[i])
+        return len(plans)
+
+    # -- batch execution ----------------------------------------------------
+
+    def _run_batch(self, key_id: str, b: int, plan: BatchPlan, xs_list
+                   ) -> tuple[_Batch | None, np.ndarray | None,
+                              BaseException | None]:
+        """Dispatch one batch.  Returns (in-flight batch, None, None) on
+        the happy path; (batch, bytes, None) when a failure forced the
+        synchronous retry path (already fetched); (None, None, error)
+        when retries were exhausted."""
+        try:
+            return self._dispatch(key_id, b, plan, xs_list), None, None
+        except Exception as e:  # fallback-ok: ANY backend/seam failure
+            # must be contained to this batch (retried or failed), never
+            # allowed to kill the serve worker
+            y, err = self._retry_sync(key_id, b, plan, xs_list, e)
+            if err is not None:
+                return None, None, err
+            return _Batch(plan, None, self._clock()), y, None
+
+    def _dispatch(self, key_id: str, b: int, plan: BatchPlan,
+                  xs_list) -> _Batch:
+        """Stage + dispatch one batch; returns the in-flight handle."""
+        t0 = self._clock()
+        xs_batch = gather_batch(xs_list, plan, self._dcf.n_bytes)
+        fire("serve.stage", key_id, plan.m)
+        # Host-path detection is DYNAMIC (resident() returns None when
+        # the facade currently resolves to cpu/numpy): a mid-serve auto
+        # fallback that lands on the numpy floor must serve through the
+        # facade, not die on the device path it selected at construction.
+        be = self.registry.resident(key_id, b)
+        if be is None:
+            bundle = self.registry.bundle(key_id)
+            fire("serve.eval", key_id, plan.m)
+            y = self._dcf.eval(b, bundle, xs_batch)
+            self._c_batches.inc()
+            return _Batch(plan, lambda: y, t0)
+        if hasattr(be, "stage"):
+            staged = be.stage(xs_batch)
+            self._h_stage.observe(max(self._clock() - t0, 0.0))
+            fire("serve.eval", key_id, plan.m)
+            y_dev = be.eval_staged(b, staged)  # async dispatch
+            # Prefix-family backends build frontier tables on first
+            # eval; re-measure so the LRU budget sees the real image.
+            self.registry.note_image_growth(key_id, b)
+            self._c_batches.inc()
+            return _Batch(plan, lambda: be.staged_to_bytes(y_dev, plan.m),
+                          t0)
+        fire("serve.eval", key_id, plan.m)
+        y = be.eval(b, xs_batch)
+        self._c_batches.inc()
+        return _Batch(plan, lambda: y, t0)
+
+    def _complete(self, batch: _Batch, key_id: str, b: int, xs_list,
+                  finish) -> None:
+        """Fetch an in-flight batch; a fetch-time failure (the dispatch
+        is async — compile/execute errors can surface here) takes the
+        same retry path as a dispatch-time one."""
+        try:
+            finish(batch, batch.fetch(), None)
+        except Exception as e:  # fallback-ok: ANY backend/seam failure
+            # must be contained to this batch (retried or failed), never
+            # allowed to kill the serve worker
+            y, err = self._retry_sync(key_id, b, batch.plan, xs_list, e)
+            if err is not None:
+                finish(batch, None, err)
+            else:
+                finish(_Batch(batch.plan, None, self._clock()), y, None)
+
+    def _retry_sync(self, key_id: str, b: int, plan: BatchPlan, xs_list,
+                    first: BaseException
+                    ) -> tuple[np.ndarray | None, BaseException | None]:
+        """Bounded synchronous retries after a batch failure, with
+        escalating invalidation.
+
+        Early attempts evict only the failed key's residency (cheap — a
+        transient fault must not cost every OTHER hot key its staged
+        image).  The FINAL attempt runs the SHARED invalidation path
+        (``Dcf.reset_backend_health`` — which evicts this service's
+        whole residency cache through the listener registration) so it
+        re-selects a healthy backend and re-stages rather than
+        re-entering the instance that just died (the ``pallas.lowering``
+        regression scenario).  With the default ``retries=1`` the one
+        retry IS the final attempt and takes the shared path."""
+        last: BaseException = first
+        for attempt in range(self.config.retries):
+            self._c_retries.inc()
+            if attempt < self.config.retries - 1:
+                self.registry.evict_key(key_id)
+            else:
+                self._dcf.reset_backend_health()
+            try:
+                batch = self._dispatch(key_id, b, plan, xs_list)
+                return batch.fetch(), None
+            except Exception as e:  # fallback-ok: retry loop boundary —
+                # the last failure is reported to the affected requests
+                last = e
+        return None, last
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "DcfService":
+        """Spawn the worker thread (idempotent)."""
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._worker_loop, name="dcf-serve", daemon=True)
+            self._worker.start()
+        return self
+
+    def _worker_loop(self) -> None:
+        max_delay = self.config.max_delay_ms / 1e3
+        q = self.queue
+        while True:
+            with q.cond:
+                while not len(q) and not q.closed:
+                    q.cond.wait(timeout=0.1)
+                if not len(q) and q.closed:
+                    return
+                # Coalescing wait: give co-batchable traffic max_delay to
+                # arrive, unless a full batch is already queued or we are
+                # draining (queue closed).
+                while not q.closed and q.points < self.config.max_batch:
+                    oldest = q.oldest_enq_t()
+                    if oldest is None:
+                        break
+                    remaining = max_delay - (self._clock() - oldest)
+                    if remaining <= 0:
+                        break
+                    q.cond.wait(timeout=remaining)
+            self.pump()
+
+    def close(self, drain: bool = True, timeout: float | None = None
+              ) -> None:
+        """Stop admission and shut down.
+
+        ``drain=True`` (default): queued requests are served before the
+        worker exits.  ``drain=False``: queued requests complete with
+        ``BackendUnavailableError``.  Always joins the worker."""
+        self.queue.close()
+        if not drain:
+            self.queue.fail_all(lambda: BackendUnavailableError(
+                "service closed without draining"))
+        if self._worker is not None and self._worker.is_alive():
+            self._worker.join(timeout)
+        else:
+            self.pump()  # no worker: drain inline
+        if drain:
+            self.pump()  # belt-and-braces: nothing may stay queued
+
+    def __enter__(self) -> "DcfService":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.close(drain=True)
+        return False
+
+    # -- observability ------------------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        """Deterministic point-in-time metrics dict (see serve.metrics)."""
+        return self.metrics.snapshot()

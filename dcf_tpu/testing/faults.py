@@ -52,6 +52,10 @@ POINTS = (
     "native.load",      # ctypes.CDLL load failure  (native/__init__.load)
     "pallas.lowering",  # Mosaic compile/lowering   (pallas backends' eval)
     "mesh.provision",   # device/mesh provisioning  (parallel.mesh.make_mesh)
+    "serve.stage",      # host->device batch staging (serve/service.py;
+    #                     handler args: key_id, batch_points)
+    "serve.eval",       # staged batch dispatch      (serve/service.py;
+    #                     handler args: key_id, batch_points)
 )
 
 _ACTIVE: dict[str, Callable] = {}

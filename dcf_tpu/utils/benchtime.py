@@ -11,7 +11,24 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["DISPATCHES_PER_SAMPLE", "DISPATCHES_PER_SAMPLE_SLOW",
-           "DISPATCHES_PER_SAMPLE_TREE", "device_sync", "measure_sync_rtt"]
+           "DISPATCHES_PER_SAMPLE_TREE", "device_sync", "measure_sync_rtt",
+           "monotonic"]
+
+
+def monotonic() -> float:
+    """The framework's one wall-clock seam: a monotonic seconds reading.
+
+    Library code (the ``dcf_tpu.serve`` batcher's delay/deadline logic in
+    particular) must NOT call ``time.*`` directly — the dcflint
+    determinism pass enforces it — because a hidden clock read makes two
+    runs of the "same" workload diverge un-reproducibly.  Instead,
+    components take a ``clock`` callable defaulting to this function, so
+    tests inject a fake clock and replay schedules deterministically
+    while production gets ``time.monotonic`` (immune to wall-clock
+    steps, the right base for deadlines and coalescing delays)."""
+    import time
+
+    return time.monotonic()
 
 # ~1.2ms of amortized sync against ~100ms per dispatch at the flagship
 # shape (measured 2026-07-31: 16 dispatches under-reported the chip by

@@ -14,7 +14,7 @@ toolchain breakage, dead accelerator, or corrupted artifact required:
 * Native core: build exit != 0 and CDLL load failure degrade AES-NI ->
   portable (warned); persistent failure raises ``NativeBuildError``.
 * Mesh provisioning failure raises ``BackendUnavailableError``.
-* The exception-hygiene static gate (tools/check_exception_hygiene.py).
+* The exception-hygiene static gate (the dcflint exception-hygiene pass).
 """
 
 import struct
@@ -340,13 +340,15 @@ def test_corrupt_helper_bounds(bundle):
 
 
 def test_exception_hygiene_gate():
-    """No blanket handlers in dcf_tpu/ outside marked fallback sites."""
+    """No blanket handlers in dcf_tpu/ outside marked fallback sites
+    (the dcflint exception-hygiene pass; the old standalone script was
+    deleted in PR 4)."""
     import os
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
-        [sys.executable, os.path.join(root, "tools",
-                                      "check_exception_hygiene.py")],
+        [sys.executable, "-m", "tools.dcflint", "dcf_tpu",
+         "--pass", "exception-hygiene"],
         capture_output=True, text=True, cwd=root)
     assert proc.returncode == 0, proc.stdout + proc.stderr
 

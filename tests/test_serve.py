@@ -1,0 +1,458 @@
+"""dcf_tpu.serve: the online evaluation service.
+
+Covers the acceptance contract end to end — bit-exact parity vs the
+numpy/spec oracle for every request of a mixed workload (3 registered
+bundles incl. a multi-key one, ragged request sizes, both parties,
+reconstruction checked), including under injected ``serve.eval`` faults
+with retries — plus each serving mechanism in isolation: admission
+shedding, deadline expiry (fake clock), LRU residency eviction under a
+device-bytes budget, re-registration staleness eviction, graceful vs
+hard shutdown, the worker thread, metrics snapshot shape, and the
+``pallas.lowering`` mid-serve backend-fallback regression (satellite:
+``Dcf.reset_backend_health`` and the serve cache share one invalidation
+path).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import dcf_tpu.api as api
+from dcf_tpu import Dcf
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.errors import (
+    BackendUnavailableError,
+    DeadlineExceededError,
+    QueueFullError,
+    ShapeError,
+)
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.serve import DcfService, ServeConfig
+from dcf_tpu.serve.registry import device_image_bytes
+from dcf_tpu.testing import faults
+
+pytestmark = pytest.mark.serve
+
+NB, LAM = 2, 16
+
+
+class FakeClock:
+    """Deterministic injectable clock (seconds)."""
+
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0x5E12)
+
+
+@pytest.fixture(scope="module")
+def ck(rng):
+    return [rng.bytes(32), rng.bytes(32)]
+
+
+@pytest.fixture(scope="module")
+def dcf(ck):
+    return Dcf(NB, LAM, ck, backend="bitsliced")
+
+
+@pytest.fixture(scope="module")
+def prg(ck):
+    return HirosePrgNp(LAM, ck)
+
+
+@pytest.fixture(scope="module")
+def bundles(dcf, rng):
+    """Three named bundles; 'multi' holds K=2 keys."""
+    out = {}
+    for name, k in (("relu-a", 1), ("relu-b", 1), ("multi", 2)):
+        alphas = rng.integers(0, 256, (k, NB), dtype=np.uint8)
+        betas = rng.integers(0, 256, (k, LAM), dtype=np.uint8)
+        out[name] = dcf.gen(alphas, betas, rng=rng)
+    return out
+
+
+def make_service(dcf, bundles, **knobs):
+    knobs.setdefault("max_batch", 32)
+    svc = dcf.serve(**knobs)
+    for name, bundle in bundles.items():
+        svc.register_key(name, bundle)
+    return svc
+
+
+def oracle(prg, bundle, b, xs):
+    return eval_batch_np(prg, b, bundle.for_party(b), xs)
+
+
+# ------------------------------------------------------------ acceptance
+
+
+def test_mixed_workload_bit_exact_vs_oracle(dcf, bundles, prg, rng):
+    """The acceptance workload: >= 3 bundles, ragged sizes, both
+    parties, every request's reconstruction bit-exact vs the oracle —
+    WITH a serve.eval fault injected mid-run and retried."""
+    svc = make_service(dcf, bundles, retries=1)
+    names = list(bundles)
+    reqs = []
+    for i in range(14):
+        name = names[i % len(names)]
+        m = int(rng.integers(1, 11)) if i != 5 else 1  # single-point too
+        xs = rng.integers(0, 256, (m, NB), dtype=np.uint8)
+        reqs.append((name, xs))
+    calls = {"n": 0}
+
+    def fail_first(*_args):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise faults.InjectedFault("injected mid-batch eval failure")
+
+    with faults.inject("serve.eval", handler=fail_first):
+        futs = [(svc.submit(name, xs, b=0), svc.submit(name, xs, b=1))
+                for name, xs in reqs]
+        svc.pump()
+    assert calls["n"] >= 2  # the fault fired and the retry re-dispatched
+    snap = svc.metrics_snapshot()
+    assert snap["serve_retries_total"] >= 1
+    for (name, xs), (f0, f1) in zip(reqs, futs):
+        y0, y1 = f0.result(1), f1.result(1)
+        want = oracle(prg, bundles[name], 0, xs) ^ \
+            oracle(prg, bundles[name], 1, xs)
+        assert np.array_equal(y0 ^ y1, want), name
+    assert snap["serve_queue_depth"] == 0
+    assert snap["serve_batches_total"] >= 1
+
+
+def test_oversized_request_spans_batches(dcf, bundles, prg, rng):
+    """A request bigger than max_batch splits, scatters back in order."""
+    svc = make_service(dcf, bundles, max_batch=32)
+    xs = rng.integers(0, 256, (70, NB), dtype=np.uint8)
+    fut = svc.submit("relu-a", xs)
+    svc.pump()
+    y0 = fut.result(1)
+    assert y0.shape == (1, 70, LAM)
+    assert np.array_equal(y0, oracle(prg, bundles["relu-a"], 0, xs))
+
+
+def test_worker_thread_end_to_end(dcf, bundles, prg, rng):
+    svc = make_service(dcf, bundles, max_delay_ms=1.0)
+    xs = rng.integers(0, 256, (6, NB), dtype=np.uint8)
+    with svc:
+        y0 = svc.evaluate("relu-b", xs, b=0, timeout=60)
+        y1 = svc.evaluate("relu-b", xs, b=1, timeout=60)
+    want = oracle(prg, bundles["relu-b"], 0, xs) ^ \
+        oracle(prg, bundles["relu-b"], 1, xs)
+    assert np.array_equal(y0 ^ y1, want)
+    with pytest.raises(QueueFullError):  # context exit closed admission
+        svc.submit("relu-b", xs)
+
+
+def test_host_path_numpy_backend(ck, bundles, prg, rng):
+    """The no-device path: a numpy-backed service still serves batches
+    (through the facade's host dispatch) bit-exactly."""
+    dcf_np = Dcf(NB, LAM, ck, backend="numpy")
+    svc = make_service(dcf_np, bundles)
+    xs = rng.integers(0, 256, (5, NB), dtype=np.uint8)
+    fut = svc.submit("multi", xs, b=1)
+    svc.pump()
+    assert np.array_equal(fut.result(1),
+                          oracle(prg, bundles["multi"], 1, xs))
+
+
+# ------------------------------------------------------ admission control
+
+
+def test_queue_full_sheds(dcf, bundles, rng):
+    svc = make_service(dcf, bundles, max_queued_points=8)
+    xs = rng.integers(0, 256, (5, NB), dtype=np.uint8)
+    svc.submit("relu-a", xs)
+    with pytest.raises(QueueFullError):
+        svc.submit("relu-a", xs)  # 5 + 5 > 8
+    # A request bigger than the bound OUTRIGHT can never be admitted:
+    # that is a size-contract ShapeError, not a retriable QueueFull.
+    with pytest.raises(ShapeError, match="split the request"):
+        svc.submit("relu-a", rng.integers(0, 256, (9, NB),
+                                          dtype=np.uint8))
+    snap = svc.metrics_snapshot()
+    assert snap["serve_shed_total"] == 1
+    assert snap["serve_queue_points"] == 5
+    svc.pump()  # leave nothing queued for later tests
+
+
+def test_take_group_fifo_no_queue_jumping():
+    """Once a same-group request does not fit, the group closes: a
+    later-submitted smaller request must not be served ahead of it."""
+    from dcf_tpu.serve.admission import AdmissionQueue, Request
+
+    q = AdmissionQueue(100_000)
+
+    def mk(m):
+        return Request("k", 0, np.zeros((m, NB), dtype=np.uint8),
+                       None, 0.0)
+
+    a, b, c = mk(3000), mk(2000), mk(1000)
+    for r in (a, b, c):
+        q.put(r)
+    assert q.take_group(4096) == [a]  # b does not fit -> c may not jump
+    assert q.take_group(4096) == [b, c]
+
+
+def test_shed_counter_covers_shutdown_rejections(dcf, bundles, rng):
+    """QueueFullError from a closed queue counts in serve_shed_total so
+    the snapshot agrees with loadgen's requests_shed."""
+    svc = make_service(dcf, bundles)
+    svc.close(drain=True)
+    with pytest.raises(QueueFullError):
+        svc.submit("relu-a", np.zeros((1, NB), dtype=np.uint8))
+    assert svc.metrics_snapshot()["serve_shed_total"] == 1
+
+
+def test_submit_validation(dcf, bundles, rng):
+    svc = make_service(dcf, bundles)
+    with pytest.raises(ValueError, match="no bundle registered"):
+        svc.submit("nope", np.zeros((1, NB), dtype=np.uint8))
+    with pytest.raises(ShapeError):
+        svc.submit("relu-a", np.zeros((1, NB + 1), dtype=np.uint8))
+    with pytest.raises(ShapeError):
+        svc.submit("relu-a", np.zeros((0, NB), dtype=np.uint8))
+    with pytest.raises(ValueError, match="party"):
+        svc.submit("relu-a", np.zeros((1, NB), dtype=np.uint8), b=2)
+
+
+def test_deadline_expiry_fake_clock(dcf, bundles, rng):
+    clock = FakeClock()
+    svc = DcfService(dcf, ServeConfig(max_batch=32), clock=clock)
+    svc.register_key("relu-a", bundles["relu-a"])
+    xs = rng.integers(0, 256, (3, NB), dtype=np.uint8)
+    f_dead = svc.submit("relu-a", xs, deadline_ms=10.0)
+    f_live = svc.submit("relu-a", xs, deadline_ms=10_000.0)
+    clock.advance(0.05)  # 50ms > 10ms deadline
+    svc.pump()
+    with pytest.raises(DeadlineExceededError):
+        f_dead.result(1)
+    assert f_live.result(1).shape == (1, 3, LAM)
+    assert svc.metrics_snapshot()["serve_deadline_expired_total"] == 1
+
+
+def test_close_drain_serves_queued(dcf, bundles, prg, rng):
+    svc = make_service(dcf, bundles)
+    xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+    fut = svc.submit("relu-a", xs)
+    svc.close(drain=True)  # no worker ever started: drains inline
+    assert np.array_equal(fut.result(1),
+                          oracle(prg, bundles["relu-a"], 0, xs))
+    with pytest.raises(QueueFullError):
+        svc.submit("relu-a", xs)
+
+
+def test_close_without_drain_fails_queued(dcf, bundles, rng):
+    svc = make_service(dcf, bundles)
+    fut = svc.submit("relu-a", rng.integers(0, 256, (4, NB),
+                                            dtype=np.uint8))
+    svc.close(drain=False)
+    with pytest.raises(BackendUnavailableError):
+        fut.result(1)
+
+
+# ----------------------------------------------------- residency / cache
+
+
+def test_lru_eviction_under_device_budget(dcf, bundles, rng):
+    """Budget sized for ~2 images: serving 3 keys round-robin must evict
+    LRU, and every result stays correct (re-staging is transparent)."""
+    probe = make_service(dcf, bundles)
+    xs = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+    probe.submit("relu-a", xs)
+    probe.pump()
+    one = device_image_bytes(probe.registry.resident("relu-a", 0))
+    assert one > 0
+    svc = make_service(dcf, bundles, device_bytes_budget=int(2.5 * one))
+    for name in ("relu-a", "relu-b", "relu-a", "relu-b", "relu-a"):
+        fut = svc.submit(name, xs)
+        svc.pump()
+        fut.result(1)
+    snap = svc.metrics_snapshot()
+    assert snap["serve_resident_device_bytes"] <= int(2.5 * one)
+    assert snap["serve_resident_images"] <= 2
+    # 'multi' is colder and bigger (K=2): staging it evicts the LRU one
+    fut = svc.submit("multi", xs)
+    svc.pump()
+    fut.result(1)
+    assert svc.metrics_snapshot()["serve_evictions_total"] >= 1
+
+
+def test_reregistration_evicts_stale_residency(dcf, bundles, prg, rng):
+    """The staleness guard: hot-swapping a key id must evict the old
+    device image — the next request serves the NEW function."""
+    svc = make_service(dcf, bundles)
+    xs = rng.integers(0, 256, (3, NB), dtype=np.uint8)
+    fut = svc.submit("relu-a", xs)
+    svc.pump()
+    fut.result(1)
+    assert svc.metrics_snapshot()["serve_resident_images"] >= 1
+    alphas = rng.integers(0, 256, (1, NB), dtype=np.uint8)
+    betas = rng.integers(0, 256, (1, LAM), dtype=np.uint8)
+    fresh = dcf.gen(alphas, betas, rng=rng)
+    svc.register_key("relu-a", fresh)
+    assert svc.metrics_snapshot()["serve_evictions_total"] >= 1
+    fut = svc.submit("relu-a", xs)
+    svc.pump()
+    assert np.array_equal(fut.result(1), oracle(prg, fresh, 0, xs))
+
+
+def test_idempotent_reregistration_keeps_residency(dcf, bundles, rng):
+    """Re-registering the SAME bundle object is a no-op: device images
+    stay resident and nothing counts as an eviction."""
+    svc = make_service(dcf, bundles)
+    xs = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+    fut = svc.submit("relu-a", xs)
+    svc.pump()
+    fut.result(1)
+    before = svc.metrics_snapshot()
+    svc.register_key("relu-a", bundles["relu-a"])
+    after = svc.metrics_snapshot()
+    assert after["serve_resident_images"] == before["serve_resident_images"]
+    assert after["serve_evictions_total"] == before["serve_evictions_total"]
+
+
+def test_unregister_between_submit_and_pump_fails_only_that_group(
+        dcf, bundles, prg, rng):
+    """The worker must outlive a key vanishing mid-queue: the stranded
+    group's futures fail typed, other groups still serve."""
+    svc = make_service(dcf, bundles)
+    xs = rng.integers(0, 256, (3, NB), dtype=np.uint8)
+    doomed = svc.submit("relu-b", xs)
+    alive = svc.submit("relu-a", xs)
+    svc.unregister_key("relu-b")
+    svc.pump()
+    with pytest.raises(ValueError, match="no bundle registered"):
+        doomed.result(1)
+    assert np.array_equal(alive.result(1),
+                          oracle(prg, bundles["relu-a"], 0, xs))
+
+
+def test_reset_backend_health_shares_invalidation_path(dcf, bundles, rng):
+    """Both spellings of reset evict the serve registry's residencies."""
+    svc = make_service(dcf, bundles)
+    xs = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+    for entry, reset in ((0, dcf.reset_backend_health),
+                         (1, api.reset_backend_health)):
+        fut = svc.submit("relu-a", xs)
+        svc.pump()
+        fut.result(1)
+        assert svc.metrics_snapshot()["serve_resident_images"] >= 1
+        reset()
+        assert svc.metrics_snapshot()["serve_resident_images"] == 0, entry
+
+
+# ------------------------------------------------------- fault injection
+
+
+def test_serve_stage_fault_exhausts_retries(dcf, bundles, rng):
+    svc = make_service(dcf, bundles, retries=1)
+    xs = rng.integers(0, 256, (3, NB), dtype=np.uint8)
+    with faults.inject("serve.stage"):
+        fut = svc.submit("relu-a", xs)
+        svc.pump()
+        with pytest.raises(faults.InjectedFault):
+            fut.result(1)
+    snap = svc.metrics_snapshot()
+    assert snap["serve_batch_failures_total"] >= 1
+    assert snap["serve_retries_total"] >= 1
+    # the service survives: the next request serves normally
+    fut = svc.submit("relu-a", xs)
+    svc.pump()
+    assert fut.result(1).shape == (1, 3, LAM)
+
+
+def test_pallas_lowering_fallback_mid_serve(ck, bundles, prg, rng,
+                                            monkeypatch):
+    """The satellite regression: a pallas backend dying mid-serve (the
+    ``pallas.lowering`` seam) must fall over to a healthy backend via
+    the SHARED invalidation path — staged device state is evicted, the
+    auto facade re-selects, and the retried requests reconstruct
+    bit-exactly on the fallback backend."""
+    monkeypatch.setattr(api, "_default_backend", lambda lam: "pallas")
+    api.reset_backend_health()
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            dcf_auto = Dcf(NB, LAM, ck, backend="auto",
+                           backend_opts={"interpret": True})
+        assert dcf_auto.backend_name == "pallas"
+        svc = make_service(dcf_auto, bundles, retries=1)
+        xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+        fut = svc.submit("relu-a", xs)
+        svc.pump()
+        fut.result(1)  # serving on pallas (interpret)
+        stagings_before = svc.metrics_snapshot()[
+            "serve_key_stagings_total"]
+        with faults.inject("pallas.lowering"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                f0 = svc.submit("relu-a", xs, b=0)
+                f1 = svc.submit("relu-a", xs, b=1)
+                svc.pump()
+                y0, y1 = f0.result(1), f1.result(1)
+        assert dcf_auto.backend_name == "bitsliced"  # fell over
+        want = oracle(prg, bundles["relu-a"], 0, xs) ^ \
+            oracle(prg, bundles["relu-a"], 1, xs)
+        assert np.array_equal(y0 ^ y1, want)
+        snap = svc.metrics_snapshot()
+        assert snap["serve_retries_total"] >= 1
+        # the dead backend's staged image was evicted and re-staged on
+        # the fallback — never served from the dead instance's cache
+        assert snap["serve_key_stagings_total"] > stagings_before
+    finally:
+        api.reset_backend_health()
+
+
+# --------------------------------------------------------- observability
+
+
+def test_metrics_snapshot_is_deterministic_and_jsonable(dcf, bundles,
+                                                        rng):
+    import json
+
+    svc = make_service(dcf, bundles)
+    fut = svc.submit("relu-a", rng.integers(0, 256, (3, NB),
+                                            dtype=np.uint8))
+    svc.pump()
+    fut.result(1)
+    snap = svc.metrics_snapshot()
+    assert list(snap) == sorted(snap)
+    json.dumps(snap)  # JSON-basic values only
+    for name in ("serve_requests_total", "serve_points_total",
+                 "serve_batches_total", "serve_batch_occupancy_count",
+                 "serve_stage_s_count", "serve_eval_s_count",
+                 "serve_queue_wait_s_count", "serve_queue_depth",
+                 "serve_resident_device_bytes", "serve_evictions_total",
+                 "serve_shed_total", "serve_registered_keys"):
+        assert name in snap, name
+    assert snap["serve_requests_total"] == 1
+    assert snap["serve_points_total"] == 3
+    # occupancy of the one 3-point batch: 3/4 bucketed under 0.75
+    assert snap["serve_batch_occupancy_count"] == 1
+
+
+def test_unregister(dcf, bundles, rng):
+    svc = make_service(dcf, bundles)
+    assert svc.key_ids() == sorted(bundles)
+    svc.unregister_key("multi")
+    assert "multi" not in svc.key_ids()
+    with pytest.raises(ValueError, match="no bundle registered"):
+        svc.submit("multi", np.zeros((1, NB), dtype=np.uint8))
+
+
+def test_register_rejects_party_restricted_and_mismatched(dcf, bundles):
+    svc = make_service(dcf, bundles)
+    with pytest.raises(ShapeError, match="two-party"):
+        svc.register_key("half", bundles["relu-a"].for_party(0))

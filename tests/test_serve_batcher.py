@@ -1,0 +1,144 @@
+"""Batcher padding/scatter math in isolation — no backend, no service.
+
+Property-style over seeded cases: the plan must partition every request
+in order, land inside power-of-two batches bounded by max_batch, and the
+gather->scatter roundtrip must reassemble every request bit-exactly even
+when batches complete out of order (the double-buffered pipeline's
+reality).  Every case class the ISSUE names is pinned: ragged sizes,
+single-point requests, exact power-of-two boundaries, out-of-order
+completion.
+"""
+
+import numpy as np
+import pytest
+
+from dcf_tpu.errors import ShapeError
+from dcf_tpu.serve.batcher import (
+    gather_batch,
+    next_pow2,
+    plan_batches,
+    scatter_batch,
+)
+
+pytestmark = pytest.mark.serve
+
+
+def check_plan_invariants(sizes, max_batch, plans):
+    """The structural contract every plan must satisfy."""
+    per_req = {i: [] for i in range(len(sizes))}
+    for plan in plans:
+        assert 1 <= plan.m <= plan.padded_m <= max_batch
+        assert plan.padded_m == next_pow2(plan.m)
+        # spans tile [0, m) exactly, in order, without overlap
+        spans = sorted(plan.spans, key=lambda s: s.batch_off)
+        off = 0
+        for sp in spans:
+            assert sp.batch_off == off
+            assert sp.length >= 1
+            off += sp.length
+        assert off == plan.m
+        for sp in plan.spans:
+            per_req[sp.req].append(sp)
+    # each request is partitioned contiguously and in submission order
+    for i, size in enumerate(sizes):
+        chunks = per_req[i]
+        assert [c.req_off for c in chunks] == sorted(
+            c.req_off for c in chunks)
+        off = 0
+        for c in chunks:
+            assert c.req_off == off
+            off += c.length
+        assert off == size
+
+
+def roundtrip(sizes, max_batch, rng, completion_order=None):
+    """gather -> fake eval (identity payload) -> scatter, optionally
+    completing batches out of order; returns per-request outputs."""
+    nb, k_num, lam = 3, 2, 4
+    xs_list = [rng.integers(0, 256, (m, nb), dtype=np.uint8)
+               for m in sizes]
+    plans = plan_batches(sizes, max_batch)
+    check_plan_invariants(sizes, max_batch, plans)
+    outs = [np.zeros((k_num, m, lam), dtype=np.uint8) for m in sizes]
+    order = (completion_order if completion_order is not None
+             else range(len(plans)))
+    for i in order:
+        plan = plans[i]
+        xb = gather_batch(xs_list, plan, nb)
+        assert xb.shape == (plan.padded_m, nb)
+        assert not xb[plan.m:].any()  # pad rows are zero
+        # fake eval: y[k, j, :] is a tag of the input row, so scatter
+        # errors (wrong row, wrong request) are detectable
+        y = np.zeros((k_num, plan.padded_m, lam), dtype=np.uint8)
+        for k in range(k_num):
+            y[k, :, 0] = xb[:, 0]
+            y[k, :, 1] = xb[:, 1]
+            y[k, :, 2] = k
+        scatter_batch(outs, plan, y)
+    for xs, out in zip(xs_list, outs):
+        for k in range(k_num):
+            assert np.array_equal(out[k, :, 0], xs[:, 0])
+            assert np.array_equal(out[k, :, 1], xs[:, 1])
+            assert (out[k, :, 2] == k).all()
+    return plans
+
+
+def test_next_pow2():
+    assert [next_pow2(m) for m in (1, 2, 3, 4, 5, 31, 32, 33)] == \
+        [1, 2, 4, 4, 8, 32, 32, 64]
+
+
+def test_ragged_sizes_seeded_property():
+    rng = np.random.default_rng(0xBA7C)
+    for _ in range(25):
+        n_req = int(rng.integers(1, 12))
+        sizes = [int(rng.integers(1, 40)) for _ in range(n_req)]
+        max_batch = int(2 ** rng.integers(0, 6))
+        roundtrip(sizes, max_batch, rng)
+
+
+def test_single_point_requests():
+    rng = np.random.default_rng(1)
+    plans = roundtrip([1] * 7, 4, rng)
+    assert [p.m for p in plans] == [4, 3]
+    assert [p.padded_m for p in plans] == [4, 4]
+
+
+def test_exact_power_of_two_boundary():
+    """Totals landing exactly on max_batch produce full, unpadded
+    batches (occupancy 1.0)."""
+    rng = np.random.default_rng(2)
+    plans = roundtrip([8, 8, 16, 32], 32, rng)
+    assert [(p.m, p.padded_m) for p in plans] == [(32, 32), (32, 32)]
+    assert all(p.occupancy == 1.0 for p in plans)
+
+
+def test_oversized_request_splits():
+    rng = np.random.default_rng(3)
+    plans = roundtrip([100], 32, rng)
+    assert [p.m for p in plans] == [32, 32, 32, 4]
+    assert plans[-1].padded_m == 4
+
+
+def test_out_of_order_completion_preserves_order():
+    rng = np.random.default_rng(4)
+    sizes = [int(rng.integers(1, 50)) for _ in range(9)]
+    n_plans = len(plan_batches(sizes, 16))
+    for _ in range(5):
+        order = rng.permutation(n_plans)
+        roundtrip(sizes, 16, rng, completion_order=list(order))
+
+
+def test_occupancy():
+    (plan,) = plan_batches([5], 32)
+    assert plan.m == 5 and plan.padded_m == 8
+    assert plan.occupancy == 5 / 8
+
+
+def test_rejects_bad_arguments():
+    with pytest.raises(ShapeError):
+        plan_batches([4], 12)  # not a power of two
+    with pytest.raises(ShapeError):
+        plan_batches([4], 0)
+    with pytest.raises(ShapeError):
+        plan_batches([3, 0], 8)  # empty request

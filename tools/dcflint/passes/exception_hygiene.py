@@ -7,7 +7,8 @@ silently-wrong shares; the only legitimate sites are the fallback chain
 itself (auto backend canary, native portable degradation, TPU-presence
 probes), and each must carry ``# fallback-ok: <reason>`` on the
 ``except`` line so the allowance is visible in the diff that introduces
-it.  This is the PR-1 ``tools/check_exception_hygiene.py`` gate, ported
+it.  This is the PR-1 exception-hygiene gate (originally a standalone
+``tools/check_exception_hygiene.py`` script, deleted in PR 4), ported
 in as a pass (the standalone script is now a shim over it).
 """
 

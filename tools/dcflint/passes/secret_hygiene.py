@@ -1,9 +1,9 @@
-"""secret-hygiene: key material never reaches print/logging, and key
-classes redact their __repr__.
+"""secret-hygiene: key material never reaches print/logging/metrics, and
+key classes redact their __repr__.
 
 In a two-party FSS deployment the seeds and correction words ARE the
-security: a seed in a log line hands the other party the function.  Two
-rules:
+security: a seed in a log line hands the other party the function.
+Three rules:
 
 1. No ``print``/``logging`` call (including the CLI's ``log`` helper)
    whose argument expression references a name bound to key material —
@@ -12,7 +12,13 @@ rules:
    deliberately conservative: printing ``bundle.num_keys`` is safe and
    gets a suppression with a reason, which is exactly the audit trail a
    reviewer wants at such a site.
-2. Every class holding key-material fields (dataclass or assignment
+2. (PR 4, the serving layer's observability surface) The same rule for
+   METRIC sinks: a recording-method call (``.inc``/``.observe``/
+   ``.set``/``.add``/``.labels``) or the serve ``labeled(...)``
+   label-builder whose arguments reference key-material names — metric
+   label values and observations end up in dashboards and committed
+   RESULTS JSONL lines, which are log lines with better formatting.
+3. Every class holding key-material fields (dataclass or assignment
    fields matching the same patterns) must define an explicit
    ``__repr__`` — the dataclass default repr prints field values, so a
    stray ``f"{bundle}"`` in a traceback or debug line would leak seed
@@ -30,9 +36,10 @@ from tools.dcflint import FileContext, LintPass, register
 SECRET_NAME_RE = re.compile(
     r"^(seed\w*|s0s?|cw(_\w+)?|cws|key_bundle|bundle|kb|key_material"
     r"|cipher_keys?)$")
-_PRINT_FUNCS = ("print", "log")
+_PRINT_FUNCS = ("print", "log", "labeled")
 _LOGGING_METHODS = ("debug", "info", "warning", "error", "critical",
                     "exception", "log")
+_METRIC_METHODS = ("inc", "observe", "set", "add", "labels")
 
 
 def _secret_refs(node: ast.AST) -> Iterator[str]:
@@ -45,7 +52,8 @@ def _secret_refs(node: ast.AST) -> Iterator[str]:
 
 
 def _is_sink(func: ast.AST) -> str | None:
-    """'print'/'logging.info'/... when the call is an output sink."""
+    """'print'/'logging.info'/metric-recording calls — anywhere data
+    leaves the process as human-readable output."""
     if isinstance(func, ast.Name) and func.id in _PRINT_FUNCS:
         return func.id
     if isinstance(func, ast.Attribute) \
@@ -53,6 +61,15 @@ def _is_sink(func: ast.AST) -> str | None:
             and isinstance(func.value, ast.Name) \
             and ("log" in func.value.id.lower()):
         return f"{func.value.id}.{func.attr}"
+    if isinstance(func, ast.Attribute) and func.attr in _METRIC_METHODS:
+        # Receiver-agnostic on purpose: serve code holds instruments
+        # under arbitrary names (self._c_shed and friends).  Only fires
+        # when an ARGUMENT references a key-material name, so ordinary
+        # set.add(x)/gauge.set(n) calls never trip it.
+        recv = func.value
+        recv_name = (recv.attr if isinstance(recv, ast.Attribute)
+                     else recv.id if isinstance(recv, ast.Name) else "?")
+        return f"{recv_name}.{func.attr}"
     return None
 
 

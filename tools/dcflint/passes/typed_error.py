@@ -34,6 +34,8 @@ DCF_ERRORS = frozenset({
     "BackendUnavailableError",
     "StaleStateError",
     "NativeBuildError",
+    "QueueFullError",
+    "DeadlineExceededError",
 })
 _ALWAYS_OK = DCF_ERRORS | {"NotImplementedError"}
 _MARKED_OK = frozenset({"ValueError", "TypeError"})
