@@ -1,0 +1,97 @@
+"""Measure and pin the mic_bench single-core numpy-oracle denominator.
+
+``mic_bench``'s ``vs_baseline`` compares served MIC points/s against
+"what would the obviously-correct host implementation serve": the
+single-core numpy protocol oracle (``protocols.oracle.mic_oracle``)
+computing all m interval rows per point.  Same pinning discipline as
+``cpu_baseline.py`` (CPU_BASELINE.md): fixed workload, warmup passes,
+>= 40 timed samples, median pinned with the p10-p90 band and host state
+recorded alongside, committed once — the denominator must not move
+between bench runs.
+
+Fixed workload: the mic_bench default shape — m=8 disjoint intervals on
+the N=16-byte flagship domain, lam=16, a fixed 2048-point batch —
+drawn from the same seed the bench uses, party-agnostic (the oracle
+computes the reconstruction directly).
+
+Writes the ``"protocols": {"mic_m8": ...}`` entry into
+``benchmarks/cpu_baseline.json`` (other fields untouched) and prints
+the record.
+
+Usage: python benchmarks/protocols_baseline.py [--samples N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+M_POINTS = 2048
+M_INTERVALS = 8
+LAM = 16
+N_BYTES = 16
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--samples", type=int, default=40)
+    args = ap.parse_args()
+
+    from benchmarks.cpu_baseline import host_state
+    from dcf_tpu.protocols.oracle import mic_oracle
+
+    rng = np.random.default_rng(2026)
+    bounds = sorted(
+        int.from_bytes(
+            rng.integers(0, 256, N_BYTES, dtype=np.uint8).tobytes(), "big")
+        for _ in range(2 * M_INTERVALS))
+    intervals = [(bounds[2 * i], bounds[2 * i + 1])
+                 for i in range(M_INTERVALS)]
+    betas = rng.integers(0, 256, (M_INTERVALS, LAM), dtype=np.uint8)
+    xs = rng.integers(0, 256, (M_POINTS, N_BYTES), dtype=np.uint8)
+
+    for _ in range(4):  # warmup (turbo burst / cache warm)
+        mic_oracle(xs, intervals, betas)
+    rates = []
+    for _ in range(max(args.samples, 8)):
+        t0 = time.perf_counter()
+        mic_oracle(xs, intervals, betas)
+        rates.append(M_POINTS / (time.perf_counter() - t0))
+    rates = np.array(rates)
+    entry = {
+        "points_per_sec": round(float(np.median(rates)), 1),
+        "band_points_per_sec": [
+            round(float(np.percentile(rates, 10)), 1),
+            round(float(np.percentile(rates, 90)), 1)],
+        "band": "p10-p90 of per-sample rates",
+        "samples": len(rates),
+        "batch_points": M_POINTS,
+        "m": M_INTERVALS,
+        "workload": (f"numpy mic_oracle, m={M_INTERVALS} disjoint "
+                     f"intervals, N={N_BYTES}B domain, lam={LAM}, "
+                     "single core, reconstruction (not one party)"),
+        "date": datetime.date.today().isoformat(),
+        **host_state(),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cpu_baseline.json")
+    with open(path) as f:
+        pinned = json.load(f)
+    pinned.setdefault("protocols", {})[f"mic_m{M_INTERVALS}"] = entry
+    with open(path, "w") as f:
+        json.dump(pinned, f, indent=1)
+        f.write("\n")
+    print(json.dumps(entry, indent=1))
+
+
+if __name__ == "__main__":
+    main()

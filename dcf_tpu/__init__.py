@@ -13,6 +13,11 @@ AES-256 Hirose PRG, key serialization), redesigned for TPU:
 - ``dcf_tpu.serve`` — the online evaluation service (micro-batching,
   device-resident key cache, admission control, metrics); entry point
   ``Dcf.serve(...)``, README "Serving" section.
+- ``dcf_tpu.protocols`` — the mixed-mode protocol layer the paper
+  builds DCF for: interval containment, MIC and piecewise-constant
+  evaluation over K-packed batched DCF keys; entry points
+  ``Dcf.interval``/``Dcf.mic``/``Dcf.piecewise``, README "Protocols"
+  section.
 """
 
 from dcf_tpu.api import Dcf, reset_backend_health  # noqa: F401

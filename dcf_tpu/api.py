@@ -155,6 +155,21 @@ knobs are ``max_batch`` (throughput / compiled-shape universe),
 working set), ``max_queued_points`` (shed point) and ``retries``
 (fail-over persistence); full semantics in ``dcf_tpu/serve/service.py``
 and the README "Serving" section.
+
+Mixed-mode protocols (``dcf_tpu.protocols``)
+--------------------------------------------
+
+DCF is the building block of mixed-mode 2PC (the source paper's actual
+point): ``Dcf.interval`` / ``Dcf.mic`` / ``Dcf.piecewise`` generate
+interval-containment, multiple-interval-containment and
+piecewise-constant keys — the 2m interval-bound DCF keys of an
+m-interval MIC packed on the K axis, the batched walk kernels' best
+shape — and ``Dcf.eval_interval`` / ``eval_mic`` / ``eval_piecewise``
+evaluate them on any facade backend (meshes included).  Protocol
+bundles register directly into ``Dcf.serve(...)`` services, which apply
+the share combine server-side under the same admission/deadline/retry
+semantics.  XOR-group derivation, wraparound handling and the DCFK v3
+wire format: README "Protocols" section.
 """
 
 from __future__ import annotations
@@ -677,6 +692,106 @@ class Dcf:
         if config is None:
             config = ServeConfig(**knobs)
         return DcfService(self, config)
+
+    # -- protocols (dcf_tpu.protocols: IC / MIC / piecewise) ----------------
+
+    def _protocol_gen(self, rng):
+        from dcf_tpu.spec import Bound as _B
+
+        def gen_fn(alphas, betas, bound: _B):
+            return self.gen(alphas, betas, bound=bound, rng=rng)
+
+        return gen_fn
+
+    def interval(self, p: int, q: int, beta: np.ndarray,
+                 bound: Bound = Bound.LT_BETA,
+                 rng: np.random.Generator | None = None):
+        """Keys for interval containment ``1_{p <= x < q} * beta``.
+
+        ``p``/``q``: ints in ``[0, 2^n_bits]`` (``q = 2^n_bits`` makes
+        ``[p, N)`` expressible); ``p > q`` is the wraparound interval
+        ``[p, N) ∪ [0, q)`` and ``p == q`` is empty.  ``beta``: uint8
+        [lam].  Returns a two-party ``protocols.ProtocolBundle`` packing
+        the two bound keys on the K axis — ship ``pb.for_party(b)`` and
+        evaluate with :meth:`eval_interval`; XOR both parties' outputs
+        to reconstruct.  Wraparound/full-domain intervals work via the
+        public combine-mask correction (README "Protocols" derivation).
+        ``bound`` picks which DCF bound family realizes the keys
+        (LT_BETA default; GT_BETA uses the ``1_{x >= b}`` decomposition
+        — same reconstruction either way).
+        """
+        from dcf_tpu.protocols import gen_interval_bundle
+
+        beta = np.asarray(beta, dtype=np.uint8).reshape(1, -1)
+        return gen_interval_bundle(
+            self._protocol_gen(rng), [(p, q)], beta, self.n_bytes, bound)
+
+    def mic(self, intervals, betas: np.ndarray,
+            bound: Bound = Bound.LT_BETA,
+            rng: np.random.Generator | None = None):
+        """Keys for multiple interval containment over ``m`` intervals.
+
+        ``intervals``: sequence of ``(p, q)`` int pairs (same convention
+        as :meth:`interval`; the paper's MIC wants them disjoint, but
+        each output row is independent so overlap is merely redundant);
+        ``betas``: uint8 [m, lam].  The 2m interval-bound DCF keys pack
+        into ONE K-axis bundle — exactly the K-key batched-walk shape
+        the flagship kernels are fastest at — evaluated with
+        :meth:`eval_mic` (facade path) or ``protocols.MicEvaluator``
+        (staged, on-device combine), and servable online by registering
+        the returned bundle in ``Dcf.serve(...)`` under a key id.
+        Reconstruction: XOR both parties' [m, M, lam] outputs.
+        """
+        from dcf_tpu.protocols import gen_interval_bundle
+
+        return gen_interval_bundle(
+            self._protocol_gen(rng), intervals,
+            np.asarray(betas, dtype=np.uint8), self.n_bytes, bound)
+
+    def piecewise(self, cuts, values: np.ndarray,
+                  rng: np.random.Generator | None = None):
+        """Keys for a piecewise-constant function (spline lookup table).
+
+        ``cuts``: strictly increasing breakpoints in ``[0, 2^n_bits)``
+        (the last piece wraps around the domain top — with
+        ``cuts[0] == 0`` that is the standard table over [0, N));
+        ``values``: uint8 [m, lam], piece i's output.  Builds the MIC
+        over the induced partition; evaluate with
+        :meth:`eval_piecewise`, which XOR-reduces the per-piece rows to
+        one [M, lam] share per party (exact because the pieces
+        partition the domain and the output group is XOR).
+        """
+        from dcf_tpu.protocols import gen_interval_bundle
+        from dcf_tpu.protocols.piecewise import partition_intervals
+
+        intervals = partition_intervals(list(cuts), 8 * self.n_bytes)
+        return gen_interval_bundle(
+            self._protocol_gen(rng), intervals,
+            np.asarray(values, dtype=np.uint8), self.n_bytes,
+            Bound.LT_BETA)
+
+    def eval_interval(self, b: int, pb, xs: np.ndarray) -> np.ndarray:
+        """Party ``b``'s IC share uint8 [M, lam] (see :meth:`interval`)."""
+        from dcf_tpu.protocols import eval_interval
+
+        return eval_interval(self, b, pb, np.asarray(xs, dtype=np.uint8))
+
+    def eval_mic(self, b: int, pb, xs: np.ndarray) -> np.ndarray:
+        """Party ``b``'s per-interval MIC shares uint8 [m, M, lam]
+        (see :meth:`mic`).  Runs on whatever backend this facade
+        selected — the 2m keys evaluate as one K-packed batch and the
+        pair-combine + public-correction mask apply locally
+        (``protocols.combine``, fault seam ``protocols.combine``)."""
+        from dcf_tpu.protocols import eval_mic
+
+        return eval_mic(self, b, pb, np.asarray(xs, dtype=np.uint8))
+
+    def eval_piecewise(self, b: int, pb, xs: np.ndarray) -> np.ndarray:
+        """Party ``b``'s piecewise-lookup share uint8 [M, lam]
+        (see :meth:`piecewise`)."""
+        from dcf_tpu.protocols import eval_piecewise
+
+        return eval_piecewise(self, b, pb, np.asarray(xs, dtype=np.uint8))
 
     # -- eval (reference eval, src/lib.rs:163-204) --------------------------
 

@@ -23,6 +23,13 @@ records the served closed-loop throughput next to the equivalent
 staged-path batch rate (same backend, same ``--max-batch`` shape) with
 the full metrics snapshot (queue depth, batch occupancy, latencies).
 
+plus ``mic_bench`` — the protocol layer (``dcf_tpu.protocols``, ISSUE
+5): an m-interval MIC bundle (2m K-packed DCF keys) served closed-loop
+with the share combine applied server-side; the ``RESULTS_protocols``
+JSONL line records served points/s, the staged ``MicEvaluator``
+equivalent, and ``vs_baseline`` against the pinned single-core
+numpy-oracle denominator (CPU_BASELINE.md).
+
 Usage::
 
     python -m dcf_tpu.cli dcf_batch_eval --backend=pallas --points=1048576
@@ -300,6 +307,23 @@ def _timed(fn, reps: int, profile: str = ""):
     return med, mad, samples
 
 
+def _load_pinned(baseline_path: str | None = None) -> dict | None:
+    """Resolve + load benchmarks/cpu_baseline.json (the ONE loader both
+    pinned-ratio helpers share); None when the file is absent or
+    corrupt.  ValueError covers json.JSONDecodeError: a corrupt baseline
+    file must make the caller omit vs_baseline, not abort the bench
+    run."""
+    import os
+
+    path = baseline_path or os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks", "cpu_baseline.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def _pinned_ratio(nb: int, k: int, rate: float,
                   interpreted: bool = False,
                   baseline_path: str | None = None,
@@ -313,18 +337,10 @@ def _pinned_ratio(nb: int, k: int, rate: float,
     a real CPU pin is meaningless noise (host backends and compiled
     device runs keep theirs).  ``baseline_path`` overrides the artifact
     location (tests feed corrupt/absent files through it)."""
-    import os
-
     if k != 1 or interpreted:
         return {}
-    path = baseline_path or os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "benchmarks", "cpu_baseline.json")
-    try:
-        with open(path) as f:
-            pinned = json.load(f)
-    except (OSError, ValueError):
-        # ValueError covers json.JSONDecodeError: a corrupt baseline file
-        # omits vs_baseline instead of aborting the whole bench run.
+    pinned = _load_pinned(baseline_path)
+    if pinned is None:
         return {}
     if lam != 16:
         tag = {128: "lam128", 256: "lam256", 16384: "lam16384"}.get(lam, "")
@@ -929,6 +945,155 @@ def bench_serve(args) -> None:
           res.throughput, unit, extra_fields=extra)
 
 
+def _protocols_pinned_ratio(m_int: int, rate: float,
+                            baseline_path: str | None = None) -> dict:
+    """vs_baseline for mic_bench: the pinned SINGLE-CORE NUMPY-ORACLE
+    denominator (``benchmarks/cpu_baseline.json`` key
+    ``protocols.mic_m{m}``, CPU_BASELINE.md protocol) — the honest
+    "what would the obviously-correct host implementation serve"
+    anchor, in served points/s at the same interval count.  Empty when
+    no pin exists for this m (no silent in-run fallback).  The ratio is
+    kept for XLA-CPU serving runs (both sides are CPU) with the
+    platform disclosed in-line on the same JSONL line."""
+    pinned = _load_pinned(baseline_path)
+    if pinned is None:
+        return {}
+    entry = pinned.get("protocols", {}).get(f"mic_m{m_int}")
+    if not entry:
+        return {}
+    return {"vs_baseline": round(rate / entry["points_per_sec"], 2),
+            "baseline": f"pinned single-core numpy-oracle mic_m{m_int} "
+                        f"({entry['points_per_sec']:,.0f} points/s, "
+                        "CPU_BASELINE.md protocol)"}
+
+
+def bench_mic(args) -> None:
+    """Closed-loop MIC serving bench (ISSUE 5): m intervals x M points.
+
+    Registers one m-interval MIC protocol bundle
+    (``Dcf.mic`` — 2m interval-bound DCF keys K-packed into one
+    bundle) in a ``DcfService`` and drives it with the same closed-loop
+    generator as ``serve_bench``; the service applies the per-interval
+    share combine server-side.  Parity is gated before timing: both
+    parties served for a sample batch, XOR reconstruction vs the numpy
+    protocol oracle (``protocols.oracle.mic_oracle``).  The JSONL line
+    records served points/s (each served point yields all m interval
+    rows), the staged ``MicEvaluator`` equivalent, and ``vs_baseline``
+    against the pinned single-core numpy-oracle denominator.
+    """
+    from dcf_tpu import Dcf
+    from dcf_tpu.protocols import MicEvaluator
+    from dcf_tpu.protocols.oracle import mic_oracle
+    from dcf_tpu.serve.loadgen import closed_loop
+
+    lam, nb = 16, 16
+    if args.backend not in ("numpy", "jax", "bitsliced", "pallas",
+                            "prefix"):
+        raise SystemExit(
+            f"mic_bench serves lam=16 single-device facade backends "
+            f"(numpy/jax/bitsliced/pallas/prefix), got {args.backend!r}")
+    m_int = args.intervals or 8
+    max_batch = args.max_batch or (1 << 14)
+    rng = np.random.default_rng(args.seed)
+    ck = _cipher_keys(lam, rng)
+    dcf = Dcf(nb, lam, ck, backend=args.backend)
+
+    # m disjoint intervals: 2m sorted distinct bounds paired up (the
+    # 128-bit domain makes collisions vanishingly unlikely; fail loudly
+    # on a duplicate — an empty interval would silently skew the
+    # workload, and the guard must survive `python -O`).
+    bounds = sorted(
+        int.from_bytes(rng.integers(0, 256, nb, dtype=np.uint8).tobytes(),
+                       "big")
+        for _ in range(2 * m_int))
+    if len(set(bounds)) != 2 * m_int:
+        raise SystemExit(
+            "mic_bench drew duplicate interval bounds; rerun with a "
+            "different --seed")
+    intervals = [(bounds[2 * i], bounds[2 * i + 1]) for i in range(m_int)]
+    betas = rng.integers(0, 256, (m_int, lam), dtype=np.uint8)
+    log(f"gen MIC bundle: {m_int} intervals -> {2 * m_int} K-packed keys")
+    pb = dcf.mic(intervals, betas, rng=rng)
+
+    svc = dcf.serve(max_batch=max_batch, max_delay_ms=args.max_delay_ms,
+                    device_bytes_budget=args.device_bytes_budget)
+    svc.register_key("mic-0", pb)
+
+    # Parity gate: both parties through the SERVICE, vs the oracle.
+    xs_check = rng.integers(0, 256, (256, nb), dtype=np.uint8)
+    f0 = svc.submit("mic-0", xs_check, b=0)
+    f1 = svc.submit("mic-0", xs_check, b=1)
+    svc.pump()
+    want = mic_oracle(xs_check, intervals, betas)
+    if not np.array_equal(f0.result() ^ f1.result(), want):
+        raise SystemExit("mic_bench parity mismatch vs the numpy oracle")
+    log(f"parity vs numpy oracle: OK ({m_int} intervals x 256 pts, "
+        "two-party, served)")
+
+    min_req = args.min_req_points or (max_batch * 3 // 8)
+    max_req = args.max_req_points or (max_batch // 2)
+    if not 1 <= min_req <= max_req:
+        raise SystemExit(f"bad request-size range [{min_req}, {max_req}]")
+
+    # Warm the padded-batch compile ladder (same rule as serve_bench).
+    from dcf_tpu.serve.batcher import next_pow2
+
+    xs_warm = rng.integers(0, 256, (max_batch, nb), dtype=np.uint8)
+    mm = next_pow2(min_req)
+    while mm <= max_batch:
+        log(f"warming batch shape {mm} ...")
+        svc.submit("mic-0", xs_warm[:mm])
+        svc.pump()
+        mm *= 2
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    interp = (platform != "tpu"
+              or bool(getattr(dcf.eval_backend(0), "interpret", False)))
+    with svc:
+        res = closed_loop(
+            svc, ["mic-0"], duration_s=float(args.duration),
+            concurrency=args.concurrency,
+            min_points=min_req, max_points=max_req, seed=args.seed)
+    snap = svc.metrics_snapshot()
+
+    # Staged equivalent: the MicEvaluator path (stage + eval_staged +
+    # on-device pair-combine + conversion) on one max_batch batch.
+    ev = MicEvaluator(dcf, pb, 0)
+    ev.eval(xs_warm)  # warm the EXACT timed shape (same rule as
+    # serve_bench: a first-sample compile would skew the staged rate)
+    dt, mad, ss = _timed(lambda: ev.eval(xs_warm), args.reps)
+    staged_rate = max_batch / dt
+    log(f"staged MicEvaluator rate at {max_batch} pts: "
+        f"{staged_rate:,.1f} points/s (median {dt * 1e3:.1f} ms +- "
+        f"{mad * 1e3:.1f} ms, {len(ss)} samples)")
+
+    extra = {
+        "duration_s": round(res.duration_s, 3),
+        "concurrency": args.concurrency,
+        "intervals": m_int,
+        "max_batch": max_batch,
+        "req_points": [min_req, max_req],
+        "requests_ok": res.requests_ok,
+        "requests_shed": res.requests_shed,
+        "requests_failed": res.requests_failed,
+        **res.latency_quantiles(),
+        "platform": platform,
+        "interpreted": interp,
+        "staged_mic_points_per_sec": round(staged_rate, 1),
+        "serve_vs_staged": round(res.throughput / staged_rate, 3),
+        "metrics_snapshot": snap,
+        **_protocols_pinned_ratio(m_int, res.throughput),
+    }
+    unit = (f"points/s (closed-loop served MIC, m={m_int}, party 0; "
+            "each point yields all m interval rows)")
+    if interp:
+        unit += " [no TPU this session: interpret/CPU mode, disclosed]"
+    _emit("mic_bench", args.backend, "points_per_sec",
+          res.throughput, unit, extra_fields=extra)
+
+
 def bench_baseline(args) -> None:
     """All five BASELINE.json configs in one run, one JSON line per
     bench invocation (8 lines total: config 1 emits gen + 1-pt eval, and
@@ -996,6 +1161,7 @@ BENCHES = {
     "secure_relu": bench_secure_relu,
     "full_domain": bench_full_domain,
     "serve_bench": bench_serve,
+    "mic_bench": bench_mic,
 }
 
 
@@ -1081,11 +1247,14 @@ def main(argv=None) -> None:
                    help="serve_bench: LRU device-residency budget "
                         "(0 = uncapped)")
     p.add_argument("--min-req-points", type=int, default=0,
-                   help="serve_bench: request-size range lower bound "
-                        "(0 = 3/8 of --max-batch)")
+                   help="serve_bench/mic_bench: request-size range lower "
+                        "bound (0 = 3/8 of --max-batch)")
     p.add_argument("--max-req-points", type=int, default=0,
-                   help="serve_bench: request-size range upper bound "
-                        "(0 = half of --max-batch)")
+                   help="serve_bench/mic_bench: request-size range upper "
+                        "bound (0 = half of --max-batch)")
+    p.add_argument("--intervals", type=int, default=0,
+                   help="mic_bench: MIC interval count m (0 = 8; the "
+                        "bundle K-packs 2m DCF keys)")
     p.add_argument("--full", action="store_true",
                    help="baseline: run config 5 at the literal 10^6-key "
                         "scale (~20 min report)")
@@ -1109,8 +1278,8 @@ def main(argv=None) -> None:
         bench_baseline(args)
         return
     for name in BENCHES if args.bench == "all" else [args.bench]:
-        if args.bench == "all" and name == "serve_bench":
-            log("skipping serve_bench (a timed load test, not a "
+        if args.bench == "all" and name in ("serve_bench", "mic_bench"):
+            log(f"skipping {name} (a timed load test, not a "
                 "criterion analog; run it explicitly)")
             continue
         if args.bench == "all" and name == "dcf_large_lambda" and \

@@ -37,7 +37,11 @@ reinterpreted, without any re-serialization):
 
 No padding or alignment between sections.  Version 2 (current writer)
 appends the CRC32 integrity trailer; version-1 frames (no trailer) are
-still read for compatibility.  Decoding is strict either way: the header
+still read for compatibility.  Version 3 (``dcf_tpu.protocols``) adds a
+uint16 ``proto`` field after ``lam``: proto=0 frames decode here
+unchanged, proto!=0 frames carry a trailing protocol section (interval
+combine masks) and are refused with a pointer at
+``protocols.ProtocolBundle.from_bytes``.  Decoding is strict either way: the header
 is bounds-checked field by field, every section must fit, the total size
 must match exactly, and any violation raises
 ``errors.KeyFormatError`` naming the offending field — a two-party FSS
@@ -63,6 +67,64 @@ _VERSION = 2
 _HEADER = "<HHIIH"  # version, P, K, n, lam (after the 4-byte magic)
 _HEADER_SIZE = 4 + struct.calcsize(_HEADER)
 _CRC_SIZE = 4
+# Version 3 (dcf_tpu.protocols): the v2 header plus a uint16 ``proto``
+# field.  proto=0 frames are plain bundles and decode here; proto!=0
+# frames carry a protocol section (combine masks) and belong to
+# ``protocols.ProtocolBundle.from_bytes`` — this reader refuses them
+# rather than silently dropping the masks.
+_VERSION_PROTO = 3
+_HEADER3 = "<HHIIHH"  # version, P, K, n, lam, proto
+_HEADER3_SIZE = 4 + struct.calcsize(_HEADER3)
+
+
+def _decode_sections(data: bytes, sections, header_size: int,
+                     crc_size: int, claims: str) -> dict[str, np.ndarray]:
+    """The strict section-decode discipline shared by every DCFK reader
+    (``KeyBundle.from_bytes`` and ``protocols.ProtocolBundle.from_bytes``
+    — ONE copy, so a hardening fix lands in both).
+
+    ``sections``: ordered ``(name, shape)`` uint8 section table.
+    ``claims``: the header's geometry fields rendered for error messages.
+    Bounds-checks every section against the frame BEFORE touching the
+    payload (so a truncated frame names the field where it ran out
+    instead of surfacing a numpy buffer error — or worse, reading the
+    CRC trailer as key material), requires the total size to match
+    exactly, verifies the CRC32 trailer when ``crc_size`` is nonzero,
+    then returns the decoded arrays by name.
+    """
+    payload_end = len(data) - crc_size
+    off = header_size
+    for name, shape in sections:
+        size = math.prod(shape)  # python ints: immune to header-claimed
+        if off + size > payload_end:  # sizes overflowing fixed-width math
+            raise KeyFormatError(
+                f"truncated frame: section {name!r} needs bytes "
+                f"[{off}, {off + size}) but the payload ends at "
+                f"{payload_end} (header claims {claims})")
+        off += size
+    if off != payload_end:
+        raise KeyFormatError(
+            f"oversized frame: {payload_end - off} trailing bytes after "
+            f"section {sections[-1][0]!r} (corrupt header or concatenated "
+            "frames)")
+    if crc_size:
+        (crc_stored,) = struct.unpack_from("<I", data, payload_end)
+        # memoryview: hash in place — a bytes slice would transiently
+        # double the footprint of a multi-GB key image.
+        crc_actual = zlib.crc32(memoryview(data)[:payload_end])
+        if crc_stored != crc_actual:
+            raise KeyFormatError(
+                f"crc32 mismatch: trailer records {crc_stored:#010x}, "
+                f"frame hashes to {crc_actual:#010x} — key material is "
+                "corrupt")
+    off = header_size
+    arrays: dict[str, np.ndarray] = {}
+    for name, shape in sections:
+        size = math.prod(shape)
+        arr = np.frombuffer(data, dtype=np.uint8, count=size, offset=off)
+        arrays[name] = arr.reshape(shape).copy()
+        off += size
+    return arrays
 
 
 @dataclass(frozen=True)
@@ -240,10 +302,25 @@ class KeyBundle:
                 f"truncated header: frame is {len(data)} bytes, the DCFK "
                 f"header needs {_HEADER_SIZE}")
         version, p, k, n, lam = struct.unpack_from(_HEADER, data, 4)
-        if version not in (1, _VERSION):
+        header_size = _HEADER_SIZE
+        if version == _VERSION_PROTO:
+            if len(data) < _HEADER3_SIZE:
+                raise KeyFormatError(
+                    f"truncated header: frame is {len(data)} bytes, the "
+                    f"DCFK v3 header needs {_HEADER3_SIZE}")
+            version, p, k, n, lam, proto = struct.unpack_from(
+                _HEADER3, data, 4)
+            header_size = _HEADER3_SIZE
+            if proto != 0:
+                raise KeyFormatError(
+                    f"frame carries protocol section {proto} (interval "
+                    "combine masks); decode with dcf_tpu.protocols."
+                    "ProtocolBundle.from_bytes — reading it as a plain "
+                    "bundle would silently drop the public correction")
+        elif version not in (1, _VERSION):
             raise KeyFormatError(
                 f"unsupported version {version} (this reader handles "
-                f"1..{_VERSION})")
+                f"1..{_VERSION_PROTO})")
         if p not in (1, 2):
             raise KeyFormatError(f"parties field must be 1 or 2, got {p}")
         if n == 0 or n % 8:
@@ -258,46 +335,11 @@ class KeyBundle:
             ("cw_t", (k, n, 2)),
             ("cw_np1", (k, lam)),
         )
-        crc_size = _CRC_SIZE if version >= 2 else 0
-        payload_end = len(data) - crc_size
-        # Bounds-check every section against the frame BEFORE touching the
-        # payload, so a truncated frame names the field where it ran out
-        # instead of surfacing a numpy buffer error (or worse, reading the
-        # CRC trailer as key material).
-        off = _HEADER_SIZE
-        for name, shape in sections:
-            size = math.prod(shape)  # python ints: immune to header-claimed
-            if off + size > payload_end:  # sizes overflowing fixed-width math
-                raise KeyFormatError(
-                    f"truncated frame: section {name!r} needs bytes "
-                    f"[{off}, {off + size}) but the payload ends at "
-                    f"{payload_end} (header claims K={k}, P={p}, n={n}, "
-                    f"lam={lam})")
-            off += size
-        if off != payload_end:
-            raise KeyFormatError(
-                f"oversized frame: {payload_end - off} trailing bytes after "
-                "section 'cw_np1' (corrupt header or concatenated frames)")
-        if crc_size:
-            (crc_stored,) = struct.unpack_from("<I", data, payload_end)
-            # memoryview: hash in place — a bytes slice would transiently
-            # double the footprint of a multi-GB key image.
-            crc_actual = zlib.crc32(memoryview(data)[:payload_end])
-            if crc_stored != crc_actual:
-                raise KeyFormatError(
-                    f"crc32 mismatch: trailer records {crc_stored:#010x}, "
-                    f"frame hashes to {crc_actual:#010x} — key material is "
-                    "corrupt")
-        off = _HEADER_SIZE
-
-        def take(shape):
-            nonlocal off
-            size = math.prod(shape)
-            arr = np.frombuffer(data, dtype=np.uint8, count=size, offset=off)
-            off += size
-            return arr.reshape(shape).copy()
-
-        return cls(*(take(shape) for _, shape in sections))
+        arrays = _decode_sections(
+            data, sections, header_size,
+            _CRC_SIZE if version >= 2 else 0,
+            f"K={k}, P={p}, n={n}, lam={lam}")
+        return cls(*(arrays[name] for name, _ in sections))
 
     def save(self, path: str) -> None:
         if path.endswith(".npz"):

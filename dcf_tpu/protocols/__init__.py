@@ -1,0 +1,71 @@
+"""dcf_tpu.protocols — mixed-mode secure-computation protocols over DCF.
+
+The source paper (Boyle et al., EUROCRYPT 2021) presents DCF as the
+building block for mixed-mode 2PC: interval containment (IC), multiple
+interval containment (MIC) and piecewise/spline function evaluation.
+This package is that layer for this framework, in the repo's XOR output
+group:
+
+- ``protocols.oracle``    numpy golden models (IC / MIC / piecewise),
+  the bit-exact reference every evaluator is tested against;
+- ``protocols.keygen``    protocol-level key generation: the 2m
+  interval-bound DCF keys of an m-interval MIC packed into ONE
+  ``KeyBundle`` on the K axis (the exact shape the batched walk kernels
+  are fastest at), wrapped in a ``ProtocolBundle`` with the per-interval
+  combine masks; DCFK v3 wire format (version-gated, v1/v2 still read);
+- ``protocols.combine``   the share-combine algebra: pairwise XOR of
+  per-bound shares (on device for the staged plane layouts), the
+  ``protocols.combine`` fault seam, and the streamed two-party
+  reconstruction helper the workloads layer rides on;
+- ``protocols.ic``        single-interval containment evaluation;
+- ``protocols.mic``       batched MIC evaluation: the facade path (any
+  backend the facade can select, meshes included) and the staged
+  ``MicEvaluator`` (put_bundle/stage/eval_staged once, combine on
+  device);
+- ``protocols.piecewise`` piecewise-constant lookup as a MIC over a
+  domain partition, XOR-reduced to one value per point.
+
+Entry points: ``Dcf.interval`` / ``Dcf.mic`` / ``Dcf.piecewise`` (key
+generation) and ``Dcf.eval_interval`` / ``Dcf.eval_mic`` /
+``Dcf.eval_piecewise`` (per-party evaluation); protocol bundles register
+directly into the serving layer (``DcfService.register_key``), which
+applies the combine server-side with the same retry semantics as plain
+DCF batches.  Derivation and wire format: README "Protocols" section.
+"""
+
+from dcf_tpu.protocols.combine import (  # noqa: F401
+    combine_pair_shares,
+    xor_reconstruct_stream,
+)
+from dcf_tpu.protocols.ic import eval_interval  # noqa: F401
+from dcf_tpu.protocols.keygen import (  # noqa: F401
+    ProtocolBundle,
+    gen_interval_bundle,
+    interval_bound_alphas,
+)
+from dcf_tpu.protocols.mic import MicEvaluator, eval_mic  # noqa: F401
+from dcf_tpu.protocols.oracle import (  # noqa: F401
+    ic_oracle,
+    mic_oracle,
+    piecewise_oracle,
+)
+from dcf_tpu.protocols.piecewise import (  # noqa: F401
+    eval_piecewise,
+    partition_intervals,
+)
+
+__all__ = [
+    "ProtocolBundle",
+    "MicEvaluator",
+    "combine_pair_shares",
+    "eval_interval",
+    "eval_mic",
+    "eval_piecewise",
+    "gen_interval_bundle",
+    "ic_oracle",
+    "interval_bound_alphas",
+    "mic_oracle",
+    "partition_intervals",
+    "piecewise_oracle",
+    "xor_reconstruct_stream",
+]

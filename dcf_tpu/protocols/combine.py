@@ -1,0 +1,125 @@
+"""Share-combine algebra for the protocol layer.
+
+Every protocol in this package reduces to the same local step: party b
+evaluates the 2m K-packed bound keys, XORs adjacent key pairs
+(interval i = keys 2i ^ 2i+1) and XORs its per-interval combine mask.
+That step is pure XOR, so it runs unchanged on host uint8 bytes OR on
+device arrays — and for the staged plane layouts it runs BEFORE the
+planes->bytes conversion, halving the conversion volume (2m keys in, m
+intervals out).
+
+``fire("protocols.combine", m, points)`` is the fault seam: it sits at
+the exact spot where a combine-time failure (a bad mask shape, a dead
+device mid-XOR) would surface, so the serving layer's retry path and
+the evaluators' error contracts are deterministically testable
+(``dcf_tpu.testing.faults``).
+
+``xor_reconstruct_stream`` is the two-party XOR reconstruction loop
+streaming over the key axis — the protocol layer's generic "both
+parties, chunked K" primitive that ``workloads.secure_relu_eval`` is a
+thin client of.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dcf_tpu.errors import ShapeError
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.testing.faults import fire
+
+__all__ = [
+    "combine_pair_shares",
+    "staged_pair_combine",
+    "xor_reconstruct_stream",
+]
+
+
+def combine_pair_shares(y, masks_b: np.ndarray | None):
+    """Pairwise share combine: y [2m, M, lam] -> [m, M, lam].
+
+    ``y`` may be host uint8 (numpy) or a device array (jax) — XOR and
+    strided slicing mean the combine stays wherever the shares already
+    live.  ``masks_b``: this party's uint8 [m, lam] combine mask
+    (``ProtocolBundle.masks_for``), or None to skip the public
+    correction (an already-masked device path).
+    """
+    if y.ndim != 3 or y.shape[0] % 2:
+        raise ShapeError(
+            f"expected [2m, M, lam] bound-key shares, got {y.shape}")
+    fire("protocols.combine", y.shape[0] // 2, y.shape[1])
+    yc = y[0::2] ^ y[1::2]
+    if masks_b is not None:
+        if masks_b.shape != (yc.shape[0], yc.shape[2]):
+            raise ShapeError(
+                f"combine mask must be [{yc.shape[0]}, {yc.shape[2]}], "
+                f"got {masks_b.shape}")
+        yc = yc ^ masks_b[:, None, :]
+    return yc
+
+
+# Staged-plane key-axis table: which axis of ``eval_staged``'s output
+# carries K for each staged backend family.  Bit-major Pallas layouts
+# are [K, 128, W]; the byte-major bitsliced layout is [8*lam, K, W].
+# Matched over the backend's MRO (by class NAME, so this module never
+# imports the jax-heavy backend classes), so subclasses of a listed
+# family inherit its axis.  Backends matching nothing
+# (keys-packed-in-lanes, the hybrid's dict-valued staging, host paths)
+# fall back to the bytes-domain combine — correct everywhere, just
+# without the pre-conversion halving.
+_KEY_AXIS = {
+    "PallasBackend": 0,
+    "PrefixPallasBackend": 0,
+    "ShardedPallasBackend": 0,
+    "ShardedPrefixBackend": 0,
+    "BitslicedBackend": 1,
+}
+
+
+def staged_pair_combine(be, y_dev):
+    """Device-side pairwise combine of ``be.eval_staged`` output, or
+    ``None`` when ``be``'s staged layout is not in the key-axis table
+    (caller then combines after ``staged_to_bytes``).  The mask XOR is
+    NOT applied here — layouts differ; apply it via
+    ``combine_pair_shares(..., masks_b)`` on the converted bytes or
+    fold it on host."""
+    axis = next((_KEY_AXIS[c.__name__] for c in type(be).__mro__
+                 if c.__name__ in _KEY_AXIS), None)
+    if axis is None:
+        return None
+    fire("protocols.combine", y_dev.shape[axis] // 2, -1)
+    if axis == 0:
+        return y_dev[0::2] ^ y_dev[1::2]
+    return y_dev[:, 0::2] ^ y_dev[:, 1::2]
+
+
+def xor_reconstruct_stream(
+    backend0, backend1, bundle: KeyBundle, xs: np.ndarray,
+    key_chunk: int = 1 << 16,
+) -> np.ndarray:
+    """Two-party XOR reconstruction of K keys on M shared points,
+    streamed over the key axis: uint8 [K, M, lam].
+
+    ``backend0``/``backend1``: evaluators holding the two party roles
+    (``put_bundle`` via the ``bundle=`` kwarg + ``eval``).  Keys stream
+    through the device in ``key_chunk`` slices so the full key image
+    (10^6 keys in the secure-ReLU shape) never needs to be HBM-resident
+    at once.  This is the generic primitive under
+    ``workloads.secure_relu_eval`` and the protocol test harnesses.
+    """
+    k = bundle.num_keys
+    m, lam = xs.shape[0], bundle.lam
+    out = np.empty((k, m, lam), dtype=np.uint8)
+    for lo in range(0, k, key_chunk):
+        hi = min(k, lo + key_chunk)
+        sub = KeyBundle(
+            s0s=bundle.s0s[lo:hi],
+            cw_s=bundle.cw_s[lo:hi],
+            cw_v=bundle.cw_v[lo:hi],
+            cw_t=bundle.cw_t[lo:hi],
+            cw_np1=bundle.cw_np1[lo:hi],
+        )
+        y0 = backend0.eval(0, xs, bundle=sub.for_party(0))
+        y1 = backend1.eval(1, xs, bundle=sub.for_party(1))
+        out[lo:hi] = y0 ^ y1
+    return out

@@ -1,0 +1,305 @@
+"""Protocol-level key generation: interval bounds -> K-packed DCF keys.
+
+An m-interval MIC needs one DCF key per interval BOUND — 2m keys.  The
+structural observation this module is built on: those 2m keys are just
+a K=2m batched keygen (one ``gen_batch`` call, the same host/native/
+device pipelines as plain DCF), and the resulting ``KeyBundle`` is
+exactly the K-axis-packed image the batched walk kernels are fastest
+at.  Key ``2i`` carries interval i's LOWER bound, key ``2i+1`` its
+UPPER bound; both use ``betas[i]``.
+
+XOR-group derivation (differs from the paper's additive-group IC, which
+subtracts shares; here subtraction IS addition):
+
+    x < p  implies  x < q   (for p <= q), so
+    1_{p <= x < q} = 1_{x < q} XOR 1_{x < p}
+
+and each one-sided bound b in [0, N] decomposes over an LT-bound DCF as
+
+    1_{x < b} = DCF_{< b mod N}(x) XOR [b == N]
+
+(the b == N case keys alpha=0, whose DCF is identically 0, and the
+public bit supplies the constant 1).  A wraparound interval p > q
+(``[p, N) ∪ [0, q)``) is the COMPLEMENT of ``[q, p)``, adding one more
+public XOR of beta.  Folding the three public bits together:
+
+    1_{(p,q)}(x) = DCF_{<q%N} XOR DCF_{<p%N} XOR pub * 1,
+    pub = [p > q] ^ [p == N] ^ [q == N]
+
+For GT-bound keys the same algebra runs on 1_{x >= b} = GT_{(b-1) mod N}
+XOR [b == 0], giving pub = [p == 0] ^ [q == 0] ^ [p > q].
+
+The public correction ``pub * beta`` is applied at share-combine time as
+a per-interval mask carried by the bundle: party 0's mask is
+``pub * beta`` and party 1's is zero (the party-0 public-correction
+scheme; the wire format stores a mask PER PARTY, so a dealer who wants
+beta hidden from party 0 outside the interval can XOR-share the
+correction across both masks instead — the combine is symmetric).
+
+Wire format: DCFK version 3 — the v2 frame plus a ``proto`` header
+field and a trailing protocol section (bound byte + combine masks),
+version-gated: v1/v2 frames (and v3 frames with proto=0) still decode
+as plain ``KeyBundle``; ``KeyBundle.from_bytes`` on a proto!=0 frame
+refuses with a pointer here instead of silently dropping the masks.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+import numpy as np
+
+from dcf_tpu.errors import KeyFormatError, ShapeError
+from dcf_tpu.keys import (
+    _CRC_SIZE,
+    _HEADER3,
+    _HEADER3_SIZE,
+    _MAGIC,
+    _VERSION_PROTO,
+    KeyBundle,
+    _decode_sections,
+)
+from dcf_tpu.spec import Bound
+
+__all__ = [
+    "PROTO_MIC",
+    "ProtocolBundle",
+    "gen_interval_bundle",
+    "interval_bound_alphas",
+]
+
+#: proto header values.  0 is reserved for "plain DCF" (decoded by
+#: ``KeyBundle.from_bytes``); 1 is the interval-containment family (IC,
+#: MIC, piecewise — all the same key structure, m intervals, 2m keys).
+PROTO_MIC = 1
+
+_BOUND_CODE = {Bound.LT_BETA: 0, Bound.GT_BETA: 1}
+_BOUND_FROM = {v: k for k, v in _BOUND_CODE.items()}
+
+
+def interval_bound_alphas(
+    intervals: Sequence[tuple[int, int]], n_bytes: int,
+    bound: Bound = Bound.LT_BETA,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Intervals -> (alphas uint8 [2m, n_bytes], pub uint8 [m]).
+
+    ``alphas[2i]``/``alphas[2i+1]`` are the DCF comparison points for
+    interval i's lower/upper bound under ``bound``'s decomposition (see
+    the module docstring); ``pub[i]`` is the public correction bit.
+    Shared by the host keygen below and any device-keygen caller
+    (``backends.device_gen.DeviceKeyGen`` consumes these alphas as-is).
+    """
+    n_total = 1 << (8 * n_bytes)
+    m = len(intervals)
+    alphas = np.zeros((2 * m, n_bytes), dtype=np.uint8)
+    pub = np.zeros(m, dtype=np.uint8)
+    for i, (p, q) in enumerate(intervals):
+        if not (0 <= p <= n_total and 0 <= q <= n_total):
+            # api-edge: documented interval-bound contract (ints in
+            # [0, 2^n_bits]; N itself legal so [p, N) is expressible)
+            raise ValueError(
+                f"interval {i} bounds must lie in [0, {n_total}], "
+                f"got ({p}, {q})")
+        if bound is Bound.LT_BETA:
+            lo, hi = p % n_total, q % n_total
+            pub[i] = (p > q) ^ (p == n_total) ^ (q == n_total)
+        else:
+            lo, hi = (p - 1) % n_total, (q - 1) % n_total
+            pub[i] = (p == 0) ^ (q == 0) ^ (p > q)
+        alphas[2 * i] = np.frombuffer(
+            lo.to_bytes(n_bytes, "big"), dtype=np.uint8)
+        alphas[2 * i + 1] = np.frombuffer(
+            hi.to_bytes(n_bytes, "big"), dtype=np.uint8)
+    return alphas, pub
+
+
+@dataclass(frozen=True)
+class ProtocolBundle:
+    """An m-interval protocol key: 2m K-packed DCF keys + combine masks.
+
+    ``keys``: the inner ``KeyBundle`` (K = 2m; two-party out of gen,
+    party-restricted after ``for_party``).  ``combine_masks``: uint8
+    [P, m, lam] — party b XORs ``combine_masks[b]`` onto its combined
+    per-interval shares (``protocols.combine``); the default keygen puts
+    the whole public correction in party 0's mask.  ``bound``: which
+    DCF bound family the keys were generated under (the evaluators do
+    not need it — the decomposition already absorbed it into the alphas
+    and pub bits — but the wire format records it so a bundle is
+    self-describing).
+    """
+
+    keys: KeyBundle
+    combine_masks: np.ndarray  # uint8 [P, m, lam]
+    bound: Bound = Bound.LT_BETA
+
+    def __post_init__(self):
+        k = self.keys.num_keys
+        if k == 0 or k % 2:
+            raise ShapeError(
+                f"protocol bundles pack 2 DCF keys per interval; got "
+                f"K={k}")
+        p = self.keys.s0s.shape[1]
+        want = (p, k // 2, self.keys.lam)
+        if self.combine_masks.shape != want:
+            raise ShapeError(
+                f"combine_masks must be {want} (parties, intervals, "
+                f"lam), got {self.combine_masks.shape}")
+        if self.combine_masks.dtype != np.uint8:
+            raise ShapeError("combine_masks must be uint8")
+        if self.bound not in _BOUND_CODE:
+            raise ShapeError(f"unknown bound {self.bound!r}")
+
+    def __repr__(self) -> str:
+        """Redacted: geometry only — the inner keys AND the masks are
+        key material (a mask is ``pub*beta``: beta in the clear)."""
+        return (f"ProtocolBundle(m={self.num_intervals}, "
+                f"n_bits={self.keys.n_bits}, lam={self.lam}, "
+                f"parties={self.combine_masks.shape[0]}, "
+                f"bound={self.bound.value}, <key material redacted>)")
+
+    @property
+    def num_intervals(self) -> int:
+        return self.keys.num_keys // 2
+
+    @property
+    def lam(self) -> int:
+        return self.keys.lam
+
+    @property
+    def n_bytes(self) -> int:
+        return self.keys.n_bytes
+
+    def masks_for(self, b: int) -> np.ndarray:
+        """Party ``b``'s combine mask, uint8 [m, lam].  On a
+        party-restricted bundle the single stored mask is returned
+        (the restriction already chose the party)."""
+        if self.combine_masks.shape[0] == 1:
+            return self.combine_masks[0]
+        if b not in (0, 1):
+            # api-edge: documented party-index contract
+            raise ValueError(f"party must be 0 or 1, got {b}")
+        return self.combine_masks[b]
+
+    def for_party(self, b: int) -> "ProtocolBundle":
+        """Restrict to party ``b``: the inner keys AND the mask."""
+        return ProtocolBundle(
+            keys=self.keys.for_party(b),
+            combine_masks=self.combine_masks[b : b + 1].copy(),
+            bound=self.bound,
+        )
+
+    # -- codec (DCFK v3) ----------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        """DCFK v3 frame: v2's sections + proto field + protocol section
+        (bound byte, combine masks) + CRC32 trailer."""
+        k, p = self.keys.s0s.shape[0], self.keys.s0s.shape[1]
+        header = _MAGIC + struct.pack(
+            _HEADER3, _VERSION_PROTO, p, k, self.keys.n_bits, self.keys.lam,
+            PROTO_MIC)
+        body = b"".join([
+            header,
+            self.keys.s0s.tobytes(),
+            self.keys.cw_s.tobytes(),
+            self.keys.cw_v.tobytes(),
+            self.keys.cw_t.tobytes(),
+            self.keys.cw_np1.tobytes(),
+            bytes([_BOUND_CODE[self.bound]]),
+            self.combine_masks.tobytes(),
+        ])
+        return body + struct.pack("<I", zlib.crc32(body))
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ProtocolBundle":
+        """Strict bounds-checked decode of a v3 proto frame; the same
+        field-naming rejection discipline as ``KeyBundle.from_bytes``.
+        Plain frames (v1/v2, or v3 with proto=0) are refused with a
+        pointer at ``KeyBundle.from_bytes`` — a protocol evaluator fed
+        a maskless bundle would silently skip the public correction."""
+        if len(data) < 4 or data[:4] != _MAGIC:
+            raise KeyFormatError(
+                f"bad magic: expected {_MAGIC!r}, got {bytes(data[:4])!r} "
+                "(not a DCFK frame)")
+        if len(data) < _HEADER3_SIZE:
+            raise KeyFormatError(
+                f"truncated header: frame is {len(data)} bytes, the DCFK "
+                f"v3 header needs {_HEADER3_SIZE}")
+        version, p, k, n, lam, proto = struct.unpack_from(_HEADER3, data, 4)
+        if version != _VERSION_PROTO:
+            raise KeyFormatError(
+                f"version {version} frames carry no protocol section; "
+                "decode with KeyBundle.from_bytes")
+        if proto != PROTO_MIC:
+            raise KeyFormatError(
+                f"proto field {proto} is not the interval-containment "
+                f"family ({PROTO_MIC}); plain v3 frames (proto=0) decode "
+                "with KeyBundle.from_bytes")
+        if p not in (1, 2):
+            raise KeyFormatError(f"parties field must be 1 or 2, got {p}")
+        if n == 0 or n % 8:
+            raise KeyFormatError(
+                f"n field must be a positive multiple of 8 bits, got {n}")
+        if lam == 0:
+            raise KeyFormatError("lam field must be positive, got 0")
+        if k == 0 or k % 2:
+            raise KeyFormatError(
+                f"K field must be a positive even key count (2 per "
+                f"interval), got {k}")
+        m = k // 2
+        sections = (
+            ("s0s", (k, p, lam)),
+            ("cw_s", (k, n, lam)),
+            ("cw_v", (k, n, lam)),
+            ("cw_t", (k, n, 2)),
+            ("cw_np1", (k, lam)),
+            ("bound", (1,)),
+            ("combine_masks", (p, m, lam)),
+        )
+        arrays = _decode_sections(
+            data, sections, _HEADER3_SIZE, _CRC_SIZE,
+            f"K={k}, P={p}, n={n}, lam={lam}")
+        bound_code = int(arrays["bound"][0])
+        if bound_code not in _BOUND_FROM:
+            raise KeyFormatError(
+                f"bound field must be 0 (LT) or 1 (GT), got {bound_code}")
+        return cls(
+            keys=KeyBundle(
+                s0s=arrays["s0s"], cw_s=arrays["cw_s"],
+                cw_v=arrays["cw_v"], cw_t=arrays["cw_t"],
+                cw_np1=arrays["cw_np1"]),
+            combine_masks=arrays["combine_masks"],
+            bound=_BOUND_FROM[bound_code],
+        )
+
+
+def gen_interval_bundle(
+    gen_fn: Callable[[np.ndarray, np.ndarray, Bound], KeyBundle],
+    intervals: Sequence[tuple[int, int]],
+    betas: np.ndarray,
+    n_bytes: int,
+    bound: Bound = Bound.LT_BETA,
+) -> ProtocolBundle:
+    """Generate an m-interval protocol bundle through ``gen_fn``.
+
+    ``gen_fn(alphas, betas, bound) -> KeyBundle`` is any K-batched DCF
+    keygen — the facade's (native core when available, else
+    ``gen.gen_batch``; what ``Dcf.mic`` passes) or a device pipeline
+    built on ``backends.device_gen.DeviceKeyGen`` (feed it the alphas
+    from ``interval_bound_alphas`` and wrap its device bundle).  The 2m
+    bound keys land in ONE K-packed bundle: interval i's shares are
+    keys 2i (lower) and 2i+1 (upper), both carrying ``betas[i]``.
+    """
+    betas = np.asarray(betas, dtype=np.uint8)
+    m = len(intervals)
+    if m == 0:
+        raise ShapeError("need at least one interval")
+    if betas.ndim != 2 or betas.shape[0] != m:
+        raise ShapeError(f"betas must be [{m}, lam], got {betas.shape}")
+    alphas, pub = interval_bound_alphas(intervals, n_bytes, bound)
+    keys = gen_fn(alphas, np.repeat(betas, 2, axis=0), bound)
+    masks = np.zeros((2, m, betas.shape[1]), dtype=np.uint8)
+    masks[0] = betas * pub[:, None]  # party-0 public correction
+    return ProtocolBundle(keys=keys, combine_masks=masks, bound=bound)

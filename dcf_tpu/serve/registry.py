@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import threading
 
-from dcf_tpu.errors import ShapeError
+from dcf_tpu.errors import ShapeError, StaleStateError
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.serve.metrics import Metrics
 
@@ -59,17 +59,25 @@ def device_image_bytes(be) -> int:
 
 
 class _Entry:
-    """One registered key: host bundle + its live device residencies."""
+    """One registered key: host bundle + its live device residencies.
 
-    __slots__ = ("bundle", "generation", "residents")
+    ``protocol`` (PR 5): the ``protocols.ProtocolBundle`` this key was
+    registered as, or None for a plain DCF key.  The DEVICE image is
+    always the inner ``KeyBundle`` (the residency machinery is
+    protocol-agnostic); the protocol record tells the service to apply
+    the per-interval share combine when it fetches a batch."""
 
-    def __init__(self, bundle: KeyBundle, generation: int):
+    __slots__ = ("bundle", "generation", "residents", "protocol")
+
+    def __init__(self, bundle: KeyBundle, generation: int, protocol=None):
         self.bundle = bundle
         self.generation = generation
+        self.protocol = protocol
         self.residents: dict = {}  # slot (party int | "kl") -> _Resident
 
     def __repr__(self) -> str:  # never the bundle's bytes — shapes only
         return (f"_Entry(gen={self.generation}, "
+                f"proto={self.protocol is not None}, "
                 f"resident_slots={sorted(map(str, self.residents))})")
 
 
@@ -119,14 +127,18 @@ class KeyRegistry:
 
     # -- registration -------------------------------------------------------
 
-    def register(self, key_id: str, bundle: KeyBundle) -> None:
+    def register(self, key_id: str, bundle: KeyBundle,
+                 protocol=None) -> None:
         """Register (or replace) the bundle served under ``key_id``.
 
         The bundle must be the full two-party bundle: the service serves
         both parties, and the keylanes image is two-party by design.
         Replacing a live key evicts its residencies atomically (the
         staleness guard), so no later batch can pair old device state
-        with the new key.
+        with the new key.  ``protocol``: the ``ProtocolBundle`` wrapper
+        when ``bundle`` is a protocol key's inner bundle — recorded so
+        the service applies the share combine at fetch time
+        (``DcfService.register_key`` unwraps and passes both).
         """
         if bundle.s0s.shape[1] != 2:
             raise ShapeError(
@@ -135,12 +147,14 @@ class KeyRegistry:
                 "not at registration")
         with self._lock:
             prev = self._entries.get(key_id)
-            if prev is not None and prev.bundle is bundle:
+            if prev is not None and prev.bundle is bundle \
+                    and prev.protocol is protocol:
                 return  # idempotent re-registration: keep the residencies
             self._generation += 1
             if prev is not None:
                 self._evict_entry(prev)
-            self._entries[key_id] = _Entry(bundle, self._generation)
+            self._entries[key_id] = _Entry(bundle, self._generation,
+                                           protocol)
             self._g_registered.set(len(self._entries))
 
     def unregister(self, key_id: str) -> None:
@@ -158,21 +172,49 @@ class KeyRegistry:
                 raise ValueError(f"no bundle registered under {key_id!r}")
             return entry.bundle
 
+    def snapshot(self, key_id: str):
+        """``(bundle, protocol, generation)`` read under ONE lock
+        acquisition — the serving layer snapshots this once per request
+        group so a concurrent ``register`` hot-swap cannot pair the old
+        key's geometry (or combine masks) with the new key's state
+        mid-group.  The generation is handed back to ``resident`` so a
+        residency lazily re-staged from a SWAPPED entry is refused (the
+        group then fails with ``StaleStateError``, same as unregistering
+        mid-flight — never silent corruption)."""
+        with self._lock:
+            entry = self._entries.get(key_id)
+            if entry is None:
+                # api-edge: unknown-name lookup contract at the serve edge
+                raise ValueError(f"no bundle registered under {key_id!r}")
+            return entry.bundle, entry.protocol, entry.generation
+
     def key_ids(self) -> list[str]:
         with self._lock:
             return sorted(self._entries)
 
     # -- residency ----------------------------------------------------------
 
-    def resident(self, key_id: str, b: int):
+    def resident(self, key_id: str, b: int, generation: int | None = None):
         """The backend instance holding ``key_id``'s party-``b`` image on
         device, staging it (and possibly evicting colder images) if
-        absent.  Returns ``None`` for host-path services."""
+        absent.  Returns ``None`` for host-path services.
+
+        ``generation``: when given (the serving layer passes its group
+        snapshot's), a mismatch with the live entry raises
+        ``StaleStateError`` — a hot-swapped key must not lazily re-stage
+        under an in-flight group whose combine masks belong to the old
+        key (the batch would resolve successfully with silently wrong
+        shares)."""
         with self._lock:
             entry = self._entries.get(key_id)
             if entry is None:
                 # api-edge: unknown-name lookup contract at the serve edge
                 raise ValueError(f"no bundle registered under {key_id!r}")
+            if generation is not None and entry.generation != generation:
+                raise StaleStateError(
+                    f"key {key_id!r} was re-registered (generation "
+                    f"{entry.generation} != snapshot {generation}); the "
+                    "in-flight group must fail, not serve mixed key state")
             slot = "kl" if self._shared_image else int(b)
             res = entry.residents.get(slot)
             if res is not None:

@@ -55,6 +55,11 @@ from dataclasses import dataclass
 import numpy as np
 
 from dcf_tpu.errors import BackendUnavailableError, ShapeError
+from dcf_tpu.protocols import ProtocolBundle
+from dcf_tpu.protocols.combine import (
+    combine_pair_shares,
+    staged_pair_combine,
+)
 from dcf_tpu.serve.admission import AdmissionQueue, Request, ServeFuture, expire
 from dcf_tpu.serve.batcher import (
     BatchPlan,
@@ -163,7 +168,20 @@ class DcfService:
 
     def register_key(self, key_id: str, bundle) -> None:
         """Register (or hot-swap) the two-party bundle ``key_id`` serves.
-        Swapping evicts the old device residencies atomically."""
+        Swapping evicts the old device residencies atomically.
+
+        ``bundle`` may be a plain ``KeyBundle`` OR a
+        ``protocols.ProtocolBundle`` (PR 5): protocol keys serve MIC/
+        IC/piecewise queries — the device ships the inner 2m-key image
+        exactly like a plain key, and the service applies the
+        per-interval share combine (+ the party's public-correction
+        mask) when it fetches each batch, under the same admission/
+        deadline/retry semantics.  Futures for a protocol key resolve
+        to uint8 [m, M, lam] (per-interval shares) instead of
+        [K, M, lam]."""
+        protocol = None
+        if isinstance(bundle, ProtocolBundle):
+            protocol, bundle = bundle, bundle.keys
         if bundle.lam != self._dcf.lam:
             raise ShapeError(
                 f"bundle lam {bundle.lam} != service lam {self._dcf.lam}")
@@ -171,7 +189,7 @@ class DcfService:
             raise ShapeError(
                 f"bundle domain {bundle.n_bits} bits != service domain "
                 f"{8 * self._dcf.n_bytes} bits")
-        self.registry.register(key_id, bundle)
+        self.registry.register(key_id, bundle, protocol=protocol)
 
     def unregister_key(self, key_id: str) -> None:
         self.registry.unregister(key_id)
@@ -240,8 +258,16 @@ class DcfService:
         for r in group:
             self._h_wait.observe(max(now - r.enq_t, 0.0))
         key_id, b = group[0].key_id, group[0].b
-        bundle = self.registry.bundle(key_id)
+        # ONE locked read: a concurrent register() hot-swap must never
+        # pair this bundle's geometry (or combine masks) with a
+        # different entry's state; the generation travels with the
+        # snapshot so resident() refuses to re-stage a swapped key under
+        # this group.
+        snap = self.registry.snapshot(key_id)
+        bundle, proto, _ = snap
         k_num, lam = bundle.num_keys, bundle.lam
+        if proto is not None:
+            k_num = proto.num_intervals  # batches fetch combined rows
         xs_list = [r.xs for r in group]
         outs = [np.empty((k_num, r.m, lam), dtype=np.uint8) for r in group]
         plans = plan_batches([r.m for r in group], self.config.max_batch)
@@ -262,9 +288,9 @@ class DcfService:
         # while batch N's result is still in flight; N is fetched after.
         prev: _Batch | None = None
         for plan in plans:
-            cur, y, err = self._run_batch(key_id, b, plan, xs_list)
+            cur, y, err = self._run_batch(key_id, b, plan, xs_list, snap)
             if prev is not None:
-                self._complete(prev, key_id, b, xs_list, finish)
+                self._complete(prev, key_id, b, xs_list, finish, snap)
             if err is not None:
                 finish(_Batch(plan, None, 0.0), None, err)
                 prev = None
@@ -274,7 +300,7 @@ class DcfService:
             else:
                 prev = cur
         if prev is not None:
-            self._complete(prev, key_id, b, xs_list, finish)
+            self._complete(prev, key_id, b, xs_list, finish, snap)
 
         for i, r in enumerate(group):
             if i in errors:
@@ -285,40 +311,59 @@ class DcfService:
 
     # -- batch execution ----------------------------------------------------
 
-    def _run_batch(self, key_id: str, b: int, plan: BatchPlan, xs_list
-                   ) -> tuple[_Batch | None, np.ndarray | None,
-                              BaseException | None]:
+    def _run_batch(self, key_id: str, b: int, plan: BatchPlan, xs_list,
+                   snap) -> tuple[_Batch | None, np.ndarray | None,
+                                  BaseException | None]:
         """Dispatch one batch.  Returns (in-flight batch, None, None) on
         the happy path; (batch, bytes, None) when a failure forced the
         synchronous retry path (already fetched); (None, None, error)
         when retries were exhausted."""
         try:
-            return self._dispatch(key_id, b, plan, xs_list), None, None
+            return self._dispatch(key_id, b, plan, xs_list, snap), None, None
         except Exception as e:  # fallback-ok: ANY backend/seam failure
             # must be contained to this batch (retried or failed), never
             # allowed to kill the serve worker
-            y, err = self._retry_sync(key_id, b, plan, xs_list, e)
+            y, err = self._retry_sync(key_id, b, plan, xs_list, e, snap)
             if err is not None:
                 return None, None, err
             return _Batch(plan, None, self._clock()), y, None
 
     def _dispatch(self, key_id: str, b: int, plan: BatchPlan,
-                  xs_list) -> _Batch:
-        """Stage + dispatch one batch; returns the in-flight handle."""
+                  xs_list, snap) -> _Batch:
+        """Stage + dispatch one batch; returns the in-flight handle.
+
+        ``snap``: the group's ``registry.snapshot`` — every batch of a
+        group serves the same (bundle, protocol) pairing even across a
+        concurrent re-register.  For protocol keys on staged backends
+        whose plane layout is known, the pair-combine runs ON DEVICE at
+        dispatch (``protocols.combine`` seam fires here; a failure takes
+        the ``_run_batch`` retry path) and only [m, M, lam] converts to
+        bytes — half the conversion volume.  Everywhere else the combine
+        applies to the fetched bytes, so a combine failure takes the
+        same retry/invalidation path as a backend failure, on both the
+        pipelined and sync-retry paths."""
         t0 = self._clock()
+        bundle, proto, generation = snap
+
+        def wrap(fetch):
+            if proto is None:
+                return fetch
+            masks = proto.masks_for(b)
+            return lambda: np.asarray(
+                combine_pair_shares(np.asarray(fetch()), masks))
+
         xs_batch = gather_batch(xs_list, plan, self._dcf.n_bytes)
         fire("serve.stage", key_id, plan.m)
         # Host-path detection is DYNAMIC (resident() returns None when
         # the facade currently resolves to cpu/numpy): a mid-serve auto
         # fallback that lands on the numpy floor must serve through the
         # facade, not die on the device path it selected at construction.
-        be = self.registry.resident(key_id, b)
+        be = self.registry.resident(key_id, b, generation)
         if be is None:
-            bundle = self.registry.bundle(key_id)
             fire("serve.eval", key_id, plan.m)
             y = self._dcf.eval(b, bundle, xs_batch)
             self._c_batches.inc()
-            return _Batch(plan, lambda: y, t0)
+            return _Batch(plan, wrap(lambda: y), t0)
         if hasattr(be, "stage"):
             staged = be.stage(xs_batch)
             self._h_stage.observe(max(self._clock() - t0, 0.0))
@@ -328,15 +373,24 @@ class DcfService:
             # eval; re-measure so the LRU budget sees the real image.
             self.registry.note_image_growth(key_id, b)
             self._c_batches.inc()
-            return _Batch(plan, lambda: be.staged_to_bytes(y_dev, plan.m),
-                          t0)
+            if proto is not None:
+                y_comb = staged_pair_combine(be, y_dev)  # fires the seam
+                if y_comb is not None:
+                    masks = proto.masks_for(b)
+                    return _Batch(
+                        plan,
+                        lambda: be.staged_to_bytes(y_comb, plan.m)
+                        ^ masks[:, None, :],
+                        t0)
+            return _Batch(
+                plan, wrap(lambda: be.staged_to_bytes(y_dev, plan.m)), t0)
         fire("serve.eval", key_id, plan.m)
         y = be.eval(b, xs_batch)
         self._c_batches.inc()
-        return _Batch(plan, lambda: y, t0)
+        return _Batch(plan, wrap(lambda: y), t0)
 
     def _complete(self, batch: _Batch, key_id: str, b: int, xs_list,
-                  finish) -> None:
+                  finish, snap) -> None:
         """Fetch an in-flight batch; a fetch-time failure (the dispatch
         is async — compile/execute errors can surface here) takes the
         same retry path as a dispatch-time one."""
@@ -345,14 +399,15 @@ class DcfService:
         except Exception as e:  # fallback-ok: ANY backend/seam failure
             # must be contained to this batch (retried or failed), never
             # allowed to kill the serve worker
-            y, err = self._retry_sync(key_id, b, batch.plan, xs_list, e)
+            y, err = self._retry_sync(key_id, b, batch.plan, xs_list, e,
+                                      snap)
             if err is not None:
                 finish(batch, None, err)
             else:
                 finish(_Batch(batch.plan, None, self._clock()), y, None)
 
     def _retry_sync(self, key_id: str, b: int, plan: BatchPlan, xs_list,
-                    first: BaseException
+                    first: BaseException, snap
                     ) -> tuple[np.ndarray | None, BaseException | None]:
         """Bounded synchronous retries after a batch failure, with
         escalating invalidation.
@@ -374,7 +429,7 @@ class DcfService:
             else:
                 self._dcf.reset_backend_health()
             try:
-                batch = self._dispatch(key_id, b, plan, xs_list)
+                batch = self._dispatch(key_id, b, plan, xs_list, snap)
                 return batch.fetch(), None
             except Exception as e:  # fallback-ok: retry loop boundary —
                 # the last failure is reported to the affected requests

@@ -56,6 +56,13 @@ POINTS = (
     #                     handler args: key_id, batch_points)
     "serve.eval",       # staged batch dispatch      (serve/service.py;
     #                     handler args: key_id, batch_points)
+    "protocols.combine",  # per-interval share combine (protocols/
+    #                     combine.py — both the host-bytes and the
+    #                     staged-device paths, and therefore every
+    #                     protocol batch the serve layer fetches;
+    #                     handler args: m_intervals, batch_points
+    #                     (-1 on the device path, where the point count
+    #                     is not yet materialized))
 )
 
 _ACTIVE: dict[str, Callable] = {}

@@ -9,6 +9,10 @@
   gate consumes one DCF evaluation per wire per input; the workload is
   exactly a huge batch of independent DCF evals, which is why it scales as
   a pure map over (keys x points).  Uses the keys-in-lanes backend.
+  Since the protocols PR it is a thin client of
+  ``dcf_tpu.protocols.combine.xor_reconstruct_stream`` (the protocol
+  layer's generic streamed two-party reconstruction) — same kernels,
+  same chunk loop, one shared implementation.
 """
 
 from __future__ import annotations
@@ -172,22 +176,15 @@ def secure_relu_eval(
     return the XOR reconstruction uint8 [K, M, lam], streaming over keys.
 
     backend0/backend1: KeyLanesBackend-compatible evaluators (put_bundle +
-    eval).  Keys stream through the device in ``key_chunk`` slices — the
-    full 10^6-key image does not need to be HBM-resident at once.
+    eval).  A thin client of the protocol layer since the protocols PR:
+    the streamed two-party reconstruction lives in
+    ``protocols.combine.xor_reconstruct_stream`` (the generic primitive
+    IC/MIC/piecewise tests and benches share); this wrapper only keeps
+    the workload's name and signature.  Keys stream through the device
+    in ``key_chunk`` slices — the full 10^6-key image does not need to
+    be HBM-resident at once.
     """
-    k = bundle.num_keys
-    m, lam = xs.shape[0], bundle.lam
-    out = np.empty((k, m, lam), dtype=np.uint8)
-    for lo in range(0, k, key_chunk):
-        hi = min(k, lo + key_chunk)
-        sub = KeyBundle(
-            s0s=bundle.s0s[lo:hi],
-            cw_s=bundle.cw_s[lo:hi],
-            cw_v=bundle.cw_v[lo:hi],
-            cw_t=bundle.cw_t[lo:hi],
-            cw_np1=bundle.cw_np1[lo:hi],
-        )
-        y0 = backend0.eval(0, xs, bundle=sub.for_party(0))
-        y1 = backend1.eval(1, xs, bundle=sub.for_party(1))
-        out[lo:hi] = y0 ^ y1
-    return out
+    from dcf_tpu.protocols.combine import xor_reconstruct_stream
+
+    return xor_reconstruct_stream(
+        backend0, backend1, bundle, xs, key_chunk=key_chunk)

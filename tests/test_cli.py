@@ -116,3 +116,31 @@ def test_bench_clamped_samples_excluded():
     # rather than print a rate)
     times, clamped = rtt_corrected_times([0.05, 0.09], rtt_s=0.1, iters=2)
     assert times == [] and clamped == 2
+
+
+@pytest.mark.slow
+@pytest.mark.protocols
+def test_cli_mic_bench_smoke(capsys):
+    """mic_bench end to end on the numpy backend (tiny closed loop):
+    parity gate vs the protocol oracle, a valid JSONL line with the
+    served-points metric, the staged-MicEvaluator companion rate, and
+    the pinned numpy-oracle vs_baseline (the committed pin covers the
+    default m=8)."""
+    recs = run_cli(
+        capsys,
+        ["mic_bench", "--backend=numpy", "--duration=1", "--reps=1",
+         "--max-batch=256", "--concurrency=2"],
+    )
+    assert recs[0]["bench"] == "mic_bench"
+    assert recs[0]["metric"] == "points_per_sec"
+    assert recs[0]["intervals"] == 8
+    assert recs[0]["value"] > 0
+    assert recs[0]["staged_mic_points_per_sec"] > 0
+    assert "vs_baseline" in recs[0]  # the committed mic_m8 pin resolves
+
+
+def test_cli_mic_bench_rejects_non_facade_backend():
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="mic_bench"):
+        cli.main(["mic_bench", "--backend=cpu"])

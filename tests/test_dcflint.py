@@ -189,6 +189,39 @@ def test_secret_hygiene_covers_metric_sinks(tmp_path):
     assert run_path(REPO / "dcf_tpu" / "serve", ["secret-hygiene"]) == []
 
 
+def test_secret_hygiene_covers_protocol_masks(tmp_path):
+    """PR 5: a protocol bundle's ``combine_masks`` is key material
+    (``pub * beta`` — the secret function value in the clear for
+    wraparound intervals): leaking it through any output sink from a
+    protocols-style module is flagged, and a mask-holding class without
+    a redacting __repr__ is flagged too."""
+    write(tmp_path, "protocols/mic.py", (
+        "def f(combine_masks, bundle, m):\n"
+        "    log(f'combining {m} intervals')\n"       # no secrets: fine
+        "    log(f'masks: {combine_masks}')\n"        # f-string leak
+        "    print('corr', bundle.combine_masks)\n"   # attribute leak
+        "    hist.observe(combine_masks)\n"))         # metric-sink leak
+    got = [v for v in run_path(tmp_path, ["secret-hygiene"])
+           if v.path.endswith("mic.py")]
+    assert [v.line for v in got] == [3, 4, 5]
+    write(tmp_path, "protocols/keygen.py", (
+        "from dataclasses import dataclass\n"
+        "@dataclass\n"
+        "class LeakyProtocolBundle:\n"
+        "    combine_masks: object\n"))
+    got = [v for v in run_path(tmp_path, ["secret-hygiene"])
+           if v.path.endswith("keygen.py")]
+    assert len(got) == 1 and "LeakyProtocolBundle" in got[0].message
+
+
+def test_protocols_layer_lint_clean():
+    """The ISSUE-5 satellite pin: dcf_tpu/protocols/ sweeps clean under
+    ALL six passes (the package-wide test_package_clean already covers
+    it; this pin keeps the guarantee legible if the sweep scope ever
+    changes)."""
+    assert run_path(REPO / "dcf_tpu" / "protocols") == []
+
+
 def test_serve_layer_lint_clean(tmp_path):
     """The ISSUE-4 CI satellite: the whole dcflint sweep over
     dcf_tpu/serve/ reports zero findings — in particular determinism

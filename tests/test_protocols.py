@@ -1,0 +1,525 @@
+"""dcf_tpu.protocols: IC / MIC / piecewise over batched DCF (ISSUE 5).
+
+Covers the acceptance contract — MIC over >= 8 intervals, K-packed,
+reconstructing bit-exactly vs the numpy oracle on every facade-reachable
+backend (auto, bitsliced, prefix, the sharded 2x2 virtual mesh, both
+parties), including under injected ``protocols.combine`` and
+``serve.eval`` faults with retries — plus the IC edge-case property
+sweep (``x = p``, ``x = q-1``, ``x = q``, empty ``p == q``, full-domain,
+wraparound ``p > q``, adjacent MIC partitions, GT_BETA), the DCFK v3
+wire format (round-trip, corruption, version gating against v2), the
+staged ``MicEvaluator``'s on-device combine parity with the facade
+path, piecewise-constant lookup, and the serve-layer protocol
+registration.
+"""
+
+import numpy as np
+import pytest
+
+from dcf_tpu import Dcf
+from dcf_tpu.errors import KeyFormatError, ShapeError, StaleStateError
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.protocols import (
+    MicEvaluator,
+    ProtocolBundle,
+    eval_interval,
+    eval_mic,
+    gen_interval_bundle,
+    ic_oracle,
+    interval_bound_alphas,
+    mic_oracle,
+    partition_intervals,
+    piecewise_oracle,
+)
+from dcf_tpu.spec import Bound
+from dcf_tpu.testing import faults
+
+pytestmark = pytest.mark.protocols
+
+NB, LAM = 2, 16
+N = 1 << 16
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0x1C5)
+
+
+@pytest.fixture(scope="module")
+def ck(rng):
+    return [rng.bytes(32), rng.bytes(32)]
+
+
+@pytest.fixture(scope="module")
+def dcf(ck):
+    return Dcf(NB, LAM, ck, backend="numpy")
+
+
+#: The acceptance MIC shape: 8 disjoint intervals exercising every edge
+#: class at once — plain, adjacent (shared bound 300), wraparound
+#: (60000, 300 wraps past the domain top... kept disjoint from the rest
+#: by construction), empty, full-ish suffix, single-point, and the
+#: N-as-upper-bound suffix form.
+MIC_INTERVALS = [
+    (10, 200),        # plain
+    (200, 300),       # adjacent to the previous (shares bound 200)
+    (300, 1000),      # adjacent again
+    (5000, 5000),     # empty
+    (6000, 6001),     # single point
+    (40000, 50000),   # plain, high
+    (60000, 2000),    # wraparound p > q
+    (65000, N),       # suffix via q = N = 2^16
+]
+
+
+def edge_points(intervals):
+    """Every bound's neighborhood: x = p, q-1, q (mod N) per interval,
+    plus the domain corners."""
+    pts = {0, N - 1, 1}
+    for p, q in intervals:
+        for b in (p, q):
+            for x in (b - 1, b, b + 1):
+                pts.add(x % N)
+    xs = sorted(pts)
+    return np.array([[x >> 8, x & 0xFF] for x in xs], dtype=np.uint8)
+
+
+def mixed_points(rng, intervals, extra=64):
+    return np.vstack([
+        edge_points(intervals),
+        rng.integers(0, 256, (extra, NB), dtype=np.uint8)])
+
+
+def make_mic(dcf, rng, intervals=MIC_INTERVALS, bound=Bound.LT_BETA):
+    betas = rng.integers(0, 256, (len(intervals), LAM), dtype=np.uint8)
+    return dcf.mic(intervals, betas, bound=bound, rng=rng), betas
+
+
+# ----------------------------------------------------- oracle self-checks
+
+
+def test_oracle_edges():
+    beta = np.arange(1, LAM + 1, dtype=np.uint8)
+    xs = np.array([[0, 9], [0, 10], [0, 199], [0, 200]], dtype=np.uint8)
+    y = ic_oracle(xs, 10, 200, beta)
+    assert not y[0].any()            # x = p - 1
+    assert np.array_equal(y[1], beta)  # x = p (inclusive)
+    assert np.array_equal(y[2], beta)  # x = q - 1
+    assert not y[3].any()            # x = q (exclusive)
+    # empty / full / wraparound
+    assert not ic_oracle(xs, 7, 7, beta).any()
+    assert np.array_equal(ic_oracle(xs, 0, N, beta),
+                          np.broadcast_to(beta, (4, LAM)))
+    yw = ic_oracle(np.array([[0xFF, 0xFF], [0, 5], [0, 100]],
+                            dtype=np.uint8), 60000, 6, beta)
+    assert np.array_equal(yw[0], beta)   # in [60000, N)
+    assert np.array_equal(yw[1], beta)   # in [0, 6)
+    assert not yw[2].any()               # in the gap
+
+
+def test_oracle_bounds_validated():
+    beta = np.zeros(LAM, dtype=np.uint8)
+    with pytest.raises(ValueError):
+        ic_oracle(np.zeros((1, NB), dtype=np.uint8), 0, N + 1, beta)
+
+
+# -------------------------------------------- IC edge-case property sweep
+
+
+@pytest.mark.parametrize("bound", [Bound.LT_BETA, Bound.GT_BETA])
+@pytest.mark.parametrize("p,q", [
+    (10, 200),        # plain interior
+    (0, 1),           # single point at the origin
+    (123, 124),       # single interior point
+    (57, 57),         # empty
+    (0, N),           # full domain
+    (0, 0),           # empty at the origin
+    (N, N),           # empty at the top
+    (60000, 300),     # wraparound
+    (N - 1, N),       # last point only
+    (0, 32768),       # exact half
+])
+def test_ic_edge_cases_both_parties(dcf, rng, p, q, bound):
+    """x = p, q-1, q and the corners, every edge interval class, both
+    parties, both DCF bound families — bit-exact vs the oracle."""
+    beta = rng.integers(1, 256, LAM, dtype=np.uint8)
+    pb = dcf.interval(p, q, beta, bound=bound, rng=rng)
+    assert pb.num_intervals == 1 and pb.keys.num_keys == 2
+    xs = mixed_points(rng, [(p, q)], extra=32)
+    y0 = dcf.eval_interval(0, pb, xs)
+    y1 = dcf.eval_interval(1, pb, xs)
+    assert np.array_equal(y0 ^ y1, ic_oracle(xs, p, q, beta))
+
+
+def test_interval_bound_alphas_decomposition():
+    """The public-correction algebra: pub bit per interval class, and
+    GT alphas shifted by one (the 1_{x >= b} decomposition)."""
+    iv = [(10, 200), (200, 10), (0, N), (5, 5), (N, N), (0, 0)]
+    _, pub = interval_bound_alphas(iv, NB, Bound.LT_BETA)
+    assert pub.tolist() == [0, 1, 1, 0, 0, 0]
+    al, pubg = interval_bound_alphas(iv, NB, Bound.GT_BETA)
+    assert pubg.tolist() == [0, 1, 1, 0, 0, 0]
+    assert al[0].tolist() == [0, 9] and al[1].tolist() == [0, 199]
+    with pytest.raises(ValueError):
+        interval_bound_alphas([(0, N + 1)], NB)
+
+
+# --------------------------------------------------- MIC acceptance sweep
+
+
+def reconstruct_facade(dcf_like, pb, xs):
+    return dcf_like.eval_mic(0, pb, xs) ^ dcf_like.eval_mic(1, pb, xs)
+
+
+def test_mic_8_intervals_numpy_oracle(dcf, rng):
+    pb, betas = make_mic(dcf, rng)
+    assert pb.keys.num_keys == 16  # 2m keys K-packed in ONE bundle
+    xs = mixed_points(rng, MIC_INTERVALS)
+    got = reconstruct_facade(dcf, pb, xs)
+    assert np.array_equal(got, mic_oracle(xs, MIC_INTERVALS, betas))
+
+
+def test_mic_gt_beta(dcf, rng):
+    pb, betas = make_mic(dcf, rng, bound=Bound.GT_BETA)
+    xs = mixed_points(rng, MIC_INTERVALS)
+    assert np.array_equal(
+        reconstruct_facade(dcf, pb, xs),
+        mic_oracle(xs, MIC_INTERVALS, betas))
+
+
+@pytest.mark.parametrize("backend", ["auto", "bitsliced", "prefix"])
+def test_mic_facade_backends(ck, rng, backend):
+    """The acceptance matrix, single-device half: MIC over 8 intervals
+    on every CPU-reachable facade backend, both parties, vs the
+    oracle."""
+    d = Dcf(NB, LAM, ck, backend=backend)
+    pb, betas = make_mic(d, rng)
+    xs = mixed_points(rng, MIC_INTERVALS, extra=32)
+    assert np.array_equal(
+        reconstruct_facade(d, pb, xs),
+        mic_oracle(xs, MIC_INTERVALS, betas))
+
+
+def test_mic_sharded_2x2_mesh(ck, rng):
+    """The acceptance matrix, mesh half: the 2m = 16 K-packed keys
+    shard over a 2x2 virtual mesh (keys axis 2 | points axis 2)."""
+    from dcf_tpu.parallel import make_mesh
+
+    d = Dcf(NB, LAM, ck, backend="bitsliced", mesh=make_mesh(shape=(2, 2)))
+    pb, betas = make_mic(d, rng)
+    xs = mixed_points(rng, MIC_INTERVALS, extra=32)
+    assert np.array_equal(
+        reconstruct_facade(d, pb, xs),
+        mic_oracle(xs, MIC_INTERVALS, betas))
+
+
+def test_mic_evaluator_staged_matches_facade(ck, rng):
+    """The staged MicEvaluator (put_bundle/stage/eval_staged once +
+    ON-DEVICE pair combine) is bit-identical to the facade path on the
+    staged backends; prefix exercises the bit-major layout branch of
+    the key-axis table, bitsliced the byte-major one."""
+    for backend in ("bitsliced", "prefix"):
+        d = Dcf(NB, LAM, ck, backend=backend)
+        pb, betas = make_mic(d, rng)
+        xs = mixed_points(rng, MIC_INTERVALS, extra=32)
+        ev0, ev1 = MicEvaluator(d, pb, 0), MicEvaluator(d, pb, 1)
+        want = mic_oracle(xs, MIC_INTERVALS, betas)
+        assert np.array_equal(ev0.reconstruct_with(ev1, xs), want)
+        assert np.array_equal(ev0.eval(xs), d.eval_mic(0, pb, xs))
+
+
+def test_adjacent_partition_covers_domain(dcf, rng):
+    """Adjacent-interval MIC partition: every point lands in exactly
+    one interval, so the rows XOR-reduce to the piecewise lookup."""
+    cuts = [0, 100, 5000, 60000]
+    intervals = partition_intervals(cuts, 8 * NB)
+    assert intervals == [(0, 100), (100, 5000), (5000, 60000), (60000, 0)]
+    betas = rng.integers(0, 256, (4, LAM), dtype=np.uint8)
+    pb = dcf.mic(intervals, betas, rng=rng)
+    xs = mixed_points(rng, intervals)
+    rows = reconstruct_facade(dcf, pb, xs)
+    # at most one row fires per point (a partition; == 1 unless beta=0)
+    assert (np.count_nonzero((rows != 0).any(axis=2), axis=0) <= 1).all()
+    assert np.array_equal(rows, mic_oracle(xs, intervals, betas))
+
+
+# ------------------------------------------------------------- piecewise
+
+
+def test_piecewise_lookup(dcf, rng):
+    cuts = [0, 100, 5000, 60000]
+    vals = rng.integers(0, 256, (4, LAM), dtype=np.uint8)
+    pb = dcf.piecewise(cuts, vals, rng=rng)
+    xs = mixed_points(rng, partition_intervals(cuts, 8 * NB))
+    y = dcf.eval_piecewise(0, pb, xs) ^ dcf.eval_piecewise(1, pb, xs)
+    assert np.array_equal(y, piecewise_oracle(xs, cuts, vals))
+    # spot-check the semantics directly: x = 4999 -> piece 1's value
+    xq = np.array([[0x13, 0x87]], dtype=np.uint8)  # 0x1387 = 4999
+    yq = dcf.eval_piecewise(0, pb, xq) ^ dcf.eval_piecewise(1, pb, xq)
+    assert np.array_equal(yq[0], vals[1])
+
+
+def test_piecewise_single_piece_is_constant(dcf, rng):
+    vals = rng.integers(0, 256, (1, LAM), dtype=np.uint8)
+    pb = dcf.piecewise([42], vals, rng=rng)
+    xs = rng.integers(0, 256, (16, NB), dtype=np.uint8)
+    y = dcf.eval_piecewise(0, pb, xs) ^ dcf.eval_piecewise(1, pb, xs)
+    assert np.array_equal(y, np.broadcast_to(vals[0], (16, LAM)))
+
+
+def test_partition_validation():
+    with pytest.raises(ValueError):
+        partition_intervals([], 16)
+    with pytest.raises(ValueError):
+        partition_intervals([5, 5], 16)
+    with pytest.raises(ValueError):
+        partition_intervals([0, N], 16)
+
+
+# ------------------------------------------------------------ wire format
+
+
+def test_wire_roundtrip_and_version_gate(dcf, rng):
+    pb, betas = make_mic(dcf, rng)
+    data = pb.to_bytes()
+    pb2 = ProtocolBundle.from_bytes(data)
+    assert pb2.bound is pb.bound
+    assert np.array_equal(pb2.combine_masks, pb.combine_masks)
+    for a, b in zip(
+            (pb2.keys.s0s, pb2.keys.cw_s, pb2.keys.cw_v, pb2.keys.cw_t,
+             pb2.keys.cw_np1),
+            (pb.keys.s0s, pb.keys.cw_s, pb.keys.cw_v, pb.keys.cw_t,
+             pb.keys.cw_np1)):
+        assert np.array_equal(a, b)
+    # the per-party restriction round-trips too
+    r = ProtocolBundle.from_bytes(pb.for_party(1).to_bytes())
+    assert r.keys.s0s.shape[1] == 1 and r.combine_masks.shape[0] == 1
+    # a plain-bundle reader must refuse the protocol frame loudly
+    with pytest.raises(KeyFormatError, match="protocol section"):
+        KeyBundle.from_bytes(data)
+    # ...and the protocol reader refuses plain v2 frames with a pointer
+    with pytest.raises(KeyFormatError, match="KeyBundle.from_bytes"):
+        ProtocolBundle.from_bytes(pb.keys.to_bytes())
+    # v2 plain bundles still read (the version gate's other half)
+    kb = KeyBundle.from_bytes(pb.keys.to_bytes())
+    assert kb.num_keys == pb.keys.num_keys
+
+
+def test_wire_corruption_detected(dcf, rng):
+    pb, _ = make_mic(dcf, rng)
+    data = pb.to_bytes()
+    # flip one byte mid-frame: the CRC trailer must catch it
+    with pytest.raises(KeyFormatError, match="crc32"):
+        ProtocolBundle.from_bytes(faults.corrupt(data, len(data) // 2))
+    # truncation names the field that ran out
+    with pytest.raises(KeyFormatError, match="truncated"):
+        ProtocolBundle.from_bytes(data[: len(data) // 2])
+    with pytest.raises(KeyFormatError, match="magic"):
+        ProtocolBundle.from_bytes(b"XXXX" + data[4:])
+
+
+def test_protocol_bundle_repr_redacted(dcf, rng):
+    pb, betas = make_mic(dcf, rng)
+    r = repr(pb)
+    assert "redacted" in r and "m=8" in r
+    assert betas.tobytes().hex()[:16] not in r
+
+
+def test_protocol_bundle_shape_contracts(dcf, rng):
+    pb, _ = make_mic(dcf, rng)
+    with pytest.raises(ShapeError):
+        ProtocolBundle(keys=pb.keys,
+                       combine_masks=np.zeros((2, 3, LAM), np.uint8))
+    odd = KeyBundle(
+        s0s=pb.keys.s0s[:3], cw_s=pb.keys.cw_s[:3],
+        cw_v=pb.keys.cw_v[:3], cw_t=pb.keys.cw_t[:3],
+        cw_np1=pb.keys.cw_np1[:3])
+    with pytest.raises(ShapeError):
+        ProtocolBundle(keys=odd,
+                       combine_masks=np.zeros((2, 1, LAM), np.uint8))
+
+
+# ------------------------------------------------------------- faults
+
+
+def test_combine_fault_seam_fires(dcf, rng):
+    pb, _ = make_mic(dcf, rng)
+    xs = rng.integers(0, 256, (8, NB), dtype=np.uint8)
+    with faults.inject("protocols.combine"):
+        with pytest.raises(faults.InjectedFault):
+            dcf.eval_mic(0, pb, xs)
+    # disarmed again afterwards
+    dcf.eval_mic(0, pb, xs)
+
+
+def test_combine_fault_seam_args(dcf, rng):
+    pb, _ = make_mic(dcf, rng)
+    xs = rng.integers(0, 256, (8, NB), dtype=np.uint8)
+    seen = []
+    with faults.inject("protocols.combine",
+                       handler=lambda m, pts: seen.append((m, pts))):
+        dcf.eval_mic(1, pb, xs)
+    assert seen == [(8, 8)]  # m intervals, batch points
+
+
+# ---------------------------------------------------------------- serve
+
+
+def make_service(d, pb, **knobs):
+    knobs.setdefault("max_batch", 32)
+    svc = d.serve(**knobs)
+    svc.register_key("mic-0", pb)
+    return svc
+
+
+def test_serve_mic_bit_exact(ck, rng):
+    """Protocol bundles registered in DcfService serve combined
+    [m, M, lam] shares with plain-DCF semantics otherwise."""
+    d = Dcf(NB, LAM, ck, backend="bitsliced")
+    pb, betas = make_mic(d, rng)
+    svc = make_service(d, pb)
+    xs = mixed_points(rng, MIC_INTERVALS, extra=16)
+    f0 = svc.submit("mic-0", xs, b=0)
+    f1 = svc.submit("mic-0", xs, b=1)
+    svc.pump()
+    got = f0.result() ^ f1.result()
+    assert got.shape == (8, xs.shape[0], LAM)
+    assert np.array_equal(got, mic_oracle(xs, MIC_INTERVALS, betas))
+
+
+def test_serve_mic_under_faults_with_retries(ck, rng):
+    """The acceptance fault clause: protocols.combine AND serve.eval
+    faults injected mid-serve; retries reconstruct bit-exactly."""
+    d = Dcf(NB, LAM, ck, backend="bitsliced")
+    pb, betas = make_mic(d, rng)
+    svc = make_service(d, pb, retries=1)
+    xs = mixed_points(rng, MIC_INTERVALS, extra=16)
+    want = mic_oracle(xs, MIC_INTERVALS, betas)
+
+    for point in ("protocols.combine", "serve.eval"):
+        calls = {"n": 0}
+
+        def fail_first(*_a):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise faults.InjectedFault(f"injected at {point}")
+
+        with faults.inject(point, handler=fail_first):
+            f0 = svc.submit("mic-0", xs, b=0)
+            f1 = svc.submit("mic-0", xs, b=1)
+            svc.pump()
+            assert np.array_equal(f0.result() ^ f1.result(), want), point
+        assert calls["n"] >= 2  # the retry actually re-entered the seam
+
+
+def test_serve_mic_retries_exhausted_fail_future(ck, rng):
+    d = Dcf(NB, LAM, ck, backend="bitsliced")
+    pb, _ = make_mic(d, rng)
+    svc = make_service(d, pb, retries=1)
+    xs = rng.integers(0, 256, (8, NB), dtype=np.uint8)
+    with faults.inject("protocols.combine"):
+        f = svc.submit("mic-0", xs, b=0)
+        svc.pump()
+        with pytest.raises(faults.InjectedFault):
+            f.result()
+
+
+def test_serve_mixed_plain_and_protocol_keys(ck, rng):
+    """One service, one plain DCF key and one MIC key: shapes and
+    values both correct (the registry's protocol record is per-key)."""
+    from dcf_tpu.backends.numpy_backend import eval_batch_np
+    from dcf_tpu.ops.prg import HirosePrgNp
+
+    d = Dcf(NB, LAM, ck, backend="bitsliced")
+    pb, betas = make_mic(d, rng)
+    alphas = rng.integers(0, 256, (1, NB), dtype=np.uint8)
+    plain_betas = rng.integers(0, 256, (1, LAM), dtype=np.uint8)
+    plain = d.gen(alphas, plain_betas, rng=rng)
+    svc = make_service(d, pb)
+    svc.register_key("plain-0", plain)
+    xs = rng.integers(0, 256, (9, NB), dtype=np.uint8)
+    fm = svc.submit("mic-0", xs, b=0)
+    fp0 = svc.submit("plain-0", xs, b=0)
+    fp1 = svc.submit("plain-0", xs, b=1)
+    fm1 = svc.submit("mic-0", xs, b=1)
+    svc.pump()
+    assert fm.result().shape == (8, 9, LAM)
+    assert fp0.result().shape == (1, 9, LAM)
+    prg = HirosePrgNp(LAM, ck)
+    want_plain = (eval_batch_np(prg, 0, plain.for_party(0), xs)
+                  ^ eval_batch_np(prg, 1, plain.for_party(1), xs))
+    assert np.array_equal(fp0.result() ^ fp1.result(), want_plain)
+    assert np.array_equal(fm.result() ^ fm1.result(),
+                          mic_oracle(xs, MIC_INTERVALS, betas))
+
+
+def test_serve_rejects_mismatched_protocol_bundle(ck, rng):
+    d = Dcf(NB, LAM, ck, backend="bitsliced")
+    d4 = Dcf(4, LAM, ck, backend="numpy")
+    pb4, _ = make_mic_any(d4, rng)
+    svc = d.serve(max_batch=32)
+    with pytest.raises(ShapeError):
+        svc.register_key("mic-bad", pb4)
+
+
+def test_registry_generation_guard_on_hot_swap(ck, rng):
+    """The snapshot consistency guard: a key hot-swapped after a group
+    snapshot was taken must not lazily re-stage under that snapshot's
+    combine masks — ``resident()`` with the stale generation refuses
+    (the group fails typed instead of resolving silently wrong shares),
+    while fresh submissions snapshot the new entry and serve it."""
+    d = Dcf(NB, LAM, ck, backend="bitsliced")
+    pb, _ = make_mic(d, rng)
+    svc = make_service(d, pb)
+    _, _, gen = svc.registry.snapshot("mic-0")
+    pb2, betas2 = make_mic(d, rng)
+    svc.register_key("mic-0", pb2)  # hot-swap: same geometry, new betas
+    with pytest.raises(StaleStateError):
+        svc.registry.resident("mic-0", 0, gen)
+    xs = mixed_points(rng, MIC_INTERVALS, extra=8)
+    f0 = svc.submit("mic-0", xs, b=0)
+    f1 = svc.submit("mic-0", xs, b=1)
+    svc.pump()
+    assert np.array_equal(f0.result() ^ f1.result(),
+                          mic_oracle(xs, MIC_INTERVALS, betas2))
+
+
+def make_mic_any(d, rng):
+    n = 1 << (8 * d.n_bytes)
+    iv = [(1, n // 2), (n // 2, n - 1)]
+    betas = rng.integers(0, 256, (2, LAM), dtype=np.uint8)
+    return d.mic(iv, betas, rng=rng), betas
+
+
+# ------------------------------------------------- keygen reuse contract
+
+
+def test_gen_interval_bundle_custom_gen_fn(ck, rng):
+    """The keygen hook: any K-batched gen (here gen.gen_batch directly,
+    standing in for a DeviceKeyGen pipeline) produces an equivalent
+    bundle — the protocol layer adds structure, not a new keygen."""
+    from dcf_tpu.gen import gen_batch, random_s0s
+    from dcf_tpu.ops.prg import HirosePrgNp
+
+    prg = HirosePrgNp(LAM, ck)
+    seeds = np.random.default_rng(3)
+
+    def gen_fn(alphas, betas, bound):
+        return gen_batch(prg, alphas, betas,
+                         random_s0s(alphas.shape[0], LAM, seeds), bound)
+
+    iv = [(100, 60000), (60001, 100)]
+    betas = rng.integers(0, 256, (2, LAM), dtype=np.uint8)
+    pb = gen_interval_bundle(gen_fn, iv, betas, NB)
+    d = Dcf(NB, LAM, ck, backend="numpy")
+    xs = mixed_points(rng, iv, extra=16)
+    got = d.eval_mic(0, pb, xs) ^ d.eval_mic(1, pb, xs)
+    assert np.array_equal(got, mic_oracle(xs, iv, betas))
+
+
+def test_eval_interval_rejects_mic_bundle(dcf, rng):
+    pb, _ = make_mic(dcf, rng)
+    xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+    with pytest.raises(ShapeError):
+        eval_interval(dcf, 0, pb, xs)
+    assert eval_mic(dcf, 0, pb, xs).shape == (8, 4, LAM)

@@ -1,0 +1,82 @@
+"""Slow serve-MIC soak: sustained closed-loop protocol load with
+intermittent ``protocols.combine`` fault injection (ISSUE 5 CI
+satellite).
+
+Serial-CI-leg material (``-m "protocols and slow"``): seconds of
+threaded closed-loop load against a registered MIC protocol key while
+the combine seam fails intermittently.  The service must stay up,
+complete or typed-fail every request, keep the queue drained, and still
+serve bit-exact combined [m, M, lam] shares afterwards.
+"""
+
+import numpy as np
+import pytest
+
+from dcf_tpu import Dcf
+from dcf_tpu.protocols import mic_oracle
+from dcf_tpu.serve.loadgen import closed_loop
+from dcf_tpu.testing import faults
+
+pytestmark = [pytest.mark.protocols, pytest.mark.slow]
+
+NB, LAM = 2, 16
+N = 1 << 16
+
+
+def test_serve_mic_soak_under_combine_faults():
+    rng = np.random.default_rng(0x50AD)
+    ck = [rng.bytes(32), rng.bytes(32)]
+    dcf = Dcf(NB, LAM, ck, backend="bitsliced")
+    svc = dcf.serve(max_batch=64, max_delay_ms=2.0, retries=1,
+                    max_queued_points=4096)
+    intervals = [(10, 200), (300, 1000), (5000, 2000), (0, N),
+                 (7, 7), (40000, 50000), (60000, 61000), (65000, N)]
+    betas = rng.integers(0, 256, (8, LAM), dtype=np.uint8)
+    pb = dcf.mic(intervals, betas, rng=rng)
+    svc.register_key("mic-soak", pb)
+
+    calls = {"n": 0}
+
+    def every_ninth(*_args):
+        calls["n"] += 1
+        if calls["n"] % 9 == 0:
+            raise faults.InjectedFault("intermittent combine failure")
+
+    with svc:
+        # Warm the padded-shape ladder before the timed soak (same
+        # reasoning as the plain serve soak: a compile inside the
+        # window starves the batch count the assertions rely on).
+        m = 1
+        while m <= 64:
+            svc.evaluate("mic-soak",
+                         rng.integers(0, 256, (m, NB), dtype=np.uint8),
+                         timeout=180)
+            m *= 2
+        with faults.inject("protocols.combine", handler=every_ninth):
+            res = closed_loop(
+                svc, ["mic-soak"], duration_s=5.0, concurrency=3,
+                min_points=1, max_points=48, seed=11)
+            rounds = 1
+            while calls["n"] < 9 and rounds < 4:
+                more = closed_loop(
+                    svc, ["mic-soak"], duration_s=5.0, concurrency=3,
+                    min_points=1, max_points=48, seed=11 + rounds)
+                res.requests_ok += more.requests_ok
+                res.points_ok += more.points_ok
+                res.requests_failed += more.requests_failed
+                res.requests_shed += more.requests_shed
+                rounds += 1
+        # post-soak, faults disarmed: combined shares still bit-exact
+        xs = rng.integers(0, 256, (9, NB), dtype=np.uint8)
+        y0 = svc.evaluate("mic-soak", xs, b=0, timeout=60)
+        y1 = svc.evaluate("mic-soak", xs, b=1, timeout=60)
+        assert y0.shape == (8, 9, LAM)
+        assert np.array_equal(y0 ^ y1, mic_oracle(xs, intervals, betas))
+
+    assert res.requests_ok > 0
+    assert res.points_ok > 0
+    snap = svc.metrics_snapshot()
+    assert snap["serve_queue_depth"] == 0
+    assert snap["serve_queue_points"] == 0
+    assert snap["serve_retries_total"] >= 1
+    assert calls["n"] >= 9  # the combine fault really fired mid-soak

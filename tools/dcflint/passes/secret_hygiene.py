@@ -8,7 +8,9 @@ Three rules:
 1. No ``print``/``logging`` call (including the CLI's ``log`` helper)
    whose argument expression references a name bound to key material —
    ``seed*``, ``s0``/``s0s``, ``cw_*``/``cws``/``cw_np1``, ``bundle``/
-   ``kb``/``key_bundle``, ``cipher_keys``.  The check is name-based and
+   ``kb``/``key_bundle``, ``cipher_keys``, ``combine_masks`` (PR 5: a
+   protocol bundle's mask is ``pub*beta`` — the secret function value
+   in the clear for wraparound intervals).  The check is name-based and
    deliberately conservative: printing ``bundle.num_keys`` is safe and
    gets a suppression with a reason, which is exactly the audit trail a
    reviewer wants at such a site.
@@ -35,7 +37,10 @@ from tools.dcflint import FileContext, LintPass, register
 
 SECRET_NAME_RE = re.compile(
     r"^(seed\w*|s0s?|cw(_\w+)?|cws|key_bundle|bundle|kb|key_material"
-    r"|cipher_keys?)$")
+    r"|cipher_keys?|combine_masks?)$")
+# ``combine_masks`` (PR 5, dcf_tpu/protocols): a protocol bundle's
+# per-interval combine mask is ``pub * beta`` — beta in the clear for
+# wraparound intervals, i.e. the secret function value itself.
 _PRINT_FUNCS = ("print", "log", "labeled")
 _LOGGING_METHODS = ("debug", "info", "warning", "error", "critical",
                     "exception", "log")
