@@ -682,7 +682,10 @@ class Dcf:
 
         Pass a ``serve.ServeConfig`` or its fields as keywords.  See
         ``dcf_tpu/serve/service.py`` for the knob semantics (micro-batch
-        coalescing, LRU device residency, admission control, metrics).
+        coalescing, LRU device residency, admission control, circuit
+        breakers + brownout — README "Resilience" — and metrics).
+        ``submit(..., priority=)`` takes CRITICAL/NORMAL/BATCH; classes
+        decide who is shed under overload, never dispatch order.
         """
         from dcf_tpu.serve import DcfService, ServeConfig
 

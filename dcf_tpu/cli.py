@@ -30,6 +30,12 @@ JSONL line records served points/s, the staged ``MicEvaluator``
 equivalent, and ``vs_baseline`` against the pinned single-core
 numpy-oracle denominator (CPU_BASELINE.md).
 
+plus ``chaos_bench`` — the serve resilience layer (ISSUE 6): a
+mixed-priority closed-loop load under a declarative fail-N-then-recover
+fault schedule at the ``serve.eval`` seam, with exit-code assertions on
+the metrics snapshot (breaker opened AND closed, zero CRITICAL sheds,
+BATCH-first shedding, post-recovery two-party parity vs the C++ core).
+
 Usage::
 
     python -m dcf_tpu.cli dcf_batch_eval --backend=pallas --points=1048576
@@ -65,6 +71,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 import time
 
@@ -801,6 +808,43 @@ def bench_full_domain(args) -> None:
           2 * (1 << n_bits) / dt, unit, dt, mad, len(ss))
 
 
+def _gen_serve_bundles(svc, native, rng, n_bundles, nb, lam) -> dict:
+    """``n_bundles`` fresh single-key two-party bundles, registered
+    under ``key-<i>`` (the serve_bench/chaos_bench workload shape)."""
+    bundles = {}
+    for i in range(n_bundles):
+        alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
+        betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
+        b = native.gen_batch(alphas, betas, random_s0s(1, lam, rng),
+                             Bound.LT_BETA)
+        bundles[f"key-{i}"] = b
+        svc.register_key(f"key-{i}", b)
+    return bundles
+
+
+def _serve_parity_gate(svc, native, bundles, rng, nb, *, points: int,
+                       bench: str, tag: str = "",
+                       priority: str = "normal",
+                       timeout: float | None = None) -> None:
+    """Every bundle, both parties, through the SERVICE, XOR
+    reconstruction vs the C++ anchor (shared by serve_bench and
+    chaos_bench — one copy, or the benches silently diverge)."""
+    xs = rng.integers(0, 256, (points, nb), dtype=np.uint8)
+    for name, bundle in bundles.items():
+        f0 = svc.submit(name, xs, b=0, priority=priority)
+        f1 = svc.submit(name, xs, b=1, priority=priority)
+        svc.pump()
+        want = native.eval(0, bundle, xs) ^ native.eval(1, bundle, xs)
+        if not np.array_equal(f0.result(timeout) ^ f1.result(timeout),
+                              want):
+            where = f" ({tag})" if tag else ""
+            raise SystemExit(
+                f"{bench} parity mismatch vs C++ on {name}{where}")
+    where = f" ({tag})" if tag else ""
+    log(f"parity vs C++ core{where}: OK ({len(bundles)} bundles x "
+        f"{points} pts, two-party)")
+
+
 def bench_serve(args) -> None:
     """Closed-loop load test of the online serving layer (ISSUE 4).
 
@@ -841,27 +885,9 @@ def bench_serve(args) -> None:
                     max_delay_ms=args.max_delay_ms,
                     device_bytes_budget=args.device_bytes_budget)
     log(f"gen {n_bundles} bundles ...")
-    bundles = {}
-    for i in range(n_bundles):
-        alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
-        betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
-        b = native.gen_batch(alphas, betas, random_s0s(1, lam, rng),
-                             Bound.LT_BETA)
-        bundles[f"key-{i}"] = b
-        svc.register_key(f"key-{i}", b)
-
-    # Parity gate: every bundle, both parties, vs the C++ anchor.
-    xs_check = rng.integers(0, 256, (512, nb), dtype=np.uint8)
-    for name, bundle in bundles.items():
-        y0 = svc.submit(name, xs_check, b=0)
-        y1 = svc.submit(name, xs_check, b=1)
-        svc.pump()
-        want = native.eval(0, bundle, xs_check) ^ \
-            native.eval(1, bundle, xs_check)
-        if not np.array_equal(y0.result() ^ y1.result(), want):
-            raise SystemExit(f"serve parity mismatch vs C++ on {name}")
-    log(f"parity vs C++ core: OK ({n_bundles} bundles x 512 pts, "
-        "two-party)")
+    bundles = _gen_serve_bundles(svc, native, rng, n_bundles, nb, lam)
+    _serve_parity_gate(svc, native, bundles, rng, nb, points=512,
+                       bench="serve_bench")
 
     min_req = args.min_req_points or (max_batch * 3 // 8)
     max_req = args.max_req_points or (max_batch // 2)
@@ -1094,6 +1120,199 @@ def bench_mic(args) -> None:
           res.throughput, unit, extra_fields=extra)
 
 
+def _parse_priority_mix(spec: str) -> dict:
+    """``critical=0.2,normal=0.5,batch=0.3`` -> weight dict, validated
+    loudly (class names, parseable non-negative weights, no duplicates
+    — a malformed entry must name the flag and the expected shape, not
+    die in ``float('')``)."""
+    from dcf_tpu.serve.admission import parse_priority
+
+    mix = {}
+    for part in spec.split(","):
+        name, _, w = part.partition("=")
+        name = name.strip().lower()
+        try:
+            parse_priority(name)
+            weight = float(w)
+        except ValueError as e:
+            raise SystemExit(
+                f"--priority-mix: bad entry {part.strip()!r} ({e}); "
+                "expected class=weight pairs, e.g. "
+                "critical=0.2,normal=0.5,batch=0.3")
+        if name in mix:
+            raise SystemExit(
+                f"--priority-mix: duplicate class {name!r}")
+        if not math.isfinite(weight) or weight < 0.0:
+            # NaN compares false to 0, so `weight < 0` alone lets it
+            # through to rng.choice inside every client thread.
+            raise SystemExit(
+                f"--priority-mix: weight for {name!r} must be a finite "
+                f"non-negative number, got {w.strip()!r}")
+        mix[name] = weight
+    if sum(mix.values()) <= 0.0:
+        raise SystemExit(
+            "--priority-mix: weights sum to zero — at least one class "
+            "needs positive weight, e.g. critical=0.2,normal=0.5")
+    return mix
+
+
+def bench_chaos(args) -> None:
+    """Chaos harness for the serve resilience layer (ISSUE 6).
+
+    Drives the service with a mixed-priority closed-loop load while a
+    DECLARATIVE fault schedule is armed at the ``serve.eval`` seam —
+    fail the first ``--fault-window`` evals, then recover (the sustained
+    failure mode the one-shot fault tests cannot express) — and then
+    ASSERTS the resilience contract off the metrics snapshot:
+
+    * the (key, backend-family) circuit breaker OPENED during the window
+      (``serve_breaker_transitions_total{to=open}`` >= 1) and CLOSED
+      again after it (``{to=closed}`` >= 1, ``any_open()`` false at
+      exit) — the open/half-open/closed walk actually happened;
+    * shedding was lowest-class-first: zero CRITICAL requests shed,
+      BATCH-class brownout refusals observed whenever a breaker opened
+      (``serve_brownout_refusals_total`` > 0 when the run sheds at all);
+    * the service still serves BIT-EXACTLY after recovery: a post-chaos
+      two-party reconstruction per bundle is checked against the C++
+      host core, same anchor as serve_bench's parity gate.
+
+    Exit code != 0 on any violated assertion (SystemExit), so the chaos
+    run is CI-usable as a soak.  Uses the real clock — the driving loop
+    is a load generator; the deterministic fake-clock replays of the
+    same scenarios live in tests/test_chaos.py.
+    """
+    from dcf_tpu import Dcf
+    from dcf_tpu.native import NativeDcf
+    from dcf_tpu.serve.loadgen import closed_loop
+    from dcf_tpu.testing import faults
+
+    lam, nb = 16, 16
+    if args.backend not in ("numpy", "jax", "bitsliced", "pallas",
+                            "prefix"):
+        raise SystemExit(
+            f"chaos_bench serves lam=16 single-device facade backends "
+            f"(numpy/jax/bitsliced/pallas/prefix), got {args.backend!r}")
+    mix = _parse_priority_mix(args.priority_mix)  # bad flags fail fast,
+    # before the warmup ladder and parity gate spend real time
+    max_batch = args.max_batch or 256
+    min_req = args.min_req_points or max(max_batch // 8, 1)
+    max_req = args.max_req_points or (max_batch // 2)
+    if not 1 <= min_req <= max_req:
+        raise SystemExit(f"bad request-size range [{min_req}, {max_req}]")
+    window = args.fault_window
+    if window < 1:
+        raise SystemExit(
+            f"--fault-window must be >= 1 failing eval, got {window}")
+    n_bundles = args.bundles or 2
+    rng = np.random.default_rng(args.seed)
+    ck = _cipher_keys(lam, rng)
+    native = NativeDcf(lam, ck)
+    dcf = Dcf(nb, lam, ck, backend=args.backend)
+    svc = dcf.serve(max_batch=max_batch,
+                    max_delay_ms=args.max_delay_ms,
+                    retries=1,
+                    breaker_failures=args.breaker_failures,
+                    breaker_cooldown_s=args.breaker_cooldown,
+                    # Queue bound generous on purpose: overload sheds
+                    # must come from the BROWNOUT/breaker machinery under
+                    # test, not from a queue sized too small for the
+                    # client count (which would shed CRITICAL too and
+                    # void the lowest-class-first assertion).
+                    max_queued_points=1 << 20)
+    bundles = _gen_serve_bundles(svc, native, rng, n_bundles, nb, lam)
+
+    # Warm the padded-batch compile ladder BEFORE arming faults (a
+    # compile inside the chaos window would eat the whole schedule).
+    from dcf_tpu.serve.batcher import next_pow2
+
+    m = next_pow2(min_req)
+    while m <= max_batch:
+        svc.submit("key-0", rng.integers(0, 256, (m, nb), dtype=np.uint8))
+        svc.pump()
+        m *= 2
+    _serve_parity_gate(svc, native, bundles, rng, nb, points=64,
+                       bench="chaos_bench", tag="pre-chaos",
+                       priority="critical", timeout=30)
+
+    with faults.inject_schedule("serve.eval",
+                                window_evals=window) as sched:
+        with svc:
+            res = closed_loop(
+                svc, sorted(bundles), duration_s=float(args.duration),
+                concurrency=args.concurrency,
+                min_points=min_req, max_points=max_req,
+                seed=args.seed, priority_mix=mix)
+        # NOTE: ``with svc`` drains on exit, so the snapshot below is a
+        # quiescent end-state, not a mid-flight race.
+    snap = svc.metrics_snapshot()
+
+    # --- the resilience assertions (the point of the harness) ---------
+    failures = []
+    opened = snap.get("serve_breaker_transitions_total{to=open}", 0)
+    closed = snap.get("serve_breaker_transitions_total{to=closed}", 0)
+    if not sched.recovered:
+        failures.append(
+            f"fault window not consumed ({sched.failed}/{window} "
+            "failing evals): raise --duration or lower --fault-window")
+    if opened < 1:
+        failures.append("breaker never opened under the fault window")
+    if closed < 1:
+        failures.append("breaker never closed after recovery")
+    stuck = sorted(k for k, v in snap.items()
+                   if k.startswith("serve_breaker_state{") and v)
+    if stuck:
+        # NOT any_open(): its cooldown filter is right for brownout
+        # pressure but wrong here — by snapshot time (drain >> cooldown)
+        # a breaker wedged OPEN is merely probe-ready and would slip
+        # through.  The state gauges are cooldown-independent.
+        failures.append(
+            f"breaker(s) not closed after recovery: {', '.join(stuck)}")
+    crit_shed = snap.get(
+        "serve_shed_by_class_total{priority=critical}", 0)
+    batch_shed = snap.get("serve_shed_by_class_total{priority=batch}", 0)
+    if crit_shed:
+        failures.append(f"{crit_shed} CRITICAL requests shed — shedding "
+                        "must be lowest-class-first")
+    if snap.get("serve_shed_total", 0) and not batch_shed:
+        failures.append("the run shed load but no BATCH-class request "
+                        "was shed — not lowest-class-first")
+    for line in failures:
+        log(f"CHAOS FAIL: {line}")
+
+    # Post-recovery proof: the drain above closed admission, so rebuild
+    # a fresh service on the same facade — it must serve bit-exactly.
+    svc2 = dcf.serve(max_batch=max_batch, retries=1)
+    for name, bundle in bundles.items():
+        svc2.register_key(name, bundle)
+    _serve_parity_gate(svc2, native, bundles, rng, nb, points=64,
+                       bench="chaos_bench", tag="post-chaos",
+                       priority="critical", timeout=30)
+
+    extra = {
+        "duration_s": round(res.duration_s, 3),
+        "concurrency": args.concurrency,
+        "max_batch": max_batch,
+        "fault_window": window,
+        "fault_evals_failed": sched.failed,
+        "priority_mix": mix,
+        "requests_ok": res.requests_ok,
+        "requests_shed": res.requests_shed,
+        "requests_failed": res.requests_failed,
+        "by_class": res.by_class,
+        "breaker_opens": opened,
+        "breaker_closes": closed,
+        "brownout_refusals": snap.get("serve_brownout_refusals_total", 0),
+        "metrics_snapshot": snap,
+        "assertions_failed": failures,
+    }
+    _emit("chaos_bench", args.backend, "requests_ok",
+          float(res.requests_ok),
+          "requests served under the chaos schedule", extra_fields=extra)
+    if failures:
+        raise SystemExit(
+            f"chaos_bench: {len(failures)} resilience assertions failed")
+
+
 def bench_baseline(args) -> None:
     """All five BASELINE.json configs in one run, one JSON line per
     bench invocation (8 lines total: config 1 emits gen + 1-pt eval, and
@@ -1162,6 +1381,7 @@ BENCHES = {
     "full_domain": bench_full_domain,
     "serve_bench": bench_serve,
     "mic_bench": bench_mic,
+    "chaos_bench": bench_chaos,
 }
 
 
@@ -1255,6 +1475,21 @@ def main(argv=None) -> None:
     p.add_argument("--intervals", type=int, default=0,
                    help="mic_bench: MIC interval count m (0 = 8; the "
                         "bundle K-packs 2m DCF keys)")
+    p.add_argument("--fault-window", type=int, default=24,
+                   help="chaos_bench: serve.eval evals to fail before "
+                        "the injected backend recovers (retries count)")
+    p.add_argument("--priority-mix",
+                   default="critical=0.2,normal=0.5,batch=0.3",
+                   help="chaos_bench: per-request priority-class "
+                        "weights, e.g. critical=0.2,normal=0.5,"
+                        "batch=0.3")
+    p.add_argument("--breaker-failures", type=int, default=3,
+                   help="chaos_bench: consecutive failed attempts "
+                        "(dispatches + retries) that open a (key, "
+                        "backend-family) breaker")
+    p.add_argument("--breaker-cooldown", type=float, default=0.25,
+                   help="chaos_bench: seconds an open breaker waits "
+                        "before its half-open probe")
     p.add_argument("--full", action="store_true",
                    help="baseline: run config 5 at the literal 10^6-key "
                         "scale (~20 min report)")
@@ -1278,7 +1513,8 @@ def main(argv=None) -> None:
         bench_baseline(args)
         return
     for name in BENCHES if args.bench == "all" else [args.bench]:
-        if args.bench == "all" and name in ("serve_bench", "mic_bench"):
+        if args.bench == "all" and name in ("serve_bench", "mic_bench",
+                                            "chaos_bench"):
             log(f"skipping {name} (a timed load test, not a "
                 "criterion analog; run it explicitly)")
             continue

@@ -19,13 +19,21 @@ grouping it with geometry staleness is what lets callers write one
       +-- BackendUnavailableError(RuntimeError) no backend could serve
       +-- StaleStateError        (RuntimeError) staged state outlived bundle
       +-- NativeBuildError       (RuntimeError) C++ core build/load failed
-      +-- QueueFullError         (RuntimeError) serve admission bound hit
+      +-- QueueFullError         (RuntimeError) serve admission shed the load
       +-- DeadlineExceededError  (TimeoutError) request deadline expired
+      +-- CircuitOpenError       (RuntimeError) breaker open: failing fast
 
-The last two belong to the online serving layer (``dcf_tpu.serve``):
-admission control sheds load with ``QueueFullError`` at submit time, and
-a request whose deadline passes before its batch is dispatched completes
-with ``DeadlineExceededError`` instead of a stale result.
+The last three belong to the online serving layer (``dcf_tpu.serve``):
+admission control sheds load with ``QueueFullError`` — at submit time
+(queue bound hit, brownout refusal of low-priority classes, or a
+draining service) or through the future when a queued request is
+evicted to admit higher-priority traffic; a request whose deadline
+passes before its batch is dispatched completes with
+``DeadlineExceededError`` instead of a stale result; and a request
+routed at a backend whose per-(key, backend-family) circuit breaker is
+open fails fast with ``CircuitOpenError`` instead of burning retry
+budget and deadline headroom on a backend known to be dying
+(``serve.breaker``).
 
 Recovery is signalled, not silent: whenever the framework degrades to a
 slower-but-correct path (auto backend fallback, AES-NI -> portable native
@@ -44,6 +52,7 @@ __all__ = [
     "NativeBuildError",
     "QueueFullError",
     "DeadlineExceededError",
+    "CircuitOpenError",
     "BackendFallbackWarning",
 ]
 
@@ -79,10 +88,13 @@ class NativeBuildError(DcfError, RuntimeError):
 
 
 class QueueFullError(DcfError, RuntimeError):
-    """The serving layer's bounded admission queue rejected a request:
-    either the queued-points bound was hit (overload — back off and
-    retry) or the service is draining/closed.  Raised at ``submit``
-    time, never after a request was accepted."""
+    """The serving layer's admission control shed a request: the
+    queued-points bound was hit (overload — back off and retry), the
+    service is in brownout and refused a low-priority class, or the
+    service is draining/closed.  Usually raised at ``submit`` time; the
+    one post-acceptance spelling is eviction — an already-queued
+    lower-priority request completed with this error through its future
+    because a higher-priority submit needed its room."""
 
 
 class DeadlineExceededError(DcfError, TimeoutError):
@@ -90,6 +102,18 @@ class DeadlineExceededError(DcfError, TimeoutError):
     dispatched; the request was dropped without evaluation (a late share
     is a useless share in an online 2PC round).  Surfaces through the
     request's result handle, not at ``submit``."""
+
+
+class CircuitOpenError(DcfError, RuntimeError):
+    """The per-(key_id, backend-family) circuit breaker is open: the
+    backend family serving this key crossed its consecutive-failure
+    threshold and the cooldown has not elapsed, so the request fails
+    fast instead of re-entering a backend known to be dying (which
+    would burn retry budget and deadline headroom for every queued
+    request behind it).  CRITICAL-priority traffic bypasses the open
+    state; after the cooldown one probe half-opens the breaker and its
+    outcome decides between closing and re-opening.  Surfaces through
+    the request's result handle (``serve.breaker``)."""
 
 
 class BackendFallbackWarning(UserWarning):

@@ -11,7 +11,12 @@ keys":
   under a device-bytes budget, one invalidation path shared with
   ``Dcf.reset_backend_health``;
 - ``serve.admission`` bounded queue (``QueueFullError`` shedding),
-  deadline propagation (``DeadlineExceededError``), result futures;
+  priority classes (CRITICAL/NORMAL/BATCH: lowest-class-first eviction
+  and brownout refusal), deadline propagation
+  (``DeadlineExceededError``), result futures;
+- ``serve.breaker``   per-(key_id, backend-family) circuit breakers
+  (closed/open/half-open on the injectable clock; open pairings fail
+  fast with ``CircuitOpenError``, CRITICAL bypasses);
 - ``serve.metrics``   dependency-free counters/gauges/histograms with a
   deterministic snapshot (embedded in RESULTS_serve JSONL lines);
 - ``serve.service``   ``DcfService``: the worker loop tying it together,
@@ -23,10 +28,11 @@ keys":
 Entry point: ``Dcf.serve(...)`` (see ``dcf_tpu.api``).
 """
 
-from dcf_tpu.serve.admission import ServeFuture  # noqa: F401
+from dcf_tpu.serve.admission import Priority, ServeFuture  # noqa: F401
+from dcf_tpu.serve.breaker import BreakerBoard  # noqa: F401
 from dcf_tpu.serve.metrics import Metrics  # noqa: F401
 from dcf_tpu.serve.registry import KeyRegistry  # noqa: F401
 from dcf_tpu.serve.service import DcfService, ServeConfig  # noqa: F401
 
-__all__ = ["DcfService", "ServeConfig", "ServeFuture", "Metrics",
-           "KeyRegistry"]
+__all__ = ["DcfService", "ServeConfig", "ServeFuture", "Priority",
+           "BreakerBoard", "Metrics", "KeyRegistry"]

@@ -1,4 +1,4 @@
-"""Admission control: bounded request queue, deadlines, result handles.
+"""Admission control: bounded request queue, priority classes, deadlines.
 
 The service's overload policy is decided HERE, at submit time, not
 discovered later as memory pressure: the queue is bounded in queued
@@ -7,6 +7,29 @@ request soak the device for seconds while claiming a queue depth of 1),
 and a submit that would exceed the bound is shed immediately with
 ``QueueFullError``.  A shed request costs the caller one exception and
 zero device work — the cheapest possible failure in a loaded system.
+
+Priority classes (ISSUE 6): every request carries a ``Priority`` —
+``CRITICAL`` / ``NORMAL`` / ``BATCH`` — and overload sheds
+lowest-class-first:
+
+* a submit that would exceed the points bound may EVICT queued
+  strictly-lower-class requests (lowest class first, newest first) to
+  make room; evicted futures complete with ``QueueFullError``.  The
+  eviction is all-or-nothing — nobody is evicted unless the incoming
+  request then fits (shedding two requests to admit zero would be pure
+  loss).
+* **brownout** — a degraded-admission mode the service enters on
+  sustained queue pressure or open circuit breakers (``serve.breaker``)
+  and exports as the ``serve_brownout`` gauge — refuses ``BATCH``
+  submits outright at the door, before they cost queue room.
+* ``CRITICAL`` keeps the pre-priority semantics exactly: admitted
+  whenever the bound allows (evicting lower classes if needed), never
+  brownout-refused, never evicted (nothing outranks it).
+
+Dispatch order stays FIFO (``take_group`` is priority-blind): classes
+decide *who is shed*, not *who jumps the queue* — a reordering queue
+would starve BATCH under permanent moderate load, whereas shed-only
+priorities degrade it exactly when something is actually wrong.
 
 Deadlines propagate as absolute clock values (the injectable serve clock,
 ``utils.benchtime.monotonic`` by default).  They are enforced at batch
@@ -22,15 +45,42 @@ returns the uint8 [K, M, lam] share or raises the typed failure.
 
 from __future__ import annotations
 
+import enum
 import threading
 from typing import Callable
 
 import numpy as np
 
 from dcf_tpu.errors import DeadlineExceededError, QueueFullError, ShapeError
-from dcf_tpu.serve.metrics import Metrics
+from dcf_tpu.serve.metrics import Metrics, labeled
 
-__all__ = ["ServeFuture", "Request", "AdmissionQueue", "expire"]
+__all__ = ["Priority", "parse_priority", "ServeFuture", "Request",
+           "AdmissionQueue", "expire"]
+
+
+class Priority(enum.IntEnum):
+    """Request priority class; LOWER value = higher priority (sorting a
+    mixed list ascending puts the most-protected traffic first)."""
+
+    CRITICAL = 0
+    NORMAL = 1
+    BATCH = 2
+
+
+def parse_priority(p) -> Priority:
+    """``Priority`` | case-insensitive name -> ``Priority`` (the serve
+    edge accepts both so CLI flags and loadgen specs stay strings)."""
+    if isinstance(p, Priority):
+        return p
+    if isinstance(p, str):
+        try:
+            return Priority[p.upper()]
+        except KeyError:
+            pass
+    # api-edge: documented priority-class contract at the serve edge
+    raise ValueError(
+        f"priority must be a Priority or one of "
+        f"{[x.name.lower() for x in Priority]}, got {p!r}")
 
 
 class ServeFuture:
@@ -73,16 +123,19 @@ class ServeFuture:
 class Request:
     """One accepted request: points for one (key_id, party) pair."""
 
-    __slots__ = ("key_id", "b", "xs", "m", "deadline", "enq_t", "future")
+    __slots__ = ("key_id", "b", "xs", "m", "deadline", "enq_t", "future",
+                 "priority")
 
     def __init__(self, key_id: str, b: int, xs: np.ndarray,
-                 deadline: float | None, enq_t: float):
+                 deadline: float | None, enq_t: float,
+                 priority: Priority = Priority.NORMAL):
         self.key_id = key_id
         self.b = int(b)
         self.xs = xs
         self.m = int(xs.shape[0])
         self.deadline = deadline
         self.enq_t = enq_t
+        self.priority = priority
         self.future = ServeFuture()
 
     def expired(self, now: float) -> bool:
@@ -90,6 +143,7 @@ class Request:
 
     def __repr__(self) -> str:  # points are caller data: shapes only
         return (f"Request(key_id={self.key_id!r}, b={self.b}, m={self.m}, "
+                f"priority={self.priority.name}, "
                 f"deadline={self.deadline})")
 
 
@@ -112,14 +166,75 @@ class AdmissionQueue:
         self._reqs: list[Request] = []
         self._points = 0
         self._closed = False
+        self._brownout = False
         self._g_depth = self._metrics.gauge("serve_queue_depth")
         self._g_points = self._metrics.gauge("serve_queue_points")
+        self._g_brownout = self._metrics.gauge("serve_brownout")
         self._c_shed = self._metrics.counter("serve_shed_total")
         self._c_accepted = self._metrics.counter("serve_requests_total")
         self._c_accepted_points = self._metrics.counter("serve_points_total")
+        self._c_brownout_refused = self._metrics.counter(
+            "serve_brownout_refusals_total")
+        self._c_evicted = self._metrics.counter("serve_queue_evicted_total")
+        # Pre-registered per-class series: a snapshot always carries all
+        # three keys (a missing class reads as "never shed" — tests and
+        # the chaos harness assert on exact zeros).
+        self._c_shed_by = {
+            pr: self._metrics.counter(labeled(
+                "serve_shed_by_class_total", priority=pr.name.lower()))
+            for pr in Priority}
+        self._c_evicted_by = {
+            pr: self._metrics.counter(labeled(
+                "serve_queue_evicted_by_class_total",
+                priority=pr.name.lower()))
+            for pr in Priority}
+
+    def set_brownout(self, on: bool) -> None:
+        """Flip the brownout gate (the SERVICE owns the entry/exit
+        policy — sustained pressure with hysteresis; the queue just
+        enforces the refusal)."""
+        on = bool(on)
+        if self._brownout == on:
+            # Hot-path no-op: the service calls this on every submit
+            # and pump iteration while pressure holds; don't take the
+            # queue condvar to rewrite an unchanged gauge.  (Unlocked
+            # read is benign: concurrent same-value sets are idempotent.)
+            return
+        with self.cond:
+            self._brownout = on
+            self._g_brownout.set(int(on))
+
+    @property
+    def brownout(self) -> bool:
+        return self._brownout
+
+    def _shed(self, req: Request) -> None:
+        self._c_shed.inc()
+        self._c_shed_by[req.priority].inc()
+
+    def _pick_victims(self, req: Request) -> list[Request] | None:
+        """Queued strictly-lower-class requests whose eviction makes
+        ``req`` fit — lowest class first, newest first within a class —
+        or ``None`` when no such set exists (all-or-nothing: nobody is
+        evicted for an admit that still fails)."""
+        need = self._points + req.m - self.max_queued_points
+        victims: list[Request] = []
+        # Newest-first = highest queue index (enq_t ties under a fake
+        # clock; position is the unambiguous arrival order).
+        candidates = [r for _, r in sorted(
+            ((i, r) for i, r in enumerate(self._reqs)
+             if r.priority > req.priority),
+            key=lambda ir: (-ir[1].priority, -ir[0]))]
+        for r in candidates:
+            if need <= 0:
+                break
+            victims.append(r)
+            need -= r.m
+        return victims if need <= 0 else None
 
     def put(self, req: Request) -> None:
-        """Admit or shed ``req`` (QueueFullError on overload/shutdown)."""
+        """Admit or shed ``req`` (QueueFullError on overload/brownout/
+        shutdown); may evict queued lower-class requests to admit it."""
         if req.m > self.max_queued_points:
             # Not an overload: this request can NEVER be admitted, so a
             # "back off and retry" QueueFullError would send the caller
@@ -128,26 +243,53 @@ class AdmissionQueue:
                 f"request of {req.m} points exceeds the admission bound "
                 f"max_queued_points={self.max_queued_points} outright; "
                 "split the request (or raise the bound)")
+        victims: list[Request] = []
         with self.cond:
             if self._closed:
                 # Shutdown rejections count as shed too: loadgen counts
                 # them off the same QueueFullError, and the two numbers
                 # land in the same RESULTS_serve line — they must agree.
-                self._c_shed.inc()
+                self._shed(req)
                 raise QueueFullError(
                     "service is draining/closed; no new requests")
-            if self._points + req.m > self.max_queued_points:
-                self._c_shed.inc()
+            if self._brownout and req.priority is Priority.BATCH:
+                self._shed(req)
+                self._c_brownout_refused.inc()
                 raise QueueFullError(
-                    f"admission queue full: {self._points} points queued "
-                    f"+ {req.m} requested > bound "
-                    f"{self.max_queued_points}; back off and retry")
+                    "brownout: the service is shedding BATCH-class load "
+                    "(sustained queue pressure or an open circuit "
+                    "breaker); back off and retry, or raise the class")
+            if self._points + req.m > self.max_queued_points:
+                picked = self._pick_victims(req)
+                if picked is None:
+                    self._shed(req)
+                    raise QueueFullError(
+                        f"admission queue full: {self._points} points "
+                        f"queued + {req.m} requested > bound "
+                        f"{self.max_queued_points}; back off and retry")
+                victims = picked
+                evicted = set(map(id, victims))
+                self._reqs = [r for r in self._reqs
+                              if id(r) not in evicted]
+                self._points -= sum(r.m for r in victims)
+                self._c_evicted.inc(len(victims))
+                for r in victims:
+                    self._c_evicted_by[r.priority].inc()
+                    # Evictions are sheds delivered late: count them in
+                    # the same totals loadgen reconciles against.
+                    self._shed(r)
             self._reqs.append(req)
             self._points += req.m
             self._c_accepted.inc()
             self._c_accepted_points.inc(req.m)
             self._sync_gauges()
             self.cond.notify_all()
+        # Complete evicted futures outside the lock: result() waiters
+        # wake immediately and must not contend the admission path.
+        for r in victims:
+            r.future.set_exception(QueueFullError(
+                f"evicted from the admission queue: a higher-priority "
+                f"submit needed the room ({r!r})"))
 
     def close(self) -> None:
         """Stop admitting; queued requests remain for draining."""

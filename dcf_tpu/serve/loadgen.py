@@ -22,7 +22,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from dcf_tpu.errors import DcfError
+from dcf_tpu.serve.admission import parse_priority
 from dcf_tpu.utils.benchtime import monotonic
 
 __all__ = ["LoadgenResult", "closed_loop"]
@@ -30,7 +30,12 @@ __all__ = ["LoadgenResult", "closed_loop"]
 
 @dataclass
 class LoadgenResult:
-    """One closed-loop run: totals, latencies, and what was shed."""
+    """One closed-loop run: totals, latencies, and what was shed.
+
+    ``by_class`` (ISSUE 6): per-priority ``{ok, shed, failed}`` counts —
+    the client-side view the chaos harness reconciles against the
+    service's ``serve_shed_by_class_total`` metrics (they must agree:
+    shedding is observable on both sides of the admission door)."""
 
     duration_s: float
     requests_ok: int = 0
@@ -38,6 +43,12 @@ class LoadgenResult:
     requests_failed: int = 0
     requests_shed: int = 0
     latencies_s: list = field(default_factory=list)
+    by_class: dict = field(default_factory=dict)
+
+    def _count(self, priority: str, outcome: str) -> None:
+        cls = self.by_class.setdefault(
+            priority, {"ok": 0, "shed": 0, "failed": 0})
+        cls[outcome] += 1
 
     @property
     def throughput(self) -> float:
@@ -58,39 +69,72 @@ class LoadgenResult:
 
 def _client(service, key_ids, stop: threading.Event, res: LoadgenResult,
             lock: threading.Lock, rng: np.random.Generator,
-            min_points: int, max_points: int, b: int, clock) -> None:
+            min_points: int, max_points: int, b: int, clock,
+            priorities, weights) -> None:
     from dcf_tpu.errors import QueueFullError
 
     nb = service._dcf.n_bytes
     while not stop.is_set():
         m = int(rng.integers(min_points, max_points + 1))
         key_id = key_ids[int(rng.integers(0, len(key_ids)))]
+        pr = priorities[int(rng.choice(len(priorities), p=weights))]
         xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
         t0 = clock()
         try:
-            fut = service.submit(key_id, xs, b=b)
+            fut = service.submit(key_id, xs, b=b, priority=pr)
             fut.result()
         except QueueFullError:
             with lock:
                 res.requests_shed += 1
+                res._count(pr, "shed")
             continue
-        except DcfError:
+        except Exception:  # fallback-ok: a client must survive ANY
+            # delivered failure — typed DcfErrors AND the raw backend
+            # exception a retries-exhausted batch passes through (the
+            # chaos harness injects exactly those); a dead client thread
+            # silently halves the offered load.
             with lock:
                 res.requests_failed += 1
+                res._count(pr, "failed")
             continue
         dt = clock() - t0
         with lock:
             res.requests_ok += 1
             res.points_ok += m
             res.latencies_s.append(dt)
+            res._count(pr, "ok")
 
 
 def closed_loop(service, key_ids, *, duration_s: float, concurrency: int,
                 min_points: int, max_points: int, seed: int = 2026,
-                party: int = 0, clock=monotonic) -> LoadgenResult:
+                party: int = 0, clock=monotonic,
+                priority_mix: dict | None = None) -> LoadgenResult:
     """Drive ``service`` with ``concurrency`` closed-loop clients for
     ``duration_s`` seconds of wall time; returns the aggregated result.
-    The service must be started (worker thread running)."""
+    The service must be started (worker thread running).
+
+    ``priority_mix``: ``{"critical": w, "normal": w, "batch": w}``
+    weights (normalized here) drawn per request from the client's seeded
+    RNG; default is the pre-priority behaviour (all NORMAL)."""
+    if priority_mix:
+        priorities = sorted(priority_mix)
+        for p in priorities:
+            # Unknown class names die here at the edge, not as a
+            # parse_priority ValueError inside every client thread
+            # (which _client's broadened except would count as
+            # requests_failed — a 100%-failed run with no loud error).
+            parse_priority(p)
+        total = float(sum(priority_mix.values()))
+        if total <= 0 or min(priority_mix.values()) < 0:
+            # api-edge: loadgen config contract at the harness edge — a
+            # negative weight would kill every client thread inside
+            # rng.choice, silently zeroing the offered load
+            raise ValueError(
+                f"priority_mix weights must be >= 0 and sum > 0, "
+                f"got {priority_mix}")
+        weights = [priority_mix[p] / total for p in priorities]
+    else:
+        priorities, weights = ["normal"], [1.0]
     res = LoadgenResult(duration_s=0.0)
     lock = threading.Lock()
     stop = threading.Event()
@@ -99,7 +143,7 @@ def closed_loop(service, key_ids, *, duration_s: float, concurrency: int,
             target=_client,
             args=(service, list(key_ids), stop, res, lock,
                   np.random.default_rng(seed + 7 * i), min_points,
-                  max_points, party, clock),
+                  max_points, party, clock, priorities, weights),
             name=f"loadgen-{i}", daemon=True)
         for i in range(concurrency)
     ]

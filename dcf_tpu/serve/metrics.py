@@ -157,6 +157,17 @@ class Metrics:
         return self._typed(name, self._get(
             name, lambda: Histogram(self._lock, bounds)), Histogram)
 
+    def remove(self, name: str) -> None:
+        """Drop an instrument (and its snapshot series) by exact name.
+
+        For bounded-cardinality hygiene on per-entity labeled series:
+        a series keyed by a retired entity (e.g. an unregistered serve
+        key's breaker-state gauge) would otherwise sit in every later
+        snapshot forever — unbounded memory and snapshot bloat under
+        entity churn.  Removing an absent name is a no-op."""
+        with self._lock:
+            self._instruments.pop(name, None)
+
     def snapshot(self) -> dict:
         """Point-in-time ``{name: value}`` with sorted keys and
         JSON-basic values only.  Counters/gauges map to their scalar;

@@ -109,11 +109,20 @@ class KeyRegistry:
 
     def __init__(self, make_backend, *, shared_image: bool = False,
                  device_bytes_budget: int = 0,
-                 metrics: Metrics | None = None):
+                 metrics: Metrics | None = None, breakers=None):
         self._make_backend = make_backend
         self._shared_image = shared_image  # keylanes: one slot, both parties
         self.device_bytes_budget = int(device_bytes_budget)
         self._metrics = metrics if metrics is not None else Metrics()
+        # The serving layer's ``serve.breaker.BreakerBoard`` (or None).
+        # Breaker state is (key_id, backend-family) failure HISTORY, so
+        # its lifetime is tied to the registration NAME, not to entry
+        # generations or device residencies: ``register`` hot-swaps and
+        # LRU/budget evictions leave it alone (a re-registered bundle
+        # re-staged onto the same dying backend is still on a dying
+        # backend), and only ``unregister`` — the name ceasing to exist
+        # — forgets it.
+        self._breakers = breakers
         self._lock = threading.RLock()
         self._entries: dict[str, _Entry] = {}
         self._tick = 0
@@ -163,6 +172,8 @@ class KeyRegistry:
             if entry is not None:
                 self._evict_entry(entry)
             self._g_registered.set(len(self._entries))
+        if self._breakers is not None:
+            self._breakers.forget(key_id)
 
     def bundle(self, key_id: str) -> KeyBundle:
         with self._lock:

@@ -28,6 +28,23 @@ Load-bearing knobs (``ServeConfig``):
   first runs the shared invalidation path (``Dcf.reset_backend_health``)
   so the retry re-stages on a freshly-selected backend instead of
   re-entering the dead one.
+* ``breaker_failures`` / ``breaker_cooldown_s`` — the per-(key_id,
+  backend-family) circuit breaker (``serve.breaker``): after
+  ``breaker_failures`` consecutive failed ATTEMPTS (each failing
+  dispatch and each failing retry records one — a batch failing
+  outright with ``retries=1`` records two) the pairing opens and
+  non-CRITICAL groups fail fast with ``CircuitOpenError`` instead of
+  burning retries against a backend known to be dying; after the
+  cooldown one probe half-opens it.  ``breaker_failures=0`` disables
+  breakers entirely.
+* ``brownout_queue_fraction`` / ``brownout_after_s`` /
+  ``brownout_clear_s`` — the brownout controller: queue points above
+  the fraction of ``max_queued_points`` for ``brownout_after_s``
+  (or ANY open breaker, immediately) enters brownout — BATCH-class
+  submits are refused at the door (``serve_brownout`` gauge = 1) —
+  and ``brownout_clear_s`` of calm exits it (hysteresis: entry and
+  exit are separated so a queue oscillating around the threshold does
+  not flap the gate).
 
 Pipelining: within a batch run, host->device staging of batch N+1
 overlaps the (async) device eval of batch N — the worker dispatches
@@ -54,13 +71,25 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from dcf_tpu.errors import BackendUnavailableError, ShapeError
+from dcf_tpu.errors import (
+    BackendUnavailableError,
+    CircuitOpenError,
+    ShapeError,
+)
 from dcf_tpu.protocols import ProtocolBundle
 from dcf_tpu.protocols.combine import (
     combine_pair_shares,
     staged_pair_combine,
 )
-from dcf_tpu.serve.admission import AdmissionQueue, Request, ServeFuture, expire
+from dcf_tpu.serve.admission import (
+    AdmissionQueue,
+    Priority,
+    Request,
+    ServeFuture,
+    expire,
+    parse_priority,
+)
+from dcf_tpu.serve.breaker import BreakerBoard
 from dcf_tpu.serve.batcher import (
     BatchPlan,
     gather_batch,
@@ -85,6 +114,11 @@ class ServeConfig:
     max_queued_points: int = 1 << 20
     device_bytes_budget: int = 0
     retries: int = 1
+    breaker_failures: int = 3
+    breaker_cooldown_s: float = 5.0
+    brownout_queue_fraction: float = 0.75
+    brownout_after_s: float = 0.5
+    brownout_clear_s: float = 1.0
 
     def __post_init__(self):
         if self.max_batch < 1 or self.max_batch & (self.max_batch - 1):
@@ -97,22 +131,48 @@ class ServeConfig:
         if self.retries < 0:
             # api-edge: config contract
             raise ValueError("retries must be >= 0")
+        if self.max_queued_points < 1:
+            # api-edge: config contract (AdmissionQueue enforces the
+            # same bound; failing here names the config field instead)
+            raise ValueError(
+                f"max_queued_points must be >= 1, "
+                f"got {self.max_queued_points}")
         if self.device_bytes_budget < 0:
             # api-edge: config contract — a negative budget would read
             # as "always over budget" and silently evict everything
             raise ValueError(
                 "device_bytes_budget must be >= 0 (0 = uncapped)")
+        if self.breaker_failures < 0:
+            # api-edge: config contract (0 disables the breakers)
+            raise ValueError("breaker_failures must be >= 0")
+        if self.breaker_cooldown_s < 0:
+            # api-edge: config contract
+            raise ValueError("breaker_cooldown_s must be >= 0")
+        if not 0 < self.brownout_queue_fraction <= 1:
+            # api-edge: config contract — 0 would make brownout
+            # permanent, > 1 unreachable
+            raise ValueError(
+                "brownout_queue_fraction must be in (0, 1]")
+        if self.brownout_after_s < 0 or self.brownout_clear_s < 0:
+            # api-edge: config contract
+            raise ValueError(
+                "brownout_after_s/brownout_clear_s must be >= 0")
 
 
 class _Batch:
-    """One in-flight batch: its plan and how to fetch its bytes."""
+    """One in-flight batch: its plan, how to fetch its bytes, and the
+    backend family it dispatched on (breaker outcomes are attributed to
+    the family that RAN the batch — under dispatch-ahead a mid-group
+    demotion must not charge an old batch's failure to the new family)."""
 
-    __slots__ = ("plan", "fetch", "t0")
+    __slots__ = ("plan", "fetch", "t0", "family")
 
-    def __init__(self, plan: BatchPlan, fetch, t0: float):
+    def __init__(self, plan: BatchPlan, fetch, t0: float,
+                 family: str = ""):
         self.plan = plan
         self.fetch = fetch
         self.t0 = t0
+        self.family = family
 
 
 class DcfService:
@@ -136,19 +196,35 @@ class DcfService:
         self.config = config if config is not None else ServeConfig()
         self.metrics = metrics if metrics is not None else Metrics()
         self._clock = clock
+        self.breakers = BreakerBoard(
+            failures_to_open=max(self.config.breaker_failures, 1),
+            cooldown_s=self.config.breaker_cooldown_s,
+            metrics=self.metrics, clock=clock)
+        self._breaker_enabled = self.config.breaker_failures > 0
         self.registry = KeyRegistry(
             dcf.new_eval_backend,
             shared_image=dcf.backend_name == "keylanes",
             device_bytes_budget=self.config.device_bytes_budget,
-            metrics=self.metrics)
+            metrics=self.metrics, breakers=self.breakers)
         self.queue = AdmissionQueue(self.config.max_queued_points,
                                     metrics=self.metrics)
         self._worker: threading.Thread | None = None
         self._pump_lock = threading.Lock()  # one batch runner at a time
+        self._pump_owner: int | None = None  # thread id holding the lock
+        # Brownout controller state (hysteresis timestamps on the
+        # injectable clock; None = the condition is not currently held).
+        # Guarded by _brownout_lock: _update_brownout runs on EVERY
+        # submit (documented thread-safe) as well as in the pump, so
+        # the check-then-subtract on these Optionals must be atomic.
+        self._brownout_lock = threading.Lock()
+        self._pressure_since: float | None = None
+        self._calm_since: float | None = None
         m = self.metrics
         self._c_batches = m.counter("serve_batches_total")
         self._c_retries = m.counter("serve_retries_total")
         self._c_failures = m.counter("serve_batch_failures_total")
+        self._c_breaker_fastfail = m.counter(
+            "serve_breaker_fast_fails_total")
         self._h_occupancy = m.histogram("serve_batch_occupancy",
                                         OCCUPANCY_BOUNDS)
         self._h_stage = m.histogram("serve_stage_s")
@@ -200,16 +276,20 @@ class DcfService:
     # -- submission ---------------------------------------------------------
 
     def submit(self, key_id: str, xs: np.ndarray, b: int = 0,
-               deadline_ms: float | None = None) -> ServeFuture:
+               deadline_ms: float | None = None,
+               priority: Priority | str = Priority.NORMAL) -> ServeFuture:
         """Submit points for one registered key, party ``b``.
 
         ``xs``: uint8 [M, n_bytes], M >= 1.  ``deadline_ms`` bounds the
         time the request may spend QUEUED; expiry completes the future
-        with ``DeadlineExceededError``.  Raises ``QueueFullError`` when
-        shed.  Thread-safe."""
+        with ``DeadlineExceededError``.  ``priority`` — CRITICAL /
+        NORMAL (default) / BATCH — decides who is shed under overload
+        and brownout, never dispatch order (``serve.admission``).
+        Raises ``QueueFullError`` when shed.  Thread-safe."""
         if b not in (0, 1):
             # api-edge: party index contract at the serve edge
             raise ValueError(f"party b must be 0 or 1, got {b}")
+        priority = parse_priority(priority)
         xs = np.ascontiguousarray(np.asarray(xs, dtype=np.uint8))
         if xs.ndim != 2 or xs.shape[1] != self._dcf.n_bytes:
             raise ShapeError(
@@ -218,39 +298,111 @@ class DcfService:
             raise ShapeError("cannot submit an empty request")
         self.registry.bundle(key_id)  # unknown key_id fails at submit
         now = self._clock()
+        self._update_brownout(now)  # the gate reflects current pressure
         deadline = None if deadline_ms is None else now + deadline_ms / 1e3
-        req = Request(key_id, b, xs, deadline, now)
+        req = Request(key_id, b, xs, deadline, now, priority)
         self.queue.put(req)  # sheds with QueueFullError on overload
         return req.future
 
     def evaluate(self, key_id: str, xs: np.ndarray, b: int = 0,
                  deadline_ms: float | None = None,
-                 timeout: float | None = None) -> np.ndarray:
+                 timeout: float | None = None,
+                 priority: Priority | str = Priority.NORMAL) -> np.ndarray:
         """Synchronous convenience: submit + wait."""
-        return self.submit(key_id, xs, b, deadline_ms).result(timeout)
+        return self.submit(key_id, xs, b, deadline_ms,
+                           priority).result(timeout)
 
     # -- serving ------------------------------------------------------------
+
+    # -- resilience (breaker + brownout) ------------------------------------
+
+    def _record_outcome(self, key_id: str, family: str,
+                        ok: bool) -> None:
+        """Feed one batch attempt's outcome to the breaker board, keyed
+        by the family the attempt DISPATCHED on (captured at dispatch
+        time and threaded through ``_Batch`` — not re-read, so under
+        dispatch-ahead a batch dispatched pre-demotion still charges
+        its late fetch failure to the family that earned it).  After a
+        final-retry ``reset_backend_health`` demotion the next
+        attempt's outcome belongs to the NEW family — a fresh breaker,
+        born closed."""
+        if not self._breaker_enabled:
+            return
+        if ok:
+            self.breakers.record_success(key_id, family)
+        else:
+            self.breakers.record_failure(key_id, family)
+
+    def _update_brownout(self, now: float) -> None:
+        """Enter/exit brownout with hysteresis (see the module
+        docstring's knob table).  Open breakers enter IMMEDIATELY —
+        the breaker's failure threshold already is a sustained-failure
+        filter; queue-depth pressure must hold for ``brownout_after_s``
+        first (one coalescing burst is not an overload).
+
+        Runs on every ``submit`` (thread-safe) and pump iteration;
+        ``_brownout_lock`` makes the check-then-subtract on the
+        hysteresis timestamps atomic — a concurrent None-reset between
+        the two would crash a submit with an untyped TypeError."""
+        cfg = self.config
+        # max(1, ...): int() truncates small bounds to a 0 threshold,
+        # which an EMPTY queue satisfies — permanent brownout on an
+        # idle service.
+        depth_pressure = self.queue.points >= max(1, int(
+            cfg.brownout_queue_fraction * cfg.max_queued_points))
+        open_pressure = self._breaker_enabled and self.breakers.any_open()
+        with self._brownout_lock:
+            if open_pressure or depth_pressure:
+                self._calm_since = None
+                if open_pressure:
+                    self.queue.set_brownout(True)
+                    return
+                if self._pressure_since is None:
+                    self._pressure_since = now
+                if now - self._pressure_since >= cfg.brownout_after_s:
+                    self.queue.set_brownout(True)
+                return
+            self._pressure_since = None
+            if not self.queue.brownout:
+                return
+            if self._calm_since is None:
+                self._calm_since = now
+            if now - self._calm_since >= cfg.brownout_clear_s:
+                self.queue.set_brownout(False)
+                self._calm_since = None
 
     def pump(self) -> int:
         """Serve everything queued right now, inline; returns the number
         of device batches dispatched.  The deterministic driving mode —
         also what the worker thread calls after its coalescing wait."""
+        if self._pump_owner == threading.get_ident():
+            # Reentrant pump (e.g. ``close`` called from a fault handler
+            # or future callback INSIDE a running pump): the outer pump
+            # already loops until the queue is empty, and re-acquiring
+            # the non-reentrant lock here would deadlock the worker.
+            return 0
         served = 0
         with self._pump_lock:
-            while True:
-                expire(self.queue.take_expired(self._clock()), self.metrics)
-                group = self.queue.take_group(self.config.max_batch)
-                if not group:
-                    return served
-                try:
-                    served += self._serve_group(group)
-                except Exception as e:  # fallback-ok: the worker must
-                    # outlive ANY per-group failure (e.g. the key was
-                    # unregistered between submit and dispatch) — fail
-                    # the group's futures, keep serving other keys
-                    for r in group:
-                        if not r.future.done():
-                            r.future.set_exception(e)
+            self._pump_owner = threading.get_ident()
+            try:
+                while True:
+                    now = self._clock()
+                    self._update_brownout(now)
+                    expire(self.queue.take_expired(now), self.metrics)
+                    group = self.queue.take_group(self.config.max_batch)
+                    if not group:
+                        return served
+                    try:
+                        served += self._serve_group(group)
+                    except Exception as e:  # fallback-ok: the worker must
+                        # outlive ANY per-group failure (e.g. the key was
+                        # unregistered between submit and dispatch) — fail
+                        # the group's futures, keep serving other keys
+                        for r in group:
+                            if not r.future.done():
+                                r.future.set_exception(e)
+            finally:
+                self._pump_owner = None
 
     def _serve_group(self, group: list[Request]) -> int:
         """Batch-evaluate one (key_id, party) group of requests."""
@@ -258,6 +410,57 @@ class DcfService:
         for r in group:
             self._h_wait.observe(max(now - r.enq_t, 0.0))
         key_id, b = group[0].key_id, group[0].b
+        # The breaker gate: an open (key, backend-family) pairing fails
+        # the whole group fast — pump's per-group containment delivers
+        # the typed CircuitOpenError to every future — unless the group
+        # carries CRITICAL traffic, which keeps its pre-breaker
+        # semantics (dispatch + bounded retries) and doubles as the
+        # recovery sensor once the half-open window arrives.
+        fam = self._dcf.backend_name
+        if self._breaker_enabled and not self.breakers.allow(
+                key_id, fam,
+                critical=any(r.priority is Priority.CRITICAL
+                             for r in group)):
+            self._c_breaker_fastfail.inc(len(group))
+            raise CircuitOpenError(
+                f"circuit breaker open for key {key_id!r} on backend "
+                f"family {fam!r}: failing fast until the cooldown's "
+                "half-open probe succeeds")
+        try:
+            return self._serve_group_batches(group, key_id, b)
+        except BaseException:  # fallback-ok: re-raised below — this
+            # handler only sweeps orphaned board state on the way out,
+            # it swallows nothing.
+            # A NON-batch failure escaped (stale snapshot, key
+            # unregistered between gate and dispatch — batch failures
+            # are contained below and recorded).
+            if self._breaker_enabled and (
+                    key_id not in self.registry.key_ids()):
+                # The gate's allow() above (re-)creates board state
+                # for its pairing.  If the key was unregistered
+                # between submit and dispatch, forget() already ran
+                # and nothing will ever run it again — sweep the
+                # orphan or the board leaks one entry per churned
+                # key (the allow()-path twin of the record_*
+                # resurrection guards).
+                self.breakers.forget(key_id)
+            raise
+        finally:
+            # Release the probe slot if the gate sanctioned this group
+            # as the half-open probe but no batch outcome ever resolved
+            # it against THIS family — the prober died pre-dispatch
+            # (non-batch failure above), or a concurrent
+            # reset_backend_health() demotion re-pointed the facade
+            # between the gate and the dispatch so every outcome was
+            # recorded against the NEW family (_Batch.family).  A
+            # resolved probe has left HALF_OPEN, making this a no-op;
+            # a wedged one would otherwise fail (key, fam) fast
+            # forever with no recovery path short of unregistering.
+            if self._breaker_enabled:
+                self.breakers.abort_probe(key_id, fam)
+
+    def _serve_group_batches(self, group: list[Request], key_id: str,
+                             b: int) -> int:
         # ONE locked read: a concurrent register() hot-swap must never
         # pair this bundle's geometry (or combine masks) with a
         # different entry's state; the generation travels with the
@@ -318,11 +521,13 @@ class DcfService:
         the happy path; (batch, bytes, None) when a failure forced the
         synchronous retry path (already fetched); (None, None, error)
         when retries were exhausted."""
+        fam = self._dcf.backend_name  # the family this attempt runs on
         try:
             return self._dispatch(key_id, b, plan, xs_list, snap), None, None
         except Exception as e:  # fallback-ok: ANY backend/seam failure
             # must be contained to this batch (retried or failed), never
             # allowed to kill the serve worker
+            self._record_outcome(key_id, fam, ok=False)
             y, err = self._retry_sync(key_id, b, plan, xs_list, e, snap)
             if err is not None:
                 return None, None, err
@@ -343,6 +548,7 @@ class DcfService:
         same retry/invalidation path as a backend failure, on both the
         pipelined and sync-retry paths."""
         t0 = self._clock()
+        fam = self._dcf.backend_name  # attribution for breaker outcomes
         bundle, proto, generation = snap
 
         def wrap(fetch):
@@ -363,7 +569,7 @@ class DcfService:
             fire("serve.eval", key_id, plan.m)
             y = self._dcf.eval(b, bundle, xs_batch)
             self._c_batches.inc()
-            return _Batch(plan, wrap(lambda: y), t0)
+            return _Batch(plan, wrap(lambda: y), t0, fam)
         if hasattr(be, "stage"):
             staged = be.stage(xs_batch)
             self._h_stage.observe(max(self._clock() - t0, 0.0))
@@ -381,13 +587,14 @@ class DcfService:
                         plan,
                         lambda: be.staged_to_bytes(y_comb, plan.m)
                         ^ masks[:, None, :],
-                        t0)
+                        t0, fam)
             return _Batch(
-                plan, wrap(lambda: be.staged_to_bytes(y_dev, plan.m)), t0)
+                plan, wrap(lambda: be.staged_to_bytes(y_dev, plan.m)), t0,
+                fam)
         fire("serve.eval", key_id, plan.m)
         y = be.eval(b, xs_batch)
         self._c_batches.inc()
-        return _Batch(plan, wrap(lambda: y), t0)
+        return _Batch(plan, wrap(lambda: y), t0, fam)
 
     def _complete(self, batch: _Batch, key_id: str, b: int, xs_list,
                   finish, snap) -> None:
@@ -395,16 +602,20 @@ class DcfService:
         is async — compile/execute errors can surface here) takes the
         same retry path as a dispatch-time one."""
         try:
-            finish(batch, batch.fetch(), None)
+            y = batch.fetch()
         except Exception as e:  # fallback-ok: ANY backend/seam failure
             # must be contained to this batch (retried or failed), never
             # allowed to kill the serve worker
+            self._record_outcome(key_id, batch.family, ok=False)
             y, err = self._retry_sync(key_id, b, batch.plan, xs_list, e,
                                       snap)
             if err is not None:
                 finish(batch, None, err)
             else:
                 finish(_Batch(batch.plan, None, self._clock()), y, None)
+            return
+        self._record_outcome(key_id, batch.family, ok=True)
+        finish(batch, y, None)
 
     def _retry_sync(self, key_id: str, b: int, plan: BatchPlan, xs_list,
                     first: BaseException, snap
@@ -428,12 +639,17 @@ class DcfService:
                 self.registry.evict_key(key_id)
             else:
                 self._dcf.reset_backend_health()
+            fam = self._dcf.backend_name  # post-invalidation family
             try:
                 batch = self._dispatch(key_id, b, plan, xs_list, snap)
-                return batch.fetch(), None
+                y = batch.fetch()
             except Exception as e:  # fallback-ok: retry loop boundary —
                 # the last failure is reported to the affected requests
+                self._record_outcome(key_id, fam, ok=False)
                 last = e
+                continue
+            self._record_outcome(key_id, fam, ok=True)
+            return y, None
         return None, last
 
     # -- lifecycle ----------------------------------------------------------
@@ -474,13 +690,21 @@ class DcfService:
 
         ``drain=True`` (default): queued requests are served before the
         worker exits.  ``drain=False``: queued requests complete with
-        ``BackendUnavailableError``.  Always joins the worker."""
+        ``BackendUnavailableError``.  Joins the worker (unless called
+        FROM it — a fault handler or chaos scenario closing the service
+        mid-batch must not self-join), and never leaves a future
+        hanging: queued requests are failed or drained here, and
+        requests already taken for an in-flight group are completed by
+        the pump that owns them (its retry loop is bounded, so the join
+        is too)."""
         self.queue.close()
         if not drain:
             self.queue.fail_all(lambda: BackendUnavailableError(
                 "service closed without draining"))
-        if self._worker is not None and self._worker.is_alive():
-            self._worker.join(timeout)
+        worker = self._worker
+        if worker is not None and worker.is_alive():
+            if worker is not threading.current_thread():
+                worker.join(timeout)
         else:
             self.pump()  # no worker: drain inline
         if drain:

@@ -23,6 +23,23 @@ the native seams) and may raise conditionally:
 
 ``corrupt`` is the canonical DCFK byte-mutation helper for key-ingestion
 tests (flip one byte, let the CRC catch it).
+
+Fault SCHEDULES (ISSUE 6): one-shot handlers cover "a batch failed";
+the failure modes production sees are *windows* — a backend that dies
+for N evals and then recovers, a flaky one that fails a seeded fraction
+of the time, a slow one that eats deadline headroom without erroring.
+
+* ``inject_schedule(point, window_evals=N)`` arms fail-N-then-recover:
+  the first N fires raise, every later fire passes through.  Yields the
+  ``Schedule`` so tests can assert exactly how many evals the window
+  absorbed.
+* ``flaky(rate, seed)`` is a handler factory failing a seeded-RNG
+  fraction of fires — deterministic per seed, so a chaos scenario's
+  exact failure pattern replays.
+* ``latency(clock, seconds)`` is the injected-latency seam: each fire
+  ADVANCES the injectable clock (``FakeClock.advance``) instead of
+  sleeping, so deadline expiry under a slow backend is testable in
+  microseconds of wall time.  Chain ``then=`` for slow-AND-failing.
 """
 
 from __future__ import annotations
@@ -38,6 +55,11 @@ __all__ = [
     "inject",
     "fail_unless",
     "corrupt",
+    "FakeClock",
+    "Schedule",
+    "inject_schedule",
+    "flaky",
+    "latency",
 ]
 
 
@@ -119,6 +141,100 @@ def inject(point: str, exc: BaseException | None = None,
             _ACTIVE.pop(point, None)
         else:
             _ACTIVE[point] = prev
+
+
+class FakeClock:
+    """Deterministic injectable clock (seconds).  The canonical fake for
+    every ``clock=``-taking serve component; ``latency`` advances it to
+    model a slow backend without sleeping."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        if dt < 0:
+            raise ValueError(f"a monotonic clock cannot go back ({dt})")
+        self.t += dt
+
+
+class Schedule:
+    """Fail-window handler state: raises for the first ``window_evals``
+    fires, passes through after — the sustained-then-recovered failure
+    one-shot handlers cannot express.  ``fired``/``failed`` expose how
+    much of the window a scenario actually consumed."""
+
+    def __init__(self, window_evals: int, exc: BaseException | None = None):
+        if window_evals < 0:
+            raise ValueError(
+                f"window_evals must be >= 0, got {window_evals}")
+        self.window_evals = int(window_evals)
+        self.exc = exc
+        self.fired = 0
+        self.failed = 0
+
+    @property
+    def recovered(self) -> bool:
+        """Has the failure window been fully consumed?"""
+        return self.failed >= self.window_evals
+
+    def __call__(self, *args) -> None:
+        self.fired += 1
+        if self.failed < self.window_evals:
+            self.failed += 1
+            raise self.exc if self.exc is not None else InjectedFault(
+                f"injected fault {self.failed}/{self.window_evals} "
+                f"of the scheduled window (args={args!r})")
+
+
+@contextmanager
+def inject_schedule(point: str, *, window_evals: int,
+                    exc: BaseException | None = None):
+    """Arm ``point`` with a fail-``window_evals``-then-recover schedule;
+    yields the ``Schedule`` for fire/fail-count assertions."""
+    sched = Schedule(window_evals, exc)
+    with inject(point, handler=sched):
+        yield sched
+
+
+def flaky(rate: float, seed: int,
+          exc: BaseException | None = None) -> Callable:
+    """Handler factory: fail a seeded-RNG ``rate`` fraction of fires.
+    Deterministic per ``(rate, seed)`` — reruns replay the exact same
+    failure pattern, so chaos assertions can be exact."""
+    import numpy as np
+
+    if not 0.0 <= rate <= 1.0:
+        raise ValueError(f"rate must be in [0, 1], got {rate}")
+    rng = np.random.default_rng(seed)
+
+    def handler(*args):
+        if rng.random() < rate:
+            raise exc if exc is not None else InjectedFault(
+                f"injected flaky fault (rate={rate}, args={args!r})")
+
+    return handler
+
+
+def latency(clock: FakeClock, seconds: float,
+            then: Callable | None = None) -> Callable:
+    """Handler factory: each fire advances the injectable ``clock`` by
+    ``seconds`` — the slow-backend seam.  No sleep is involved: deadline
+    expiry and brownout hysteresis react to the CLOCK, so advancing it
+    is indistinguishable from the eval actually taking that long.
+    ``then`` chains another handler (e.g. a ``Schedule``) after the
+    advance for slow-AND-failing backends."""
+    if seconds < 0:
+        raise ValueError(f"latency must be >= 0, got {seconds}")
+
+    def handler(*args):
+        clock.advance(seconds)
+        if then is not None:
+            then(*args)
+
+    return handler
 
 
 def corrupt(data: bytes, offset: int, xor: int = 0x01) -> bytes:

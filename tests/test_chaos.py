@@ -1,0 +1,711 @@
+"""Scripted chaos scenarios for the serve resilience layer (ISSUE 6).
+
+Every scenario here replays DETERMINISTICALLY on the injectable fake
+clock (``faults.FakeClock``) with ``pump()``-driven serving — no worker
+thread, no wall time, no sleeps — covering the acceptance walk end to
+end: a backend failing for a scheduled window opens its (key,
+backend-family) circuit breaker within the failure threshold, the open
+breaker fast-fails NORMAL traffic (``CircuitOpenError``) and brownout
+refuses BATCH traffic at the door (``QueueFullError``) while CRITICAL
+requests bypass and complete bit-exactly, the breaker half-opens after
+the cooldown and closes on one sanctioned probe — exactly one
+open/half_open/closed transition each (no thrash) — and every delivered
+result reconstructs bit-exactly against the numpy oracle.
+
+Plus the machinery in isolation: the breaker state machine walk,
+priority eviction (lowest class first, newest first, all-or-nothing),
+brownout hysteresis on queue-depth pressure, injected LATENCY (the
+clock-advancing seam — deadline expiry under a slow backend without a
+single sleep), seeded flaky faults replaying the same pattern, and
+breaker-state lifetime across registry hot-swaps vs unregistration.
+
+The ``chaos and slow`` soak at the bottom runs the real-clock,
+3-thread flapping-window version in the serial CI leg only.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dcf_tpu import Dcf
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.errors import (
+    CircuitOpenError,
+    DeadlineExceededError,
+    QueueFullError,
+)
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.serve import DcfService, ServeConfig
+from dcf_tpu.serve.admission import AdmissionQueue, Priority, Request
+from dcf_tpu.serve.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerBoard,
+    CircuitBreaker,
+)
+from dcf_tpu.testing import faults
+from dcf_tpu.testing.faults import FakeClock
+
+pytestmark = pytest.mark.chaos
+
+NB, LAM = 2, 16
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0xC4A05)
+
+
+@pytest.fixture(scope="module")
+def ck(rng):
+    return [rng.bytes(32), rng.bytes(32)]
+
+
+@pytest.fixture(scope="module")
+def dcf(ck):
+    return Dcf(NB, LAM, ck, backend="bitsliced")
+
+
+@pytest.fixture(scope="module")
+def prg(ck):
+    return HirosePrgNp(LAM, ck)
+
+
+@pytest.fixture(scope="module")
+def bundles(dcf, rng):
+    out = {}
+    for name in ("relu-a", "relu-b"):
+        alphas = rng.integers(0, 256, (1, NB), dtype=np.uint8)
+        betas = rng.integers(0, 256, (1, LAM), dtype=np.uint8)
+        out[name] = dcf.gen(alphas, betas, rng=rng)
+    return out
+
+
+def oracle(prg, bundle, b, xs):
+    return eval_batch_np(prg, b, bundle.for_party(b), xs)
+
+
+def make_service(dcf, bundles, clock, **knobs):
+    knobs.setdefault("max_batch", 32)
+    kwargs = {} if clock is None else {"clock": clock}  # None = real
+    svc = DcfService(dcf, ServeConfig(**knobs), **kwargs)
+    for name, bundle in bundles.items():
+        svc.register_key(name, bundle)
+    return svc
+
+
+def mk_req(m=3, priority=Priority.NORMAL, key="k", enq_t=0.0):
+    return Request(key, 0, np.zeros((m, NB), dtype=np.uint8), None,
+                   enq_t, priority)
+
+
+# ------------------------------------------------- breaker state machine
+
+
+def test_breaker_state_machine_walk():
+    """The classic three-state walk on explicit fake times."""
+    br = CircuitBreaker(failures_to_open=3, cooldown_s=5.0)
+    assert br.state == CLOSED
+    br.record_failure(10.0)
+    br.record_failure(11.0)
+    br.record_success()  # success resets the consecutive count
+    br.record_failure(13.0)
+    br.record_failure(14.0)
+    assert br.state == CLOSED
+    br.record_failure(15.0)  # third consecutive -> OPEN
+    assert br.state == OPEN
+    assert not br.allow(16.0)  # cooldown not elapsed: fail fast
+    assert br.allow(16.0, critical=True)  # CRITICAL bypasses
+    br.record_failure(16.5)  # bypass failure must NOT restart cooldown
+    assert br.opened_at == 15.0
+    br.record_success()  # bypass success is not a sanctioned probe
+    assert br.state == OPEN
+    assert br.allow(20.0)  # cooldown elapsed: this caller is the probe
+    assert br.state == HALF_OPEN
+    assert not br.allow(20.1)  # one probe at a time
+    assert br.allow(20.1, critical=True)  # criticals ride along
+    br.record_failure(20.2)  # probe failed -> reopen, cooldown restarts
+    assert br.state == OPEN and br.opened_at == 20.2
+    assert br.allow(25.2)  # second probe
+    br.record_success()
+    assert br.state == CLOSED and br.failures == 0
+
+
+def test_breaker_abort_probe_releases_the_slot():
+    br = CircuitBreaker(failures_to_open=1, cooldown_s=1.0)
+    br.record_failure(0.0)
+    assert br.allow(1.0)  # the probe
+    assert not br.allow(1.0)  # slot taken
+    br.abort_probe()  # prober died without an outcome
+    assert br.allow(1.1)  # next caller can probe; breaker not wedged
+    br.abort_probe()
+
+
+def test_breaker_board_metrics_and_forget():
+    clock = FakeClock()
+    board = BreakerBoard(failures_to_open=1, cooldown_s=5.0, clock=clock)
+    board.allow("k1", "bitsliced")
+    board.allow("k2", "bitsliced")
+    board.record_failure("k1", "bitsliced")
+    board.record_failure("k2", "bitsliced")
+    assert board.any_open()
+    snap = board._metrics.snapshot()
+    assert snap["serve_breakers_open"] == 2
+    assert snap["serve_breaker_state{backend=bitsliced,key=k1}"] == 2
+    assert snap["serve_breaker_transitions_total{to=open}"] == 2
+    board.forget("k1")  # unregistration: the pairing no longer exists
+    assert board.state("k1", "bitsliced") == CLOSED
+    snap = board._metrics.snapshot()
+    assert snap["serve_breakers_open"] == 1
+    # Unregistration is not a recovery: forget must not count a
+    # to=closed transition (chaos_bench reads that counter as proof the
+    # backend healed after the fault window).
+    assert "serve_breaker_transitions_total{to=closed}" not in snap
+    assert snap["serve_breaker_transitions_total"] == 2
+    # Cardinality hygiene: the forgotten pairing's labeled series is
+    # REMOVED from the snapshot, not parked at 0 — under key churn dead
+    # series would otherwise accumulate forever.
+    assert "serve_breaker_state{backend=bitsliced,key=k1}" not in snap
+    assert snap["serve_breaker_state{backend=bitsliced,key=k2}"] == 2
+    board.forget("k2")
+    assert not board.any_open()
+    # A late in-flight outcome for a forgotten pairing (unregister raced
+    # a dispatched batch — routine under dispatch-ahead) must NOT
+    # resurrect the entry or its labeled series: under key churn record_*
+    # auto-creating would leak one board entry per churned key forever.
+    board.record_failure("k1", "bitsliced")
+    board.record_success("k2", "bitsliced")
+    assert not board.any_open()
+    snap = board._metrics.snapshot()
+    assert "serve_breaker_state{backend=bitsliced,key=k1}" not in snap
+    assert "serve_breaker_state{backend=bitsliced,key=k2}" not in snap
+    assert len(board._breakers) == 0
+
+
+def test_breaker_survives_hot_swap_cleared_by_unregister(dcf, bundles):
+    """Breaker state is (key, family) failure HISTORY: a re-register
+    hot-swap keeps it (same dying backend), unregister forgets it."""
+    clock = FakeClock()
+    svc = make_service(dcf, bundles, clock, breaker_failures=1)
+    svc.breakers.allow("relu-a", "bitsliced")  # the gate creates the
+    # entry; record_* never does (a late outcome for a forgotten
+    # pairing must not resurrect it)
+    svc.breakers.record_failure("relu-a", "bitsliced")
+    assert svc.breakers.state("relu-a", "bitsliced") == OPEN
+    svc.register_key("relu-a", bundles["relu-b"])  # hot-swap
+    assert svc.breakers.state("relu-a", "bitsliced") == OPEN
+    svc.unregister_key("relu-a")
+    assert svc.breakers.state("relu-a", "bitsliced") == CLOSED
+    assert not svc.breakers.any_open()
+
+
+# ------------------------------------------------ the acceptance walk
+
+
+def test_unregister_racing_dispatch_leaves_no_board_state(dcf, bundles,
+                                                          rng):
+    """submit -> unregister -> pump: the breaker gate runs before the
+    registry read, so allow() re-creates board state for the forgotten
+    pairing; the group-failure sweep must forget it again or the board
+    leaks one entry per churned key (the allow()-path twin of the
+    record_* resurrection guards)."""
+    clock = FakeClock()
+    svc = make_service(dcf, bundles, clock, breaker_failures=1)
+    xs = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+    fut = svc.submit("relu-a", xs)
+    svc.unregister_key("relu-a")  # forget() runs here, pre-dispatch
+    svc.pump()  # gate re-creates ('relu-a', fam); registry fails typed
+    with pytest.raises(ValueError, match="registered"):
+        fut.result(0)
+    assert all(k[0] != "relu-a" for k in svc.breakers._breakers)
+
+
+def test_scripted_window_open_shed_by_class_recover(dcf, bundles, prg,
+                                                    rng):
+    """The ISSUE 6 acceptance scenario, scripted on the fake clock.
+
+    A backend failing for a 6-eval window (spread over the failing
+    batches and their retries) opens its breaker at the third recorded
+    failure; while open, NORMAL requests fast-fail typed
+    (CircuitOpenError), BATCH submits are brownout-refused typed
+    (QueueFullError), CRITICAL requests bypass and complete BIT-EXACTLY;
+    after the cooldown one sanctioned probe closes the breaker — exactly
+    one open/half_open/closed transition each, i.e. no thrash — and
+    every delivered result reconstructs against the numpy oracle."""
+    clock = FakeClock()
+    svc = make_service(dcf, bundles, clock, retries=1,
+                       breaker_failures=3, breaker_cooldown_s=5.0,
+                       brownout_clear_s=1.0)
+    xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+    want0 = oracle(prg, bundles["relu-a"], 0, xs)
+    want1 = oracle(prg, bundles["relu-a"], 1, xs)
+
+    with faults.inject_schedule("serve.eval", window_evals=6) as sched:
+        # Failing batch 1: dispatch + retry = window evals 1, 2.
+        f1 = svc.submit("relu-a", xs)
+        svc.pump()
+        with pytest.raises(faults.InjectedFault):
+            f1.result(0)
+        assert svc.breakers.state("relu-a", "bitsliced") == CLOSED
+        # Failing batch 2: dispatch = 3rd consecutive failure -> OPEN;
+        # its retry consumes eval 4 (recorded as a no-op: open).
+        f2 = svc.submit("relu-a", xs)
+        svc.pump()
+        with pytest.raises(faults.InjectedFault):
+            f2.result(0)
+        assert svc.breakers.state("relu-a", "bitsliced") == OPEN
+
+        # NORMAL while open: fast-fail, no retry budget burned, no
+        # window eval consumed.
+        consumed = sched.fired
+        f3 = svc.submit("relu-a", xs)
+        svc.pump()
+        with pytest.raises(CircuitOpenError):
+            f3.result(0)
+        assert sched.fired == consumed  # the backend was never touched
+
+        # BATCH while open: brownout (open breaker = immediate entry)
+        # refuses at the door, typed.
+        with pytest.raises(QueueFullError, match="brownout"):
+            svc.submit("relu-a", xs, priority="batch")
+        snap = svc.metrics_snapshot()
+        assert snap["serve_brownout"] == 1
+        assert snap["serve_brownout_refusals_total"] == 1
+
+        # CRITICAL while open: bypasses the breaker, burns the last two
+        # window evals (5, 6) on its dispatch + retry, and FAILS — the
+        # backend is still inside its failure window.
+        fc1 = svc.submit("relu-a", xs, priority=Priority.CRITICAL)
+        svc.pump()
+        with pytest.raises(faults.InjectedFault):
+            fc1.result(0)
+        assert sched.recovered  # the 6-eval window is now consumed
+
+        # CRITICAL after the backend recovered but while the breaker is
+        # STILL OPEN: completes bit-exactly (the acceptance clause), and
+        # its lucky success must not flip the open breaker (no thrash).
+        fc2 = svc.submit("relu-a", xs, b=0, priority="critical")
+        fc3 = svc.submit("relu-a", xs, b=1, priority="critical")
+        svc.pump()
+        assert np.array_equal(fc2.result(0), want0)
+        assert np.array_equal(fc2.result(0) ^ fc3.result(0),
+                              want0 ^ want1)
+        assert svc.breakers.state("relu-a", "bitsliced") == OPEN
+
+        # NORMAL is still fast-failed until the cooldown elapses.
+        f4 = svc.submit("relu-a", xs)
+        svc.pump()
+        with pytest.raises(CircuitOpenError):
+            f4.result(0)
+
+        # Cooldown elapses on the injected clock: the next NORMAL batch
+        # is the sanctioned half-open probe; it succeeds and closes.
+        clock.advance(5.0)
+        f5 = svc.submit("relu-a", xs)
+        svc.pump()
+        assert np.array_equal(f5.result(0), want0)
+        assert svc.breakers.state("relu-a", "bitsliced") == CLOSED
+
+    snap = svc.metrics_snapshot()
+    # No thrash: exactly one transition per state over the whole walk.
+    assert snap["serve_breaker_transitions_total{to=open}"] == 1
+    assert snap["serve_breaker_transitions_total{to=half_open}"] == 1
+    assert snap["serve_breaker_transitions_total{to=closed}"] == 1
+    assert snap["serve_breakers_open"] == 0
+    # Shedding was lowest-class-first: CRITICAL never shed.
+    assert snap["serve_shed_by_class_total{priority=critical}"] == 0
+    assert snap["serve_shed_by_class_total{priority=batch}"] == 1
+
+    # Brownout exits after clear_s of calm (hysteresis), re-admitting
+    # BATCH traffic, which then serves bit-exactly.
+    clock.advance(0.5)
+    svc.pump()  # calm observation 1 (starts the clear window)
+    clock.advance(1.1)
+    fb = svc.submit("relu-a", xs, priority="batch")
+    svc.pump()
+    assert np.array_equal(fb.result(0), want0)
+    assert svc.metrics_snapshot()["serve_brownout"] == 0
+
+
+def test_breaker_disabled_keeps_pr4_semantics(dcf, bundles, rng):
+    """breaker_failures=0 disables the gate entirely: every batch
+    dispatches (and burns retries) no matter how many failures."""
+    clock = FakeClock()
+    svc = make_service(dcf, bundles, clock, retries=0,
+                       breaker_failures=0)
+    xs = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+    with faults.inject("serve.eval"):
+        for _ in range(5):
+            f = svc.submit("relu-a", xs)
+            svc.pump()
+            with pytest.raises(faults.InjectedFault):
+                f.result(0)
+    snap = svc.metrics_snapshot()
+    assert snap["serve_breaker_fast_fails_total"] == 0
+    assert snap.get("serve_breaker_transitions_total", 0) == 0
+
+
+# ------------------------------------------------- priority admission
+
+
+def test_eviction_lowest_class_first_newest_first():
+    q = AdmissionQueue(10)
+    b_old = mk_req(4, Priority.BATCH, enq_t=1.0)
+    b_new = mk_req(3, Priority.BATCH, enq_t=2.0)
+    n1 = mk_req(3, Priority.NORMAL, enq_t=3.0)
+    for r in (b_old, b_new, n1):
+        q.put(r)
+    assert q.points == 10
+
+    # CRITICAL(5) needs 5 points: BATCH evicted newest-first (b_new
+    # first, then b_old); NORMAL untouched because the two BATCH
+    # evictions already make room.
+    c1 = mk_req(5, Priority.CRITICAL, enq_t=4.0)
+    q.put(c1)
+    with pytest.raises(QueueFullError, match="evicted"):
+        b_new.future.result(0)
+    with pytest.raises(QueueFullError, match="evicted"):
+        b_old.future.result(0)
+    assert not n1.future.done()
+    assert q.points == 8
+
+    # All-or-nothing: CRITICAL(8) would need 6 more points but only
+    # NORMAL(3) is evictable -> the submit sheds, nobody is evicted.
+    with pytest.raises(QueueFullError, match="full"):
+        q.put(mk_req(8, Priority.CRITICAL, enq_t=5.0))
+    assert not n1.future.done()
+    assert q.points == 8
+
+    # NORMAL cannot evict NORMAL (strictly-lower-class only).
+    with pytest.raises(QueueFullError, match="full"):
+        q.put(mk_req(6, Priority.NORMAL, enq_t=6.0))
+    assert not n1.future.done()
+
+    snap = q._metrics.snapshot()
+    assert snap["serve_queue_evicted_by_class_total{priority=batch}"] == 2
+    assert snap["serve_queue_evicted_by_class_total{priority=normal}"] == 0
+    assert snap["serve_queue_evicted_total"] == 2
+    # Evictions count as sheds (delivered late) in the same totals.
+    assert snap["serve_shed_by_class_total{priority=batch}"] == 2
+
+
+def test_dispatch_order_stays_fifo_across_classes():
+    """Classes decide who is SHED, never who jumps the queue."""
+    q = AdmissionQueue(100)
+    b = mk_req(2, Priority.BATCH, enq_t=1.0)
+    c = mk_req(2, Priority.CRITICAL, enq_t=2.0)
+    q.put(b)
+    q.put(c)
+    assert q.take_group(100) == [b, c]  # FIFO, not priority order
+
+
+def test_brownout_hysteresis_on_queue_depth(dcf, bundles, prg, rng):
+    """Queue-depth pressure must HOLD for brownout_after_s before
+    brownout enters (one coalescing burst is not an overload), and
+    clear_s of calm must pass before it exits.
+
+    The pressure check reads the queue BEFORE the submit's own points
+    are admitted, so pressure starts at the first submit that OBSERVES
+    a loaded queue."""
+    clock = FakeClock()
+    svc = make_service(dcf, bundles, clock, breaker_failures=0,
+                       max_queued_points=20, brownout_queue_fraction=0.5,
+                       brownout_after_s=1.0, brownout_clear_s=2.0)
+    xs8 = rng.integers(0, 256, (8, NB), dtype=np.uint8)
+    xs2 = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+    svc.submit("relu-a", xs8)
+    svc.submit("relu-a", xs2)  # observes 8/20 < fraction
+    svc.submit("relu-a", xs2)  # observes 10/20: pressure clock starts
+    assert svc.metrics_snapshot()["serve_brownout"] == 0
+    clock.advance(0.5)
+    svc.submit("relu-a", xs2)  # pressure held 0.5s < after_s: not yet
+    assert svc.metrics_snapshot()["serve_brownout"] == 0
+    clock.advance(0.6)
+    svc.submit("relu-a", xs2)  # held 1.1s >= after_s: brownout
+    assert svc.metrics_snapshot()["serve_brownout"] == 1
+    with pytest.raises(QueueFullError, match="brownout"):
+        svc.submit("relu-a", xs2, priority="batch")
+    # NORMAL and CRITICAL are still admitted under brownout.
+    svc.submit("relu-a", xs2, priority="critical")
+    svc.pump()  # drains the queue: pressure gone, calm starts
+    assert svc.metrics_snapshot()["serve_brownout"] == 1  # not yet
+    clock.advance(1.0)
+    svc.pump()  # calm 1.0s < clear_s
+    assert svc.metrics_snapshot()["serve_brownout"] == 1
+    clock.advance(1.5)
+    svc.pump()  # calm 2.5s >= clear_s: exit
+    assert svc.metrics_snapshot()["serve_brownout"] == 0
+    fb = svc.submit("relu-a", xs2, priority="batch")
+    svc.pump()
+    assert np.array_equal(fb.result(0),
+                          oracle(prg, bundles["relu-a"], 0, xs2))
+
+
+def test_tiny_queue_bound_does_not_latch_brownout(dcf, bundles, rng):
+    """A small max_queued_points must not truncate the brownout depth
+    threshold to 0 — an EMPTY queue satisfies ``points >= 0``, so an
+    idle service would enter brownout after brownout_after_s and never
+    exit, refusing every BATCH submit forever."""
+    clock = FakeClock()
+    svc = make_service(dcf, bundles, clock, breaker_failures=0,
+                       max_queued_points=1, brownout_queue_fraction=0.75,
+                       brownout_after_s=0.5, brownout_clear_s=1.0)
+    xs1 = rng.integers(0, 256, (1, NB), dtype=np.uint8)
+    svc.submit("relu-a", xs1)
+    svc.pump()  # empty queue observed; an idle tick, not pressure
+    clock.advance(1.0)  # > brownout_after_s of pure idleness
+    svc.pump()
+    assert svc.metrics_snapshot()["serve_brownout"] == 0
+    fb = svc.submit("relu-a", xs1, priority="batch")  # still admitted
+    svc.pump()
+    fb.result(0)
+    with pytest.raises(ValueError, match="max_queued_points"):
+        ServeConfig(max_queued_points=0)
+
+
+def test_stale_open_breaker_does_not_latch_brownout(dcf, bundles, prg,
+                                                    rng):
+    """An OPEN breaker whose cooldown has elapsed unprobed — e.g. its
+    backend family was demoted away from, so no traffic will ever route
+    there to probe it — must stop counting as brownout pressure: open
+    pressure means *actively failing*, not *historically failed*.
+    Without this, one pallas failure before a demotion to bitsliced
+    would refuse BATCH traffic forever on a healthy service."""
+    clock = FakeClock()
+    svc = make_service(dcf, bundles, clock, breaker_failures=1,
+                       breaker_cooldown_s=5.0, brownout_clear_s=1.0)
+    # A failure recorded against a family the facade no longer selects:
+    # after this gate-then-outcome pair nothing will ever call allow()
+    # for it again, so it can never half-open.
+    svc.breakers.allow("relu-a", "pallas")
+    svc.breakers.record_failure("relu-a", "pallas")
+    xs = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+    with pytest.raises(QueueFullError, match="brownout"):
+        svc.submit("relu-a", xs, priority="batch")  # inside cooldown
+    clock.advance(5.5)  # cooldown elapsed; the breaker is probe-ready
+    svc.pump()  # pressure gone: calm starts
+    clock.advance(1.1)  # > brownout_clear_s
+    fb = svc.submit("relu-a", xs, priority="batch")
+    svc.pump()
+    assert np.array_equal(fb.result(0),
+                          oracle(prg, bundles["relu-a"], 0, xs))
+    assert svc.metrics_snapshot()["serve_brownout"] == 0
+    # The stale breaker keeps its state (history is preserved; only
+    # unregister forgets) — it just no longer holds the brownout gate.
+    assert svc.breakers.state("relu-a", "pallas") == OPEN
+
+
+def test_loadgen_priority_mix_rejects_negative_weights():
+    """A negative weight must fail loudly at the loadgen edge — inside
+    the client threads it would kill every one of them at rng.choice
+    and silently zero the offered load."""
+    from dcf_tpu.serve.loadgen import closed_loop
+
+    for mix in ({"batch": -0.2, "normal": 1.0}, {"batch": 0.0}):
+        with pytest.raises(ValueError, match=">= 0 and sum > 0"):
+            closed_loop(None, [], duration_s=0.0, concurrency=0,
+                        min_points=1, max_points=1, priority_mix=mix)
+
+
+def test_loadgen_priority_mix_rejects_unknown_class():
+    """A typo'd class name must fail loudly at the loadgen edge too —
+    inside the clients it would raise from parse_priority on every
+    submit, which the broadened client except counts as requests_failed
+    (a 100%-failed run with no loud error)."""
+    from dcf_tpu.serve.loadgen import closed_loop
+
+    with pytest.raises(ValueError, match="priority"):
+        closed_loop(None, [], duration_s=0.0, concurrency=0,
+                    min_points=1, max_points=1,
+                    priority_mix={"critcal": 1.0})
+
+
+# ------------------------------------------------- injected latency
+
+
+def test_latency_seam_expires_deadlines_without_sleeping(dcf, bundles,
+                                                         prg, rng):
+    """A slow backend modeled by ADVANCING the fake clock at the eval
+    seam: the first group's eval pushes the clock past the second
+    queued group's deadline, which then expires typed at the next batch
+    formation — zero wall-clock sleeps anywhere."""
+    clock = FakeClock()
+    svc = make_service(dcf, bundles, clock, breaker_failures=0)
+    xs = rng.integers(0, 256, (3, NB), dtype=np.uint8)
+    with faults.inject("serve.eval",
+                       handler=faults.latency(clock, 0.2)):
+        f_slow = svc.submit("relu-a", xs)  # group 1: eval advances 0.2s
+        f_dead = svc.submit("relu-b", xs, deadline_ms=100.0)  # group 2
+        svc.pump()
+    assert np.array_equal(f_slow.result(0),
+                          oracle(prg, bundles["relu-a"], 0, xs))
+    with pytest.raises(DeadlineExceededError):
+        f_dead.result(0)
+    snap = svc.metrics_snapshot()
+    assert snap["serve_deadline_expired_total"] == 1
+    # The latency showed up in the eval histogram off the same clock.
+    assert snap["serve_eval_s_sum"] >= 0.2
+
+
+def test_latency_then_chains_slow_and_failing(dcf, bundles, rng):
+    clock = FakeClock()
+    svc = make_service(dcf, bundles, clock, retries=0,
+                       breaker_failures=0)
+    xs = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+    sched = faults.Schedule(window_evals=1)
+    with faults.inject("serve.eval",
+                       handler=faults.latency(clock, 0.5, then=sched)):
+        t0 = clock()
+        f = svc.submit("relu-a", xs)
+        svc.pump()
+        with pytest.raises(faults.InjectedFault):
+            f.result(0)
+        assert clock() - t0 >= 0.5  # slow AND failing
+
+
+# ------------------------------------------------- seeded flaky faults
+
+
+def test_flaky_fault_pattern_is_seed_deterministic(dcf, bundles, prg,
+                                                   rng):
+    """Two runs with the same (rate, seed) replay the exact same
+    ok/fail pattern; every delivered success is bit-exact."""
+    xs = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+    want = oracle(prg, bundles["relu-a"], 0, xs)
+
+    def run():
+        clock = FakeClock()
+        svc = make_service(dcf, bundles, clock, retries=0,
+                           breaker_failures=0)
+        pattern = []
+        with faults.inject("serve.eval",
+                           handler=faults.flaky(0.5, seed=7)):
+            for _ in range(12):
+                f = svc.submit("relu-a", xs)
+                svc.pump()
+                try:
+                    y = f.result(0)
+                except faults.InjectedFault:
+                    pattern.append(False)
+                else:
+                    assert np.array_equal(y, want)
+                    pattern.append(True)
+        return pattern
+
+    p1, p2 = run(), run()
+    assert p1 == p2
+    assert True in p1 and False in p1  # rate=0.5 actually mixes
+
+
+# ----------------------------------------------------- the chaos soak
+
+
+@pytest.mark.slow
+def test_soak_flapping_windows_threaded_bit_exact(dcf, bundles, prg,
+                                                  rng):
+    """Serial-leg soak: 3 client threads of closed-loop load while the
+    ``serve.eval`` seam flaps — fail-6 / pass-18, repeating — under a
+    short real-clock breaker cooldown.  The breaker must complete at
+    least one full open -> half_open -> closed walk per direction, the
+    board must end closed (recovery, not wedge), and EVERY delivered
+    result must be bit-exact against the numpy oracle."""
+    svc = make_service(dcf, bundles, None, retries=1, breaker_failures=3,
+                       breaker_cooldown_s=0.05, max_batch=64)
+    counter = {"n": 0}  # fired from the single worker thread only
+
+    def flapping(*_args):
+        counter["n"] += 1
+        if counter["n"] % 24 < 6:
+            raise faults.InjectedFault("flap window")
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    delivered = []  # (name, xs, y) for post-hoc oracle verification
+    failures = {"typed": 0, "injected": 0, "other": 0}
+
+    def client(i):
+        crng = np.random.default_rng(1000 + i)
+        names = sorted(bundles)
+        prio = ["critical", "normal", "batch"]
+        while not stop.is_set():
+            name = names[int(crng.integers(0, len(names)))]
+            xs = crng.integers(0, 256, (int(crng.integers(1, 9)), NB),
+                               dtype=np.uint8)
+            try:
+                fut = svc.submit(name, xs, priority=prio[i % 3])
+                y = fut.result(30)
+            except (QueueFullError, CircuitOpenError):
+                with lock:
+                    failures["typed"] += 1
+                continue
+            except faults.InjectedFault:
+                with lock:
+                    failures["injected"] += 1
+                continue
+            except Exception:  # noqa: BLE001 — counted and asserted 0
+                with lock:
+                    failures["other"] += 1
+                continue
+            with lock:
+                delivered.append((name, xs, y))
+
+    def flapped_enough():
+        snap = svc.metrics_snapshot()
+        with lock:
+            n = len(delivered)
+        return (snap.get("serve_breaker_transitions_total{to=open}", 0)
+                >= 1
+                and snap.get(
+                    "serve_breaker_transitions_total{to=closed}", 0) >= 1
+                and n > 50)
+
+    with svc:
+        # Warm the padded-shape ladder BEFORE arming faults: an XLA
+        # compile inside the flap window would starve the batch count
+        # (same rule as test_serve_soak and chaos_bench).
+        m = 1
+        while m <= 64:
+            svc.evaluate("relu-a",
+                         rng.integers(0, 256, (m, NB), dtype=np.uint8),
+                         timeout=180)
+            m *= 2
+        with faults.inject("serve.eval", handler=flapping):
+            threads = [threading.Thread(target=client, args=(i,),
+                                        daemon=True) for i in range(3)]
+            for t in threads:
+                t.start()
+            # Soak in bounded slices until the breaker really completed
+            # a full flap under load (contended CI hosts fit few batches
+            # per second — keep going, bounded).
+            for _ in range(12):
+                stop.wait(2.0)
+                if flapped_enough():
+                    break
+            stop.set()
+            for t in threads:
+                t.join(30)
+                assert not t.is_alive()
+        # Seam clean again: drive each key until any mid-flap open
+        # breaker has cooled down, probed, and closed (bounded).
+        xs_post = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+        for name in sorted(bundles):
+            for _ in range(60):
+                try:
+                    svc.evaluate(name, xs_post, timeout=60)
+                    break
+                except CircuitOpenError:
+                    threading.Event().wait(0.02)  # let the cooldown run
+            else:
+                pytest.fail(f"breaker for {name} never recovered")
+
+    snap = svc.metrics_snapshot()
+    assert snap["serve_breaker_transitions_total{to=open}"] >= 1
+    assert snap["serve_breaker_transitions_total{to=closed}"] >= 1
+    assert not svc.breakers.any_open()  # recovered, not wedged
+    assert snap["serve_shed_by_class_total{priority=critical}"] == 0
+    assert failures["other"] == 0, "non-chaos failures leaked to clients"
+    assert len(delivered) > 50, "soak barely served anything"
+    for name, xs, y in delivered:
+        assert np.array_equal(y, oracle(prg, bundles[name], 0, xs)), name

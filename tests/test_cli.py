@@ -144,3 +144,73 @@ def test_cli_mic_bench_rejects_non_facade_backend():
 
     with pytest.raises(SystemExit, match="mic_bench"):
         cli.main(["mic_bench", "--backend=cpu"])
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_cli_chaos_bench_smoke(capsys):
+    """chaos_bench end to end on the numpy backend: the declarative
+    fail-N-then-recover schedule runs to recovery, the harness's own
+    resilience assertions hold (it raises SystemExit otherwise — the
+    CI-soak contract), and the JSONL line records the breaker walk and
+    the class-split outcome."""
+    recs = run_cli(
+        capsys,
+        ["chaos_bench", "--backend=numpy", "--duration=3",
+         "--max-batch=64", "--concurrency=3", "--fault-window=8",
+         "--breaker-failures=2", "--breaker-cooldown=0.05"],
+    )
+    assert recs[0]["bench"] == "chaos_bench"
+    assert recs[0]["assertions_failed"] == []
+    assert recs[0]["fault_evals_failed"] == 8
+    assert recs[0]["breaker_opens"] >= 1
+    assert recs[0]["breaker_closes"] >= 1
+    assert recs[0]["by_class"]["critical"].get("shed", 0) == 0
+
+
+def test_cli_chaos_bench_rejects_non_facade_backend():
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="chaos_bench"):
+        cli.main(["chaos_bench", "--backend=cpu"])
+
+
+def test_cli_chaos_bench_validates_range_and_window_fast():
+    """A bad request-size range or fault window dies loudly BEFORE the
+    bundle gen / warmup ladder spend real time — a min_req > max_req
+    range would otherwise kill every loadgen client at rng.integers
+    (outside the client's try) and report 'breaker never opened'."""
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="request-size range"):
+        cli.main(["chaos_bench", "--backend=bitsliced", "--max-batch=64",
+                  "--min-req-points=200"])
+    with pytest.raises(SystemExit, match="fault-window"):
+        cli.main(["chaos_bench", "--backend=bitsliced",
+                  "--fault-window=0"])
+
+
+def test_cli_parse_priority_mix_validation():
+    """Malformed --priority-mix entries fail loudly naming the flag and
+    the expected shape — not with a bare float('') traceback — and
+    duplicates are rejected instead of silently overwritten."""
+    from dcf_tpu.cli import _parse_priority_mix
+
+    assert _parse_priority_mix("critical=0.2,batch=0.8") == {
+        "critical": 0.2, "batch": 0.8}
+    for bad in ("critical,normal=1", "critical=", "critical=x",
+                "urgent=1"):
+        with pytest.raises(SystemExit, match="priority-mix"):
+            _parse_priority_mix(bad)
+    with pytest.raises(SystemExit, match="duplicate"):
+        _parse_priority_mix("batch=0.2,batch=0.3")
+    # Negative / NaN / inf weights and an all-zero mix must die HERE,
+    # before the warmup ladder — NaN in particular compares false to 0
+    # and would otherwise reach rng.choice inside every client thread,
+    # silently zeroing the offered load.
+    for bad in ("critical=-1,normal=2", "critical=nan,normal=1",
+                "critical=inf"):
+        with pytest.raises(SystemExit, match="finite non-negative"):
+            _parse_priority_mix(bad)
+    with pytest.raises(SystemExit, match="sum to zero"):
+        _parse_priority_mix("critical=0,normal=0")

@@ -1,0 +1,124 @@
+"""Seeded byte-flip fuzz over the DCFK wire formats (ISSUE 6 satellite).
+
+~200 random single-byte corruptions per format (offset and flipped bits
+drawn from a seeded RNG, so a failure names a reproducible frame):
+every mutation of a valid v2 frame fed to ``KeyBundle.from_bytes`` and
+every mutation of a valid v3 protocol frame fed to
+``ProtocolBundle.from_bytes`` must raise the typed ``KeyFormatError`` —
+never a bare exception (numpy buffer errors, struct errors, enum
+lookups), and never a silent success with wrong key material or wrong
+combine masks.
+
+Why every flip is catchable: the CRC32 trailer covers the header AND
+payload, so any payload/header flip that survives field validation dies
+at the CRC check; flips of the version field move the frame to a reader
+path whose size arithmetic no longer matches (v1 has no trailer, v3 has
+a wider header), which the strict exact-size section decode rejects.
+The fuzz pins exactly that reasoning against regressions in either
+reader (they share ``keys._decode_sections`` by design).
+"""
+
+import numpy as np
+import pytest
+
+from dcf_tpu.errors import KeyFormatError
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.native import NativeDcf
+from dcf_tpu.protocols import ProtocolBundle
+from dcf_tpu.protocols.keygen import gen_interval_bundle
+from dcf_tpu.spec import Bound
+from dcf_tpu.testing import faults
+
+pytestmark = pytest.mark.faults
+
+NB, LAM, N_FLIPS = 2, 16, 200
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0xF122)
+
+
+@pytest.fixture(scope="module")
+def native(rng):
+    return NativeDcf(LAM, [rng.bytes(32), rng.bytes(32)])
+
+
+@pytest.fixture(scope="module")
+def v2_frame(native, rng):
+    from dcf_tpu.gen import random_s0s
+
+    alphas = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+    betas = rng.integers(0, 256, (2, LAM), dtype=np.uint8)
+    bundle = native.gen_batch(alphas, betas, random_s0s(2, LAM, rng),
+                              Bound.LT_BETA)
+    return bundle.to_bytes()
+
+
+@pytest.fixture(scope="module")
+def v3_frame(native, rng):
+    from dcf_tpu.gen import random_s0s
+
+    def gen_fn(alphas, betas, bound):
+        return native.gen_batch(
+            alphas, betas, random_s0s(alphas.shape[0], LAM, rng), bound)
+
+    betas = rng.integers(0, 256, (2, LAM), dtype=np.uint8)
+    pb = gen_interval_bundle(gen_fn, [(10, 60), (100, 200)], betas, NB)
+    return pb.to_bytes()
+
+
+def _fuzz(frame: bytes, decode, rng, n_flips: int) -> None:
+    # Clean frame decodes (the fuzz must mutate a VALID baseline).
+    decode(frame)
+    offsets = rng.integers(0, len(frame), n_flips)
+    xors = rng.integers(1, 256, n_flips)
+    for i, (off, xor) in enumerate(zip(offsets, xors)):
+        mutated = faults.corrupt(frame, int(off), int(xor))
+        try:
+            decode(mutated)
+        except KeyFormatError:
+            continue  # the contract: typed, field-naming rejection
+        except BaseException as e:  # noqa: BLE001 — the fuzz's point
+            pytest.fail(
+                f"flip #{i} (offset {off}, xor {xor:#04x}) escaped the "
+                f"typed-error contract: {type(e).__name__}: {e}")
+        pytest.fail(
+            f"flip #{i} (offset {off}, xor {xor:#04x}) decoded "
+            "SILENTLY — corrupt key material accepted")
+
+
+def test_v2_byte_flips_all_rejected_typed(v2_frame, rng):
+    _fuzz(v2_frame, KeyBundle.from_bytes, rng, N_FLIPS)
+
+
+def test_v3_byte_flips_all_rejected_typed(v3_frame, rng):
+    _fuzz(v3_frame, ProtocolBundle.from_bytes, rng, N_FLIPS)
+
+
+def test_v3_frame_fed_to_plain_reader_rejected(v3_frame, rng):
+    """Cross-reader flips: a (possibly corrupted) protocol frame must
+    never decode as a plain bundle — dropping the combine masks would
+    silently break the public correction."""
+    with pytest.raises(KeyFormatError, match="protocol section"):
+        KeyBundle.from_bytes(v3_frame)
+    for _ in range(40):
+        mutated = faults.corrupt(v3_frame,
+                                 int(rng.integers(0, len(v3_frame))),
+                                 int(rng.integers(1, 256)))
+        with pytest.raises(KeyFormatError):
+            KeyBundle.from_bytes(mutated)
+
+
+def test_truncations_and_extensions_rejected_typed(v2_frame, v3_frame,
+                                                   rng):
+    """Length mutations ride along: every truncation point and a tail
+    extension must fail typed too (the exact-size discipline)."""
+    for frame, decode in ((v2_frame, KeyBundle.from_bytes),
+                          (v3_frame, ProtocolBundle.from_bytes)):
+        for cut in sorted({int(c) for c in
+                           rng.integers(0, len(frame), 25)}):
+            with pytest.raises(KeyFormatError):
+                decode(frame[:cut])
+        with pytest.raises(KeyFormatError):
+            decode(frame + b"\x00")

@@ -31,23 +31,11 @@ from dcf_tpu.ops.prg import HirosePrgNp
 from dcf_tpu.serve import DcfService, ServeConfig
 from dcf_tpu.serve.registry import device_image_bytes
 from dcf_tpu.testing import faults
+from dcf_tpu.testing.faults import FakeClock
 
 pytestmark = pytest.mark.serve
 
 NB, LAM = 2, 16
-
-
-class FakeClock:
-    """Deterministic injectable clock (seconds)."""
-
-    def __init__(self):
-        self.t = 1000.0
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, dt: float) -> None:
-        self.t += dt
 
 
 @pytest.fixture(scope="module")
@@ -260,6 +248,51 @@ def test_close_without_drain_fails_queued(dcf, bundles, rng):
     svc.close(drain=False)
     with pytest.raises(BackendUnavailableError):
         fut.result(1)
+
+
+def test_close_no_drain_during_inflight_sync_retry(dcf, bundles, rng):
+    """ISSUE 6 regression: ``close(drain=False)`` while the worker is
+    MID ``_retry_sync`` must resolve every pending future typed and
+    promptly — queued requests with ``BackendUnavailableError`` the
+    moment admission closes (not after the retry unblocks), the
+    in-flight group with the retry's final error once its bounded loop
+    ends — and the close itself must not hang (the join is bounded by
+    the retry budget)."""
+    import threading
+
+    in_retry = threading.Event()
+    release = threading.Event()
+    fires = {"n": 0}
+
+    def handler(*_args):
+        fires["n"] += 1
+        if fires["n"] == 1:  # dispatch attempt of the in-flight group
+            raise BackendUnavailableError("injected: dispatch dies")
+        in_retry.set()  # the sync retry is now in flight...
+        assert release.wait(60), "close() never released the retry"
+        raise BackendUnavailableError("injected: retry dies too")
+
+    svc = make_service(dcf, bundles, retries=1, breaker_failures=0,
+                       max_delay_ms=0.0)
+    xs = rng.integers(0, 256, (3, NB), dtype=np.uint8)
+    with faults.inject("serve.eval", handler=handler):
+        svc.start()
+        f_inflight = svc.submit("relu-a", xs)
+        assert in_retry.wait(60)  # worker holds the group, mid-retry
+        f_queued = svc.submit("relu-b", xs)  # stays queued behind it
+        closer = threading.Thread(
+            target=lambda: svc.close(drain=False), daemon=True)
+        closer.start()
+        # The queued future resolves typed WHILE the retry is still
+        # blocked — close must not gate fail_all on the worker join.
+        with pytest.raises(BackendUnavailableError, match="closed"):
+            f_queued.result(30)
+        assert closer.is_alive()  # still joining the blocked worker
+        release.set()
+        closer.join(60)
+        assert not closer.is_alive(), "close() hung on the worker join"
+    with pytest.raises(BackendUnavailableError, match="retry dies"):
+        f_inflight.result(30)
 
 
 # ----------------------------------------------------- residency / cache

@@ -36,6 +36,7 @@ DCF_ERRORS = frozenset({
     "NativeBuildError",
     "QueueFullError",
     "DeadlineExceededError",
+    "CircuitOpenError",
 })
 _ALWAYS_OK = DCF_ERRORS | {"NotImplementedError"}
 _MARKED_OK = frozenset({"ValueError", "TypeError"})
