@@ -152,9 +152,15 @@ service: named long-lived key bundles, micro-batched ragged requests,
 LRU device residency, admission control, metrics.  The load-bearing
 knobs are ``max_batch`` (throughput / compiled-shape universe),
 ``max_delay_ms`` (coalescing latency), ``device_bytes_budget`` (hot key
-working set), ``max_queued_points`` (shed point) and ``retries``
-(fail-over persistence); full semantics in ``dcf_tpu/serve/service.py``
-and the README "Serving" section.
+working set — shared by staged images and cached frontiers),
+``frontier_cache`` (ISSUE 7, default on: prefix-family frontier
+expansions live in a serve-resident LRU keyed (key_id, generation,
+party, k) and survive residency churn, so a re-staged hot key skips
+the 2^k-node top-k expansion; ``serve_frontier_hits_total`` /
+``_misses_total`` in the snapshot; False = the pre-cache
+instance-store behavior), ``max_queued_points`` (shed point) and
+``retries`` (fail-over persistence); full semantics in
+``dcf_tpu/serve/service.py`` and the README "Serving" section.
 
 Mixed-mode protocols (``dcf_tpu.protocols``)
 --------------------------------------------

@@ -1,0 +1,81 @@
+"""Frontier storage contract shared by the prefix-family backends.
+
+Both prefix-shared evaluators (``backends.pallas_prefix`` at lam=16 and
+the hybrid ``backends.large_lambda`` at lam >= 48, plus their sharded
+subclasses) materialize a per-(key image, party) frontier: the top-k
+walk levels expanded once as gather tables so each eval walks only the
+remaining n-k levels.  The frontier is *key material* — a pure function
+of (bundle, party, k), xs-independent — so where it is CACHED is a
+policy question, not a correctness one:
+
+* **instance store** (default): the frontier lives in the backend
+  instance's ``_frontier`` dict and dies with it.  Right for the bare
+  staged API, where one instance holds one bundle for its lifetime.
+* **frontier provider** (the serving layer): ``frontier_provider`` is
+  bound post-``put_bundle`` to an object with a single method
+  ``get(party, k, build)``; the backend then consults the provider on
+  every ``_frontier_tables`` call and never touches its local store.
+  ``dcf_tpu.serve.frontier_cache.FrontierCache`` binds one provider per
+  (key_id, registration generation), so the expanded frontier survives
+  residency eviction and is shared across re-staged instances of the
+  same key — the serve-resident amortization of the narrow-walk floor.
+
+``invalidate_frontier`` is the ONE invalidation hook: re-staging a new
+bundle onto an instance (``put_bundle``) and the serve registry evicting
+the owning entry both route through it, clearing the local store AND
+unbinding the provider (a provider bound to the previous key image must
+never serve the next one).  Before this hook existed the two paths were
+separate seams: ``put_bundle`` cleared ``_frontier`` but a registry
+eviction left the dropped instance's frontier bytes device-resident (an
+in-flight batch closure pins the instance) and uncounted by any budget.
+
+Subclass contract: provide ``_k()`` (effective prefix depth for the
+held bundle) and ``_build_frontier_tables(b)`` (the uncached build,
+returning whatever table object the backend's eval path consumes —
+sharded subclasses return mesh-placed tables so the cache holds the
+placed copy).
+"""
+
+from __future__ import annotations
+
+__all__ = ["FrontierConsumerMixin"]
+
+
+class FrontierConsumerMixin:
+    """Get-or-build frontier tables through the instance store or a
+    bound provider (see module docstring)."""
+
+    #: Bound by the owner of the key-id namespace (the serve registry);
+    #: None = the instance-local store.  Must expose
+    #: ``get(party, k, build)`` returning the (possibly cached) tables.
+    frontier_provider = None
+
+    def invalidate_frontier(self) -> None:
+        """The ONE frontier-invalidation hook: drop the instance store
+        and unbind the provider.  Called by ``put_bundle`` (new key
+        image) and by the serve registry when it evicts the owning
+        entry (hot-swap / unregister / failure eviction)."""
+        self._frontier: dict = {}
+        self.frontier_provider = None
+
+    def ensure_frontier(self, b: int) -> None:
+        """Build (or cache-fetch) party ``b``'s frontier now — the serve
+        registry calls this at stage time so the expansion runs off the
+        eval clock of later batches."""
+        self._frontier_tables(int(b))
+
+    def _frontier_tables(self, b: int):
+        """Party ``b``'s frontier tables, cached in the bound provider
+        (keyed (key_id, generation, party, k) there) or the instance
+        store (keyed by party — a new key image resets it through
+        ``invalidate_frontier``)."""
+        b = int(b)
+        prov = self.frontier_provider
+        if prov is not None:
+            return prov.get(b, self._k(),
+                            lambda: self._build_frontier_tables(b))
+        tbl = self._frontier.get(b)
+        if tbl is None:
+            tbl = self._build_frontier_tables(b)
+            self._frontier[b] = tbl
+        return tbl

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dcf_tpu.errors import ShapeError, StaleStateError
+from dcf_tpu.backends.frontier import FrontierConsumerMixin
 from dcf_tpu.backends.jax_bitsliced import (
     _pack_lanes_dev,
     _planes_to_bytes_dev,
@@ -397,7 +398,7 @@ _hybrid_prefix_eval = partial(
     hybrid_prefix_gather_walk)
 
 
-class LargeLambdaBackend:
+class LargeLambdaBackend(FrontierConsumerMixin):
     """Device evaluator for lam >= 48 via the narrow-walk + affine split.
 
     Multi-key: the narrow Pallas walk grids over keys and the GF(2)
@@ -483,7 +484,7 @@ class LargeLambdaBackend:
             from dcf_tpu.backends.pallas_prefix import _PERM_I32
 
             self._perm_i32 = jnp.asarray(_PERM_I32)
-        self._frontier: dict = {}
+        self.invalidate_frontier()
         self._dev = None
 
     def _k(self) -> int:
@@ -512,7 +513,7 @@ class LargeLambdaBackend:
         # put_bundle (staged lazily on first eval) and never reused across
         # parties.
         self._bundle = bundle
-        self._frontier = {}  # new key image invalidates cached frontiers
+        self.invalidate_frontier()  # new key image, one hook (backends.frontier)
 
         if self.narrow == "pallas":
             from dcf_tpu.utils.bits import bitmajor_plane_masks
@@ -574,15 +575,14 @@ class LargeLambdaBackend:
         eager pallas_call cannot consume mesh-sharded operands)."""
         return self._dev
 
-    def _frontier_tables(self, b: int):
+    def _build_frontier_tables(self, b: int):
         """The party-b frontier: (state rows int32 [K, 2^k, 16], per-node
         trajectory words uint32 [K, 2^k]).  Built once per (bundle,
         party) by walking all 2^k node prefixes k levels on device
         (``ops.pallas_hybrid_prefix.narrow_state_walk_pallas``) and
-        cached with the key image — key material, off the eval clock."""
-        tbl = self._frontier.get(int(b))
-        if tbl is not None:
-            return tbl
+        cached with the key image (instance store or the serve-resident
+        frontier cache — ``backends.frontier``); key material, off the
+        eval clock."""
         from dcf_tpu.backends.pallas_backend import _stage_xs
         from dcf_tpu.backends.pallas_prefix import _planes_to_rows
         from dcf_tpu.ops.pallas_hybrid_prefix import narrow_state_walk_pallas
@@ -602,9 +602,7 @@ class LargeLambdaBackend:
             [jnp.stack([_planes_to_rows(p[key], self._perm_i32)
                         for key in range(k_num)])
              for p in (sa, sb, va, vb)], axis=2)  # [K, 2^k, 16]
-        tbl = (state_tbl, _traj_words(traj))
-        self._frontier[int(b)] = tbl
-        return tbl
+        return state_tbl, _traj_words(traj)
 
     def _wide_staged(self):
         if self._wide is None:

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dcf_tpu.backends.frontier import FrontierConsumerMixin
 from dcf_tpu.backends.fulldomain import tree_expand_np
 from dcf_tpu.backends.pallas_backend import PallasBackend, _stage_xs
 from dcf_tpu.errors import DcfError, ShapeError, StaleStateError
@@ -131,7 +132,7 @@ _eval_prefix_staged = partial(
                               "frontier_size"))(gather_and_walk)
 
 
-class PrefixPallasBackend(PallasBackend):
+class PrefixPallasBackend(FrontierConsumerMixin, PallasBackend):
     """Prefix-shared DCF evaluator (lam = 16, shared points).
 
     ``prefix_levels`` picks k (clamped to n-8 and the measured gather
@@ -162,7 +163,7 @@ class PrefixPallasBackend(PallasBackend):
             warnings.simplefilter("ignore", ReferenceContractWarning)
             self._prg = HirosePrgNp(lam, cipher_keys)
         self._perm_i32 = jnp.asarray(_PERM_I32)
-        self._frontier: dict = {}
+        self.invalidate_frontier()
         self._bundle_host = None
 
     def _k(self) -> int:
@@ -185,7 +186,7 @@ class PrefixPallasBackend(PallasBackend):
                 f"domain of {8 * bundle.n_bytes} levels is too shallow "
                 "for prefix sharing; use PallasBackend")
         super().put_bundle(bundle)
-        self._frontier = {}  # new key image invalidates cached frontiers
+        self.invalidate_frontier()  # new key image, one hook (backends.frontier)
         self._bundle_host = bundle
         # The remaining-level CW views are bundle constants: sliced once
         # here (off the eval clock) instead of per eval_staged dispatch.
@@ -230,21 +231,17 @@ class PrefixPallasBackend(PallasBackend):
             [_planes_to_rows(s_p, self._perm_i32),
              _planes_to_rows(v_p, self._perm_i32)], axis=1)  # [2^k, 8]
 
-    def _frontier_tables(self, b: int):
+    def _build_frontier_tables(self, b: int):
         """The party-b frontier gather table int32 [K * 2^k, 8] (per-key
         tables stacked).  Built once per (bundle, party) on device,
-        cached like the CW image."""
-        tbl = self._frontier.get(int(b))
-        if tbl is not None:
-            return tbl
+        cached like the CW image (instance store or the serve-resident
+        frontier cache — ``backends.frontier``)."""
         k = self._k()
         k0 = min(self.host_levels, k)
         k_num = self._dims()[0]
-        tbl = jnp.concatenate(
+        return jnp.concatenate(
             [self._one_key_table(b, key, k, k0) for key in range(k_num)],
             axis=0)
-        self._frontier[int(b)] = tbl
-        return tbl
 
     def stage(self, xs: np.ndarray) -> dict:
         """Stage xs as walk-order masks (full depth, for the parity

@@ -863,6 +863,23 @@ def bench_serve(args) -> None:
     overhead budget is ``serve_vs_staged`` of that rate.  Parity is
     gated before timing: one sample request per bundle, both parties,
     XOR reconstruction vs the C++ host core.
+
+    ``--skew s`` (ISSUE 7) switches the key choice to Zipf(s) and runs
+    the skew-curve experiment: a CACHED leg (the serve-resident
+    frontier cache on, the default) and a COLD-frontier leg
+    (``frontier_cache=False`` — the pre-cache instance-store behavior)
+    at the SAME shape, seeds and device-byte budget (defaulted to 80%
+    of the party-0 working set so the LRU actually churns — an uncapped
+    registry never rebuilds anything and the two legs coincide),
+    interleaved in alternating segments so shared-host throughput
+    drift cancels out of the ratio.  The
+    emitted line gains ``skew``, ``frontier_hit_rate`` (hits /
+    consults), the cold leg's rate and ``cached_vs_cold``; with a
+    frontier-capable backend the run FAILS (exit != 0) unless hit-rate
+    >= 0.5 and the cached leg strictly beats the cold one — the
+    amortization claim is falsifiable with one command
+    (``--backend prefix --skew 1.1``; plain ``--skew 1.1`` defaults the
+    backend to ``prefix`` for exactly this reason).
     """
     from dcf_tpu import Dcf
     from dcf_tpu.native import NativeDcf
@@ -870,29 +887,85 @@ def bench_serve(args) -> None:
     from dcf_tpu.utils.benchtime import device_sync, measure_sync_rtt
 
     lam, nb = 16, 16
-    if args.backend not in ("numpy", "jax", "bitsliced", "pallas",
-                            "prefix"):
+    skew = _parse_skew(args.skew)  # bad flags fail fast, before the
+    # bundle gen / warmup ladder / parity gate spend real time
+    backend = args.backend
+    if skew > 0 and backend == "cpu":
+        # The skew curve is about the serve frontier cache; "cpu" is the
+        # global argparse default (rejected below), so route it to the
+        # frontier-capable lam=16 backend instead of dying on a flag the
+        # user never chose.
+        backend = "prefix"
+        log("--skew exercises the serve frontier cache; defaulting "
+            "--backend to prefix (the frontier-capable lam=16 backend)")
+    if backend not in ("numpy", "jax", "bitsliced", "pallas", "prefix"):
         raise SystemExit(
             f"serve_bench serves lam=16 single-device facade backends "
-            f"(numpy/jax/bitsliced/pallas/prefix), got {args.backend!r}")
-    max_batch = args.max_batch or (1 << 17)
-    n_bundles = args.bundles or 3
+            f"(numpy/jax/bitsliced/pallas/prefix), got {backend!r}")
+    max_batch = args.max_batch or ((1 << 10) if skew > 0 else (1 << 17))
+    n_bundles = args.bundles or (8 if skew > 0 else 3)
     rng = np.random.default_rng(args.seed)
     ck = _cipher_keys(lam, rng)
     native = NativeDcf(lam, ck)
-    dcf = Dcf(nb, lam, ck, backend=args.backend)
+    opts = None
+    if backend == "prefix" and args.prefix_levels:
+        opts = {"prefix_levels": args.prefix_levels}
+    elif backend == "prefix" and skew > 0:
+        import jax
+
+        if jax.devices()[0].platform != "tpu":
+            # Interpret-mode frontier expansion at the backend's default
+            # depth 21 takes ~2 minutes per (key, party) on XLA-CPU —
+            # the skew experiment needs churnable frontiers, not a
+            # 30-minute warmup.  k=10 also keeps a frontier (32 KB)
+            # byte-cheap next to its key image (133 KB): the merged LRU
+            # then sheds images first and cached frontiers survive the
+            # churn, which is the amortization under test (at equal
+            # byte cost the sweep drops a cold key's image AND frontier
+            # together and every re-stage rebuilds).  On-chip the
+            # default depth stands.
+            opts = {"prefix_levels": 10}
+            log("no TPU: clamping the prefix frontier to "
+                "prefix_levels=10 for interpret mode (override with "
+                "--prefix-levels)")
+    dcf = Dcf(nb, lam, ck, backend=backend, backend_opts=opts)
     svc = dcf.serve(max_batch=max_batch,
                     max_delay_ms=args.max_delay_ms,
                     device_bytes_budget=args.device_bytes_budget)
     log(f"gen {n_bundles} bundles ...")
     bundles = _gen_serve_bundles(svc, native, rng, n_bundles, nb, lam)
-    _serve_parity_gate(svc, native, bundles, rng, nb, points=512,
+    parity_pts = 128 if skew > 0 else 512
+    _serve_parity_gate(svc, native, bundles, rng, nb, points=parity_pts,
                        bench="serve_bench")
 
     min_req = args.min_req_points or (max_batch * 3 // 8)
     max_req = args.max_req_points or (max_batch // 2)
     if not 1 <= min_req <= max_req:
         raise SystemExit(f"bad request-size range [{min_req}, {max_req}]")
+
+    # Skew mode: a churn budget — without one the LRU never evicts, no
+    # frontier is ever rebuilt, and the cached and cold legs coincide.
+    # Default: 80% of the party-0 working set (image + frontier per
+    # key, probed from the already-staged key-0 residency) — below the
+    # full image demand so residencies churn, with enough slack that
+    # the byte-cheap frontier population can persist through the churn
+    # (measured: at 50% the steady state pins AT the budget, every
+    # frontier insert evicts a frontier, and the cache holds only the
+    # hot keys that never needed re-staging — zero amortization in
+    # EITHER leg's favor).
+    budget = args.device_bytes_budget
+    if skew > 0 and not budget:
+        from dcf_tpu.serve.registry import device_image_bytes
+
+        per_img = device_image_bytes(svc.registry.resident("key-0", 0))
+        fc = svc.frontier_cache
+        n_fc = len(fc.lru_entries()) if fc is not None else 0
+        per_frontier = fc.total_bytes() // n_fc if n_fc else 0
+        budget = max(1, (per_img + per_frontier) * n_bundles * 4 // 5)
+        log(f"skew mode: device_bytes_budget defaulted to {budget:,} B "
+            f"(80% of the party-0 working set of {n_bundles} keys)")
+    if skew > 0:
+        svc.registry.device_bytes_budget = budget
 
     # Warm every padded batch shape the loop can produce (each distinct
     # power of two is one XLA compile; a compile inside the timed loop
@@ -918,13 +991,64 @@ def bench_serve(args) -> None:
     platform = jax.devices()[0].platform
     interp = (platform != "tpu"
               or bool(getattr(dcf.eval_backend(0), "interpret", False)))
-    with svc:
-        res = closed_loop(
-            svc, sorted(bundles), duration_s=float(args.duration),
-            concurrency=args.concurrency,
-            min_points=min_req, max_points=max_req,
-            seed=args.seed)
-    snap = svc.metrics_snapshot()
+    res_cold = cold_snap = None
+    if skew > 0:
+        # The COLD-frontier comparison leg: same backend, same bundles,
+        # same budget/shape/seeds, frontier_cache=False — every budget
+        # eviction costs the next touch a full 2^k frontier expansion
+        # on the serving clock.  Parity-gated like the cached leg (the
+        # gate also pre-stages both parties, keeping the legs' starting
+        # states symmetric before the budget bites).
+        log("cold-frontier comparison service (frontier_cache=False) ...")
+        svc_cold = dcf.serve(max_batch=max_batch,
+                             max_delay_ms=args.max_delay_ms,
+                             frontier_cache=False)
+        for name, bundle in bundles.items():
+            svc_cold.register_key(name, bundle)
+        _serve_parity_gate(svc_cold, native, bundles, rng, nb,
+                           points=parity_pts, bench="serve_bench",
+                           tag="cold leg")
+        svc_cold.registry.device_bytes_budget = budget
+        m = next_pow2(min_req)
+        while m <= max_batch:  # same ladder; the compiles are shared
+            svc_cold.submit("key-0", xs_warm[:m])
+            svc_cold.pump()
+            m *= 2
+        # The legs run INTERLEAVED, 3 alternating segments each, not
+        # back to back: a shared host's throughput drifts by more than
+        # the effect under test over tens of seconds, and alternation
+        # makes the drift hit both legs equally — the cached/cold ratio
+        # then reflects the cache, not the neighbors.  Each leg still
+        # gets --duration seconds of load in total, and segment state
+        # (residencies, cache population) carries across segments, so
+        # the steady-state churn dynamics are those of one long run.
+        segs = 3
+        seg_s = float(args.duration) / segs
+        runs = {"cached": [], "cold": []}
+        with svc, svc_cold:
+            for i in range(2 * segs):
+                leg, tgt = (("cached", svc) if i % 2 == 0
+                            else ("cold", svc_cold))
+                # i // 2: the cached and cold halves of each segment
+                # pair draw the SAME seeded key/size streams — seed
+                # luck must not decide the cached_vs_cold gate.
+                runs[leg].append(closed_loop(
+                    tgt, sorted(bundles), duration_s=seg_s,
+                    concurrency=args.concurrency,
+                    min_points=min_req, max_points=max_req,
+                    seed=args.seed + i // 2, skew=skew))
+        res = _merge_loadgen(runs["cached"])
+        res_cold = _merge_loadgen(runs["cold"])
+        snap = svc.metrics_snapshot()
+        cold_snap = svc_cold.metrics_snapshot()
+    else:
+        with svc:
+            res = closed_loop(
+                svc, sorted(bundles), duration_s=float(args.duration),
+                concurrency=args.concurrency,
+                min_points=min_req, max_points=max_req,
+                seed=args.seed, skew=skew)
+        snap = svc.metrics_snapshot()
 
     # Staged-path equivalent: same backend, one staged max_batch batch,
     # bare dispatch loop (one dispatch per sample — CPU-mode dispatches
@@ -964,11 +1088,96 @@ def bench_serve(args) -> None:
     if staged_rate is not None:
         extra["staged_path_evals_per_sec"] = round(staged_rate, 1)
         extra["serve_vs_staged"] = round(res.throughput / staged_rate, 3)
+    hit_rate = None
+    if skew > 0:
+        fr_hits = snap.get("serve_frontier_hits_total", 0)
+        fr_misses = snap.get("serve_frontier_misses_total", 0)
+        hit_rate = fr_hits / max(fr_hits + fr_misses, 1)
+        log(f"frontier cache: {fr_hits} hits / {fr_misses} misses "
+            f"(hit rate {hit_rate:.3f}); cached {res.throughput:,.1f} "
+            f"vs cold {res_cold.throughput:,.1f} evals/s")
+        extra.update({
+            "skew": skew,
+            "segments_per_leg": segs,
+            "prefix_levels": getattr(dcf.eval_backend(0),
+                                     "prefix_levels", 0),
+            "frontier_hit_rate": round(hit_rate, 4),
+            "frontier_hits": fr_hits,
+            "frontier_misses": fr_misses,
+            "frontier_evictions":
+                snap.get("serve_frontier_evictions_total", 0),
+            "device_bytes_budget_effective": budget,
+            "cached_key_stagings": snap.get("serve_key_stagings_total",
+                                            0),
+            "cold_frontier_evals_per_sec": round(res_cold.throughput, 1),
+            "cold_requests_ok": res_cold.requests_ok,
+            "cold_key_stagings": cold_snap.get("serve_key_stagings_total",
+                                               0),
+            "cached_vs_cold": round(
+                res.throughput / max(res_cold.throughput, 1e-9), 3),
+        })
+    extra.update(_serve_pinned_ratio(res.throughput, platform))
     unit = "evals/s (closed-loop served, party 0)"
     if interp:
         unit += " [no TPU this session: interpret/CPU mode, disclosed]"
-    _emit("serve_bench", args.backend, "evals_per_sec",
+    _emit("serve_bench", backend, "evals_per_sec",
           res.throughput, unit, extra_fields=extra)
+
+    # The skew-mode acceptance assertions (ISSUE 7) — emitted-then-
+    # asserted like chaos_bench, so the JSONL line survives a failure
+    # and the exit code makes the claim falsifiable in CI/on-chip.
+    if skew > 0 and getattr(dcf.eval_backend(0), "prefix_levels", 0):
+        failures = []
+        if hit_rate < 0.5:
+            failures.append(
+                f"frontier hit-rate {hit_rate:.3f} < 0.5 — the cache is "
+                "not amortizing under this skew/budget")
+        if res.throughput <= res_cold.throughput:
+            failures.append(
+                f"cached leg ({res.throughput:,.1f} evals/s) did not "
+                f"beat the cold-frontier leg ({res_cold.throughput:,.1f})"
+                " at the same shape")
+        if failures:
+            raise SystemExit("serve_bench --skew: "
+                             + "; ".join(failures))
+
+
+def _merge_loadgen(rs):
+    """Fold the per-segment ``LoadgenResult``s of one interleaved leg
+    into a single total (rates are then points per SUMMED duration).
+    Folds INTO ``rs[0]`` — callers hand over the segment list."""
+    tot = rs[0]
+    for r in rs[1:]:
+        tot.duration_s += r.duration_s
+        tot.requests_ok += r.requests_ok
+        tot.points_ok += r.points_ok
+        tot.requests_failed += r.requests_failed
+        tot.requests_shed += r.requests_shed
+        tot.latencies_s.extend(r.latencies_s)
+        for cls, counts in r.by_class.items():
+            for outcome, n in counts.items():
+                tot.by_class.setdefault(
+                    cls, {"ok": 0, "shed": 0, "failed": 0})[outcome] += n
+    return tot
+
+
+def _serve_pinned_ratio(rate: float, platform: str,
+                        baseline_path: str | None = None) -> dict:
+    """vs_baseline for serve_bench: the pinned single-core C++ flagship
+    eval denominator (``benchmarks/cpu_baseline.json`` top level,
+    CPU_BASELINE.md protocol) — what the obviously-correct host core
+    evaluates per second at the same N=16/lam=16 shape, single thread.
+    Kept for XLA-CPU/interpret serving runs (both sides are CPU; the
+    mic_bench precedent) with the platform disclosed on the same JSONL
+    line.  Empty when no pin exists (no silent in-run fallback)."""
+    pinned = _load_pinned(baseline_path)
+    if pinned is None or "evals_per_sec" not in pinned:
+        return {}
+    return {"vs_baseline": round(rate / pinned["evals_per_sec"], 3),
+            "baseline": f"pinned single-core flagship C++ eval "
+                        f"({pinned['evals_per_sec']:,.0f} evals/s, "
+                        f"CPU_BASELINE.md protocol; serving platform "
+                        f"{platform})"}
 
 
 def _protocols_pinned_ratio(m_int: int, rate: float,
@@ -1013,6 +1222,11 @@ def bench_mic(args) -> None:
     from dcf_tpu.serve.loadgen import closed_loop
 
     lam, nb = 16, 16
+    skew = _parse_skew(args.skew)  # shared --skew plumbing: validated
+    # loudly here, before the bundle gen and warmup ladder (mic_bench
+    # registers ONE protocol bundle, so a Zipf draw over one key is
+    # uniform — the flag is still validated and recorded, keeping the
+    # three serve benches' loadgen contracts identical)
     if args.backend not in ("numpy", "jax", "bitsliced", "pallas",
                             "prefix"):
         raise SystemExit(
@@ -1081,7 +1295,8 @@ def bench_mic(args) -> None:
         res = closed_loop(
             svc, ["mic-0"], duration_s=float(args.duration),
             concurrency=args.concurrency,
-            min_points=min_req, max_points=max_req, seed=args.seed)
+            min_points=min_req, max_points=max_req, seed=args.seed,
+            skew=skew)
     snap = svc.metrics_snapshot()
 
     # Staged equivalent: the MicEvaluator path (stage + eval_staged +
@@ -1098,6 +1313,7 @@ def bench_mic(args) -> None:
     extra = {
         "duration_s": round(res.duration_s, 3),
         "concurrency": args.concurrency,
+        "skew": skew,
         "intervals": m_int,
         "max_batch": max_batch,
         "req_points": [min_req, max_req],
@@ -1118,6 +1334,27 @@ def bench_mic(args) -> None:
         unit += " [no TPU this session: interpret/CPU mode, disclosed]"
     _emit("mic_bench", args.backend, "points_per_sec",
           res.throughput, unit, extra_fields=extra)
+
+
+def _parse_skew(value, flag: str = "--skew") -> float:
+    """Zipf-exponent validation shared by serve_bench / mic_bench /
+    chaos_bench (the ``_parse_priority_mix`` discipline: reject a bad
+    flag loudly, naming it, BEFORE the warmup ladder and parity gate
+    spend real time).  0 = uniform key choice; s > 0 weights the r-th
+    registered key by 1/r^s (``serve.loadgen``)."""
+    try:
+        s = float(value)
+    except (TypeError, ValueError):
+        raise SystemExit(
+            f"{flag}: expected a Zipf exponent (a finite number >= 0, "
+            f"0 = uniform), got {value!r}")
+    if not math.isfinite(s) or s < 0.0:
+        # NaN compares false to 0, so `s < 0` alone would let it
+        # through to rng.choice inside every client thread.
+        raise SystemExit(
+            f"{flag}: Zipf exponent must be finite and >= 0 "
+            f"(0 = uniform), got {value!r}")
+    return s
 
 
 def _parse_priority_mix(spec: str) -> dict:
@@ -1194,6 +1431,7 @@ def bench_chaos(args) -> None:
             f"(numpy/jax/bitsliced/pallas/prefix), got {args.backend!r}")
     mix = _parse_priority_mix(args.priority_mix)  # bad flags fail fast,
     # before the warmup ladder and parity gate spend real time
+    skew = _parse_skew(args.skew)  # same edge discipline for --skew
     max_batch = args.max_batch or 256
     min_req = args.min_req_points or max(max_batch // 8, 1)
     max_req = args.max_req_points or (max_batch // 2)
@@ -1241,7 +1479,7 @@ def bench_chaos(args) -> None:
                 svc, sorted(bundles), duration_s=float(args.duration),
                 concurrency=args.concurrency,
                 min_points=min_req, max_points=max_req,
-                seed=args.seed, priority_mix=mix)
+                seed=args.seed, priority_mix=mix, skew=skew)
         # NOTE: ``with svc`` drains on exit, so the snapshot below is a
         # quiescent end-state, not a mid-flight race.
     snap = svc.metrics_snapshot()
@@ -1295,6 +1533,7 @@ def bench_chaos(args) -> None:
         "fault_window": window,
         "fault_evals_failed": sched.failed,
         "priority_mix": mix,
+        "skew": skew,
         "requests_ok": res.requests_ok,
         "requests_shed": res.requests_shed,
         "requests_failed": res.requests_failed,
@@ -1472,6 +1711,12 @@ def main(argv=None) -> None:
     p.add_argument("--max-req-points", type=int, default=0,
                    help="serve_bench/mic_bench: request-size range upper "
                         "bound (0 = half of --max-batch)")
+    p.add_argument("--skew", default="0",
+                   help="serve_bench/mic_bench/chaos_bench: Zipf "
+                        "exponent for key choice (0 = uniform; "
+                        "serve_bench --skew also runs the cold-frontier "
+                        "comparison leg and reports the frontier-cache "
+                        "hit rate — ISSUE 7)")
     p.add_argument("--intervals", type=int, default=0,
                    help="mic_bench: MIC interval count m (0 = 8; the "
                         "bundle K-packs 2m DCF keys)")
@@ -1504,11 +1749,11 @@ def main(argv=None) -> None:
         raise SystemExit(
             "--backend=hybrid is the large-lambda evaluator; it only "
             "applies to the dcf_large_lambda bench (and baseline)")
-    if args.prefix_levels and args.backend != "hybrid":
+    if args.prefix_levels and args.backend not in ("hybrid", "prefix"):
         raise SystemExit(
-            "--prefix-levels configures the hybrid's prefix-shared "
-            "narrow walk; use it with --backend=hybrid (the lam=16 "
-            "prefix backend picks its own depth from the batch size)")
+            "--prefix-levels configures the prefix-shared narrow walk; "
+            "use it with --backend=hybrid (dcf_large_lambda) or "
+            "--backend=prefix (serve_bench --skew frontier depth)")
     if args.bench == "baseline":
         bench_baseline(args)
         return

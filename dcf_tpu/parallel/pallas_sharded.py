@@ -359,14 +359,13 @@ class ShardedLargeLambdaBackend(LargeLambdaBackend):
     def _narrow_dev_for_build(self) -> dict:
         return self._dev_host
 
-    def _frontier_tables(self, b: int):
-        state_tbl, traj_tbl = super()._frontier_tables(b)
-        if not isinstance(state_tbl.sharding, NamedSharding):
-            sh = NamedSharding(self.mesh, self._spec_keyed)
-            state_tbl = jax.device_put(state_tbl, sh)
-            traj_tbl = jax.device_put(traj_tbl, sh)
-            self._frontier[int(b)] = (state_tbl, traj_tbl)  # placed copy
-        return state_tbl, traj_tbl
+    def _build_frontier_tables(self, b: int):
+        """Build, then place across the mesh's keys axis — the cache
+        (instance store or serve frontier cache) holds the PLACED copy,
+        so a cache hit never re-broadcasts from device 0."""
+        state_tbl, traj_tbl = super()._build_frontier_tables(b)
+        sh = NamedSharding(self.mesh, self._spec_keyed)
+        return jax.device_put(state_tbl, sh), jax.device_put(traj_tbl, sh)
 
     def _wide_staged(self):
         if self._wide is None:
@@ -591,12 +590,11 @@ class ShardedPrefixBackend(PrefixPallasBackend):
         overhead measurement alone would never catch)."""
         return jax.device_put(arr, NamedSharding(self.mesh, P()))
 
-    def _frontier_tables(self, b: int):
-        tbl = super()._frontier_tables(b)
-        if not isinstance(tbl.sharding, NamedSharding):
-            tbl = jax.device_put(tbl, NamedSharding(self.mesh, P()))
-            self._frontier[int(b)] = tbl  # cache the placed copy
-        return tbl
+    def _build_frontier_tables(self, b: int):
+        """Build, then replicate across the mesh — the cache (instance
+        store or serve frontier cache) holds the PLACED copy."""
+        tbl = super()._build_frontier_tables(b)
+        return jax.device_put(tbl, NamedSharding(self.mesh, P()))
 
     def _plan_tiles(self, m: int) -> tuple[int, int]:
         """Per-shard tile plan (each point-shard gets whole tiles)."""

@@ -17,6 +17,10 @@ keys":
 - ``serve.breaker``   per-(key_id, backend-family) circuit breakers
   (closed/open/half-open on the injectable clock; open pairings fail
   fast with ``CircuitOpenError``, CRITICAL bypasses);
+- ``serve.frontier_cache`` serve-resident LRU over prefix-family
+  frontier expansions, keyed (key_id, generation, party, k), sharing
+  the registry's byte budget and deterministic LRU stamps (ISSUE 7:
+  amortize the narrow-walk floor under skewed traffic);
 - ``serve.metrics``   dependency-free counters/gauges/histograms with a
   deterministic snapshot (embedded in RESULTS_serve JSONL lines);
 - ``serve.service``   ``DcfService``: the worker loop tying it together,
@@ -30,9 +34,10 @@ Entry point: ``Dcf.serve(...)`` (see ``dcf_tpu.api``).
 
 from dcf_tpu.serve.admission import Priority, ServeFuture  # noqa: F401
 from dcf_tpu.serve.breaker import BreakerBoard  # noqa: F401
+from dcf_tpu.serve.frontier_cache import FrontierCache  # noqa: F401
 from dcf_tpu.serve.metrics import Metrics  # noqa: F401
 from dcf_tpu.serve.registry import KeyRegistry  # noqa: F401
 from dcf_tpu.serve.service import DcfService, ServeConfig  # noqa: F401
 
 __all__ = ["DcfService", "ServeConfig", "ServeFuture", "Priority",
-           "BreakerBoard", "Metrics", "KeyRegistry"]
+           "BreakerBoard", "FrontierCache", "Metrics", "KeyRegistry"]

@@ -6,9 +6,13 @@ capacity instead of running away from it, which makes the headline
 number a genuine sustainable throughput (an open-loop generator against
 a saturated service measures its own queue, not the server).
 
-Clients pick key ids round-robin from a seeded RNG over the registered
-set and draw ragged request sizes uniformly from ``[min_points,
-max_points]`` — the bursty many-keys shape the batcher exists for.
+Clients pick key ids from a seeded RNG over the registered set —
+uniformly by default, or Zipf-weighted with ``skew`` > 0 (``key_ids``
+order is rank order: p(rank r) ∝ 1/r^skew, the standard model of
+skewed production query streams and the shape the serve-resident
+frontier cache amortizes) — and draw ragged request sizes uniformly
+from ``[min_points, max_points]``, the bursty many-keys shape the
+batcher exists for.
 Timing uses the SAME injectable clock as the service, so the module
 stays clean under the dcflint determinism pass; it is the one
 measurement harness allowed to loop on the clock, and the loop bound is
@@ -70,13 +74,16 @@ class LoadgenResult:
 def _client(service, key_ids, stop: threading.Event, res: LoadgenResult,
             lock: threading.Lock, rng: np.random.Generator,
             min_points: int, max_points: int, b: int, clock,
-            priorities, weights) -> None:
+            priorities, weights, key_probs) -> None:
     from dcf_tpu.errors import QueueFullError
 
     nb = service._dcf.n_bytes
     while not stop.is_set():
         m = int(rng.integers(min_points, max_points + 1))
-        key_id = key_ids[int(rng.integers(0, len(key_ids)))]
+        if key_probs is None:
+            key_id = key_ids[int(rng.integers(0, len(key_ids)))]
+        else:
+            key_id = key_ids[int(rng.choice(len(key_ids), p=key_probs))]
         pr = priorities[int(rng.choice(len(priorities), p=weights))]
         xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
         t0 = clock()
@@ -108,14 +115,33 @@ def _client(service, key_ids, stop: threading.Event, res: LoadgenResult,
 def closed_loop(service, key_ids, *, duration_s: float, concurrency: int,
                 min_points: int, max_points: int, seed: int = 2026,
                 party: int = 0, clock=monotonic,
-                priority_mix: dict | None = None) -> LoadgenResult:
+                priority_mix: dict | None = None,
+                skew: float = 0.0) -> LoadgenResult:
     """Drive ``service`` with ``concurrency`` closed-loop clients for
     ``duration_s`` seconds of wall time; returns the aggregated result.
     The service must be started (worker thread running).
 
     ``priority_mix``: ``{"critical": w, "normal": w, "batch": w}``
     weights (normalized here) drawn per request from the client's seeded
-    RNG; default is the pre-priority behaviour (all NORMAL)."""
+    RNG; default is the pre-priority behaviour (all NORMAL).
+
+    ``skew``: Zipf exponent for key choice — 0 (default) is uniform;
+    s > 0 weights rank r (the r-th entry of ``key_ids``) by 1/r^s,
+    normalized.  Must be finite and >= 0 (the CLI benches validate the
+    ``--skew`` flag before spending warmup time; this is the API-edge
+    backstop)."""
+    import math
+
+    if not math.isfinite(skew) or skew < 0:
+        # api-edge: loadgen config contract at the harness edge — a
+        # negative or NaN exponent would die inside rng.choice in every
+        # client thread, silently zeroing the offered load
+        raise ValueError(f"skew must be finite and >= 0, got {skew}")
+    key_probs = None
+    if skew > 0:
+        ranks = np.arange(1, len(list(key_ids)) + 1, dtype=np.float64)
+        w = ranks ** -float(skew)
+        key_probs = w / w.sum()
     if priority_mix:
         priorities = sorted(priority_mix)
         for p in priorities:
@@ -143,7 +169,8 @@ def closed_loop(service, key_ids, *, duration_s: float, concurrency: int,
             target=_client,
             args=(service, list(key_ids), stop, res, lock,
                   np.random.default_rng(seed + 7 * i), min_points,
-                  max_points, party, clock, priorities, weights),
+                  max_points, party, clock, priorities, weights,
+                  key_probs),
             name=f"loadgen-{i}", daemon=True)
         for i in range(concurrency)
     ]

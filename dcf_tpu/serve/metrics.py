@@ -19,6 +19,15 @@ in sorted order and only JSON-basic values, so a snapshot can be embedded
 verbatim in a ``RESULTS_serve`` JSONL line and two snapshots diff
 cleanly in tests.
 
+Frontier-cache series (ISSUE 7, recorded by
+``serve.frontier_cache.FrontierCache`` through this registry):
+``serve_frontier_hits_total`` / ``serve_frontier_misses_total``
+(cache consults — one per prefix-family eval dispatch plus the
+stage-time warm), ``serve_frontier_evictions_total`` (budget +
+invalidation), and the ``serve_frontier_cache_bytes`` /
+``serve_frontier_cache_entries`` gauges.  Hit rate =
+hits / (hits + misses); ``serve_bench --skew`` reports it per run.
+
 Secret hygiene: metric NAMES are static strings and metric values are
 scalars; key ids chosen by callers become label values via ``labeled``
 and must never be derived from key material (the dcflint secret-hygiene

@@ -27,6 +27,15 @@ src/lib.rs:269-272), so its residency slot is per-key, not per
 LRU order is tracked with a deterministic access counter, not a clock:
 eviction order must be a pure function of the request sequence so tests
 can pin it (and the dcflint determinism pass holds serve code to that).
+
+ISSUE 7: a ``serve.frontier_cache.FrontierCache`` can live beside the
+registry — prefix-family backends then keep their expanded top-k
+frontiers in it (keyed (key_id, generation, party, k)) instead of the
+instance store, so the expansion survives residency eviction under
+skewed traffic.  The cache shares this registry's deterministic stamp
+sequence and ``device_bytes_budget``: one merged LRU over staged images
+and cached frontiers, one entry-invalidation hook (``_evict_entry``)
+for hot-swap/unregister/failure eviction.
 """
 
 from __future__ import annotations
@@ -35,26 +44,34 @@ import threading
 
 from dcf_tpu.errors import ShapeError, StaleStateError
 from dcf_tpu.keys import KeyBundle
+from dcf_tpu.serve.frontier_cache import (
+    FrontierCache,
+    TickSource,
+    tables_nbytes,
+)
 from dcf_tpu.serve.metrics import Metrics
 
 __all__ = ["KeyRegistry", "device_image_bytes"]
 
 # Device-image dict attributes across the backend zoo: ``_bundle_dev``
 # (pallas / bitsliced / keylanes), ``_dev`` (large-lambda hybrid),
-# ``_frontier`` (prefix family's cached gather tables, filled lazily).
+# ``_frontier`` (prefix family's instance-store gather tables, filled
+# lazily when no serve frontier cache is bound).
 _IMAGE_ATTRS = ("_bundle_dev", "_dev", "_frontier")
 
 
 def device_image_bytes(be) -> int:
     """Best-effort byte count of a backend instance's device-resident
     key image (the LRU accounting unit).  Sums ``nbytes`` over the known
-    image dicts; a backend that stages nothing (host paths) counts 0."""
+    image dicts through the ONE byte rule (``tables_nbytes`` — the
+    hybrid's frontier store holds (state, trajectory) TUPLES per party);
+    a backend that stages nothing (host paths) counts 0."""
     total = 0
     for attr in _IMAGE_ATTRS:
         d = getattr(be, attr, None)
         if isinstance(d, dict):
             for v in d.values():
-                total += int(getattr(v, "nbytes", 0) or 0)
+                total += tables_nbytes(v)
     return total
 
 
@@ -109,11 +126,27 @@ class KeyRegistry:
 
     def __init__(self, make_backend, *, shared_image: bool = False,
                  device_bytes_budget: int = 0,
-                 metrics: Metrics | None = None, breakers=None):
+                 metrics: Metrics | None = None, breakers=None,
+                 frontier_cache: FrontierCache | None = None):
         self._make_backend = make_backend
         self._shared_image = shared_image  # keylanes: one slot, both parties
         self.device_bytes_budget = int(device_bytes_budget)
         self._metrics = metrics if metrics is not None else Metrics()
+        # The serve-resident frontier cache (serve.frontier_cache), or
+        # None to leave prefix-family frontiers in their instance stores
+        # (then they die with each LRU residency eviction — the pre-
+        # cache behavior, kept as the ``frontier_cache=False`` knob and
+        # the cold leg of ``serve_bench --skew``).  The cache shares
+        # this registry's LRU stamp sequence and byte budget: eviction
+        # order across staged images AND cached frontiers is one merged
+        # least-recently-used order.
+        self._frontier_cache = frontier_cache
+        self._ticks = (frontier_cache.ticks if frontier_cache is not None
+                       else TickSource())
+        self._staging_keep = None  # the residency mid-staging (RLock-
+        # guarded): a frontier warm's budget sweep must not evict it
+        if frontier_cache is not None:
+            frontier_cache.set_growth_hook(self._apply_budget)
         # The serving layer's ``serve.breaker.BreakerBoard`` (or None).
         # Breaker state is (key_id, backend-family) failure HISTORY, so
         # its lifetime is tied to the registration NAME, not to entry
@@ -125,7 +158,6 @@ class KeyRegistry:
         self._breakers = breakers
         self._lock = threading.RLock()
         self._entries: dict[str, _Entry] = {}
-        self._tick = 0
         self._generation = 0
         g = self._metrics.gauge
         self._g_resident_bytes = g("serve_resident_device_bytes")
@@ -161,7 +193,7 @@ class KeyRegistry:
                 return  # idempotent re-registration: keep the residencies
             self._generation += 1
             if prev is not None:
-                self._evict_entry(prev)
+                self._evict_entry(key_id, prev)
             self._entries[key_id] = _Entry(bundle, self._generation,
                                            protocol)
             self._g_registered.set(len(self._entries))
@@ -170,7 +202,7 @@ class KeyRegistry:
         with self._lock:
             entry = self._entries.pop(key_id, None)
             if entry is not None:
-                self._evict_entry(entry)
+                self._evict_entry(key_id, entry)
             self._g_registered.set(len(self._entries))
         if self._breakers is not None:
             self._breakers.forget(key_id)
@@ -229,8 +261,7 @@ class KeyRegistry:
             slot = "kl" if self._shared_image else int(b)
             res = entry.residents.get(slot)
             if res is not None:
-                self._tick += 1
-                res.stamp = self._tick
+                res.stamp = self._ticks.next()
                 return res.be
             be = self._make_backend()
             if be is None:
@@ -239,10 +270,33 @@ class KeyRegistry:
                   else entry.bundle.for_party(b))
             be.put_bundle(kb)
             self._c_stagings.inc()
-            self._tick += 1
-            res = _Resident(be, device_image_bytes(be), self._tick,
+            res = _Resident(be, device_image_bytes(be), self._ticks.next(),
                             entry.generation)
             entry.residents[slot] = res
+            # Prefix-family backends: bind the serve-resident frontier
+            # provider (scoped to this key_id + generation — put_bundle
+            # just unbound any previous one) and warm the frontier at
+            # STAGE time, so later batches' evals gather from cache
+            # instead of expanding 2^k nodes on their clock.  The warm
+            # runs BEFORE the image budget sweep below: a re-staged
+            # key's consult re-stamps its surviving frontier FIRST, so
+            # the sweep sees it as the hot entry it is (sweep-first
+            # would eat the returning key's own cold-stamped frontier
+            # moments before the warm hits it — every re-stage then
+            # misses and the cache amortizes nothing).  The warm's own
+            # budget sweep (frontier-cache growth hook) must not evict
+            # the residency being staged: _staging_keep extends the
+            # ``keep`` guarantee across the re-entrant sweep.
+            if self._frontier_cache is not None \
+                    and hasattr(be, "frontier_provider") \
+                    and getattr(be, "prefix_levels", 0):
+                be.frontier_provider = self._frontier_cache.bind(
+                    key_id, entry.generation)
+                self._staging_keep = res
+                try:
+                    be.ensure_frontier(int(b))
+                finally:
+                    self._staging_keep = None
             self._enforce_budget(keep=res)
             self._update_gauges()
             return res.be
@@ -269,51 +323,107 @@ class KeyRegistry:
             for slot, res in list(entry.residents.items()):
                 yield entry, slot, res
 
+    def _apply_budget(self) -> None:
+        """The frontier cache's growth hook: re-run the merged budget
+        sweep after an insert.  Takes the registry lock (an RLock — a
+        stage-time warm re-enters from ``resident``, where
+        ``_staging_keep`` extends the keep guarantee)."""
+        with self._lock:
+            self._enforce_budget(keep=self._staging_keep)
+            self._update_gauges()
+
     def _enforce_budget(self, keep) -> None:
-        """Evict least-recently-used residencies until the summed image
-        bytes fit the budget.  ``keep`` (the residency being served) is
-        never evicted, so one over-budget key still serves — a budget
-        too small for a single image degrades to stage-per-use, not to
-        an unservable key.  Budget 0 disables the cap."""
+        """Evict least-recently-used holdings until the summed device
+        bytes fit the budget.  Staged key images AND serve-cached
+        frontiers share the budget and the stamp sequence, so the sweep
+        picks the coldest across BOTH populations — a frontier whose
+        key keeps getting evals outlives the churn of colder keys'
+        images, which is the whole amortization.  ``keep`` (the
+        residency being served/staged) is never evicted, so one
+        over-budget key still serves — a budget too small for a single
+        image degrades to stage-per-use, not to an unservable key.
+        Budget 0 disables the cap."""
         if not self.device_bytes_budget:
             return
-        while True:
-            total = sum(r.bytes for _, _, r in self._iter_residents())
+        fc = self._frontier_cache
+        total = sum(r.bytes for _, _, r in self._iter_residents())
+        if fc is not None:
+            total += fc.total_bytes()
+        if total <= self.device_bytes_budget:
+            return
+        # One snapshot of both populations, coldest-first, then a
+        # decrementing walk: the sweep runs on the serving path under
+        # the registry lock, so it must be O(entries log entries), not
+        # O(victims * entries) of repeated rescans.  (Cache entries can
+        # be re-stamped concurrently by eval-path hits — the staleness
+        # window is one sweep, and ``evict`` returning 0 for an entry
+        # a racing miss already replaced keeps the total honest.)
+        victims = [(res.stamp, "res", (entry, slot, res))
+                   for entry, slot, res in self._iter_residents()
+                   if res is not keep]
+        if fc is not None:
+            victims += [(stamp, "frontier", key)
+                        for stamp, key, _nb in fc.lru_entries()]
+        victims.sort(key=lambda v: v[0])
+        for _, kind, victim in victims:
             if total <= self.device_bytes_budget:
                 return
-            victims = [(res.stamp, entry, slot, res)
-                       for entry, slot, res in self._iter_residents()
-                       if res is not keep]
-            if not victims:
-                return
-            _, entry, slot, res = min(victims, key=lambda v: v[0])
-            del entry.residents[slot]
-            self._c_evictions.inc()
+            if kind == "res":
+                entry, slot, res = victim
+                if hasattr(res.be, "invalidate_frontier"):
+                    # Budget eviction keeps the key's CACHED frontiers
+                    # (their stamps decide their own fate) but clears
+                    # the dropped instance's local state: an in-flight
+                    # batch closure can pin the instance, and pinned
+                    # instance-store frontier bytes would be resident
+                    # and uncounted.
+                    res.be.invalidate_frontier()
+                del entry.residents[slot]
+                self._c_evictions.inc()
+                total -= res.bytes
+            else:
+                total -= fc.evict(victim)
 
-    def _evict_entry(self, entry: _Entry) -> None:
+    def _evict_entry(self, key_id: str, entry: _Entry) -> None:
+        """The ONE entry-invalidation hook: hot-swap, unregister and
+        failure eviction all route here, which (a) drops the entry's
+        residencies, (b) clears each dropped instance's frontier state
+        through ``invalidate_frontier`` (an in-flight batch closure can
+        pin the instance — its frontier bytes must not linger unbound
+        and uncounted), and (c) drops the serve frontier cache's
+        entries for the key (the key image they were expanded from is
+        gone or superseded)."""
         n = len(entry.residents)
+        for res in entry.residents.values():
+            if hasattr(res.be, "invalidate_frontier"):
+                res.be.invalidate_frontier()
         entry.residents.clear()
         if n:
             self._c_evictions.inc(n)
+        if self._frontier_cache is not None:
+            self._frontier_cache.invalidate_key(key_id)
         self._update_gauges()
 
     def evict_key(self, key_id: str) -> None:
         """Drop one key's device residencies (registration stays).  The
         serving layer's cheap first-line invalidation after a batch
         failure — transient faults must not cost every other hot key its
-        staged image."""
+        staged image.  Routes through the shared entry-invalidation
+        hook, so the key's cached frontiers go too: they were built by
+        the device state that just failed."""
         with self._lock:
             entry = self._entries.get(key_id)
             if entry is not None:
-                self._evict_entry(entry)
+                self._evict_entry(key_id, entry)
 
     def evict_all(self) -> None:
         """Drop every device residency (the shared invalidation path:
         ``reset_backend_health`` routes here so a backend declared dead
-        mid-serve never serves again from cached state)."""
+        mid-serve never serves again from cached state — frontiers
+        included)."""
         with self._lock:
-            for entry in self._entries.values():
-                self._evict_entry(entry)
+            for key_id, entry in self._entries.items():
+                self._evict_entry(key_id, entry)
 
     def _update_gauges(self) -> None:
         total = n = 0

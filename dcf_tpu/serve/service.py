@@ -21,7 +21,20 @@ Load-bearing knobs (``ServeConfig``):
 * ``device_bytes_budget`` — LRU bound on summed resident key images
   (0 = uncapped).  The working-set knob: more resident keys means fewer
   re-stagings; the budget is what stops a long tail of cold keys from
-  evicting the hot set.
+  evicting the hot set.  With ``frontier_cache`` on, serve-cached
+  prefix frontiers share this budget (one merged LRU — see
+  ``serve.frontier_cache``).
+* ``frontier_cache`` — keep prefix-family frontier expansions in a
+  serve-resident LRU (``serve.frontier_cache.FrontierCache``) keyed by
+  (key_id, generation, party, k) instead of per backend instance, so a
+  hot key's expanded top-k walk levels survive residency churn and
+  re-staged instances skip the 2^k-node expansion entirely
+  (``serve_frontier_hits_total`` / ``_misses_total`` /
+  ``serve_frontier_cache_bytes`` in the snapshot).  Default on; only
+  consulted by frontier-capable backends (``prefix``, ``hybrid`` with
+  ``prefix_levels``) — everything else ignores it.  ``False`` restores
+  the instance-store behavior (the cold leg ``serve_bench --skew``
+  measures against).
 * ``max_queued_points`` — admission bound; beyond it, submits shed with
   ``QueueFullError`` (see ``serve.admission``).
 * ``retries`` — per-batch retries after a backend failure; each retry
@@ -96,6 +109,7 @@ from dcf_tpu.serve.batcher import (
     plan_batches,
     scatter_batch,
 )
+from dcf_tpu.serve.frontier_cache import FrontierCache
 from dcf_tpu.serve.metrics import Metrics, OCCUPANCY_BOUNDS
 from dcf_tpu.serve.registry import KeyRegistry
 from dcf_tpu.testing.faults import fire
@@ -113,6 +127,7 @@ class ServeConfig:
     max_delay_ms: float = 2.0
     max_queued_points: int = 1 << 20
     device_bytes_budget: int = 0
+    frontier_cache: bool = True
     retries: int = 1
     breaker_failures: int = 3
     breaker_cooldown_s: float = 5.0
@@ -201,11 +216,17 @@ class DcfService:
             cooldown_s=self.config.breaker_cooldown_s,
             metrics=self.metrics, clock=clock)
         self._breaker_enabled = self.config.breaker_failures > 0
+        # Serve-resident frontier cache (ISSUE 7): prefix-family
+        # frontier expansions keyed (key_id, generation, party, k),
+        # sharing the registry's byte budget and LRU stamp sequence.
+        self.frontier_cache = (FrontierCache(metrics=self.metrics)
+                               if self.config.frontier_cache else None)
         self.registry = KeyRegistry(
             dcf.new_eval_backend,
             shared_image=dcf.backend_name == "keylanes",
             device_bytes_budget=self.config.device_bytes_budget,
-            metrics=self.metrics, breakers=self.breakers)
+            metrics=self.metrics, breakers=self.breakers,
+            frontier_cache=self.frontier_cache)
         self.queue = AdmissionQueue(self.config.max_queued_points,
                                     metrics=self.metrics)
         self._worker: threading.Thread | None = None
@@ -239,6 +260,13 @@ class DcfService:
 
     def _on_backend_health_reset(self) -> None:
         self.registry.evict_all()
+        if self.frontier_cache is not None:
+            # evict_all already invalidated per registered key; this
+            # sweeps anything else and bumps the cache epoch, so an
+            # in-flight build that started before the reset cannot
+            # persist its result — dead-backend state must not survive
+            # the shared reset path anywhere.
+            self.frontier_cache.invalidate_all()
 
     # -- key management -----------------------------------------------------
 

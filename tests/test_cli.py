@@ -190,6 +190,21 @@ def test_cli_chaos_bench_validates_range_and_window_fast():
                   "--fault-window=0"])
 
 
+def test_cli_skew_flag_validated_before_warmup():
+    """ISSUE 7 satellite: serve_bench / mic_bench / chaos_bench share
+    the --skew edge validation — a negative, NaN or unparseable Zipf
+    exponent dies with SystemExit naming the flag BEFORE the bundle gen
+    and warmup ladder spend real time (inside the clients it would die
+    in rng.choice, silently zeroing the offered load)."""
+    from dcf_tpu import cli
+
+    for bench in ("serve_bench", "mic_bench", "chaos_bench"):
+        for bad in ("-1", "nan", "zipf"):
+            with pytest.raises(SystemExit, match="--skew"):
+                cli.main([bench, "--backend=bitsliced",
+                          f"--skew={bad}"])
+
+
 def test_cli_parse_priority_mix_validation():
     """Malformed --priority-mix entries fail loudly naming the flag and
     the expected shape — not with a bare float('') traceback — and

@@ -240,6 +240,18 @@ def test_serve_layer_lint_clean(tmp_path):
     assert "benchtime" in got[0].message
 
 
+def test_frontier_cache_layer_lint_clean():
+    """The ISSUE-7 CI satellite: the frontier-cache module pair —
+    ``serve/frontier_cache.py`` (the LRU + TickSource) and
+    ``backends/frontier.py`` (the consumer mixin) — sweeps clean under
+    ALL six passes.  Determinism is the load-bearing one here: LRU
+    stamps come from the shared TickSource, never a clock, so eviction
+    order is a pure function of the request sequence (the orders
+    tests/test_frontier_cache.py pins exactly)."""
+    assert run_path(REPO / "dcf_tpu" / "serve" / "frontier_cache.py") == []
+    assert run_path(REPO / "dcf_tpu" / "backends" / "frontier.py") == []
+
+
 def test_determinism_detects_and_exempts(tmp_path):
     bad = ("import time, random\n"
            "import numpy as np\n"
