@@ -123,6 +123,11 @@ opaque ``RuntimeError``/``struct.error``/XLA tracebacks:
                              was staged against (prefix backend)
     NativeBuildError         the C++ core failed to build/load after
                              bounded retries
+    KeyQuarantinedError      a durable key-store frame failed validation
+                             at read time and was set aside (renamed,
+                             counted, never fatal to the other keys)
+    BatchTimeoutError        a dispatched serve batch overran the
+                             hung-batch watchdog's wall deadline
 
 ``Dcf.reset_backend_health()`` (or the module-level function — one
 shared invalidation path) wipes the process verdict cache AND notifies
@@ -158,9 +163,16 @@ expansions live in a serve-resident LRU keyed (key_id, generation,
 party, k) and survive residency churn, so a re-staged hot key skips
 the 2^k-node top-k expansion; ``serve_frontier_hits_total`` /
 ``_misses_total`` in the snapshot; False = the pre-cache
-instance-store behavior), ``max_queued_points`` (shed point) and
-``retries`` (fail-over persistence); full semantics in
-``dcf_tpu/serve/service.py`` and the README "Serving" section.
+instance-store behavior), ``max_queued_points`` (shed point),
+``retries`` (fail-over persistence), ``store_dir`` (ISSUE 8: the
+durable key store — ``register_key(..., durable=True)`` persists the
+frame atomically before acking and ``restore_keys()`` warm-restarts
+the registry with generations preserved and zero re-keygen; damaged
+frames quarantine typed) and ``batch_timeout_s`` (the hung-batch
+watchdog: an overdue dispatch fails ``BatchTimeoutError`` into the
+breaker/retry path instead of stalling the worker); full semantics in
+``dcf_tpu/serve/service.py`` and the README "Serving" /
+"Durability & restart" sections.
 
 Mixed-mode protocols (``dcf_tpu.protocols``)
 --------------------------------------------

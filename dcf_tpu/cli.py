@@ -808,9 +808,12 @@ def bench_full_domain(args) -> None:
           2 * (1 << n_bits) / dt, unit, dt, mad, len(ss))
 
 
-def _gen_serve_bundles(svc, native, rng, n_bundles, nb, lam) -> dict:
+def _gen_serve_bundles(svc, native, rng, n_bundles, nb, lam,
+                       durable: bool = False) -> dict:
     """``n_bundles`` fresh single-key two-party bundles, registered
-    under ``key-<i>`` (the serve_bench/chaos_bench workload shape)."""
+    under ``key-<i>`` (the serve_bench/chaos_bench workload shape).
+    ``durable=True`` writes each through the service's key store
+    (chaos_bench --crash-restart)."""
     bundles = {}
     for i in range(n_bundles):
         alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
@@ -818,7 +821,7 @@ def _gen_serve_bundles(svc, native, rng, n_bundles, nb, lam) -> dict:
         b = native.gen_batch(alphas, betas, random_s0s(1, lam, rng),
                              Bound.LT_BETA)
         bundles[f"key-{i}"] = b
-        svc.register_key(f"key-{i}", b)
+        svc.register_key(f"key-{i}", b, durable=durable)
     return bundles
 
 
@@ -1393,6 +1396,162 @@ def _parse_priority_mix(spec: str) -> dict:
     return mix
 
 
+def _chaos_flags(args) -> tuple:
+    """The fail-fast flag validation shared by chaos_bench's two
+    scenarios (flapping-window and --crash-restart) — one copy, or the
+    SystemExit wording the tests match on silently diverges.  Returns
+    ``(max_batch, min_req, max_req, window)``."""
+    if args.backend not in ("numpy", "jax", "bitsliced", "pallas",
+                            "prefix"):
+        raise SystemExit(
+            f"chaos_bench serves lam=16 single-device facade backends "
+            f"(numpy/jax/bitsliced/pallas/prefix), got {args.backend!r}")
+    max_batch = args.max_batch or 256
+    min_req = args.min_req_points or max(max_batch // 8, 1)
+    max_req = args.max_req_points or (max_batch // 2)
+    if not 1 <= min_req <= max_req:
+        raise SystemExit(f"bad request-size range [{min_req}, {max_req}]")
+    window = args.fault_window
+    if window < 1:
+        raise SystemExit(
+            f"--fault-window must be >= 1 failing eval, got {window}")
+    return max_batch, min_req, max_req, window
+
+
+def _chaos_crash_restart(args) -> None:
+    """``chaos_bench --crash-restart`` (ISSUE 8): the durable-store
+    process-lifecycle scenario.  A service with a key store registers
+    its bundles ``durable=True``, serves mixed load under a
+    ``serve.eval`` fault window, and is then KILLED mid-stage (closed
+    without draining while requests are in flight — the in-process
+    stand-in for SIGKILL; the deterministic fake-clock replays live in
+    tests/test_store.py).  A fresh service on the same store directory
+    restores, and the harness asserts:
+
+    * every durable key came back (``regen_count == 0`` — zero
+      re-keygen: the offline phase is the expensive one) with its
+      GENERATION preserved (no aliasing of pre-crash snapshots);
+    * nothing was quarantined (the store's atomic publish discipline
+      means a kill can never leave a torn visible frame);
+    * the restored registry serves BIT-EXACT two-party reconstructions
+      against the C++ host core (the same parity anchor every serve
+      bench uses).
+
+    Exit code != 0 on any violated assertion, so the scenario is
+    CI-usable like the flapping-window chaos soak.
+    """
+    import shutil
+    import tempfile
+
+    from dcf_tpu import Dcf
+    from dcf_tpu.native import NativeDcf
+    from dcf_tpu.serve.batcher import next_pow2
+    from dcf_tpu.serve.loadgen import closed_loop
+    from dcf_tpu.testing import faults
+
+    lam, nb = 16, 16
+    max_batch, min_req, max_req, window = _chaos_flags(args)
+    n_bundles = args.bundles or 3
+    store_dir = args.store_dir or tempfile.mkdtemp(prefix="dcf-chaos-")
+    cleanup = not args.store_dir  # keep an operator-chosen dir around
+    rng = np.random.default_rng(args.seed)
+    ck = _cipher_keys(lam, rng)
+    native = NativeDcf(lam, ck)
+    dcf = Dcf(nb, lam, ck, backend=args.backend)
+    try:
+        svc = dcf.serve(max_batch=max_batch,
+                        max_delay_ms=args.max_delay_ms, retries=1,
+                        breaker_failures=args.breaker_failures,
+                        breaker_cooldown_s=args.breaker_cooldown,
+                        store_dir=store_dir)
+        bundles = _gen_serve_bundles(svc, native, rng, n_bundles, nb,
+                                     lam, durable=True)
+        gens_pre = {k: svc.registry.snapshot(k)[2] for k in bundles}
+        m = next_pow2(min_req)
+        while m <= max_batch:  # compile ladder before timing anything
+            svc.submit("key-0",
+                       rng.integers(0, 256, (m, nb), dtype=np.uint8))
+            svc.pump()
+            m *= 2
+        _serve_parity_gate(svc, native, bundles, rng, nb, points=64,
+                           bench="chaos_bench", tag="pre-crash",
+                           timeout=30)
+        # Mixed load under a fail-then-recover window: durable keys
+        # must survive retries/invalidation sweeps like any other.
+        with faults.inject_schedule("serve.eval",
+                                    window_evals=window) as sched:
+            svc.start()
+            res = closed_loop(
+                svc, sorted(bundles), duration_s=float(args.duration),
+                concurrency=args.concurrency,
+                min_points=min_req, max_points=max_req, seed=args.seed)
+            # The KILL: in-flight submits, then shutdown without drain
+            # (queued futures fail typed; nothing is persisted beyond
+            # what register_key already acked — exactly a crash's view).
+            kill_futs = [svc.submit(
+                k, rng.integers(0, 256, (min_req, nb), dtype=np.uint8))
+                for k in sorted(bundles)]
+            svc.close(drain=False)
+        del svc  # abandoned, as a killed process would be
+
+        # Warm restart: fresh facade state, same store directory.
+        svc2 = dcf.serve(max_batch=max_batch, retries=1,
+                         store_dir=store_dir)
+        report = svc2.restore_keys()
+        failures = []
+        regen = sorted(set(bundles) - set(report.restored))
+        if regen:
+            failures.append(
+                f"regen_count={len(regen)}: durable keys {regen} did "
+                "not restore — keygen would have to re-run")
+        if report.quarantined:
+            failures.append(
+                f"quarantined on restore: {sorted(report.quarantined)} "
+                "— a kill must never leave a torn visible frame")
+        gens_post = {k: svc2.registry.snapshot(k)[2]
+                     for k in report.restored}
+        if gens_post != {k: gens_pre[k] for k in gens_post}:
+            failures.append(
+                f"generations drifted across restart: {gens_pre} -> "
+                f"{gens_post}")
+        if not failures:
+            _serve_parity_gate(svc2, native, bundles, rng, nb,
+                               points=64, bench="chaos_bench",
+                               tag="post-restart", timeout=30)
+        for line in failures:
+            log(f"CRASH-RESTART FAIL: {line}")
+        snap = svc2.metrics_snapshot()
+        extra = {
+            "scenario": "crash-restart",
+            "duration_s": round(res.duration_s, 3),
+            "concurrency": args.concurrency,
+            "max_batch": max_batch,
+            "bundles": n_bundles,
+            "fault_window": window,
+            "fault_evals_failed": sched.failed,
+            "requests_ok": res.requests_ok,
+            "requests_failed": res.requests_failed,
+            "killed_inflight": len(kill_futs),
+            "regen_count": len(regen),
+            "restored": len(report.restored),
+            "quarantined": len(report.quarantined),
+            "store_restored_total": snap.get(
+                "serve_store_restored_total", 0),
+            "assertions_failed": failures,
+        }
+        _emit("chaos_bench", args.backend, "restored_keys",
+              float(len(report.restored)),
+              "durable keys restored after the mid-stage kill",
+              extra_fields=extra)
+        if failures:
+            raise SystemExit(
+                f"chaos_bench --crash-restart: {len(failures)} "
+                "durability assertions failed")
+    finally:
+        if cleanup:
+            shutil.rmtree(store_dir, ignore_errors=True)
+
+
 def bench_chaos(args) -> None:
     """Chaos harness for the serve resilience layer (ISSUE 6).
 
@@ -1417,30 +1576,25 @@ def bench_chaos(args) -> None:
     run is CI-usable as a soak.  Uses the real clock — the driving loop
     is a load generator; the deterministic fake-clock replays of the
     same scenarios live in tests/test_chaos.py.
+
+    ``--crash-restart`` (ISSUE 8) switches to the durable-store
+    process-lifecycle scenario instead: durable keys, a mid-stage kill,
+    a warm restart from the store, and bit-exact post-restart parity vs
+    the C++ core with zero re-keygen (see ``_chaos_crash_restart``).
     """
     from dcf_tpu import Dcf
     from dcf_tpu.native import NativeDcf
     from dcf_tpu.serve.loadgen import closed_loop
     from dcf_tpu.testing import faults
 
+    if args.crash_restart:
+        _chaos_crash_restart(args)
+        return
     lam, nb = 16, 16
-    if args.backend not in ("numpy", "jax", "bitsliced", "pallas",
-                            "prefix"):
-        raise SystemExit(
-            f"chaos_bench serves lam=16 single-device facade backends "
-            f"(numpy/jax/bitsliced/pallas/prefix), got {args.backend!r}")
+    max_batch, min_req, max_req, window = _chaos_flags(args)
     mix = _parse_priority_mix(args.priority_mix)  # bad flags fail fast,
     # before the warmup ladder and parity gate spend real time
     skew = _parse_skew(args.skew)  # same edge discipline for --skew
-    max_batch = args.max_batch or 256
-    min_req = args.min_req_points or max(max_batch // 8, 1)
-    max_req = args.max_req_points or (max_batch // 2)
-    if not 1 <= min_req <= max_req:
-        raise SystemExit(f"bad request-size range [{min_req}, {max_req}]")
-    window = args.fault_window
-    if window < 1:
-        raise SystemExit(
-            f"--fault-window must be >= 1 failing eval, got {window}")
     n_bundles = args.bundles or 2
     rng = np.random.default_rng(args.seed)
     ck = _cipher_keys(lam, rng)
@@ -1735,6 +1889,15 @@ def main(argv=None) -> None:
     p.add_argument("--breaker-cooldown", type=float, default=0.25,
                    help="chaos_bench: seconds an open breaker waits "
                         "before its half-open probe")
+    p.add_argument("--crash-restart", action="store_true",
+                   help="chaos_bench: run the durable-store scenario "
+                        "instead — durable keys, a mid-stage kill, "
+                        "warm restart, bit-exact post-restart parity "
+                        "vs the C++ core with zero re-keygen")
+    p.add_argument("--store-dir", default="",
+                   help="chaos_bench --crash-restart: durable key "
+                        "store directory (default: a fresh temp dir, "
+                        "removed afterwards; an explicit dir is kept)")
     p.add_argument("--full", action="store_true",
                    help="baseline: run config 5 at the literal 10^6-key "
                         "scale (~20 min report)")

@@ -22,8 +22,11 @@ grouping it with geometry staleness is what lets callers write one
       +-- QueueFullError         (RuntimeError) serve admission shed the load
       +-- DeadlineExceededError  (TimeoutError) request deadline expired
       +-- CircuitOpenError       (RuntimeError) breaker open: failing fast
+      +-- KeyQuarantinedError    (RuntimeError) durable frame corrupt: set aside
+      +-- BatchTimeoutError      (TimeoutError) batch overran its wall deadline
 
-The last three belong to the online serving layer (``dcf_tpu.serve``):
+The serve-layer classes belong to the online serving layer
+(``dcf_tpu.serve``):
 admission control sheds load with ``QueueFullError`` — at submit time
 (queue bound hit, brownout refusal of low-priority classes, or a
 draining service) or through the future when a queued request is
@@ -33,7 +36,13 @@ passes before its batch is dispatched completes with
 routed at a backend whose per-(key, backend-family) circuit breaker is
 open fails fast with ``CircuitOpenError`` instead of burning retry
 budget and deadline headroom on a backend known to be dying
-(``serve.breaker``).
+(``serve.breaker``).  The durable key store (``serve.store``) sets a
+corrupt or truncated on-disk frame aside at restore time and reports it
+with ``KeyQuarantinedError`` — one damaged key must never be silently
+skipped NOR take the other restored keys down with it; and the
+hung-batch watchdog fails a dispatched batch that overran its
+configured wall deadline with ``BatchTimeoutError``, feeding the same
+breaker/retry machinery a plain failure would.
 
 Recovery is signalled, not silent: whenever the framework degrades to a
 slower-but-correct path (auto backend fallback, AES-NI -> portable native
@@ -53,6 +62,8 @@ __all__ = [
     "QueueFullError",
     "DeadlineExceededError",
     "CircuitOpenError",
+    "KeyQuarantinedError",
+    "BatchTimeoutError",
     "BackendFallbackWarning",
 ]
 
@@ -114,6 +125,31 @@ class CircuitOpenError(DcfError, RuntimeError):
     state; after the cooldown one probe half-opens the breaker and its
     outcome decides between closing and re-opening.  Surfaces through
     the request's result handle (``serve.breaker``)."""
+
+
+class KeyQuarantinedError(DcfError, RuntimeError):
+    """A durable key-store frame failed validation when read back (bad
+    magic, truncated payload, CRC mismatch — see ``KeyFormatError`` for
+    the underlying rejection, carried as ``__cause__``) and was set
+    aside: the file is renamed to ``<name>.quarantined-<n>`` and its
+    manifest entry dropped, so the damage is preserved for forensics
+    and the next restore does not trip over it again.  Raised by
+    ``serve.store.KeyStore.load``; ``KeyRegistry.restore`` catches it
+    PER KEY and records the quarantine in its report — one corrupt
+    frame is never silently skipped and never fatal to the other keys
+    (``serve.store``)."""
+
+
+class BatchTimeoutError(DcfError, TimeoutError):
+    """A dispatched serve batch overran the ``batch_timeout_s`` wall
+    deadline on the injectable clock (a wedged backend: the eval
+    neither completed nor errored in time).  The hung-batch watchdog
+    fails the batch typed, records a failure outcome against the
+    backend family that dispatched it (``serve.breaker``), and sends it
+    down the same retry/invalidation path a plain batch failure takes —
+    so a backend that hangs instead of crashing still demotes, still
+    opens its breaker, and still stops stalling the worker while the
+    queue sheds behind it (``serve.service``)."""
 
 
 class BackendFallbackWarning(UserWarning):

@@ -21,6 +21,11 @@ keys":
   frontier expansions, keyed (key_id, generation, party, k), sharing
   the registry's byte budget and deterministic LRU stamps (ISSUE 7:
   amortize the narrow-walk floor under skewed traffic);
+- ``serve.store``     durable key store (ISSUE 8): DCFK frames
+  published atomic write-fsync-rename under a CRC'd manifest, 0o600,
+  typed quarantine of damaged frames (``KeyQuarantinedError``) and the
+  warm-restart path ``KeyRegistry.restore`` /
+  ``DcfService.restore_keys`` preserving generations;
 - ``serve.metrics``   dependency-free counters/gauges/histograms with a
   deterministic snapshot (embedded in RESULTS_serve JSONL lines);
 - ``serve.service``   ``DcfService``: the worker loop tying it together,
@@ -38,6 +43,8 @@ from dcf_tpu.serve.frontier_cache import FrontierCache  # noqa: F401
 from dcf_tpu.serve.metrics import Metrics  # noqa: F401
 from dcf_tpu.serve.registry import KeyRegistry  # noqa: F401
 from dcf_tpu.serve.service import DcfService, ServeConfig  # noqa: F401
+from dcf_tpu.serve.store import KeyStore, RestoreReport  # noqa: F401
 
 __all__ = ["DcfService", "ServeConfig", "ServeFuture", "Priority",
-           "BreakerBoard", "FrontierCache", "Metrics", "KeyRegistry"]
+           "BreakerBoard", "FrontierCache", "Metrics", "KeyRegistry",
+           "KeyStore", "RestoreReport"]

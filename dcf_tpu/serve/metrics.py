@@ -28,6 +28,16 @@ invalidation), and the ``serve_frontier_cache_bytes`` /
 ``serve_frontier_cache_entries`` gauges.  Hit rate =
 hits / (hits + misses); ``serve_bench --skew`` reports it per run.
 
+Durability series (ISSUE 8, recorded by ``serve.store.KeyStore`` and
+the warm-restart path): ``serve_store_writes_total`` /
+``serve_store_deletes_total`` (durable publishes and removals),
+``serve_store_quarantined_total`` (frames set aside typed at read
+time), ``serve_store_restored_total`` (keys ``KeyRegistry.restore``
+re-registered with their generations preserved), and the
+``serve_store_keys`` gauge.  The hung-batch watchdog adds
+``serve_batch_timeouts_total`` (batches failed typed with
+``BatchTimeoutError`` for overrunning ``batch_timeout_s``).
+
 Secret hygiene: metric NAMES are static strings and metric values are
 scalars; key ids chosen by callers become label values via ``labeled``
 and must never be derived from key material (the dcflint secret-hygiene

@@ -28,6 +28,13 @@ LRU order is tracked with a deterministic access counter, not a clock:
 eviction order must be a pure function of the request sequence so tests
 can pin it (and the dcflint determinism pass holds serve code to that).
 
+ISSUE 8: ``restore(store)`` is the warm-restart path — a
+``serve.store.KeyStore`` re-registers every durable key at startup
+with its persisted generation intact (and the registry's generation
+counter advanced past all of them, so nothing a later hot-swap mints
+can alias a pre-crash snapshot).  Quarantined frames are reported and
+skipped per key, never fatal to the rest.
+
 ISSUE 7: a ``serve.frontier_cache.FrontierCache`` can live beside the
 registry — prefix-family backends then keep their expanded top-k
 frontiers in it (keyed (key_id, generation, party, k)) instead of the
@@ -169,8 +176,10 @@ class KeyRegistry:
     # -- registration -------------------------------------------------------
 
     def register(self, key_id: str, bundle: KeyBundle,
-                 protocol=None) -> None:
-        """Register (or replace) the bundle served under ``key_id``.
+                 protocol=None) -> int:
+        """Register (or replace) the bundle served under ``key_id``;
+        returns the entry's generation (the durable write-through path
+        publishes the frame under it).
 
         The bundle must be the full two-party bundle: the service serves
         both parties, and the keylanes image is two-party by design.
@@ -190,13 +199,15 @@ class KeyRegistry:
             prev = self._entries.get(key_id)
             if prev is not None and prev.bundle is bundle \
                     and prev.protocol is protocol:
-                return  # idempotent re-registration: keep the residencies
+                # idempotent re-registration: keep the residencies
+                return prev.generation
             self._generation += 1
             if prev is not None:
                 self._evict_entry(key_id, prev)
             self._entries[key_id] = _Entry(bundle, self._generation,
                                            protocol)
             self._g_registered.set(len(self._entries))
+            return self._generation
 
     def unregister(self, key_id: str) -> None:
         with self._lock:
@@ -206,6 +217,68 @@ class KeyRegistry:
             self._g_registered.set(len(self._entries))
         if self._breakers is not None:
             self._breakers.forget(key_id)
+
+    def restore(self, store) -> "RestoreReport":
+        """Warm restart (ISSUE 8): re-register every key a
+        ``serve.store.KeyStore`` holds, PRESERVING each key's persisted
+        generation — and advance this registry's generation counter
+        past the highest restored one, so a post-restore hot-swap
+        mints a generation no pre-crash snapshot (or pre-crash durable
+        frame) ever carried.  That is the PR 5 aliasing guard extended
+        across process death: a restored key must never share a
+        generation with different key content.
+
+        A frame the store quarantines (corrupt, truncated, vanished)
+        is recorded in the report and SKIPPED — typed, counted, never
+        fatal to the other keys.  A corrupt MANIFEST, by contrast,
+        raises ``KeyFormatError``: without a trustworthy index there is
+        nothing safe to restore.  Returns the ``RestoreReport``
+        (``restored``: key_id -> generation; ``quarantined``: key_id ->
+        failure message)."""
+        from dcf_tpu.serve.store import RestoreReport
+
+        report = RestoreReport()
+        store.sweep_orphans()  # crash debris: unreferenced frames/tmps
+        loaded, report.quarantined = store.load_all()  # ONE manifest
+        # read for the whole restore — per-key load() would make this
+        # O(n^2) manifest parses over n stored keys
+        for key_id in sorted(loaded):
+            bundle, protocol, generation = loaded[key_id]
+            if bundle.s0s.shape[1] != 2:
+                # the store's put() refuses one-party frames, so this
+                # is defense in depth against a hand-edited store —
+                # and it must REALLY quarantine (rename aside, drop the
+                # manifest entry, bump the counter), or every later
+                # restore re-reads the bad frame and re-reports it
+                # forever while its manifest entry lingers.
+                store.quarantine(key_id)
+                report.quarantined[key_id] = (
+                    "restored frame is party-restricted; the service "
+                    "serves both parties")
+                continue
+            with self._lock:
+                prev = self._entries.get(key_id)
+                if prev is not None:
+                    self._evict_entry(key_id, prev)
+                self._entries[key_id] = _Entry(bundle, generation,
+                                               protocol)
+                self._generation = max(self._generation, generation)
+                self._g_registered.set(len(self._entries))
+            report.restored[key_id] = generation
+        self._metrics.counter("serve_store_restored_total").inc(
+            len(report.restored))
+        return report
+
+    def sync_generation_floor(self, floor: int) -> None:
+        """Advance the generation counter to at least ``floor`` (a
+        store-backed service passes its store's ``max_generation()`` at
+        construction).  Without this, a FRESH process on an existing
+        store that registers durably BEFORE restoring would mint
+        generations the manifest already records — the store's
+        monotonic guard would then silently drop the write-through,
+        un-acking an acked durable registration."""
+        with self._lock:
+            self._generation = max(self._generation, int(floor))
 
     def bundle(self, key_id: str) -> KeyBundle:
         with self._lock:

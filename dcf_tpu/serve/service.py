@@ -58,6 +58,22 @@ Load-bearing knobs (``ServeConfig``):
   and ``brownout_clear_s`` of calm exits it (hysteresis: entry and
   exit are separated so a queue oscillating around the threshold does
   not flap the gate).
+* ``store_dir`` — durable key store (ISSUE 8, ``serve.store``): a
+  directory holding DCFK frames published write-fsync-rename under a
+  CRC'd manifest.  ``register_key(..., durable=True)`` writes through
+  BEFORE acking; after a crash, ``restore_keys()`` re-registers every
+  durable key with its generation preserved (zero re-keygen — the
+  offline phase is the expensive one) and quarantines damaged frames
+  typed (``KeyQuarantinedError``) without failing the rest.  Empty
+  (the default) = no store; ``durable=True`` then fails loudly.
+* ``batch_timeout_s`` — the hung-batch watchdog: a wall deadline (on
+  the injectable clock) each dispatched batch must complete within.
+  An overdue batch fails typed (``BatchTimeoutError``), records a
+  breaker outcome against the family it DISPATCHED on, and takes the
+  same retry/invalidation path a plain batch failure takes — so a
+  backend that wedges instead of crashing still demotes and still
+  stops stalling the worker while the queue sheds behind it.  0 (the
+  default) disables the watchdog.
 
 Pipelining: within a batch run, host->device staging of batch N+1
 overlaps the (async) device eval of batch N — the worker dispatches
@@ -86,7 +102,9 @@ import numpy as np
 
 from dcf_tpu.errors import (
     BackendUnavailableError,
+    BatchTimeoutError,
     CircuitOpenError,
+    DeadlineExceededError,
     ShapeError,
 )
 from dcf_tpu.protocols import ProtocolBundle
@@ -112,6 +130,7 @@ from dcf_tpu.serve.batcher import (
 from dcf_tpu.serve.frontier_cache import FrontierCache
 from dcf_tpu.serve.metrics import Metrics, OCCUPANCY_BOUNDS
 from dcf_tpu.serve.registry import KeyRegistry
+from dcf_tpu.serve.store import KeyStore
 from dcf_tpu.testing.faults import fire
 from dcf_tpu.utils.benchtime import monotonic
 
@@ -134,6 +153,8 @@ class ServeConfig:
     brownout_queue_fraction: float = 0.75
     brownout_after_s: float = 0.5
     brownout_clear_s: float = 1.0
+    store_dir: str = ""
+    batch_timeout_s: float = 0.0
 
     def __post_init__(self):
         if self.max_batch < 1 or self.max_batch & (self.max_batch - 1):
@@ -172,6 +193,10 @@ class ServeConfig:
             # api-edge: config contract
             raise ValueError(
                 "brownout_after_s/brownout_clear_s must be >= 0")
+        if self.batch_timeout_s < 0:
+            # api-edge: config contract (0 disables the watchdog)
+            raise ValueError(
+                f"batch_timeout_s must be >= 0, got {self.batch_timeout_s}")
 
 
 class _Batch:
@@ -229,6 +254,22 @@ class DcfService:
             frontier_cache=self.frontier_cache)
         self.queue = AdmissionQueue(self.config.max_queued_points,
                                     metrics=self.metrics)
+        # Durable key store (ISSUE 8): the write-through target of
+        # register_key(durable=True) and the source restore_keys()
+        # re-registers from after a crash.
+        self.store = (KeyStore(self.config.store_dir,
+                               metrics=self.metrics)
+                      if self.config.store_dir else None)
+        if self.store is not None:
+            # Floor the registry's generation counter on the store's
+            # highest persisted generation BEFORE anything registers:
+            # a fresh process on an existing store must never mint a
+            # generation the manifest already records (the store's
+            # monotonic put guard would silently drop that durable
+            # write-through), and restore() preserving generations
+            # stays exact either way.
+            self.registry.sync_generation_floor(
+                self.store.max_generation())
         self._worker: threading.Thread | None = None
         self._pump_lock = threading.Lock()  # one batch runner at a time
         self._pump_owner: int | None = None  # thread id holding the lock
@@ -246,6 +287,8 @@ class DcfService:
         self._c_failures = m.counter("serve_batch_failures_total")
         self._c_breaker_fastfail = m.counter(
             "serve_breaker_fast_fails_total")
+        self._c_batch_timeouts = m.counter("serve_batch_timeouts_total")
+        self._c_deadline = m.counter("serve_deadline_expired_total")
         self._h_occupancy = m.histogram("serve_batch_occupancy",
                                         OCCUPANCY_BOUNDS)
         self._h_stage = m.histogram("serve_stage_s")
@@ -270,7 +313,8 @@ class DcfService:
 
     # -- key management -----------------------------------------------------
 
-    def register_key(self, key_id: str, bundle) -> None:
+    def register_key(self, key_id: str, bundle,
+                     durable: bool = False) -> None:
         """Register (or hot-swap) the two-party bundle ``key_id`` serves.
         Swapping evicts the old device residencies atomically.
 
@@ -282,7 +326,20 @@ class DcfService:
         mask) when it fetches each batch, under the same admission/
         deadline/retry semantics.  Futures for a protocol key resolve
         to uint8 [m, M, lam] (per-interval shares) instead of
-        [K, M, lam]."""
+        [K, M, lam].
+
+        ``durable=True`` (ISSUE 8, needs ``store_dir``): the frame is
+        written through to the durable store — atomic
+        write-fsync-rename under the key's registry generation —
+        BEFORE this call returns, so an acked durable registration
+        survives a crash and ``restore_keys()`` brings it back with
+        zero re-keygen.  If the persist raises (disk fault), the key
+        IS live in the registry but NOT durable — the caller must
+        treat the exception as "not persisted" and retry or
+        re-register.  Hot-swapping a durable key with ``durable=False``
+        deliberately leaves the previous durable snapshot in the store
+        (durability is opt-in per write; a crash then restores the
+        last DURABLE generation)."""
         protocol = None
         if isinstance(bundle, ProtocolBundle):
             protocol, bundle = bundle, bundle.keys
@@ -293,10 +350,39 @@ class DcfService:
             raise ShapeError(
                 f"bundle domain {bundle.n_bits} bits != service domain "
                 f"{8 * self._dcf.n_bytes} bits")
-        self.registry.register(key_id, bundle, protocol=protocol)
+        if durable and self.store is None:
+            # api-edge: config contract — silently accepting a durable
+            # registration with nowhere to persist it would be exactly
+            # the data loss the flag exists to prevent
+            raise ValueError(
+                f"register_key({key_id!r}, durable=True) needs a "
+                "configured store (ServeConfig.store_dir)")
+        generation = self.registry.register(key_id, bundle,
+                                            protocol=protocol)
+        if durable:
+            self.store.put(key_id, bundle, protocol=protocol,
+                           generation=generation)
 
     def unregister_key(self, key_id: str) -> None:
+        """Forget ``key_id`` entirely: registry entry, residencies,
+        breaker history — and its durable frame, when a store is
+        configured (the name ceased to exist; restoring it after this
+        would resurrect a key the operator deleted)."""
         self.registry.unregister(key_id)
+        if self.store is not None:
+            self.store.delete(key_id)
+
+    def restore_keys(self):
+        """Warm restart (ISSUE 8): re-register every key the durable
+        store holds, preserving generations (zero re-keygen; damaged
+        frames quarantined typed, never fatal to the rest — see
+        ``KeyRegistry.restore``).  Returns the ``RestoreReport``."""
+        if self.store is None:
+            # api-edge: config contract (restore needs a store)
+            raise ValueError(
+                "restore_keys() needs a configured store "
+                "(ServeConfig.store_dir)")
+        return self.registry.restore(self.store)
 
     def key_ids(self) -> list[str]:
         return self.registry.key_ids()
@@ -360,6 +446,66 @@ class DcfService:
             self.breakers.record_success(key_id, family)
         else:
             self.breakers.record_failure(key_id, family)
+
+    def _watchdog_check(self, batch: _Batch,
+                        since: float | None = None) -> None:
+        """The hung-batch watchdog (ISSUE 8): raise typed if ``batch``
+        overran its wall deadline.  Called INSIDE the dispatch/fetch
+        containment try blocks, so an overdue batch records a failure
+        outcome against the family it dispatched on and takes the
+        existing retry/invalidation path — a backend that wedges (eats
+        the clock without erroring) degrades exactly like one that
+        crashes, instead of stalling the worker forever while the queue
+        sheds behind it.
+
+        Two windows are judged SEPARATELY: the dispatch window
+        (``batch.t0`` to dispatch-complete — a stage/eval call that ate
+        the clock) and, via ``since``, the fetch wait on its own.  The
+        pipeline overlap between them (batch N+1 staging while N is in
+        flight) is deliberately charged to NEITHER: that time is the
+        worker doing productive work, and charging it to batch N would
+        spuriously fail a healthy batch whenever staging is slower than
+        the timeout — double-burning device work on the retry.  Python
+        cannot preempt a call that never returns; the watchdog's
+        contract is that a slow call is judged against the injectable
+        clock the moment it yields, which the ``latency`` fault seam
+        makes deterministically testable."""
+        timeout = self.config.batch_timeout_s
+        if not timeout:
+            return
+        elapsed = self._clock() - (batch.t0 if since is None else since)
+        if elapsed > timeout:
+            self._c_batch_timeouts.inc()
+            raise BatchTimeoutError(
+                f"batch overran its wall deadline: {elapsed:.3f}s "
+                f"elapsed > batch_timeout_s={timeout}s on backend "
+                f"family {batch.family!r} — treating the dispatch as "
+                "hung")
+
+    def _expire_at_dispatch(self, group: list[Request], errors: dict,
+                            pending) -> None:
+        """Deadline enforcement at DISPATCH time (ISSUE 8 satellite):
+        batch formation already expired what was overdue THEN, but a
+        request can outlive its deadline while its batch sits in the
+        dispatch-ahead slot behind a slow eval — burning a device eval
+        on it would produce a share the caller already abandoned.
+        Marks newly-expired requests failed (``DeadlineExceededError``
+        through the group's error map, same counter as queue expiry);
+        the plan loop then skips any batch whose every request is
+        already failed.
+
+        ``pending``: the request indices with spans in the current or a
+        LATER plan.  A request whose evaluation already completed in
+        earlier plans is never swept — failing it here would discard a
+        finished result after its device work was burned, and make the
+        outcome depend on what it happened to be co-batched with."""
+        now = self._clock()
+        for i in pending:
+            if i not in errors and group[i].expired(now):
+                errors[i] = DeadlineExceededError(
+                    f"deadline passed in the dispatch-ahead slot "
+                    f"({group[i]!r})")
+                self._c_deadline.inc()
 
     def _update_brownout(self, now: float) -> None:
         """Enter/exit brownout with hysteresis (see the module
@@ -517,8 +663,27 @@ class DcfService:
 
         # Dispatch-ahead pipeline: batch N+1 is staged and dispatched
         # while batch N's result is still in flight; N is fetched after.
+        # last_plan: each request's final plan index, so the dispatch-
+        # time deadline sweep only touches requests with work still
+        # ahead of the current plan.
+        last_plan: dict[int, int] = {}
+        for pi, plan in enumerate(plans):
+            for sp in plan.spans:
+                last_plan[sp.req] = pi
         prev: _Batch | None = None
-        for plan in plans:
+        dispatched = 0
+        for pi, plan in enumerate(plans):
+            self._expire_at_dispatch(
+                group, errors,
+                [i for i, last in last_plan.items() if last >= pi])
+            if all(sp.req in errors for sp in plan.spans):
+                # Every request this batch would evaluate has already
+                # failed (deadline expired in the dispatch-ahead slot):
+                # skip the eval outright — ``prev`` stays in flight and
+                # completes on the next dispatched plan or after the
+                # loop.
+                continue
+            dispatched += 1
             cur, y, err = self._run_batch(key_id, b, plan, xs_list, snap)
             if prev is not None:
                 self._complete(prev, key_id, b, xs_list, finish, snap)
@@ -538,7 +703,7 @@ class DcfService:
                 r.future.set_exception(errors[i])
             else:
                 r.future.set_result(outs[i])
-        return len(plans)
+        return dispatched
 
     # -- batch execution ----------------------------------------------------
 
@@ -551,7 +716,9 @@ class DcfService:
         when retries were exhausted."""
         fam = self._dcf.backend_name  # the family this attempt runs on
         try:
-            return self._dispatch(key_id, b, plan, xs_list, snap), None, None
+            batch = self._dispatch(key_id, b, plan, xs_list, snap)
+            self._watchdog_check(batch)  # a dispatch that ate the clock
+            return batch, None, None
         except Exception as e:  # fallback-ok: ANY backend/seam failure
             # must be contained to this batch (retried or failed), never
             # allowed to kill the serve worker
@@ -629,8 +796,12 @@ class DcfService:
         """Fetch an in-flight batch; a fetch-time failure (the dispatch
         is async — compile/execute errors can surface here) takes the
         same retry path as a dispatch-time one."""
+        t_fetch = self._clock()  # the fetch WAIT is judged on its own:
+        # time since dispatch includes batch N+1's staging (pipeline
+        # overlap — productive, not a stall) and must not count
         try:
             y = batch.fetch()
+            self._watchdog_check(batch, since=t_fetch)
         except Exception as e:  # fallback-ok: ANY backend/seam failure
             # must be contained to this batch (retried or failed), never
             # allowed to kill the serve worker
@@ -671,6 +842,7 @@ class DcfService:
             try:
                 batch = self._dispatch(key_id, b, plan, xs_list, snap)
                 y = batch.fetch()
+                self._watchdog_check(batch)  # a wedged retry fails too
             except Exception as e:  # fallback-ok: retry loop boundary —
                 # the last failure is reported to the affected requests
                 self._record_outcome(key_id, fam, ok=False)

@@ -40,6 +40,19 @@ of the time, a slow one that eats deadline headroom without erroring.
   ADVANCES the injectable clock (``FakeClock.advance``) instead of
   sleeping, so deadline expiry under a slow backend is testable in
   microseconds of wall time.  Chain ``then=`` for slow-AND-failing.
+  Armed at ``serve.eval`` it IS the slow-eval seam the hung-batch
+  watchdog tests drive: advancing the clock past ``batch_timeout_s``
+  at the dispatch fire is indistinguishable from a wedged backend.
+
+Durable-store seams (ISSUE 8): ``store.write`` / ``store.manifest``
+fire AFTER the temp file is written and fsynced but BEFORE the atomic
+rename publishes it (handler args: key_id — the caller-chosen name,
+never key material — and the temp path).  A raising handler models a
+crash before the rename (the store keeps its previous consistent
+state); ``torn_write(nbytes)`` is the partial-write handler factory —
+it truncates the temp file and lets the rename proceed, so a torn
+frame lands DURABLY on disk, exactly what a power cut mid-flush leaves
+behind for the quarantine machinery to find at restore.
 """
 
 from __future__ import annotations
@@ -60,6 +73,7 @@ __all__ = [
     "inject_schedule",
     "flaky",
     "latency",
+    "torn_write",
 ]
 
 
@@ -85,6 +99,14 @@ POINTS = (
     #                     handler args: m_intervals, batch_points
     #                     (-1 on the device path, where the point count
     #                     is not yet materialized))
+    "store.write",      # durable key-frame publish (serve/store.py —
+    #                     fires after write+fsync of the temp file,
+    #                     before the atomic rename; handler args:
+    #                     key_id, tmp_path.  Raise = crash pre-rename;
+    #                     torn_write = partial write made durable)
+    "store.manifest",   # manifest publish (serve/store.py — same
+    #                     write-fsync-rename seam for the CRC'd
+    #                     manifest; handler args: "", tmp_path)
 )
 
 _ACTIVE: dict[str, Callable] = {}
@@ -233,6 +255,22 @@ def latency(clock: FakeClock, seconds: float,
         clock.advance(seconds)
         if then is not None:
             then(*args)
+
+    return handler
+
+
+def torn_write(nbytes: int) -> Callable:
+    """Handler factory for the ``store.write``/``store.manifest`` seams:
+    truncate the not-yet-renamed temp file to ``nbytes`` and RETURN, so
+    the atomic rename proceeds and the torn frame becomes durable — the
+    on-disk state a power cut mid-flush (or an fsync that lied) leaves
+    behind.  The quarantine path, not the writer, must absorb it."""
+    if nbytes < 0:
+        raise ValueError(f"nbytes must be >= 0, got {nbytes}")
+
+    def handler(_key_id, path, *_args):
+        with open(path, "r+b") as fh:
+            fh.truncate(nbytes)
 
     return handler
 

@@ -175,6 +175,48 @@ def test_cli_chaos_bench_rejects_non_facade_backend():
         cli.main(["chaos_bench", "--backend=cpu"])
 
 
+@pytest.mark.slow
+@pytest.mark.durability
+def test_cli_chaos_bench_crash_restart_smoke(capsys, tmp_path):
+    """ISSUE 8: chaos_bench --crash-restart end to end — durable keys
+    survive a mid-stage kill, restore with zero re-keygen and preserved
+    generations, and the post-restart two-party parity gate vs the C++
+    core passes (the harness raises SystemExit otherwise)."""
+    recs = run_cli(
+        capsys,
+        ["chaos_bench", "--backend=numpy", "--crash-restart",
+         "--duration=2", "--max-batch=64", "--concurrency=2",
+         "--fault-window=6", "--breaker-cooldown=0.05",
+         f"--store-dir={tmp_path / 'store'}"],
+    )
+    assert recs[0]["bench"] == "chaos_bench"
+    assert recs[0]["scenario"] == "crash-restart"
+    assert recs[0]["assertions_failed"] == []
+    assert recs[0]["regen_count"] == 0
+    assert recs[0]["restored"] == recs[0]["bundles"]
+    assert recs[0]["quarantined"] == 0
+    # an operator-chosen --store-dir is kept around for forensics
+    assert (tmp_path / "store" / "MANIFEST.dcfm").exists()
+
+
+@pytest.mark.durability
+def test_cli_crash_restart_validates_flags_fast(tmp_path):
+    """The --crash-restart scenario applies the same fail-fast flag
+    discipline as the other serve benches: bad ranges/windows die
+    loudly before the bundle gen and warmup ladder spend real time."""
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="request-size range"):
+        cli.main(["chaos_bench", "--backend=bitsliced",
+                  "--crash-restart", "--max-batch=64",
+                  "--min-req-points=200"])
+    with pytest.raises(SystemExit, match="fault-window"):
+        cli.main(["chaos_bench", "--backend=bitsliced",
+                  "--crash-restart", "--fault-window=0"])
+    with pytest.raises(SystemExit, match="chaos_bench"):
+        cli.main(["chaos_bench", "--backend=cpu", "--crash-restart"])
+
+
 def test_cli_chaos_bench_validates_range_and_window_fast():
     """A bad request-size range or fault window dies loudly BEFORE the
     bundle gen / warmup ladder spend real time — a min_req > max_req

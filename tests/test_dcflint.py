@@ -252,6 +252,46 @@ def test_frontier_cache_layer_lint_clean():
     assert run_path(REPO / "dcf_tpu" / "backends" / "frontier.py") == []
 
 
+def test_secret_hygiene_covers_store_layer(tmp_path):
+    """ISSUE 8 rule 4: the durable store layer.  ``frame`` joined the
+    key-material name set (a serialized DCFK frame IS the key), and a
+    ``serve/store.py`` creating files with builtin ``open`` in a write
+    mode — umask-default permissions for bytes that must be 0o600 — is
+    flagged; read-mode opens and the same write elsewhere are not."""
+    write(tmp_path, "serve/store.py", (
+        "def publish(path, frame, key_frame):\n"
+        "    log(f'writing {frame}')\n"                   # name leak
+        "    with open(path, 'wb') as fh:\n"              # write mode
+        "        fh.write(frame)\n"
+        "    with open(path, 'rb') as fh:\n"              # read: fine
+        "        return fh.read()\n"
+        "def publish_kw(path, data):\n"
+        "    fh = open(path, mode='x+b')\n"               # kw write mode
+        "    fh.write(data)\n"))
+    got = [v for v in run_path(tmp_path, ["secret-hygiene"])
+           if v.path.endswith("store.py")]
+    assert [v.line for v in got] == [2, 3, 8]
+    assert "0o600" in got[1].message
+    # the same write-mode open OUTSIDE the store layer is not the
+    # store rule's business (other passes own general file hygiene)
+    write(tmp_path, "util.py", (
+        "def save(path, data):\n"
+        "    with open(path, 'wb') as fh:\n"
+        "        fh.write(data)\n"))
+    assert [v for v in run_path(tmp_path, ["secret-hygiene"])
+            if v.path.endswith("util.py")] == []
+
+
+def test_store_layer_lint_clean():
+    """The ISSUE-8 CI satellite: the durable store module sweeps clean
+    under ALL six passes — in particular secret-hygiene (no
+    key-material names in log/print/metric sinks; store files created
+    through the os.open 0o600 helper, pinned by rule 4's own scope)
+    and determinism (no clocks, no RNG: on-disk bytes are a pure
+    function of the store's logical state)."""
+    assert run_path(REPO / "dcf_tpu" / "serve" / "store.py") == []
+
+
 def test_determinism_detects_and_exempts(tmp_path):
     bad = ("import time, random\n"
            "import numpy as np\n"

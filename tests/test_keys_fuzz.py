@@ -16,16 +16,26 @@ path whose size arithmetic no longer matches (v1 has no trailer, v3 has
 a wider header), which the strict exact-size section decode rejects.
 The fuzz pins exactly that reasoning against regressions in either
 reader (they share ``keys._decode_sections`` by design).
+
+ISSUE 8 extends the sweep to the DURABLE STORE: the same seeded flips
+and truncations applied to the on-disk frame files and to the CRC'd
+manifest.  A mutated frame read back through ``KeyStore.load`` must die
+``KeyQuarantinedError`` (the typed quarantine — renamed aside, counter
+bumped, the other keys untouched); a mutated manifest must die
+``KeyFormatError`` on any store operation — never bare, never silent.
 """
+
+import os
 
 import numpy as np
 import pytest
 
-from dcf_tpu.errors import KeyFormatError
+from dcf_tpu.errors import KeyFormatError, KeyQuarantinedError
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.native import NativeDcf
 from dcf_tpu.protocols import ProtocolBundle
 from dcf_tpu.protocols.keygen import gen_interval_bundle
+from dcf_tpu.serve.store import MANIFEST_NAME, KeyStore
 from dcf_tpu.spec import Bound
 from dcf_tpu.testing import faults
 
@@ -122,3 +132,132 @@ def test_truncations_and_extensions_rejected_typed(v2_frame, v3_frame,
                 decode(frame[:cut])
         with pytest.raises(KeyFormatError):
             decode(frame + b"\x00")
+
+
+# --------------------------------------- the durable store (ISSUE 8)
+
+
+def _overwrite(path, data: bytes) -> None:
+    """Replace a store file's bytes in place, bypassing the writer (the
+    fuzz models external damage, not the atomic-publish path)."""
+    tmp = str(path) + ".fuzz"
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.write(fd, data)
+    finally:
+        os.close(fd)
+    os.replace(tmp, str(path))
+
+
+@pytest.fixture()
+def store_with_keys(v2_frame, v3_frame, tmp_path):
+    """A store holding one plain and one protocol key, plus the frame
+    paths for direct mutation."""
+    store = KeyStore(str(tmp_path))
+    kb = KeyBundle.from_bytes(v2_frame)
+    pb = ProtocolBundle.from_bytes(v3_frame)
+    store.put("plain", kb, generation=1)
+    store.put("proto", pb.keys, protocol=pb, generation=2)
+    entries = store._read_manifest()
+    paths = {key: tmp_path / entries[key]["file"]
+             for key in ("plain", "proto")}
+    return store, {"plain": kb, "proto": pb}, paths
+
+
+@pytest.mark.parametrize("key", ["plain", "proto"])
+def test_store_frame_byte_flips_quarantined_typed(store_with_keys, rng,
+                                                  key):
+    """Every seeded flip of an on-disk frame dies
+    ``KeyQuarantinedError`` at ``KeyStore.load`` — never bare, never
+    silent — and the pristine frame re-published after each flip loads
+    again (the quarantine took the damaged file, not the key id)."""
+    store, originals, paths = store_with_keys
+    path, n_flips = paths[key], 60
+    pristine = open(path, "rb").read()
+    offsets = rng.integers(0, len(pristine), n_flips)
+    xors = rng.integers(1, 256, n_flips)
+    for i, (off, xor) in enumerate(zip(offsets, xors)):
+        _overwrite(path, faults.corrupt(pristine, int(off), int(xor)))
+        try:
+            store.load(key)
+        except KeyQuarantinedError:
+            pass  # the contract: typed quarantine
+        except BaseException as e:  # noqa: BLE001 — the fuzz's point
+            pytest.fail(
+                f"flip #{i} (offset {off}, xor {xor:#04x}) escaped the "
+                f"typed-quarantine contract: {type(e).__name__}: {e}")
+        else:
+            pytest.fail(
+                f"flip #{i} (offset {off}, xor {xor:#04x}) loaded "
+                "SILENTLY — corrupt key material accepted from disk")
+        # re-publish the pristine frame for the next flip (the
+        # quarantine dropped the manifest entry)
+        obj = originals[key]
+        if key == "proto":
+            store.put(key, obj.keys, protocol=obj,
+                      generation=store._read_manifest().get(
+                          key, {}).get("generation", 2))
+        else:
+            store.put(key, obj, generation=1)
+        store.load(key)
+    assert store._metrics.snapshot()[
+        "serve_store_quarantined_total"] == n_flips
+
+
+def test_store_frame_truncations_quarantined_typed(store_with_keys,
+                                                   rng):
+    """Truncation sweeps on the on-disk frames: typed quarantine at
+    every cut point and on a tail extension."""
+    store, originals, paths = store_with_keys
+    for key in ("plain", "proto"):
+        path = paths[key]
+        pristine = open(path, "rb").read()
+        cuts = sorted({int(c) for c in
+                       rng.integers(0, len(pristine), 12)})
+        for mutated in [pristine[:c] for c in cuts] + [pristine + b"\0"]:
+            _overwrite(path, mutated)
+            with pytest.raises(KeyQuarantinedError):
+                store.load(key)
+            obj = originals[key]
+            if key == "proto":
+                store.put(key, obj.keys, protocol=obj, generation=2)
+            else:
+                store.put(key, obj, generation=1)
+
+
+def test_manifest_byte_flips_rejected_typed(store_with_keys, rng):
+    """Every seeded flip of the CRC'd manifest dies ``KeyFormatError``
+    on the next store operation — a store whose index cannot be
+    trusted must fail loudly, not serve a guess."""
+    store, _originals, _paths = store_with_keys
+    path = os.path.join(store.root, MANIFEST_NAME)
+    pristine = open(path, "rb").read()
+    offsets = rng.integers(0, len(pristine), 60)
+    xors = rng.integers(1, 256, 60)
+    for i, (off, xor) in enumerate(zip(offsets, xors)):
+        _overwrite(path, faults.corrupt(pristine, int(off), int(xor)))
+        try:
+            store.key_ids()
+        except KeyFormatError:
+            pass
+        except BaseException as e:  # noqa: BLE001 — the fuzz's point
+            pytest.fail(
+                f"manifest flip #{i} (offset {off}, xor {xor:#04x}) "
+                f"escaped the typed-error contract: "
+                f"{type(e).__name__}: {e}")
+        else:
+            pytest.fail(
+                f"manifest flip #{i} (offset {off}, xor {xor:#04x}) "
+                "read SILENTLY — a corrupt index accepted")
+        _overwrite(path, pristine)
+    # truncations and a tail extension die typed too
+    for cut in sorted({int(c) for c in
+                       rng.integers(0, len(pristine), 15)}):
+        _overwrite(path, pristine[:cut])
+        with pytest.raises(KeyFormatError):
+            store.key_ids()
+    _overwrite(path, pristine + b"\x00")
+    with pytest.raises(KeyFormatError):
+        store.key_ids()
+    _overwrite(path, pristine)
+    assert store.key_ids() == ["plain", "proto"]  # pristine still reads

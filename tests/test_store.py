@@ -1,0 +1,664 @@
+"""Durable key store, warm restart, and hung-batch watchdog (ISSUE 8).
+
+Three clusters, all deterministic (fake clock + ``pump()``, injected
+fault seams, tmp-dir stores):
+
+* **Store mechanics** — DCFK v2/v3 frames published write-fsync-rename
+  under a CRC'd manifest: roundtrips bit-exact for plain AND protocol
+  bundles, files ``0o600``, crash-pre-rename keeps the old state
+  (``store.write``/``store.manifest`` seams), a torn write made durable
+  (``faults.torn_write``) quarantines typed, orphan sweep.
+* **Warm restart** — the acceptance scenario: a service with durable
+  keys is killed mid-stage, a fresh service restores from the store,
+  every key comes back with its GENERATION preserved and zero
+  re-keygen, and serves bit-exact two-party reconstructions vs the
+  numpy oracle AND the C++ host core; a corrupt frame at restore time
+  quarantines exactly that key and the rest still serve; post-restore
+  hot-swaps mint generations past every restored one (no aliasing of
+  pre-crash snapshots).
+* **Hung-batch watchdog** — a wedged backend (latency fault past
+  ``batch_timeout_s`` on the injectable clock) yields
+  ``BatchTimeoutError`` + a breaker outcome against the dispatched
+  family + a successful retry on the (demoted) family; and the
+  dispatch-time deadline satellite: a request whose deadline passed
+  while its batch sat in the dispatch-ahead slot fails
+  ``DeadlineExceededError`` without burning an eval.
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import dcf_tpu.api as api
+from dcf_tpu import Dcf
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.errors import (
+    BatchTimeoutError,
+    DeadlineExceededError,
+    KeyQuarantinedError,
+    ShapeError,
+    StaleStateError,
+)
+from dcf_tpu.native import NativeDcf
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.protocols.oracle import mic_oracle
+from dcf_tpu.serve import DcfService, ServeConfig
+from dcf_tpu.serve.store import KeyStore, _frame_name
+from dcf_tpu.testing import faults
+from dcf_tpu.testing.faults import FakeClock
+
+pytestmark = pytest.mark.durability
+
+NB, LAM = 2, 16
+MIC_INTERVALS = [(10, 200), (300, 1000), (60000, 2000)]
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0xD0_12AB)
+
+
+@pytest.fixture(scope="module")
+def ck(rng):
+    return [rng.bytes(32), rng.bytes(32)]
+
+
+@pytest.fixture(scope="module")
+def dcf(ck):
+    return Dcf(NB, LAM, ck, backend="bitsliced")
+
+
+@pytest.fixture(scope="module")
+def prg(ck):
+    return HirosePrgNp(LAM, ck)
+
+
+@pytest.fixture(scope="module")
+def native(ck):
+    return NativeDcf(LAM, ck)
+
+
+def gen_one(dcf, rng):
+    alphas = rng.integers(0, 256, (1, NB), dtype=np.uint8)
+    betas = rng.integers(0, 256, (1, LAM), dtype=np.uint8)
+    return dcf.gen(alphas, betas, rng=rng)
+
+
+def oracle(prg, bundle, b, xs):
+    return eval_batch_np(prg, b, bundle.for_party(b), xs)
+
+
+def corrupt_file(path, offset=40, xor=0xFF):
+    data = bytearray(open(path, "rb").read())
+    data[offset] ^= xor
+    fd = os.open(path, os.O_WRONLY)
+    try:
+        os.write(fd, bytes(data))
+    finally:
+        os.close(fd)
+
+
+# ------------------------------------------------------- store mechanics
+
+
+def test_store_roundtrip_plain_and_protocol(dcf, rng, tmp_path):
+    """Both wire formats through the store, bit-exact, generations and
+    proto flags preserved."""
+    store = KeyStore(str(tmp_path))
+    kb = gen_one(dcf, rng)
+    betas = rng.integers(0, 256, (len(MIC_INTERVALS), LAM),
+                         dtype=np.uint8)
+    pb = dcf.mic(MIC_INTERVALS, betas, rng=rng)
+    store.put("plain", kb, generation=3)
+    store.put("proto", pb.keys, protocol=pb, generation=7)
+    assert store.key_ids() == ["plain", "proto"]
+    got_kb, got_proto, gen = store.load("plain")
+    assert gen == 3 and got_proto is None
+    assert np.array_equal(got_kb.s0s, kb.s0s)
+    assert np.array_equal(got_kb.cw_np1, kb.cw_np1)
+    got_kb2, got_pb, gen2 = store.load("proto")
+    assert gen2 == 7 and got_pb is not None
+    assert np.array_equal(got_pb.combine_masks, pb.combine_masks)
+    assert np.array_equal(got_kb2.cw_s, pb.keys.cw_s)
+    assert store.generation_of("proto") == 7
+
+
+def test_store_files_are_0600(dcf, rng, tmp_path):
+    store = KeyStore(str(tmp_path))
+    store.put("k", gen_one(dcf, rng), generation=1)
+    for f in os.listdir(tmp_path):
+        mode = os.stat(tmp_path / f).st_mode & 0o777
+        assert mode == 0o600, (f, oct(mode))
+
+
+def test_store_put_validation(dcf, rng, tmp_path):
+    store = KeyStore(str(tmp_path))
+    kb = gen_one(dcf, rng)
+    with pytest.raises(ShapeError, match="two-party"):
+        store.put("half", kb.for_party(0))
+    with pytest.raises(ValueError, match="non-empty"):
+        store.put("", kb)
+    betas = rng.integers(0, 256, (len(MIC_INTERVALS), LAM),
+                         dtype=np.uint8)
+    pb = dcf.mic(MIC_INTERVALS, betas, rng=rng)
+    with pytest.raises(ShapeError, match="desync"):
+        store.put("mismatch", kb, protocol=pb)
+    with pytest.raises(ValueError, match="no durable frame"):
+        store.load("nope")
+    assert store.delete("nope") is False
+
+
+def test_crash_before_rename_keeps_old_state(dcf, rng, tmp_path):
+    """The atomic-publish discipline: a crash between fsync and rename
+    (the ``store.write``/``store.manifest`` seams raising) leaves the
+    previous frame AND the previous manifest fully intact."""
+    store = KeyStore(str(tmp_path))
+    old, new = gen_one(dcf, rng), gen_one(dcf, rng)
+    store.put("k", old, generation=1)
+    for seam in ("store.write", "store.manifest"):
+        with pytest.raises(faults.InjectedFault):
+            with faults.inject(seam):
+                store.put("k", new, generation=2)
+        kb, _, gen = store.load("k")
+        assert gen == 1, seam
+        assert np.array_equal(kb.s0s, old.s0s), seam
+    # the interrupted publishes left debris the sweep removes
+    assert store.sweep_orphans() >= 1
+    assert store.key_ids() == ["k"]
+
+
+def test_torn_write_quarantined_typed(dcf, rng, tmp_path):
+    """A partial write made durable (truncated temp file, rename
+    proceeds — what a power cut mid-flush leaves) dies typed at read
+    time: ``KeyQuarantinedError``, file renamed aside, counter bumped,
+    and the OTHER stored key untouched."""
+    store = KeyStore(str(tmp_path))
+    store.put("good", gen_one(dcf, rng), generation=1)
+    with faults.inject("store.write", handler=faults.torn_write(25)):
+        store.put("torn", gen_one(dcf, rng), generation=2)
+    with pytest.raises(KeyQuarantinedError, match="torn"):
+        store.load("torn")
+    assert len(store.quarantined_files()) == 1
+    assert store.key_ids() == ["good"]  # manifest entry dropped
+    kb, _, gen = store.load("good")
+    assert gen == 1
+    snap = store._metrics.snapshot()
+    assert snap["serve_store_quarantined_total"] == 1
+
+
+def test_hot_swap_lands_in_new_file_no_gen_aliasing(dcf, rng, tmp_path):
+    """A durable hot-swap writes a NEW generation-suffixed file and
+    flips the manifest after — no crash window can pair new frame
+    bytes with an old generation."""
+    store = KeyStore(str(tmp_path))
+    old, new = gen_one(dcf, rng), gen_one(dcf, rng)
+    store.put("k", old, generation=1)
+    f1 = _frame_name("k", 1)
+    store.put("k", new, generation=2)
+    f2 = _frame_name("k", 2)
+    assert f1 != f2
+    assert not (tmp_path / f1).exists()  # superseded frame removed
+    kb, _, gen = store.load("k")
+    assert gen == 2 and np.array_equal(kb.s0s, new.s0s)
+
+
+def test_stale_put_cannot_roll_back_newer_generation(dcf, rng,
+                                                     tmp_path):
+    """Review regression: durable publishes are monotonic per key —
+    two concurrent hot-swaps serialize on the store lock in arbitrary
+    order, and the OLDER generation landing last must not roll the
+    stored key back (a restart would silently restore superseded key
+    material with regen_count == 0)."""
+    store = KeyStore(str(tmp_path))
+    old, new = gen_one(dcf, rng), gen_one(dcf, rng)
+    store.put("k", new, generation=5)
+    store.put("k", old, generation=4)  # the stale write-through: no-op
+    kb, _, gen = store.load("k")
+    assert gen == 5 and np.array_equal(kb.s0s, new.s0s)
+    store.put("k", old, generation=6)  # a genuinely newer one still wins
+    assert store.load("k")[2] == 6
+
+
+def test_quarantine_survives_manifest_publish_failure(dcf, rng,
+                                                      tmp_path):
+    """Review regression: the quarantine path must never raise — if
+    the manifest publish inside it dies (disk full, armed seam), the
+    typed KeyQuarantinedError still reaches the caller instead of an
+    untyped escape aborting restore for EVERY key."""
+    store = KeyStore(str(tmp_path))
+    store.put("bad", gen_one(dcf, rng), generation=1)
+    store.put("good", gen_one(dcf, rng), generation=2)
+    corrupt_file(tmp_path / _frame_name("bad", 1))
+    with faults.inject("store.manifest"):
+        with pytest.raises(KeyQuarantinedError):
+            store.load("bad")
+    # the stale manifest entry points at the renamed-away file; the
+    # next read re-quarantines it typed (vanished-file path) and the
+    # other key is untouched throughout
+    with pytest.raises(KeyQuarantinedError, match="vanished"):
+        store.load("bad")
+    assert store.load("good")[2] == 2
+
+
+def test_transient_read_errors_do_not_quarantine(dcf, rng, tmp_path,
+                                                 monkeypatch):
+    """Review regression: a transient OSError (fd pressure, EACCES)
+    while reading a frame must PROPAGATE, not destroy a valid durable
+    key via the quarantine rename — the condition clears on retry."""
+    import builtins
+
+    store = KeyStore(str(tmp_path))
+    kb = gen_one(dcf, rng)
+    store.put("k", kb, generation=1)
+    real_open = builtins.open
+
+    def flaky_open(path, *a, **kw):
+        if str(path).endswith(".dcfk"):
+            raise OSError(24, "Too many open files")
+        return real_open(path, *a, **kw)
+
+    monkeypatch.setattr(builtins, "open", flaky_open)
+    with pytest.raises(OSError, match="Too many open files"):
+        store.load("k")
+    monkeypatch.setattr(builtins, "open", real_open)
+    # nothing was quarantined; the key still loads once pressure clears
+    assert store.quarantined_files() == []
+    kb2, _, gen = store.load("k")
+    assert gen == 1 and np.array_equal(kb2.s0s, kb.s0s)
+
+
+# --------------------------------------------------- service write-through
+
+
+def make_service(dcf, clock=None, **knobs):
+    knobs.setdefault("max_batch", 32)
+    kwargs = {} if clock is None else {"clock": clock}
+    return DcfService(dcf, ServeConfig(**knobs), **kwargs)
+
+
+def test_register_durable_writes_through_before_ack(dcf, rng, tmp_path):
+    svc = make_service(dcf, store_dir=str(tmp_path))
+    kb = gen_one(dcf, rng)
+    svc.register_key("k", kb, durable=True)
+    # acked => already on disk, under the registry's generation
+    kb2, _, gen = svc.store.load("k")
+    assert gen == svc.registry.snapshot("k")[2]
+    assert np.array_equal(kb2.s0s, kb.s0s)
+    # non-durable registration persists nothing
+    svc.register_key("volatile", gen_one(dcf, rng))
+    assert svc.store.key_ids() == ["k"]
+    # unregister forgets the durable frame too
+    svc.unregister_key("k")
+    assert svc.store.key_ids() == []
+
+
+def test_register_durable_without_store_fails_loudly(dcf, rng):
+    svc = make_service(dcf)
+    with pytest.raises(ValueError, match="store_dir"):
+        svc.register_key("k", gen_one(dcf, rng), durable=True)
+    with pytest.raises(ValueError, match="store_dir"):
+        svc.restore_keys()
+
+
+def test_fresh_process_durable_register_without_restore(dcf, rng,
+                                                        tmp_path):
+    """Review regression: a fresh process on an EXISTING store that
+    registers durably before (or without) restoring must not mint a
+    generation the manifest already records — the store's monotonic
+    guard would silently drop the write-through, un-acking an acked
+    durable registration.  The service floors its registry counter on
+    the store's max generation at construction."""
+    svc = make_service(dcf, store_dir=str(tmp_path))
+    svc.register_key("a", gen_one(dcf, rng), durable=True)
+    svc.register_key("a", gen_one(dcf, rng), durable=True)  # gen 2
+    svc.register_key("b", gen_one(dcf, rng), durable=True)  # gen 3
+    del svc
+
+    svc2 = make_service(dcf, store_dir=str(tmp_path))  # NO restore_keys
+    fresh = gen_one(dcf, rng)
+    svc2.register_key("a", fresh, durable=True)  # must actually persist
+    kb, _, gen = svc2.store.load("a")
+    assert gen > 3  # past everything the manifest recorded
+    assert np.array_equal(kb.s0s, fresh.s0s)  # the new bundle, on disk
+    # and a later restart restores the fresh registration, not a
+    # silently-kept stale one
+    svc3 = make_service(dcf, store_dir=str(tmp_path))
+    report = svc3.restore_keys()
+    assert report.restored["a"] == gen
+    kb3 = svc3.registry.snapshot("a")[0]
+    assert np.array_equal(kb3.s0s, fresh.s0s)
+
+
+def test_restore_quarantines_party_restricted_frame_for_real(
+        dcf, rng, tmp_path):
+    """Review regression: the defense-in-depth party check at restore
+    must route through the REAL quarantine (file renamed aside,
+    manifest entry dropped, counter bumped) — a lingering manifest
+    entry would make every later restore re-report the key forever."""
+    store = KeyStore(str(tmp_path))
+    store.put("good", gen_one(dcf, rng), generation=1)
+    # hand-craft the damage put() refuses: a 1-party frame with a
+    # manifest entry claiming parties=1 (so the codec-level mismatch
+    # check cannot see it)
+    half = gen_one(dcf, rng).for_party(0)
+    with store._lock:
+        entries = store._read_manifest()
+        fname = _frame_name("half", 9)
+        store._publish(fname, half.to_bytes(), "store.write", "half")
+        entries["half"] = {"file": fname, "generation": 9,
+                          "proto": False, "parties": 1}
+        store._write_manifest(entries)
+
+    svc = make_service(dcf, store_dir=str(tmp_path))
+    report = svc.restore_keys()
+    assert sorted(report.restored) == ["good"]
+    assert "party-restricted" in report.quarantined["half"]
+    # REALLY quarantined: entry gone, file set aside, counter bumped
+    assert svc.store.key_ids() == ["good"]
+    assert len(svc.store.quarantined_files()) == 1
+    assert svc.metrics_snapshot()["serve_store_quarantined_total"] == 1
+    # a second restore is clean — nothing re-reports forever
+    report2 = svc.restore_keys()
+    assert report2.quarantined == {}
+    # and the floor covered the doctored gen 9: a new durable register
+    # for the same name persists instead of being silently dropped
+    svc.register_key("half", gen_one(dcf, rng), durable=True)
+    assert svc.store.load("half")[2] > 9
+
+
+# ----------------------------------------------------------- warm restart
+
+
+def test_crash_restart_bit_exact_zero_regen(dcf, prg, native, rng,
+                                            tmp_path):
+    """THE acceptance scenario, deterministic on the fake clock: a
+    service with durable keys (plain + protocol) killed mid-stage, a
+    fresh service restores — every key back at its pre-crash
+    generation, zero re-keygen, quarantine empty — and serves
+    bit-exact two-party reconstructions vs the numpy oracle AND the
+    C++ host core."""
+    clock = FakeClock()
+    svc = make_service(dcf, clock, store_dir=str(tmp_path), retries=0)
+    plain = {f"key-{i}": gen_one(dcf, rng) for i in range(3)}
+    for name, kb in plain.items():
+        svc.register_key(name, kb, durable=True)
+    betas = rng.integers(0, 256, (len(MIC_INTERVALS), LAM),
+                         dtype=np.uint8)
+    pb = dcf.mic(MIC_INTERVALS, betas, rng=rng)
+    svc.register_key("mic-0", pb, durable=True)
+    gens_pre = {k: svc.registry.snapshot(k)[2]
+                for k in (*plain, "mic-0")}
+    xs = rng.integers(0, 256, (9, NB), dtype=np.uint8)
+    # the mid-stage kill: staging dies, the service is abandoned undrained
+    with faults.inject("serve.stage"):
+        doomed = svc.submit("key-0", xs)
+        svc.pump()
+    with pytest.raises(faults.InjectedFault):
+        doomed.result(1)
+    svc.queue.close()  # the crash: no drain, no clean unregister
+    del svc
+
+    svc2 = make_service(dcf, FakeClock(), store_dir=str(tmp_path))
+    report = svc2.restore_keys()
+    assert sorted(report.restored) == sorted(gens_pre)  # zero re-keygen
+    assert report.quarantined == {}
+    assert report.restored == gens_pre  # generations preserved exactly
+    snap = svc2.metrics_snapshot()
+    assert snap["serve_store_restored_total"] == len(gens_pre)
+    # plain keys: both parties, vs numpy oracle AND the C++ core
+    for name, kb in plain.items():
+        f0 = svc2.submit(name, xs, b=0)
+        f1 = svc2.submit(name, xs, b=1)
+        svc2.pump()
+        y = f0.result(1) ^ f1.result(1)
+        assert np.array_equal(
+            y, oracle(prg, kb, 0, xs) ^ oracle(prg, kb, 1, xs)), name
+        assert np.array_equal(
+            y, native.eval(0, kb, xs) ^ native.eval(1, kb, xs)), name
+    # the protocol key: combined per-interval rows vs the MIC oracle
+    f0 = svc2.submit("mic-0", xs, b=0)
+    f1 = svc2.submit("mic-0", xs, b=1)
+    svc2.pump()
+    assert np.array_equal(f0.result(1) ^ f1.result(1),
+                          mic_oracle(xs, MIC_INTERVALS, betas))
+
+
+def test_restore_quarantines_only_the_damaged_frame(dcf, prg, rng,
+                                                    tmp_path):
+    """The corrupt-store acceptance clause: restore quarantines exactly
+    the damaged frames typed and serves the rest."""
+    svc = make_service(dcf, store_dir=str(tmp_path))
+    bundles = {f"key-{i}": gen_one(dcf, rng) for i in range(3)}
+    for name, kb in bundles.items():
+        svc.register_key(name, kb, durable=True)
+    gens = {k: svc.registry.snapshot(k)[2] for k in bundles}
+    del svc
+    corrupt_file(tmp_path / _frame_name("key-1", gens["key-1"]))
+
+    svc2 = make_service(dcf, store_dir=str(tmp_path))
+    report = svc2.restore_keys()
+    assert sorted(report.restored) == ["key-0", "key-2"]
+    assert sorted(report.quarantined) == ["key-1"]
+    assert "quarantined" in report.quarantined["key-1"]
+    snap = svc2.metrics_snapshot()
+    assert snap["serve_store_quarantined_total"] == 1
+    assert len(svc2.store.quarantined_files()) == 1
+    xs = rng.integers(0, 256, (5, NB), dtype=np.uint8)
+    for name in ("key-0", "key-2"):  # the rest still serve, bit-exact
+        fut = svc2.submit(name, xs)
+        svc2.pump()
+        assert np.array_equal(fut.result(1),
+                              oracle(prg, bundles[name], 0, xs)), name
+    with pytest.raises(ValueError, match="no bundle registered"):
+        svc2.submit("key-1", xs)
+
+
+def test_restore_preserves_generations_no_aliasing(dcf, rng, tmp_path):
+    """The PR 5 guard across process death: restored keys keep their
+    generations, and a post-restore hot-swap mints one strictly past
+    every restored generation — a pre-crash snapshot can never alias
+    post-restore key content."""
+    svc = make_service(dcf, store_dir=str(tmp_path))
+    kb1, kb2 = gen_one(dcf, rng), gen_one(dcf, rng)
+    svc.register_key("a", kb1, durable=True)
+    svc.register_key("b", gen_one(dcf, rng), durable=True)
+    svc.register_key("a", kb2, durable=True)  # durable hot-swap: gen 3
+    gen_a = svc.registry.snapshot("a")[2]
+    assert gen_a == 3
+    del svc
+
+    svc2 = make_service(dcf, store_dir=str(tmp_path))
+    report = svc2.restore_keys()
+    assert report.restored == {"a": 3, "b": 2}
+    # the restored content is the hot-swapped bundle, not the original
+    kb, _, _ = svc2.store.load("a")
+    assert np.array_equal(kb.s0s, kb2.s0s)
+    # a new register can never reuse a restored generation
+    gen_new = svc2.registry.register("c", gen_one(dcf, rng))
+    assert gen_new > 3
+    # and the in-flight staleness guard still bites across a hot-swap
+    snap_gen = svc2.registry.snapshot("a")[2]
+    svc2.register_key("a", gen_one(dcf, rng))
+    with pytest.raises(StaleStateError):
+        svc2.registry.resident("a", 0, snap_gen)
+
+
+# ------------------------------------------------- hung-batch watchdog
+
+
+def test_watchdog_times_out_wedged_batch_typed(dcf, rng):
+    """retries=0: a dispatch that eats the clock past batch_timeout_s
+    fails the future with BatchTimeoutError and records a breaker
+    failure against the dispatched family."""
+    clock = FakeClock()
+    svc = make_service(dcf, clock, batch_timeout_s=1.0, retries=0)
+    svc.register_key("k", gen_one(dcf, rng))
+    xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+    with faults.inject("serve.eval", handler=faults.latency(clock, 5.0)):
+        fut = svc.submit("k", xs)
+        svc.pump()
+    with pytest.raises(BatchTimeoutError, match="wall deadline"):
+        fut.result(1)
+    snap = svc.metrics_snapshot()
+    assert snap["serve_batch_timeouts_total"] == 1
+    assert snap["serve_batch_failures_total"] == 1
+    fam = dcf.backend_name
+    assert svc.breakers._breakers[("k", fam)].failures == 1
+
+
+def test_watchdog_retry_serves_after_timeout(dcf, prg, rng):
+    """retries=1: the timed-out batch takes the shared retry/
+    invalidation path and the retry (backend healthy again) serves
+    bit-exactly."""
+    clock = FakeClock()
+    svc = make_service(dcf, clock, batch_timeout_s=1.0, retries=1)
+    kb = gen_one(dcf, rng)
+    svc.register_key("k", kb)
+    calls = {"n": 0}
+
+    def slow_once(*_args):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            clock.advance(5.0)  # only the first dispatch wedges
+
+    xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+    with faults.inject("serve.eval", handler=slow_once):
+        fut = svc.submit("k", xs)
+        svc.pump()
+    assert np.array_equal(fut.result(1), oracle(prg, kb, 0, xs))
+    snap = svc.metrics_snapshot()
+    assert snap["serve_batch_timeouts_total"] == 1
+    assert snap["serve_retries_total"] == 1
+    assert calls["n"] == 2  # timeout + the successful retry
+
+
+def test_watchdog_demotes_auto_facade_retry_on_new_family(ck, prg, rng,
+                                                          monkeypatch):
+    """The acceptance walk: a wedged pallas backend times out typed,
+    the final-retry reset_backend_health demotes the auto facade, and
+    the retry succeeds on the demoted family — a backend that hangs
+    degrades exactly like one that crashes."""
+    monkeypatch.setattr(api, "_default_backend", lambda lam: "pallas")
+    api.reset_backend_health()
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            dcf_auto = Dcf(NB, LAM, ck, backend="auto",
+                           backend_opts={"interpret": True})
+        assert dcf_auto.backend_name == "pallas"
+        clock = FakeClock()
+        svc = make_service(dcf_auto, clock, batch_timeout_s=1.0,
+                           retries=1)
+        kb = gen_one(dcf_auto, rng)
+        svc.register_key("k", kb)
+        wedged = {"n": 0}
+        lowers = {"n": 0}
+
+        def wedge_pallas(*_args):
+            # the pallas instance is wedged; the demoted family is not
+            if wedged["n"] == 0:
+                wedged["n"] += 1
+                clock.advance(5.0)
+
+        def canary_dies(*_args):
+            # fire 1 = the wedged dispatch itself (let it run — the
+            # WATCHDOG must be what fails it); fire 2 = the post-reset
+            # canary re-probing the wedged backend, which dies like a
+            # wedged backend's canary would — that is the demotion.
+            lowers["n"] += 1
+            if lowers["n"] >= 2:
+                raise faults.InjectedFault("wedged backend canary")
+
+        xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+        with faults.inject("serve.eval", handler=wedge_pallas), \
+                faults.inject("pallas.lowering", handler=canary_dies):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                fut = svc.submit("k", xs)
+                svc.pump()
+                y = fut.result(1)
+        assert wedged["n"] >= 1
+        assert dcf_auto.backend_name == "bitsliced"  # demoted
+        assert np.array_equal(y, oracle(prg, kb, 0, xs))
+        snap = svc.metrics_snapshot()
+        assert snap["serve_batch_timeouts_total"] >= 1
+        assert snap["serve_retries_total"] >= 1
+    finally:
+        api.reset_backend_health()
+
+
+def test_watchdog_disabled_by_default(dcf, prg, rng):
+    """batch_timeout_s=0 (the default): a slow batch still serves —
+    PR 6 semantics exactly."""
+    clock = FakeClock()
+    svc = make_service(dcf, clock)
+    kb = gen_one(dcf, rng)
+    svc.register_key("k", kb)
+    xs = rng.integers(0, 256, (3, NB), dtype=np.uint8)
+    with faults.inject("serve.eval",
+                       handler=faults.latency(clock, 3600.0)):
+        fut = svc.submit("k", xs)
+        svc.pump()
+    assert np.array_equal(fut.result(1), oracle(prg, kb, 0, xs))
+    assert svc.metrics_snapshot()["serve_batch_timeouts_total"] == 0
+
+
+def test_config_rejects_negative_batch_timeout():
+    with pytest.raises(ValueError, match="batch_timeout_s"):
+        ServeConfig(batch_timeout_s=-1.0)
+
+
+# ------------------------------------- deadline expiry at dispatch time
+
+
+def test_deadline_expiry_in_dispatch_ahead_slot(dcf, prg, rng):
+    """The satellite regression: batch formation took the request while
+    its deadline was live, but the deadline passes while its later
+    plans wait in the dispatch-ahead slot behind a slow eval — those
+    plans must never dispatch (no evals burnt on a share the caller
+    already abandoned) and the request fails DeadlineExceededError."""
+    clock = FakeClock()
+    svc = make_service(dcf, clock, max_batch=4, retries=0)
+    kb = gen_one(dcf, rng)
+    svc.register_key("k", kb)
+    fires = {"n": 0}
+
+    def slow_each(*_args):
+        fires["n"] += 1
+        clock.advance(1.0)  # each dispatched eval costs a second
+
+    # Control: an oversized live request runs all three of its plans.
+    xs = rng.integers(0, 256, (12, NB), dtype=np.uint8)
+    f_live = svc.submit("k", xs)
+    with faults.inject("serve.eval", handler=slow_each):
+        svc.pump()
+    assert fires["n"] == 3
+    assert np.array_equal(f_live.result(1), oracle(prg, kb, 0, xs))
+
+    # The regression: same shape, 100ms deadline — live at formation
+    # AND at the first dispatch, expired by the time plans 2 and 3
+    # reach the dispatch-ahead slot.
+    fires["n"] = 0
+    f_dead = svc.submit("k", xs, deadline_ms=100.0)
+    with faults.inject("serve.eval", handler=slow_each):
+        svc.pump()
+    with pytest.raises(DeadlineExceededError, match="dispatch-ahead"):
+        f_dead.result(1)
+    assert fires["n"] == 1  # plans 2 and 3 were skipped, not evaluated
+    snap = svc.metrics_snapshot()
+    assert snap["serve_deadline_expired_total"] == 1
+
+
+def test_deadline_still_enforced_at_formation(dcf, rng):
+    """The PR 4 path rides along: queue-time expiry is unchanged."""
+    clock = FakeClock()
+    svc = make_service(dcf, clock)
+    svc.register_key("k", gen_one(dcf, rng))
+    fut = svc.submit("k", rng.integers(0, 256, (3, NB), dtype=np.uint8),
+                     deadline_ms=10.0)
+    clock.advance(0.05)
+    svc.pump()
+    with pytest.raises(DeadlineExceededError):
+        fut.result(1)

@@ -1,0 +1,120 @@
+"""Slow crash/restore soak for the durable key store (ISSUE 8).
+
+Serial-CI-leg material (``-m "durability and slow"``): repeated
+kill/restore cycles under 3-thread closed-loop load with an every-9th
+``serve.eval`` fault armed the whole time.  Each cycle the service is
+closed WITHOUT draining mid-load (the in-process kill), a fresh service
+restores from the same store directory, and the soak asserts that every
+cycle restored the full key set with generations preserved, nothing was
+ever quarantined (atomic publish: a kill cannot tear a visible frame),
+and EVERY delivered result across all cycles was bit-exact vs the numpy
+oracle (the clients verify inline — a wrong share anywhere fails the
+soak, not just at the end).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dcf_tpu import Dcf
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.errors import DcfError
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.serve import DcfService, ServeConfig
+from dcf_tpu.testing import faults
+
+pytestmark = [pytest.mark.durability, pytest.mark.slow]
+
+NB, LAM = 2, 16
+
+
+def test_crash_restore_soak_under_faults(tmp_path):
+    rng = np.random.default_rng(0xD0_50AC)
+    ck = [rng.bytes(32), rng.bytes(32)]
+    dcf = Dcf(NB, LAM, ck, backend="bitsliced")
+    prg = HirosePrgNp(LAM, ck)
+    bundles = {}
+    for name in ("d0", "d1", "d2"):
+        alphas = rng.integers(0, 256, (1, NB), dtype=np.uint8)
+        betas = rng.integers(0, 256, (1, LAM), dtype=np.uint8)
+        bundles[name] = dcf.gen(alphas, betas, rng=rng)
+    names = sorted(bundles)
+
+    calls = {"n": 0}
+
+    def every_ninth(*_args):
+        calls["n"] += 1
+        if calls["n"] % 9 == 0:
+            raise faults.InjectedFault("intermittent eval failure")
+
+    mismatches: list[str] = []
+    ok_counts = {"n": 0}
+
+    def client(svc, stop, seed):
+        crng = np.random.default_rng(seed)
+        while not stop.is_set():
+            name = names[int(crng.integers(0, len(names)))]
+            b = int(crng.integers(0, 2))
+            m = int(crng.integers(1, 25))
+            xs = crng.integers(0, 256, (m, NB), dtype=np.uint8)
+            try:
+                y = svc.evaluate(name, xs, b=b, timeout=60)
+            except (DcfError, faults.InjectedFault):
+                continue  # typed shed/retry-exhausted failures are fine
+            want = eval_batch_np(prg, b, bundles[name].for_party(b), xs)
+            if not np.array_equal(y, want):
+                mismatches.append(f"{name} party {b} m={m}")
+                return
+            ok_counts["n"] += 1
+
+    def make_svc():
+        return DcfService(dcf, ServeConfig(
+            max_batch=64, max_delay_ms=2.0, retries=1,
+            max_queued_points=4096, store_dir=str(tmp_path)))
+
+    gens = None
+    with faults.inject("serve.eval", handler=every_ninth):
+        for cycle in range(3):
+            svc = make_svc()
+            if cycle == 0:
+                for name in names:
+                    svc.register_key(name, bundles[name], durable=True)
+                gens = {k: svc.registry.snapshot(k)[2] for k in names}
+            else:
+                report = svc.restore_keys()
+                assert sorted(report.restored) == names, cycle
+                assert report.quarantined == {}, cycle
+                assert report.restored == gens, cycle  # gens preserved
+            svc.start()
+            stop = threading.Event()
+            threads = [threading.Thread(
+                target=client, args=(svc, stop, 31 * cycle + i),
+                daemon=True) for i in range(3)]
+            for t in threads:
+                t.start()
+            stop.wait(1.5)
+            stop.set()
+            # The kill: close mid-load without draining, clients still
+            # submitting — queued futures fail typed, nothing drains.
+            svc.close(drain=False)
+            for t in threads:
+                t.join(60)
+            assert not any(t.is_alive() for t in threads)
+    assert mismatches == [], mismatches
+    assert ok_counts["n"] > 0  # the soak actually delivered results
+
+    # Final restart, faults disarmed: full two-party parity per key.
+    svc = make_svc()
+    report = svc.restore_keys()
+    assert sorted(report.restored) == names
+    assert report.restored == gens
+    xs = rng.integers(0, 256, (9, NB), dtype=np.uint8)
+    for name in names:
+        f0 = svc.submit(name, xs, b=0)
+        f1 = svc.submit(name, xs, b=1)
+        svc.pump()
+        want = eval_batch_np(prg, 0, bundles[name].for_party(0), xs) ^ \
+            eval_batch_np(prg, 1, bundles[name].for_party(1), xs)
+        assert np.array_equal(f0.result(5) ^ f1.result(5), want), name
+    svc.close()

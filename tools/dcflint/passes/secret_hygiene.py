@@ -25,6 +25,13 @@ Three rules:
    ``__repr__`` — the dataclass default repr prints field values, so a
    stray ``f"{bundle}"`` in a traceback or debug line would leak seed
    and CW bytes.
+4. (ISSUE 8, the durable store layer) In ``serve/store.py``, no
+   builtin ``open(...)`` call in a write/append/create mode: store
+   files hold DCFK frames — key material on disk — and must be
+   created through the ``os.open(..., 0o600)`` + fsync atomic-write
+   helper, never with the umask-default permissions builtin ``open``
+   gives a freshly-created file.  (The name set also knows ``frame``:
+   a serialized DCFK frame is the key material it encodes.)
 """
 
 from __future__ import annotations
@@ -37,7 +44,10 @@ from tools.dcflint import FileContext, LintPass, register
 
 SECRET_NAME_RE = re.compile(
     r"^(seed\w*|s0s?|cw(_\w+)?|cws|key_bundle|bundle|kb|key_material"
-    r"|cipher_keys?|combine_masks?)$")
+    r"|cipher_keys?|combine_masks?|frames?|key_frame)$")
+# ``frame`` (ISSUE 8, dcf_tpu/serve/store.py): a serialized DCFK frame
+# is the seeds and correction words it encodes — logging one is
+# logging the key.
 # ``combine_masks`` (PR 5, dcf_tpu/protocols): a protocol bundle's
 # per-interval combine mask is ``pub * beta`` — beta in the clear for
 # wraparound intervals, i.e. the secret function value itself.
@@ -78,6 +88,24 @@ def _is_sink(func: ast.AST) -> str | None:
     return None
 
 
+def _is_writing_open(node: ast.Call) -> bool:
+    """A builtin ``open(path, mode)`` call whose literal mode creates
+    or writes (``w``/``x``/``a``/``+``).  Conservative by design: a
+    computed mode is not flagged (suppression-with-reason covers the
+    exotic case), and read-mode ``open`` stays legal — restore must
+    read frames back."""
+    if not (isinstance(node.func, ast.Name) and node.func.id == "open"):
+        return False
+    mode = node.args[1] if len(node.args) > 1 else None
+    if mode is None:
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+    return (isinstance(mode, ast.Constant)
+            and isinstance(mode.value, str)
+            and any(c in mode.value for c in "wxa+"))
+
+
 @register
 class SecretHygienePass(LintPass):
     name = "secret-hygiene"
@@ -85,8 +113,21 @@ class SecretHygienePass(LintPass):
                    "define a redacting __repr__")
 
     def check(self, ctx: FileContext) -> Iterator[tuple[int, str]]:
+        # Rule 4 scope: the durable store module (serve/store.py) —
+        # the one place in the tree where key frames meet a filesystem.
+        is_store = (ctx.basename == "store.py"
+                    and "serve" in ctx.parts[:-1])
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.Call):
+                if is_store and _is_writing_open(node):
+                    yield (node.lineno,
+                           "builtin open(...) in a write mode inside "
+                           "the store layer: store files hold DCFK "
+                           "frames (key material) — create them via "
+                           "os.open(..., 0o600) + fsync (the atomic-"
+                           "write helper), never with umask-default "
+                           "permissions")
+                    continue
                 sink = _is_sink(node.func)
                 if sink is None:
                     continue

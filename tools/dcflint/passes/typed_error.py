@@ -37,6 +37,8 @@ DCF_ERRORS = frozenset({
     "QueueFullError",
     "DeadlineExceededError",
     "CircuitOpenError",
+    "KeyQuarantinedError",
+    "BatchTimeoutError",
 })
 _ALWAYS_OK = DCF_ERRORS | {"NotImplementedError"}
 _MARKED_OK = frozenset({"ValueError", "TypeError"})
