@@ -97,15 +97,23 @@ shape with the remaining headroom priced at the cipher floor.  Auto
 keeps the from-root hybrid until a chip session records the
 prefix-enabled crossover; these thresholds move with the measurements.
 
-Key generation runs on the C++ core when available, else numpy.  Two
-subsystems stay explicit constructor-level choices rather than facade
-backends (their APIs are pipeline-shaped, not gen/eval-shaped): the
-device-resident keygen pipeline ``backends.device_gen.DeviceKeyGen``
-and full-domain evaluation ``backends.fulldomain.TreeFullDomain``
-(domain expansion, not point evaluation).  The keylanes *eval* kernel,
-by contrast, IS a facade backend (``backend="keylanes"``, with or
-without a mesh); only the device-keygen half of the config-5 pipeline
-stays constructor-level.
+Key generation runs on the C++ core when available, else numpy —
+unless ``gen(..., device=True)``, which runs the GGM level walk ON the
+accelerator through ``gen.gen_on_device`` (ISSUE 10): lam >= 48 uses
+the Pallas narrow keygen kernel + affine wide tail
+(``ops.pallas_keygen`` — ONE shared level-walk core with the eval
+kernels), smaller lams the keys-in-lanes XLA generator
+(``backends.device_gen``), with the keylanes-style off-TPU interpreter
+rule and a counted, warned fallback to the host walk on any device
+failure (seam ``keygen.device``).  The protocol generators
+(``interval``/``mic``/``piecewise``) take the same ``device=`` flag —
+an m-interval MIC's 2m bound keys are one K-packed device keygen.
+Bundles are byte-identical across pipelines, so wire frames, serve
+registration and the durable store cannot tell them apart.
+Full-domain evaluation (``backends.fulldomain.TreeFullDomain``, domain
+expansion rather than point evaluation) stays an explicit
+constructor-level choice; the keylanes *eval* kernel, by contrast, IS
+a facade backend (``backend="keylanes"``, with or without a mesh).
 
 Fault tolerance (the ``dcf_tpu.errors`` taxonomy)
 -------------------------------------------------
@@ -205,7 +213,7 @@ from dcf_tpu.errors import (
     BackendUnavailableError,
     ShapeError,
 )
-from dcf_tpu.gen import gen_batch, random_s0s
+from dcf_tpu.gen import gen_batch, gen_on_device, random_s0s
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.prg import HirosePrgNp
 from dcf_tpu.spec import (
@@ -628,11 +636,18 @@ class Dcf:
     def gen(self, alphas: np.ndarray, betas: np.ndarray,
             s0s: np.ndarray | None = None,
             bound: Bound = Bound.LT_BETA,
-            rng: np.random.Generator | None = None) -> KeyBundle:
+            rng: np.random.Generator | None = None,
+            device: bool = False) -> KeyBundle:
         """Generate K keys: alphas uint8 [K, n_bytes], betas uint8 [K, lam].
 
         s0s (uint8 [K, 2, lam]) default to fresh random seeds.  Returns the
         two-party KeyBundle; ship ``bundle.for_party(b)`` to party b.
+
+        ``device=True`` runs the level walk on the accelerator
+        (``gen.gen_on_device``; the keylanes off-TPU interpreter rule
+        applies) — same bytes out, throughput scaling with K instead of
+        a single host core; falls back to the host walk, counted and
+        warned, if the device path fails.
         """
         alphas = np.asarray(alphas, dtype=np.uint8)
         betas = np.asarray(betas, dtype=np.uint8)
@@ -644,6 +659,9 @@ class Dcf:
                 # dcflint: disable=determinism fresh key seeds MUST be
                 # unpredictable (OS entropy); pass rng= to reproduce
                 rng if rng is not None else np.random.default_rng())
+        if device:
+            return gen_on_device(
+                self.lam, self.cipher_keys, alphas, betas, s0s, bound)
         if self._gen_native is not None:
             return self._gen_native.gen_batch(alphas, betas, s0s, bound)
         return gen_batch(self._prg, alphas, betas, s0s, bound)
@@ -716,17 +734,19 @@ class Dcf:
 
     # -- protocols (dcf_tpu.protocols: IC / MIC / piecewise) ----------------
 
-    def _protocol_gen(self, rng):
+    def _protocol_gen(self, rng, device: bool = False):
         from dcf_tpu.spec import Bound as _B
 
         def gen_fn(alphas, betas, bound: _B):
-            return self.gen(alphas, betas, bound=bound, rng=rng)
+            return self.gen(alphas, betas, bound=bound, rng=rng,
+                            device=device)
 
         return gen_fn
 
     def interval(self, p: int, q: int, beta: np.ndarray,
                  bound: Bound = Bound.LT_BETA,
-                 rng: np.random.Generator | None = None):
+                 rng: np.random.Generator | None = None,
+                 device: bool = False):
         """Keys for interval containment ``1_{p <= x < q} * beta``.
 
         ``p``/``q``: ints in ``[0, 2^n_bits]`` (``q = 2^n_bits`` makes
@@ -745,11 +765,13 @@ class Dcf:
 
         beta = np.asarray(beta, dtype=np.uint8).reshape(1, -1)
         return gen_interval_bundle(
-            self._protocol_gen(rng), [(p, q)], beta, self.n_bytes, bound)
+            self._protocol_gen(rng, device), [(p, q)], beta,
+            self.n_bytes, bound)
 
     def mic(self, intervals, betas: np.ndarray,
             bound: Bound = Bound.LT_BETA,
-            rng: np.random.Generator | None = None):
+            rng: np.random.Generator | None = None,
+            device: bool = False):
         """Keys for multiple interval containment over ``m`` intervals.
 
         ``intervals``: sequence of ``(p, q)`` int pairs (same convention
@@ -762,15 +784,19 @@ class Dcf:
         (staged, on-device combine), and servable online by registering
         the returned bundle in ``Dcf.serve(...)`` under a key id.
         Reconstruction: XOR both parties' [m, M, lam] outputs.
+        ``device=True`` runs the 2m-key packed keygen on the
+        accelerator (``gen.gen_on_device`` — the K axis is exactly
+        what the device walk scales with).
         """
         from dcf_tpu.protocols import gen_interval_bundle
 
         return gen_interval_bundle(
-            self._protocol_gen(rng), intervals,
+            self._protocol_gen(rng, device), intervals,
             np.asarray(betas, dtype=np.uint8), self.n_bytes, bound)
 
     def piecewise(self, cuts, values: np.ndarray,
-                  rng: np.random.Generator | None = None):
+                  rng: np.random.Generator | None = None,
+                  device: bool = False):
         """Keys for a piecewise-constant function (spline lookup table).
 
         ``cuts``: strictly increasing breakpoints in ``[0, 2^n_bits)``
@@ -787,7 +813,7 @@ class Dcf:
 
         intervals = partition_intervals(list(cuts), 8 * self.n_bytes)
         return gen_interval_bundle(
-            self._protocol_gen(rng), intervals,
+            self._protocol_gen(rng, device), intervals,
             np.asarray(values, dtype=np.uint8), self.n_bytes,
             Bound.LT_BETA)
 

@@ -12,7 +12,12 @@ Layout: keys packed 32-per-uint32-lane-word (Wk = K/32 words).  Seeds and
 values live as byte-major planes [8*lam, Wk] (plane p = byte*8 + bit, the
 ``prg_planes`` convention); per-level outputs stack to [n, 8*lam, Wk].
 Correctness is pinned to the numpy ``gen_batch`` bit-for-bit
-(tests/test_device_gen.py).
+(tests/test_device_gen.py, tests/test_keygen_device.py).
+
+This generator is lam-generic (the plane count scales with lam) and
+serves as the lam < 48 route of ``gen.gen_on_device`` (ISSUE 10); the
+hybrid family (lam >= 48) routes to ``ops.pallas_keygen``, whose narrow
+kernel shares the eval kernels' per-level AES core.
 """
 
 from __future__ import annotations

@@ -497,7 +497,15 @@ class LargeLambdaBackend(FrontierConsumerMixin):
         k_cap = HYBRID_MAX_PREFIX_LEVELS - (k_num - 1).bit_length()
         return max(min(self.prefix_levels, n - 8, k_cap), 5)
 
-    def put_bundle(self, bundle: KeyBundle) -> None:
+    def put_bundle(self, bundle: KeyBundle,
+                   dev_planes: dict | None = None) -> None:
+        """Ship this party's key image.  ``dev_planes`` (ISSUE 10,
+        Pallas narrow path only): a device-resident staged plane dict
+        straight from the on-device keygen
+        (``ops.pallas_keygen.PallasKeyGen.staged_planes``) — the narrow
+        image then stages without the host bit-plane expansion or a
+        host->device transfer; only the wide affine tail still reads
+        the host bundle's wide halves."""
         if bundle.lam != self.lam:
             raise ShapeError("bundle lam mismatch")
         if bundle.s0s.shape[1] != 1:
@@ -515,7 +523,19 @@ class LargeLambdaBackend(FrontierConsumerMixin):
         self._bundle = bundle
         self.invalidate_frontier()  # new key image, one hook (backends.frontier)
 
-        if self.narrow == "pallas":
+        if dev_planes is not None:
+            if self.narrow != "pallas":
+                raise ShapeError(
+                    "dev_planes is the Pallas narrow staged layout; the "
+                    "XLA narrow path stages its own plane order")
+            want = (bundle.num_keys, bundle.n_bits, 128, 1)
+            got = tuple(dev_planes["cs0"].shape)
+            if got != want:
+                raise ShapeError(
+                    f"dev_planes geometry {got} does not match the "
+                    f"bundle's {want} (keys, levels, planes, words)")
+            self._dev = dict(dev_planes)
+        elif self.narrow == "pallas":
             from dcf_tpu.utils.bits import bitmajor_plane_masks
 
             def blk(a, lo):  # bit-major plane masks for one 16-byte block

@@ -1,27 +1,58 @@
-"""Host-side batched key generation.
+"""Batched key generation: the host walk and the on-device router.
 
-Vectorized numpy port of the GGM keygen (reference src/lib.rs:86-161) over a
-key axis: K comparison functions are processed level-by-level with one batched
-PRG call per party per level (2K AES-256 block pairs), instead of the
-reference's one-key-at-a-time loop.  Keygen is inherently sequential across
-the n = 8*n_bytes levels (level i consumes level i-1's seeds), so it stays on
-the host; keys are generated once and shipped to HBM for evaluation.
+Keygen (reference src/lib.rs:86-161) is sequential across the
+n = 8*n_bytes levels (level i consumes level i-1's seeds) but
+embarrassingly parallel across keys, and at production scale — fresh
+keys per session, the protocol layer packing 2m bound keys per MIC
+query class — it is a first-class hot path, not a setup step.  Three
+pipelines produce byte-identical ``KeyBundle``s:
 
-A C++ fast path with the same output lives in ``dcf_tpu.native``.
+* ``gen_batch`` (this module): the vectorized numpy walk — K comparison
+  functions processed level-by-level with one batched PRG call per
+  party per level (2K AES-256 block pairs), instead of the reference's
+  one-key-at-a-time loop.  The portable floor and the parity oracle.
+* the C++ native core (``dcf_tpu.native``, AES-NI): the fast HOST path,
+  what the facade uses by default when the toolchain is present.
+* ``gen_on_device`` (this module's router): the GGM level walk run ON
+  the accelerator.  For lam >= 48 it is ``ops.pallas_keygen`` — the
+  narrow keygen walk as one K-packed Pallas kernel sharing the per-level
+  AES core (``make_narrow_aes`` + ``narrow_prg_expand``) with the eval
+  kernels, plus the GF(2)-affine wide correction words; for smaller lam
+  it is the keys-in-lanes XLA generator (``backends.device_gen``).
+  The facade spelling is ``Dcf.gen(..., device=True)``.
+
+When does the device path win?  The walk is sequential across levels,
+so a SINGLE key gains nothing; the win is the key axis.  K keys cost
+the same n-level latency as one (the kernel lanes and the AES cores
+are K-wide), so throughput scales with K until the lane budget — the
+MIC shape (K = 2m) and session-keygen bursts are exactly that regime,
+and the correction-word image is born in HBM next to the evaluators
+that will consume it instead of crossing the host link.  Interop,
+wire-format and durable-store consumers see identical DCFK bytes
+either way.  ``python -m dcf_tpu.cli keygen_bench`` measures keys/s
+against the pinned single-core host baseline (CPU_BASELINE.md).
+
+Knobs (``gen_on_device``): ``interpret`` (None = auto: Mosaic on TPU,
+the Pallas interpreter elsewhere — the keylanes rule), ``tile_words``
+(kernel lane tile).  Failures of the device path fall back to
+``gen_batch`` — silent-correct, counted by ``device_fallback_count()``,
+warned via ``errors.BackendFallbackWarning``, and injectable at the
+``keygen.device`` fault seam (``testing.faults``).
 """
 
 from __future__ import annotations
 
-from typing import Sequence
+import warnings
 
 import numpy as np
 
-from dcf_tpu.errors import ShapeError
+from dcf_tpu.errors import BackendFallbackWarning, ShapeError
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.prg import HirosePrgNp
 from dcf_tpu.spec import Bound
 
-__all__ = ["gen_batch", "random_s0s"]
+__all__ = ["gen_batch", "gen_on_device", "random_s0s",
+           "device_fallback_count"]
 
 
 def random_s0s(num_keys: int, lam: int, rng: np.random.Generator) -> np.ndarray:
@@ -35,6 +66,26 @@ def _sel(left: np.ndarray, right: np.ndarray, take_right: np.ndarray) -> np.ndar
     return np.where(cond, right, left)
 
 
+def _check_gen_inputs(alphas, betas, s0s, lam: int) -> None:
+    """Typed api-edge validation shared by every keygen pipeline: a
+    non-uint8 array must die ``ShapeError`` naming the argument, not as
+    ``np.unpackbits``'s bare TypeError deep in the walk."""
+    for name, arr in (("alphas", alphas), ("betas", betas), ("s0s", s0s)):
+        if not isinstance(arr, np.ndarray) or arr.dtype != np.uint8:
+            got = (arr.dtype if isinstance(arr, np.ndarray)
+                   else type(arr).__name__)
+            raise ShapeError(
+                f"{name} must be a uint8 numpy array (got {got}); key "
+                "material is byte-exact — cast explicitly, never "
+                "implicitly")
+    k_num = alphas.shape[0] if alphas.ndim == 2 else -1
+    if alphas.ndim != 2 or alphas.shape[1] < 1:
+        raise ShapeError(
+            f"alphas must be [K, n_bytes], got {alphas.shape}")
+    if betas.shape != (k_num, lam) or s0s.shape != (k_num, 2, lam):
+        raise ShapeError("alphas/betas/s0s shape mismatch")
+
+
 def gen_batch(
     prg: HirosePrgNp,
     alphas: np.ndarray,
@@ -42,15 +93,14 @@ def gen_batch(
     s0s: np.ndarray,
     bound: Bound,
 ) -> KeyBundle:
-    """Generate K DCF keys at once.
+    """Generate K DCF keys at once (host numpy walk).
 
     alphas: uint8 [K, n_bytes]; betas: uint8 [K, lam]; s0s: uint8 [K, 2, lam].
     Returns a two-party KeyBundle (s0s retained with P=2).
     """
-    k_num, n_bytes = alphas.shape
     lam = prg.lam
-    if betas.shape != (k_num, lam) or s0s.shape != (k_num, 2, lam):
-        raise ShapeError("alphas/betas/s0s shape mismatch")
+    _check_gen_inputs(alphas, betas, s0s, lam)
+    k_num, n_bytes = alphas.shape
     n = 8 * n_bytes
     # MSB-first bit planes of alpha: uint8 [K, n] (np.unpackbits is MSB-first,
     # matching the reference's Msb0 bit view at src/lib.rs:106).
@@ -101,3 +151,114 @@ def gen_batch(
     return KeyBundle(
         s0s=s0s.copy(), cw_s=cw_s, cw_v=cw_v, cw_t=cw_t, cw_np1=cw_np1
     )
+
+
+# -- the on-device router -----------------------------------------------------
+
+# Device generators hold only derived cipher state (bit-major round-key
+# masks); cached per (lam, cipher_keys, interpret, tile_words) so repeated
+# facade/bench calls don't re-expand round keys.  Small and bounded.
+_DEVICE_GENS: dict = {}
+_DEVICE_GENS_CAP = 16
+_DEVICE_FALLBACKS = 0
+
+
+def device_fallback_count() -> int:
+    """How many ``gen_on_device`` calls fell back to the host walk this
+    process (chaos tests assert the fallback is silent-correct AND
+    counted)."""
+    return _DEVICE_FALLBACKS
+
+
+def _device_gen_for(lam: int, cipher_keys, interpret: bool,
+                    tile_words: int):
+    key = (lam, tuple(cipher_keys), interpret, tile_words)
+    kg = _DEVICE_GENS.get(key)
+    if kg is None:
+        if len(_DEVICE_GENS) >= _DEVICE_GENS_CAP:
+            _DEVICE_GENS.pop(next(iter(_DEVICE_GENS)))
+        if lam >= 48 and lam % 16 == 0:
+            from dcf_tpu.ops.pallas_keygen import PallasKeyGen
+
+            kg = PallasKeyGen(lam, cipher_keys, interpret=interpret,
+                              tile_words=tile_words)
+        else:
+            from dcf_tpu.backends.device_gen import DeviceKeyGen
+
+            kg = DeviceKeyGen(lam, cipher_keys)
+        _DEVICE_GENS[key] = kg
+    return kg
+
+
+def gen_on_device(
+    lam: int,
+    cipher_keys,
+    alphas: np.ndarray,
+    betas: np.ndarray,
+    s0s: np.ndarray,
+    bound: Bound,
+    *,
+    interpret: bool | None = None,
+    tile_words: int = 128,
+) -> KeyBundle:
+    """Generate K keys with the GGM level walk ON the accelerator.
+
+    Routes lam >= 48 to the Pallas narrow keygen kernel + affine wide
+    tail (``ops.pallas_keygen`` — one shared level-walk core with the
+    eval kernels) and smaller lams to the keys-in-lanes XLA generator
+    (``backends.device_gen``).  ``interpret=None`` applies the keylanes
+    rule: Mosaic on TPU, the Pallas interpreter elsewhere.  Returns the
+    host two-party ``KeyBundle``, byte-identical to ``gen_batch`` on
+    the same ``(alphas, betas, s0s, bound)`` — wire frames, serve
+    registration and the durable store cannot tell the pipelines apart.
+
+    Any device failure (lowering, OOM, a broken install — injectable at
+    the ``keygen.device`` seam) falls back to the host ``gen_batch``:
+    silent-correct, counted (``device_fallback_count``), warned once per
+    call via ``BackendFallbackWarning``.
+    """
+    _check_gen_inputs(alphas, betas, s0s, lam)
+    global _DEVICE_FALLBACKS
+    try:
+        from dcf_tpu.testing.faults import fire
+
+        fire("keygen.device", alphas.shape[0], lam)
+        if interpret is None:
+            import jax
+
+            interpret = jax.devices()[0].platform != "tpu"
+        kg = _device_gen_for(lam, cipher_keys, bool(interpret), tile_words)
+        if hasattr(kg, "to_host_bundle"):  # keys-in-lanes generator
+            return kg.to_host_bundle(kg.gen(alphas, betas, s0s, bound))
+        return kg.gen(alphas, betas, s0s, bound)
+    except Exception as e:  # fallback-ok: keygen must never fail for a
+        # device-side reason — the host walk is always correct, and the
+        # caller asked for keys, not for a particular pipeline.  The
+        # fallback is counted and warned so it cannot pass unnoticed,
+        # and it prefers the SAME host path the non-device facade would
+        # take (C++ AES-NI core when the toolchain is present, numpy
+        # floor otherwise) so a fallback storm degrades to the default
+        # host rate, not silently to the portable floor.
+        _DEVICE_FALLBACKS += 1
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # the facade edge already
+            # validated the Hirose shape; don't re-warn from the fallback
+            native = None
+            try:
+                from dcf_tpu.native import NativeDcf
+
+                native = NativeDcf(lam, cipher_keys)
+            except Exception:  # fallback-ok: no toolchain -> numpy walk
+                pass
+        warnings.warn(
+            BackendFallbackWarning(
+                "device-keygen",
+                "native gen_batch" if native is not None else "gen_batch",
+                e),
+            stacklevel=2)
+        if native is not None:
+            return native.gen_batch(alphas, betas, s0s, bound)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            prg = HirosePrgNp(lam, cipher_keys)
+        return gen_batch(prg, alphas, betas, s0s, bound)

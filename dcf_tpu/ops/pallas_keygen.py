@@ -1,0 +1,436 @@
+"""K-packed Pallas kernel for on-device GGM keygen (lam >= 48).
+
+Keygen is the same narrow walk as evaluation, run once per KEY instead
+of once per point: per level both parties' seeds expand through the
+identical Hirose PRG, the lose-side correction words derive from the
+XOR of the two expansions, and the keep-side children advance the walk
+(reference src/lib.rs:86-161; ``gen.gen_batch`` is the host twin this
+kernel is pinned byte-identical to).  The kernel therefore consumes the
+SAME per-level AES core as the eval kernels — ``make_narrow_aes`` +
+``narrow_prg_expand`` from ``ops.pallas_narrow`` — so gen and eval
+cannot drift apart at the cipher layer: one walk implementation, one
+parity surface.
+
+Layout: the batch axis is KEYS, packed 32-per-int32-lane-word
+(W = ceil(K/32) words), with each 16-byte block a separate [128, W]
+bit-major plane tile — the eval kernels' exact convention with points
+swapped for keys.  Per level the kernel runs TWO cipher applications
+(one per party, each covering that party's four encryptions) and emits
+the correction-word planes level by level in the hybrid evaluator's
+staged two-block decomposition: ``cs0``/``cs1``/``cv0``/``cv1``
+[n, 128, W], ``cw_tl``/``cw_tr`` [n, 1, W], the final-CW blocks
+``np1_0``/``np1_1`` [128, W], plus both parties' control-bit
+TRAJECTORIES [n, 1, W] (t at entry of each level) — the wide tail's
+only coupling to the walk.
+
+The wide part (bytes 32..lam-1) is keygen's mirror of the eval-side
+affine split (``backends.large_lambda``): beyond byte 32 the Hirose PRG
+is a structural copy, so per level
+
+    s_cw[wide]  = mask(s_a ^ s_b)          (lose side == keep side)
+    v_cw[wide]  = mask(s_a ^ s_b) ^ v_alpha ^ beta * gate
+    v_alpha'    = beta * gate              (v_l == v_r: the walk
+                                            accumulation cancels)
+    s_p'[wide]  = mask(s_p) ^ s_cw * t_p   (p in {a, b})
+
+— a pure GF(2) recursion in the per-level alpha bits and the two
+trajectories, computed as one ``lax.scan`` over uint8 planes
+(``_keygen_wide_tail``).  ``mask`` clears the global 8*lam-1 bit, which
+always lies in the wide slice for lam > 32 (src/prg.rs:65-68).
+
+lam < 48 has no wide/narrow split and is served by the keys-in-lanes
+device generator (``backends.device_gen``); ``gen.gen_on_device`` is
+the one router.  Bit-exact parity with the host ``gen_batch`` across
+(lam, K, bound) is pinned by tests/test_keygen_device.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+from dcf_tpu.errors import ShapeError
+from dcf_tpu.keys import KeyBundle
+from dcf_tpu.ops._compat import CompilerParams as _CompilerParams
+from dcf_tpu.ops.aes_bitsliced import round_key_masks_bitmajor
+from dcf_tpu.ops.pallas_narrow import make_narrow_aes, narrow_prg_expand
+from dcf_tpu.spec import Bound, hirose_used_cipher_indices
+from dcf_tpu.utils.bits import (
+    bitmajor_perm,
+    bits_lsb_to_bytes,
+    byte_bits_lsb,
+    byte_bits_msb,
+    pack_lanes,
+    unpack_lanes,
+)
+
+__all__ = ["PallasKeyGen", "dcf_keygen_walk_pallas"]
+
+NARROW = 32  # bytes covered by the encrypted blocks (ciphers 0, 17)
+
+
+def _kernel(rk2_ref, s0a0_ref, s0a1_ref, s0b0_ref, s0b1_ref, beta0_ref,
+            beta1_ref, am_ref,
+            cs0_ref, cs1_ref, cv0_ref, cv1_ref, tl_ref, tr_ref,
+            np10_ref, np11_ref, tra_ref, trb_ref,
+            *, n: int, lt_beta: bool, interpret: bool):
+    wt = am_ref.shape[2]
+    ones = jnp.int32(-1)
+    aes = make_narrow_aes(rk2_ref, wt, interpret)
+
+    def mux(m, if_one, if_zero):
+        return (if_one & m) | (if_zero & (m ^ ones))
+
+    z = jnp.zeros((128, wt), jnp.int32)
+    sa0 = s0a0_ref[...] ^ z  # party 0 seed planes, blocks 0/1
+    sa1 = s0a1_ref[...] ^ z
+    sb0 = s0b0_ref[...] ^ z  # party 1
+    sb1 = s0b1_ref[...] ^ z
+    beta0 = beta0_ref[...] ^ z
+    beta1 = beta1_ref[...] ^ z
+    t_a = jnp.zeros((1, wt), jnp.int32)       # t^(0)_0 = 0
+    t_b = jnp.full((1, wt), ones, jnp.int32)  # t^(0)_1 = 1
+    va0 = z  # v_alpha blocks
+    va1 = z
+
+    def level(i, carry):
+        sa0, sa1, sb0, sb1, t_a, t_b, va0, va1 = carry
+        tra_ref[pl.dslice(i, 1)] = t_a[None]  # t at level entry
+        trb_ref[pl.dslice(i, 1)] = t_b[None]
+        am = am_ref[i]  # [1, wt]: -1 where the walk bit of alpha is 1
+        # Both parties through the ONE shared per-level PRG core — the
+        # same two cipher applications gen_batch's two prg.gen calls do.
+        ea_s0, ea_v0, ea_s1, ea_v1, spa0, spa1, tla, tra = \
+            narrow_prg_expand(aes, sa0, sa1)
+        eb_s0, eb_v0, eb_s1, eb_v1, spb0, spb1, tlb, trb = \
+            narrow_prg_expand(aes, sb0, sb1)
+        # lose side: L when the alpha bit is 1, R when 0
+        # (src/lib.rs:107-111); child blocks per narrow_prg_expand.
+        s_cw0 = mux(am, ea_s0 ^ eb_s0, sa0 ^ sb0)
+        s_cw1 = mux(am, sa1 ^ sb1, ea_s1 ^ eb_s1)
+        v_cw0 = mux(am, ea_v0 ^ eb_v0, spa0 ^ spb0) ^ va0
+        v_cw1 = mux(am, spa1 ^ spb1, ea_v1 ^ eb_v1) ^ va1
+        # beta folds into v_cw when the lose side matches the bound
+        # (src/lib.rs:114-125): LT on lose==L (bit 1), GT on lose==R.
+        bg = am if lt_beta else am ^ ones
+        v_cw0 = v_cw0 ^ (beta0 & bg)
+        v_cw1 = v_cw1 ^ (beta1 & bg)
+        # keep-side v accumulation (gen.gen_batch's v_alpha update)
+        va0 = va0 ^ mux(am, spa0 ^ spb0, ea_v0 ^ eb_v0) ^ v_cw0
+        va1 = va1 ^ mux(am, ea_v1 ^ eb_v1, spa1 ^ spb1) ^ v_cw1
+        tl_cw = tla ^ tlb ^ am ^ ones
+        tr_cw = tra ^ trb ^ am
+        t_cw_keep = mux(am, tr_cw, tl_cw)
+        # keep-side children + CW correction gated by each party's t
+        new_sa0 = mux(am, sa0, ea_s0) ^ (s_cw0 & t_a)
+        new_sa1 = mux(am, ea_s1, sa1) ^ (s_cw1 & t_a)
+        new_sb0 = mux(am, sb0, eb_s0) ^ (s_cw0 & t_b)
+        new_sb1 = mux(am, eb_s1, sb1) ^ (s_cw1 & t_b)
+        new_t_a = mux(am, tra, tla) ^ (t_a & t_cw_keep)
+        new_t_b = mux(am, trb, tlb) ^ (t_b & t_cw_keep)
+        cs0_ref[pl.dslice(i, 1)] = s_cw0[None]
+        cs1_ref[pl.dslice(i, 1)] = s_cw1[None]
+        cv0_ref[pl.dslice(i, 1)] = v_cw0[None]
+        cv1_ref[pl.dslice(i, 1)] = v_cw1[None]
+        tl_ref[pl.dslice(i, 1)] = tl_cw[None]
+        tr_ref[pl.dslice(i, 1)] = tr_cw[None]
+        return (new_sa0, new_sa1, new_sb0, new_sb1, new_t_a, new_t_b,
+                va0, va1)
+
+    sa0, sa1, sb0, sb1, _t_a, _t_b, va0, va1 = jax.lax.fori_loop(
+        0, n, level, (sa0, sa1, sb0, sb1, t_a, t_b, va0, va1))
+    np10_ref[...] = sa0 ^ sb0 ^ va0  # cw_{n+1}, narrow blocks
+    np11_ref[...] = sa1 ^ sb1 ^ va1
+
+
+def dcf_keygen_walk_pallas(
+    rk2,        # int32 [15, 128, 2]  bit-major round keys (ciphers 0, 17)
+    s0a0, s0a1,  # int32 [128, W]     party-0 seed planes, blocks 0/1
+    s0b0, s0b1,  # int32 [128, W]     party-1 seed planes
+    beta0, beta1,  # int32 [128, W]   beta planes, blocks 0/1
+    alpha_mask,  # int32 [n, 1, W]    per-level walk-order alpha-bit masks
+    *,
+    lt_beta: bool,
+    tile_words: int = 128,
+    interpret: bool = False,
+):
+    """The full n-level keygen walk for W*32 lane-packed keys.
+
+    Returns ``(cs0, cs1, cv0, cv1 [n, 128, W], cw_tl, cw_tr [n, 1, W],
+    np1_0, np1_1 [128, W], tr_a, tr_b [n, 1, W])`` — the narrow
+    correction-word planes in the hybrid evaluator's staged two-block
+    decomposition plus both parties' level-entry control-bit
+    trajectories (the wide tail's input)."""
+    n = alpha_mask.shape[0]
+    w = alpha_mask.shape[2]
+    wt = min(tile_words, w)
+    if w % wt != 0:
+        raise ShapeError(f"key words {w} not a multiple of tile {wt}")
+
+    grid = (w // wt,)
+    plane = pl.BlockSpec((128, wt), lambda j: (0, j))
+    level_out = pl.BlockSpec((n, 128, wt), lambda j: (0, 0, j))
+    bit_out = pl.BlockSpec((n, 1, wt), lambda j: (0, 0, j))
+    params = (dict() if interpret else dict(
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024)))
+    return pl.pallas_call(
+        partial(_kernel, n=n, lt_beta=lt_beta, interpret=interpret),
+        **params,
+        out_shape=(
+            jax.ShapeDtypeStruct((n, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((n, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((n, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((n, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((n, 1, w), jnp.int32),
+            jax.ShapeDtypeStruct((n, 1, w), jnp.int32),
+            jax.ShapeDtypeStruct((128, w), jnp.int32),
+            jax.ShapeDtypeStruct((128, w), jnp.int32),
+            jax.ShapeDtypeStruct((n, 1, w), jnp.int32),
+            jax.ShapeDtypeStruct((n, 1, w), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((15, 128, 2), lambda j: (0, 0, 0)),
+            plane, plane, plane, plane, plane, plane,
+            pl.BlockSpec((n, 1, wt), lambda j: (0, 0, j)),
+        ],
+        out_specs=(
+            level_out, level_out, level_out, level_out,
+            bit_out, bit_out, plane, plane, bit_out, bit_out,
+        ),
+        interpret=interpret,
+    )(rk2, s0a0, s0a1, s0b0, s0b1, beta0, beta1, alpha_mask)
+
+
+@partial(jax.jit, static_argnames=("lam", "lt_beta", "k_num"))
+def _keygen_wide_tail(s0w, beta_w, abits, tr_a, tr_b, *, lam: int,
+                      lt_beta: bool, k_num: int):
+    """The wide (bytes 32..lam-1) correction words from the narrow
+    trajectories — keygen's mirror of the eval-side affine split
+    (module docstring).  ``s0w`` uint8 [K, 2, lam-32], ``beta_w`` uint8
+    [K, lam-32], ``abits`` uint8 [K, n] walk-order alpha bits,
+    ``tr_a``/``tr_b`` int32 [n, 1, W] kernel trajectory planes
+    (W*32 >= K = k_num).  Returns (cw_s_w, cw_v_w uint8 [K, n, lam-32],
+    np1_w uint8 [K, lam-32])."""
+    n = abits.shape[1]
+    wd = lam - NARROW
+
+    def lane_bits(tr):  # [n, 1, W] lane planes -> uint8 [n, K]
+        u = jax.lax.bitcast_convert_type(tr, jnp.uint32)
+        b = (u[..., None] >> jnp.arange(32, dtype=jnp.uint32)) \
+            & jnp.uint32(1)
+        return b.reshape(n, -1)[:, :k_num].astype(jnp.uint8)
+
+    t_a = lane_bits(tr_a)
+    t_b = lane_bits(tr_b)
+    # mask: clear the global 8*lam-1 bit (byte lam-1, wide index lam-33)
+    mask_vec = jnp.full((wd,), 255, jnp.uint8).at[lam - 33].set(
+        jnp.uint8(0xFE))
+
+    def body(carry, lev):
+        s_a, s_b, v = carry
+        a_bit, ta, tb = lev  # uint8 [K] each
+        bg = (a_bit if lt_beta else a_bit ^ jnp.uint8(1))[:, None]
+        sx = (s_a ^ s_b) & mask_vec     # s_cw (lose == keep wide)
+        v_cw = sx ^ v ^ beta_w * bg
+        v2 = v ^ sx ^ v_cw              # keep-side v_l == v_r
+        s_a2 = (s_a & mask_vec) ^ sx * ta[:, None]
+        s_b2 = (s_b & mask_vec) ^ sx * tb[:, None]
+        return (s_a2, s_b2, v2), (sx, v_cw)
+
+    init = (s0w[:, 0], s0w[:, 1], jnp.zeros((k_num, wd), jnp.uint8))
+    (s_a, s_b, v), (cw_s_w, cw_v_w) = jax.lax.scan(
+        body, init, (abits.T, t_a, t_b))
+    return (cw_s_w.transpose(1, 0, 2), cw_v_w.transpose(1, 0, 2),
+            s_a ^ s_b ^ v)
+
+
+@partial(jax.jit, static_argnames=("k_num",))
+def _lanes_to_key_masks(planes, *, k_num: int):
+    """Kernel lane planes [..., 128, W] -> per-key staged masks
+    int32 [K, ..., 128, 1] (0 / -1) — the hybrid evaluator's
+    ``put_bundle`` plane layout, derived on device so a generated image
+    can stage without a host round-trip."""
+    u = jax.lax.bitcast_convert_type(planes, jnp.uint32)
+    bits = (u[..., None] >> jnp.arange(32, dtype=jnp.uint32)) \
+        & jnp.uint32(1)
+    bits = bits.reshape(*planes.shape[:-1], -1)[..., :k_num]
+    masks = jax.lax.bitcast_convert_type(
+        bits * jnp.uint32(0xFFFFFFFF), jnp.int32)
+    return jnp.moveaxis(masks, -1, 0)[..., None]
+
+
+class PallasKeyGen:
+    """On-device K-packed GGM keygen for the hybrid family (lam >= 48).
+
+    Runs the narrow keygen walk as one Pallas kernel (keys in lanes) and
+    the wide correction words as a GF(2) scan over the emitted
+    trajectories; ``gen`` downloads and reassembles the standard host
+    ``KeyBundle`` (byte-identical to ``gen.gen_batch`` — the DCFK wire
+    bytes, the serve registration path and the durable store see exactly
+    what the host keygen would have produced), while ``staged_planes``
+    exposes the narrow image in the hybrid evaluator's staged layout
+    without leaving the device.  Prefer the ``gen.gen_on_device`` router
+    (or facade ``Dcf.gen(..., device=True)``) over direct construction.
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes],
+                 interpret: bool = False, tile_words: int = 128):
+        if lam < 48 or lam % 16:
+            # api-edge: constructor lam contract (mirrors the hybrid
+            # evaluator; smaller lams route to backends.device_gen)
+            raise ValueError(
+                "PallasKeyGen wants lam >= 48 (a multiple of 16); "
+                "use backends.device_gen for small lam")
+        used = hirose_used_cipher_indices(lam, len(cipher_keys),
+                                          warn=False)
+        assert tuple(used) == (0, 17)
+        self.lam = lam
+        self.interpret = interpret
+        self.tile_words = tile_words
+        self.rk2 = jnp.asarray(np.concatenate(
+            [round_key_masks_bitmajor(cipher_keys[i]) for i in used],
+            axis=2))  # [15, 128, 2]
+        self._perm = bitmajor_perm(16)
+        self._inv_perm = np.argsort(self._perm)
+
+    def _block_planes(self, a16: np.ndarray) -> jax.Array:
+        """uint8 [K_pad, 16] -> bit-major keys-in-lanes planes
+        int32 [128, W]."""
+        bits = byte_bits_lsb(a16)[:, self._perm]  # [K, 128] bit-major
+        return jnp.asarray(pack_lanes(
+            np.ascontiguousarray(bits.T)).view(np.int32))
+
+    def _walk(self, alphas, betas, s0s, bound: Bound):
+        """Pad + stage + run the kernel.  Returns (out tuple, padded
+        inputs).  Deliberately NOT memoized: a memo would retain seeds
+        and correction-word planes — key material — in a long-lived
+        generator object; callers who need the bundle AND the staged
+        planes from one walk use ``gen_with_planes``."""
+        k = alphas.shape[0]
+        k_pad = (k + 31) // 32 * 32
+        if k_pad != k:
+            pad = [(0, k_pad - k)]
+            alphas = np.pad(alphas, pad + [(0, 0)])
+            betas = np.pad(betas, pad + [(0, 0)])
+            s0s = np.pad(s0s, pad + [(0, 0), (0, 0)])
+        n = 8 * alphas.shape[1]
+        am = pack_lanes(np.ascontiguousarray(
+            byte_bits_msb(alphas).T)).view(np.int32)[:, None, :]
+        out = dcf_keygen_walk_pallas(
+            self.rk2,
+            self._block_planes(s0s[:, 0, :16]),
+            self._block_planes(s0s[:, 0, 16:32]),
+            self._block_planes(s0s[:, 1, :16]),
+            self._block_planes(s0s[:, 1, 16:32]),
+            self._block_planes(betas[:, :16]),
+            self._block_planes(betas[:, 16:32]),
+            jnp.asarray(am),
+            lt_beta=(bound is Bound.LT_BETA),
+            tile_words=self.tile_words, interpret=self.interpret)
+        return out, (alphas, betas, s0s, n, k_pad)
+
+    def _check(self, alphas, betas, s0s) -> int:
+        k = alphas.shape[0]
+        if betas.shape != (k, self.lam) or s0s.shape != (k, 2, self.lam):
+            raise ShapeError("alphas/betas/s0s shape mismatch")
+        return k
+
+    def gen(self, alphas: np.ndarray, betas: np.ndarray, s0s: np.ndarray,
+            bound: Bound) -> KeyBundle:
+        """Generate K keys on device: alphas uint8 [K, n_bytes], betas
+        uint8 [K, lam], s0s uint8 [K, 2, lam].  Returns the two-party
+        host ``KeyBundle``, byte-identical to ``gen_batch`` on the same
+        inputs (K is padded to a lane-word multiple internally; pad keys
+        are generated and discarded)."""
+        k = self._check(alphas, betas, s0s)
+        out, padded = self._walk(alphas, betas, s0s, bound)
+        return self._assemble_bundle(out, padded, s0s, bound, k)
+
+    def gen_with_planes(self, alphas: np.ndarray, betas: np.ndarray,
+                        s0s: np.ndarray, bound: Bound, b: int):
+        """ONE walk, both outputs: ``(host KeyBundle, party-b staged
+        plane dict)`` — the no-round-trip registration flow (the bundle
+        feeds the wire format / wide affine, the planes feed
+        ``LargeLambdaBackend.put_bundle(bundle, dev_planes=...)``)
+        without running the kernel twice and without retaining key
+        material in a memo."""
+        k = self._check(alphas, betas, s0s)
+        out, padded = self._walk(alphas, betas, s0s, bound)
+        return (self._assemble_bundle(out, padded, s0s, bound, k),
+                self._assemble_planes(out, padded[2], k, b))
+
+    def _assemble_bundle(self, out, padded, s0s, bound: Bound,
+                         k: int) -> KeyBundle:
+        cs0, cs1, cv0, cv1, tl, tr, np10, np11, tr_a, tr_b = out
+        alphas_p, betas_p, s0s_p, n, _k_pad = padded
+        cw_s_w, cw_v_w, np1_w = _keygen_wide_tail(
+            jnp.asarray(s0s_p[:, :, NARROW:]),
+            jnp.asarray(betas_p[:, NARROW:]),
+            jnp.asarray(byte_bits_msb(alphas_p)),
+            tr_a, tr_b, lam=self.lam,
+            lt_beta=(bound is Bound.LT_BETA), k_num=alphas_p.shape[0])
+
+        def blocks(b0, b1):  # [..., 128, W] x2 -> uint8 [K, ..., 32]
+            by = [bits_lsb_to_bytes(
+                np.moveaxis(unpack_lanes(np.asarray(
+                    jax.lax.bitcast_convert_type(a, jnp.uint32))),
+                    -1, 0)[:k][..., self._inv_perm])
+                for a in (b0, b1)]
+            return np.concatenate(by, axis=-1)
+
+        def bits(a):  # [n, 1, W] -> uint8 [K, n]
+            return np.moveaxis(
+                unpack_lanes(np.asarray(
+                    jax.lax.bitcast_convert_type(a, jnp.uint32))),
+                -1, 0)[:k, :, 0]
+
+        cw_s = np.concatenate(
+            [blocks(cs0, cs1), np.asarray(cw_s_w)[:k]], axis=-1)
+        cw_v = np.concatenate(
+            [blocks(cv0, cv1), np.asarray(cw_v_w)[:k]], axis=-1)
+        cw_np1 = np.concatenate(
+            [blocks(np10[None], np11[None])[:, 0],
+             np.asarray(np1_w)[:k]], axis=-1)
+        return KeyBundle(
+            s0s=s0s.copy(),
+            cw_s=cw_s, cw_v=cw_v,
+            cw_t=np.stack([bits(tl), bits(tr)], axis=2),
+            cw_np1=cw_np1,
+        )
+
+    def staged_planes(self, alphas: np.ndarray, betas: np.ndarray,
+                      s0s: np.ndarray, bound: Bound, b: int) -> dict:
+        """Party ``b``'s NARROW key image in the hybrid evaluator's
+        staged plane layout (``LargeLambdaBackend.put_bundle``'s
+        ``_dev`` dict: per-key [K, n, 128, 1] CW masks, [K, 128, 1]
+        seed/final planes, [K, n, 2] t-masks) — derived on device from
+        the kernel's lane planes, no host round-trip.  Feed it to
+        ``put_bundle(bundle, dev_planes=...)`` together with the host
+        bundle (whose wide halves the affine tail still consumes) —
+        and when you need both, ``gen_with_planes`` produces the pair
+        from ONE walk."""
+        k = self._check(alphas, betas, s0s)
+        out, (_a, _b, s0s_p, _n, _k_pad) = self._walk(
+            alphas, betas, s0s, bound)
+        return self._assemble_planes(out, s0s_p, k, b)
+
+    def _assemble_planes(self, out, s0s_p, k: int, b: int) -> dict:
+        cs0, cs1, cv0, cv1, tl, tr, np10, np11, _tr_a, _tr_b = out
+        km = partial(_lanes_to_key_masks, k_num=k)
+        # km on the [n, 1, W] t planes gives [K, n, 1, 1] masks each
+        cw_t = jnp.concatenate(
+            [km(tl), km(tr)], axis=2)[..., 0]  # [K, n, 2] int32 0/-1
+        return dict(
+            s0a=km(self._block_planes(s0s_p[:, b, :16])),
+            s0b=km(self._block_planes(s0s_p[:, b, 16:32])),
+            cs0=km(cs0), cs1=km(cs1), cv0=km(cv0), cv1=km(cv1),
+            np1a=km(np10), np1b=km(np11), cw_t=cw_t,
+        )

@@ -44,7 +44,7 @@ from dcf_tpu.ops.aes_bitsliced import (
 )
 
 __all__ = ["dcf_narrow_walk_pallas", "make_narrow_aes",
-           "narrow_walk_levels"]
+           "narrow_prg_expand", "narrow_walk_levels"]
 
 
 def make_narrow_aes(rk2_ref, wt: int, interpret: bool):
@@ -70,6 +70,38 @@ def make_narrow_aes(rk2_ref, wt: int, interpret: bool):
     return aes
 
 
+def narrow_prg_expand(aes, s0, s1):
+    """One party's narrow Hirose PRG expansion on packed two-block planes
+    — the per-level AES core shared by the eval walk
+    (``narrow_walk_levels``) and the device keygen
+    (``ops.pallas_keygen``), so gen and eval cannot drift apart at the
+    cipher layer.
+
+    ``s0``/``s1``: the party's block-0/block-1 seed planes [128, wt].
+    ONE ``aes`` application (``make_narrow_aes``: cipher 0 over the
+    first 2*wt lanes, cipher 17 over the last) covers all four
+    encryptions of the level.  Returns
+    ``(e_s0, e_v0, e_s1, e_v1, sp0, sp1, t_l, t_r)`` where the child
+    triples assemble as (reference src/prg.rs:48-62):
+
+        left  s = (e_s0, s1)    left  v = (e_v0, sp1)
+        right s = (s0, e_s1)    right v = (sp0, e_v1)
+
+    and ``t_l``/``t_r`` are the [1, wt] t-bit planes (bit 0 of byte 0 of
+    the block-0 s/v outputs, src/prg.rs:63-64).  No final-bit masking:
+    the big PRG's masked byte is wide (module docstring)."""
+    ones = jnp.int32(-1)
+    wt = s0.shape[1]
+    sp0 = s0 ^ ones
+    sp1 = s1 ^ ones
+    enc = aes(jnp.concatenate([s0, sp0, s1, sp1], axis=1))
+    e_s0 = enc[:, :wt] ^ s0           # left child block 0 (s)
+    e_v0 = enc[:, wt:2 * wt] ^ sp0    # left child block 0 (v)
+    e_s1 = enc[:, 2 * wt:3 * wt] ^ s1  # RIGHT child block 1 (s)
+    e_v1 = enc[:, 3 * wt:] ^ sp1      # right child block 1 (v)
+    return e_s0, e_v0, e_s1, e_v1, sp0, sp1, e_s0[0:1, :], e_v0[0:1, :]
+
+
 def narrow_walk_levels(aes, sa, sb, t, va, vb, cs0_ref, cs1_ref, cv0_ref,
                        cv1_ref, cw_t_ref, xm_ref, tr_ref, n: int):
     """The n-level NARROW walk loop on packed two-block planes, shared by
@@ -79,20 +111,12 @@ def narrow_walk_levels(aes, sa, sb, t, va, vb, cs0_ref, cs1_ref, cv0_ref,
     written to ``tr_ref`` (n+1 entries).  Returns the final carry
     (sa, sb, t, va, vb)."""
     ones = jnp.int32(-1)
-    wt = xm_ref.shape[3]
 
     def level(i, carry):
         sa, sb, t, va, vb = carry
         tr_ref[0, pl.dslice(i, 1)] = t  # emit the GATE bit of this level
-        spa = sa ^ ones
-        spb = sb ^ ones
-        enc = aes(jnp.concatenate([sa, spa, sb, spb], axis=1))
-        e_sa = enc[:, :wt] ^ sa           # left child block 0 (s)
-        e_va = enc[:, wt:2 * wt] ^ spa    # left child block 0 (v)
-        e_sb = enc[:, 2 * wt:3 * wt] ^ sb  # RIGHT child block 1 (s)
-        e_vb = enc[:, 3 * wt:] ^ spb      # right child block 1 (v)
-        t_l = e_sa[0:1, :]
-        t_r = e_va[0:1, :]
+        e_sa, e_va, e_sb, e_vb, spa, spb, t_l, t_r = narrow_prg_expand(
+            aes, sa, sb)
 
         cs0 = cs0_ref[0, i]  # [128, 1] per level
         cs1 = cs1_ref[0, i]

@@ -340,7 +340,19 @@ class ShardedLargeLambdaBackend(LargeLambdaBackend):
         self._spec_xmask_rem = P(None, None, None, paxis)
         self._fns: dict = {}
 
-    def put_bundle(self, bundle: KeyBundle) -> None:
+    def put_bundle(self, bundle: KeyBundle,
+                   dev_planes: dict | None = None) -> None:
+        if dev_planes is not None:
+            # The parent (ISSUE 10) accepts a device-resident staged
+            # image from the on-device keygen; this subclass re-places
+            # every plane across the mesh's keys axis, and a
+            # single-device planes dict has no shard placement — die
+            # typed here instead of as a bare TypeError or a silently
+            # unplaced image.
+            raise ShapeError(
+                "dev_planes is the single-device staged layout; the "
+                "sharded hybrid backend stages from the host bundle "
+                "and places shards itself")
         if bundle.num_keys % self._ksize:
             raise ShapeError(
                 f"num_keys={bundle.num_keys} not divisible by keys-axis "

@@ -285,12 +285,14 @@ def gen_interval_bundle(
     """Generate an m-interval protocol bundle through ``gen_fn``.
 
     ``gen_fn(alphas, betas, bound) -> KeyBundle`` is any K-batched DCF
-    keygen — the facade's (native core when available, else
-    ``gen.gen_batch``; what ``Dcf.mic`` passes) or a device pipeline
-    built on ``backends.device_gen.DeviceKeyGen`` (feed it the alphas
-    from ``interval_bound_alphas`` and wrap its device bundle).  The 2m
-    bound keys land in ONE K-packed bundle: interval i's shares are
-    keys 2i (lower) and 2i+1 (upper), both carrying ``betas[i]``.
+    keygen — the facade's host path (native core when available, else
+    ``gen.gen_batch``) or the on-device walk (``gen.gen_on_device``,
+    what ``Dcf.mic(..., device=True)`` passes: the m-interval MIC's 2m
+    bound keys are exactly the K-packed shape the device keygen kernel
+    scales with — ISSUE 10).  The 2m bound keys land in ONE K-packed
+    bundle: interval i's shares are keys 2i (lower) and 2i+1 (upper),
+    both carrying ``betas[i]``.  The pipelines are byte-identical, so
+    the ``ProtocolBundle`` wire frame does not record which one ran.
     """
     betas = np.asarray(betas, dtype=np.uint8)
     m = len(intervals)

@@ -328,6 +328,13 @@ class DcfService:
         to uint8 [m, M, lam] (per-interval shares) instead of
         [K, M, lam].
 
+        Device-GENERATED bundles (``gen.gen_on_device`` /
+        ``Dcf.gen(..., device=True)``, ISSUE 10) register exactly like
+        host-generated ones: the pipelines are pinned byte-identical,
+        so the registry, the staging backends and the durable store
+        codecs see the same DCFK bytes either way — a keygen pipeline
+        choice can never invalidate a stored frame.
+
         ``durable=True`` (ISSUE 8, needs ``store_dir``): the frame is
         written through to the durable store — atomic
         write-fsync-rename under the key's registry generation —

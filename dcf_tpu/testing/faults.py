@@ -107,6 +107,14 @@ POINTS = (
     "store.manifest",   # manifest publish (serve/store.py — same
     #                     write-fsync-rename seam for the CRC'd
     #                     manifest; handler args: "", tmp_path)
+    "keygen.device",    # on-device keygen walk (gen.gen_on_device —
+    #                     fires before the device pipeline is touched;
+    #                     handler args: num_keys, lam.  A raising
+    #                     handler models a dead kernel/driver: the
+    #                     router must fall back to the host gen_batch
+    #                     silent-correct, counted by
+    #                     gen.device_fallback_count, warned via
+    #                     BackendFallbackWarning)
 )
 
 _ACTIVE: dict[str, Callable] = {}

@@ -96,6 +96,70 @@ def test_pinned_ratio_corrupt_baseline(tmp_path):
     assert rec["vs_baseline"] == 2.0
 
 
+@pytest.mark.keygen
+def test_cli_keygen_bench_validates_lam_fast():
+    """keygen_bench's lam contract dies loudly BEFORE any keygen or
+    compile work (the _parse_priority_mix discipline)."""
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="lam >= 48"):
+        cli.main(["keygen_bench", "--lam=16"])
+    with pytest.raises(SystemExit, match="lam >= 48"):
+        cli.main(["keygen_bench", "--lam=40"])
+
+
+@pytest.mark.keygen
+def test_pinned_ratio_keygen_shapes(tmp_path):
+    """_pinned_ratio's keygen route (ISSUE 10): the ratio comes from
+    the ``keygen.lam{lam}`` pin in keys/s, only at the pin's own key
+    count, survives interpreted runs WITH the disclosure note, and
+    stays {} for corrupt/missing artifacts or unknown shapes."""
+    from dcf_tpu.cli import _pinned_ratio
+
+    healthy = tmp_path / "ok.json"
+    healthy.write_text(json.dumps(
+        {"keygen": {"lam128": {"keys_per_sec": 50.0, "keys": 64}}}))
+    rec = _pinned_ratio(16, 64, 100.0, lam=128, keygen=True,
+                        baseline_path=str(healthy))
+    assert rec["vs_baseline"] == 2.0
+    # interpreted keeps the ratio but discloses the numerator in-line
+    rec_i = _pinned_ratio(16, 64, 100.0, lam=128, keygen=True,
+                          interpreted=True, baseline_path=str(healthy))
+    assert rec_i["vs_baseline"] == 2.0
+    assert "interpret-mode numerator" in rec_i["baseline"]
+    # wrong K, unknown lam, corrupt artifact -> no silent ratio
+    assert _pinned_ratio(16, 8, 100.0, lam=128, keygen=True,
+                         baseline_path=str(healthy)) == {}
+    assert _pinned_ratio(16, 64, 100.0, lam=256, keygen=True,
+                         baseline_path=str(healthy)) == {}
+    corrupt = tmp_path / "bad.json"
+    corrupt.write_text("{ nope")
+    assert _pinned_ratio(16, 64, 100.0, lam=128, keygen=True,
+                         baseline_path=str(corrupt)) == {}
+
+
+@pytest.mark.slow
+@pytest.mark.keygen
+def test_cli_keygen_bench_smoke(capsys):
+    """The slow serial-leg CLI smoke (ISSUE 10): keygen_bench end to
+    end at lam=128 with a single-K sweep — the reconstruction gate, the
+    MIC 2m leg, the JSONL line with legs + interpret disclosure."""
+    recs = run_cli(capsys, ["keygen_bench", "--lam=128", "--reps=1",
+                            "--keys=2", "--intervals=2", "--seed=7"])
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["bench"] == "keygen_bench"
+    assert rec["metric"] == "keys_per_sec"
+    assert rec["value"] > 0
+    assert rec["lam"] == 128
+    assert [leg["keys"] for leg in rec["legs"]] == [2]
+    assert rec["mic_keys_per_sec"] > 0
+    assert rec["host_gen_batch_keys_per_sec"] > 0
+    assert "repro" in rec
+    if rec["interpreted"]:
+        assert "interpret" in rec["unit"]
+
+
 def test_bench_clamped_samples_excluded():
     """ADVICE finding 1, regression-locked: a sample the sync-RTT
     correction dominates is EXCLUDED from the headline median (and

@@ -282,6 +282,20 @@ def test_secret_hygiene_covers_store_layer(tmp_path):
             if v.path.endswith("util.py")] == []
 
 
+def test_keygen_layer_lint_clean():
+    """The ISSUE-10 CI satellite: the device-keygen layer —
+    ``ops/pallas_keygen.py`` (the K-packed keygen kernel + wide tail),
+    the refactored shared walk core in ``ops/pallas_narrow.py`` that
+    gen and eval now both consume, and the ``gen.py`` router — sweeps
+    clean under ALL six passes.  Crypto-dtype and secret-hygiene are
+    the load-bearing ones: correction words and seeds are key material,
+    and a float or a logged plane on the keygen path is a broken or
+    leaked key."""
+    assert run_path(REPO / "dcf_tpu" / "ops" / "pallas_keygen.py") == []
+    assert run_path(REPO / "dcf_tpu" / "ops" / "pallas_narrow.py") == []
+    assert run_path(REPO / "dcf_tpu" / "gen.py") == []
+
+
 def test_store_layer_lint_clean():
     """The ISSUE-8 CI satellite: the durable store module sweeps clean
     under ALL six passes — in particular secret-hygiene (no

@@ -1,0 +1,328 @@
+"""On-device keygen (ISSUE 10): parity, integration, fallback.
+
+The contract under test: ``gen.gen_on_device`` — the Pallas narrow
+keygen kernel + affine wide tail for lam >= 48 (``ops.pallas_keygen``,
+sharing the eval kernels' per-level AES core) and the keys-in-lanes XLA
+generator below that — produces keys BYTE-IDENTICAL to the host
+``gen_batch`` (itself pinned to the reference vectors) and to the C++
+native core, across (lam, K, bound); device-generated keys evaluate
+correctly on the facade backends; the MIC K=2m packing takes the device
+path; and a dead device path falls back to the host walk
+silent-correct, counted, and warned (seam ``keygen.device``).
+"""
+
+import random
+import warnings
+
+import numpy as np
+import pytest
+
+from dcf_tpu import Dcf, gen, spec
+from dcf_tpu.errors import ShapeError
+from dcf_tpu.gen import gen_batch, gen_on_device, random_s0s
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.testing import faults
+
+pytestmark = pytest.mark.keygen
+
+
+def _cipher_keys(rng: random.Random, lam: int) -> list:
+    n = max(2, 2 * (lam // 16))
+    if lam >= 32:
+        n = max(n, 18)
+    return [bytes(rng.getrandbits(8) for _ in range(32))
+            for _ in range(n)]
+
+
+def _prg(lam, ck):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return HirosePrgNp(lam, ck)
+
+
+def _native(lam, ck):
+    try:
+        from dcf_tpu.native import NativeDcf
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return NativeDcf(lam, ck)
+    except Exception:  # fallback-ok: toolchain-less host skips the
+        # C++ anchor; the numpy parity assertions still run
+        return None
+
+
+@pytest.mark.parametrize("bound", [spec.Bound.LT_BETA, spec.Bound.GT_BETA])
+@pytest.mark.parametrize("lam", [16, 128, 256])
+def test_device_keygen_parity_fuzz(lam, bound):
+    """Seeded sweep: device keys byte-identical to the host gen_batch
+    AND to the C++ native path at K in {1, 3, 8}, both bounds, lam
+    covering the keys-in-lanes route (16) and the Pallas narrow route
+    (128, 256).  The silent-correct fallback must NOT be what passes
+    this test: the fallback counter is pinned unchanged."""
+    rng = random.Random(1000 + lam)
+    ck = _cipher_keys(rng, lam)
+    nprng = np.random.default_rng(
+        31 * lam + (1 if bound is spec.Bound.GT_BETA else 0))
+    prg = _prg(lam, ck)
+    native = _native(lam, ck)
+    before = gen.device_fallback_count()
+    for k in (1, 3, 8):
+        alphas = nprng.integers(0, 256, (k, 2), dtype=np.uint8)
+        betas = nprng.integers(0, 256, (k, lam), dtype=np.uint8)
+        s0s = random_s0s(k, lam, nprng)
+        want = gen_batch(prg, alphas, betas, s0s, bound)
+        got = gen_on_device(lam, ck, alphas, betas, s0s, bound,
+                            interpret=True)
+        assert got.to_bytes() == want.to_bytes(), (lam, k, bound)
+        if native is not None:
+            nat = native.gen_batch(alphas, betas, s0s, bound)
+            assert nat.to_bytes() == want.to_bytes(), (lam, k, bound)
+    assert gen.device_fallback_count() == before, \
+        "parity came from the host fallback, not the device path"
+
+
+@pytest.mark.slow
+def test_device_keys_reconstruct_on_backends():
+    """End to end: device-generated keys evaluated on the auto,
+    bitsliced and prefix facade backends reconstruct the comparison
+    function (the numpy-oracle expectation) bit-exactly, including the
+    x = alpha boundary.  Serial CI leg (slow): four backend
+    constructions x two parties of interpret-mode eval — the byte-level
+    parity matrix above already pins the bundles identical in tier-1,
+    so this adds the eval integration, not the correctness gate."""
+    rng = random.Random(77)
+    nprng = np.random.default_rng(77)
+    k, nb, m = 3, 2, 16
+
+    def check(dcf, bundle, alphas, betas, lam, xs):
+        y0 = dcf.eval(0, bundle, xs)
+        y1 = dcf.eval(1, bundle, xs)
+        recon = y0 ^ y1
+        for i in range(k):
+            a = alphas[i].tobytes()
+            for j in range(xs.shape[0]):
+                want = (betas[i].tobytes() if xs[j].tobytes() < a
+                        else bytes(lam))
+                assert recon[i, j].tobytes() == want, (dcf.backend_name,
+                                                       lam, i, j)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        # lam=16: the keys-in-lanes device route, served by auto
+        # (bitsliced off-TPU) and the prefix kernel backend.
+        ck16 = _cipher_keys(rng, 16)
+        alphas = nprng.integers(0, 256, (k, nb), dtype=np.uint8)
+        betas = nprng.integers(0, 256, (k, 16), dtype=np.uint8)
+        s0s = random_s0s(k, 16, nprng)
+        bundle = gen_on_device(16, ck16, alphas, betas, s0s,
+                               spec.Bound.LT_BETA, interpret=True)
+        xs = nprng.integers(0, 256, (m, nb), dtype=np.uint8)
+        xs[0] = alphas[0]  # exact boundary
+        check(Dcf(nb, 16, ck16, backend="auto"), bundle, alphas, betas,
+              16, xs)
+        check(Dcf(nb, 16, ck16, backend="prefix"), bundle, alphas,
+              betas, 16, xs)
+        # lam=128: the Pallas narrow keygen route, served by auto
+        # (hybrid at lam >= 48) and bitsliced.
+        ck128 = _cipher_keys(rng, 128)
+        betas = nprng.integers(0, 256, (k, 128), dtype=np.uint8)
+        s0s = random_s0s(k, 128, nprng)
+        bundle = gen_on_device(128, ck128, alphas, betas, s0s,
+                               spec.Bound.LT_BETA, interpret=True)
+        check(Dcf(nb, 128, ck128, backend="auto"), bundle, alphas,
+              betas, 128, xs)
+        check(Dcf(nb, 128, ck128, backend="bitsliced"), bundle, alphas,
+              betas, 128, xs)
+
+
+def test_gen_interval_bundle_device_path_mic():
+    """``Dcf.mic(..., device=True)`` routes the K=2m packed keygen
+    through the device walk: the ProtocolBundle is byte-identical to
+    the host path's (same rng stream), and the served-shape MIC
+    evaluation reconstructs against the protocol oracle."""
+    from dcf_tpu.protocols.oracle import mic_oracle
+
+    rng = random.Random(55)
+    nprng = np.random.default_rng(55)
+    nb, lam = 2, 128
+    ck = _cipher_keys(rng, lam)
+    intervals = [(100, 2000), (3000, 50000)]
+    betas = nprng.integers(0, 256, (2, lam), dtype=np.uint8)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        dcf = Dcf(nb, lam, ck, backend="bitsliced")
+        pb_host = dcf.mic(intervals, betas,
+                          rng=np.random.default_rng(9))
+        pb_dev = dcf.mic(intervals, betas,
+                         rng=np.random.default_rng(9), device=True)
+        assert pb_dev.to_bytes() == pb_host.to_bytes()
+        xs = nprng.integers(0, 256, (16, nb), dtype=np.uint8)
+        y0 = dcf.eval_mic(0, pb_dev.for_party(0), xs)
+        y1 = dcf.eval_mic(1, pb_dev.for_party(1), xs)
+    assert np.array_equal(y0 ^ y1, mic_oracle(xs, intervals, betas))
+
+
+def test_keygen_device_fault_falls_back_counted():
+    """The ``keygen.device`` seam (chaos contract): a dead device path
+    must yield HOST-identical keys (silent-correct), bump the fallback
+    counter, and warn structured — never crash, never alter bytes."""
+    rng = random.Random(42)
+    nprng = np.random.default_rng(42)
+    lam, nb, k = 128, 2, 4
+    ck = _cipher_keys(rng, lam)
+    alphas = nprng.integers(0, 256, (k, nb), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k, lam), dtype=np.uint8)
+    s0s = random_s0s(k, lam, nprng)
+    want = gen_batch(_prg(lam, ck), alphas, betas, s0s,
+                     spec.Bound.LT_BETA)
+    before = gen.device_fallback_count()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with faults.inject("keygen.device"):
+            got = gen_on_device(lam, ck, alphas, betas, s0s,
+                                spec.Bound.LT_BETA, interpret=True)
+    assert got.to_bytes() == want.to_bytes()
+    assert gen.device_fallback_count() == before + 1
+    from dcf_tpu.errors import BackendFallbackWarning
+
+    msgs = [x for x in w if isinstance(x.message, BackendFallbackWarning)]
+    assert len(msgs) == 1 and msgs[0].message.failed == "device-keygen"
+    # and the facade spelling takes the same seam
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        dcf = Dcf(nb, lam, ck, backend="numpy")
+        with faults.inject("keygen.device"):
+            fb = dcf.gen(alphas, betas, s0s=s0s, device=True)
+    assert fb.to_bytes() == want.to_bytes()
+    assert gen.device_fallback_count() == before + 2
+
+
+def test_gen_batch_typed_dtype_validation():
+    """The PR-2 typed-error sweep's missing edge: non-uint8 inputs die
+    ``ShapeError`` naming the argument at the API edge, not as
+    ``np.unpackbits``'s bare TypeError mid-walk — on the host walk AND
+    the device router (which validates BEFORE the fallback try, so a
+    caller bug is never laundered into a counted device fallback)."""
+    rng = random.Random(3)
+    nprng = np.random.default_rng(3)
+    lam = 16
+    ck = _cipher_keys(rng, lam)
+    prg = _prg(lam, ck)
+    alphas = nprng.integers(0, 256, (2, 2), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (2, lam), dtype=np.uint8)
+    s0s = random_s0s(2, lam, nprng)
+    before = gen.device_fallback_count()
+    for bad_args in (
+        (alphas.astype(np.int32), betas, s0s),
+        (alphas, betas.astype(np.float64), s0s),
+        (alphas, betas, s0s.tolist()),
+    ):
+        with pytest.raises(ShapeError, match="uint8"):
+            gen_batch(prg, *bad_args, spec.Bound.LT_BETA)
+        with pytest.raises(ShapeError, match="uint8"):
+            gen_on_device(lam, ck, *bad_args, spec.Bound.LT_BETA,
+                          interpret=True)
+    with pytest.raises(ShapeError, match="mismatch"):
+        gen_batch(prg, alphas, betas[:1], s0s, spec.Bound.LT_BETA)
+    assert gen.device_fallback_count() == before
+
+
+@pytest.mark.slow
+def test_staged_planes_skip_host_round_trip():
+    """The no-host-round-trip staging path: the keygen kernel's
+    correction-word planes, converted on device to the hybrid
+    evaluator's staged layout (``PallasKeyGen.gen_with_planes`` — ONE
+    walk produces the host bundle and the party's staged dict), drive
+    ``put_bundle(bundle, dev_planes=...)`` to a bit-identical eval with
+    the host-staged image."""
+    from dcf_tpu.backends.large_lambda import LargeLambdaBackend
+    from dcf_tpu.ops.pallas_keygen import PallasKeyGen
+
+    rng = random.Random(88)
+    nprng = np.random.default_rng(88)
+    lam, nb, k = 128, 2, 3
+    ck = _cipher_keys(rng, lam)
+    alphas = nprng.integers(0, 256, (k, nb), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k, lam), dtype=np.uint8)
+    s0s = random_s0s(k, lam, nprng)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        kg = PallasKeyGen(lam, ck, interpret=True)
+        bundle, planes = kg.gen_with_planes(alphas, betas, s0s,
+                                            spec.Bound.LT_BETA, b=1)
+        xs = nprng.integers(0, 256, (8, nb), dtype=np.uint8)
+        be_host = LargeLambdaBackend(lam, ck, narrow="pallas",
+                                     interpret=True)
+        y_host = np.asarray(
+            be_host.eval(1, xs, bundle=bundle.for_party(1)))
+        be_dev = LargeLambdaBackend(lam, ck, narrow="pallas",
+                                    interpret=True)
+        be_dev.put_bundle(bundle.for_party(1), dev_planes=planes)
+        y_dev = np.asarray(be_dev.eval(1, xs))
+    assert np.array_equal(y_host, y_dev)
+    # geometry mismatches die typed, not as opaque kernel errors
+    with pytest.raises(ShapeError, match="geometry"):
+        be_dev.put_bundle(bundle.for_party(1),
+                          dev_planes={**planes,
+                                      "cs0": planes["cs0"][:, :8]})
+
+
+def test_sharded_hybrid_rejects_dev_planes_typed():
+    """The sharded hybrid backend re-places its plane image across the
+    mesh; a single-device ``dev_planes`` dict has no shard placement
+    and must die typed (ShapeError) at put_bundle, not as a bare
+    TypeError or a silently unplaced image."""
+    from dcf_tpu.parallel import ShardedLargeLambdaBackend, make_mesh
+
+    rng = random.Random(11)
+    nprng = np.random.default_rng(11)
+    lam = 128
+    ck = _cipher_keys(rng, lam)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        be = ShardedLargeLambdaBackend(lam, ck, make_mesh(shape=(2, 2)),
+                                       interpret=True)
+        alphas = nprng.integers(0, 256, (2, 2), dtype=np.uint8)
+        betas = nprng.integers(0, 256, (2, lam), dtype=np.uint8)
+        s0s = random_s0s(2, lam, nprng)
+        bundle = gen_batch(_prg(lam, ck), alphas, betas, s0s,
+                           spec.Bound.LT_BETA)
+    with pytest.raises(ShapeError, match="single-device"):
+        be.put_bundle(bundle.for_party(0), dev_planes={"cs0": None})
+
+
+@pytest.mark.slow
+def test_device_bundle_serves_and_persists(tmp_path):
+    """ISSUE 10 serve integration: a device-generated bundle registers
+    into ``DcfService`` (durable write-through included) exactly like a
+    host-generated one — the store frame on disk is byte-identical to
+    what the host keygen would have persisted, and served evaluation
+    reconstructs the comparison function."""
+    rng = random.Random(21)
+    nprng = np.random.default_rng(21)
+    lam, nb, k = 16, 2, 2
+    ck = _cipher_keys(rng, lam)
+    alphas = nprng.integers(0, 256, (k, nb), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k, lam), dtype=np.uint8)
+    s0s = random_s0s(k, lam, nprng)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        dcf = Dcf(nb, lam, ck, backend="bitsliced")
+        host = dcf.gen(alphas, betas, s0s=s0s)
+        dev = dcf.gen(alphas, betas, s0s=s0s, device=True)
+        svc = dcf.serve(store_dir=str(tmp_path / "store"))
+        svc.register_key("dev-key", dev, durable=True)
+        f0 = svc.submit("dev-key", alphas[:1], b=0)
+        f1 = svc.submit("dev-key", alphas[:1], b=1)
+        svc.pump()
+        recon = f0.result() ^ f1.result()
+    # x = alphas[0]: key 0 evaluates OUTSIDE its own interval (x < x is
+    # false), key 1 per the comparison function
+    a1 = alphas[1].tobytes()
+    assert recon[0, 0].tobytes() == bytes(lam)
+    assert recon[1, 0].tobytes() == (
+        betas[1].tobytes() if alphas[0].tobytes() < a1 else bytes(lam))
+    # the durable frame is the host pipeline's frame, byte for byte
+    stored, _proto, _generation = svc.store.load("dev-key")
+    assert stored.to_bytes() == host.to_bytes()
