@@ -176,11 +176,17 @@ instance-store behavior), ``max_queued_points`` (shed point),
 durable key store — ``register_key(..., durable=True)`` persists the
 frame atomically before acking and ``restore_keys()`` warm-restarts
 the registry with generations preserved and zero re-keygen; damaged
-frames quarantine typed) and ``batch_timeout_s`` (the hung-batch
+frames quarantine typed), ``batch_timeout_s`` (the hung-batch
 watchdog: an overdue dispatch fails ``BatchTimeoutError`` into the
-breaker/retry path instead of stalling the worker); full semantics in
+breaker/retry path instead of stalling the worker) and
+``keyfactory_refill_interval_s`` (ISSUE 11, the key factory:
+``add_pool(PoolSpec(...))`` declares ahead-of-demand keygen pools
+topped up on device in K-packed batches and published to the store in
+batched atomic manifest flips; ``register_key(key_id, pool=...)``
+then mints a fresh session key at pool-pop latency with a counted,
+warned synchronous fallback on exhaustion); full semantics in
 ``dcf_tpu/serve/service.py`` and the README "Serving" /
-"Durability & restart" sections.
+"Durability & restart" / "Key factory" sections.
 
 Mixed-mode protocols (``dcf_tpu.protocols``)
 --------------------------------------------
@@ -722,6 +728,12 @@ class Dcf:
         breakers + brownout — README "Resilience" — and metrics).
         ``submit(..., priority=)`` takes CRITICAL/NORMAL/BATCH; classes
         decide who is shed under overload, never dispatch order.
+        Fresh-key-per-session traffic: declare
+        ``add_pool(serve.PoolSpec(...))`` and register with
+        ``register_key(key_id, pool=...)`` — the key factory
+        (``serve.keyfactory``, README "Key factory") pre-mints session
+        keys in K-packed device batches so registration is a pool pop,
+        not a keygen walk.
         """
         from dcf_tpu.serve import DcfService, ServeConfig
 

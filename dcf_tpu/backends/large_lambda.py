@@ -467,6 +467,12 @@ class LargeLambdaBackend(FrontierConsumerMixin):
         self.lam = lam
         self.col_chunk = col_chunk
         self.narrow = narrow
+        # Capability flag the serving registry reads (ISSUE 11): only
+        # the single-device Pallas narrow path can stage a
+        # device-resident keygen plane dict verbatim (the sharded
+        # subclass re-places shards and overrides this to False; the
+        # XLA narrow path stages its own plane order).
+        self.accepts_dev_planes = narrow == "pallas"
         self.interpret = interpret
         self.prefix_levels = min(prefix_levels, HYBRID_MAX_PREFIX_LEVELS)
         self.rk_masks = tuple(

@@ -1558,6 +1558,285 @@ def bench_keygen(args) -> None:
                 "bench the host explicitly")
 
 
+def bench_keyfactory(args) -> None:
+    """Key-factory provisioning bench (ISSUE 11): does ahead-of-demand
+    pooling actually take keygen off the registration clock?
+
+    Shape: the flagship N=16-byte domain at ``--lam`` (default 128 —
+    the pinned keygen-baseline shape), a single-key-per-session plain
+    pool refilled in ``--keys``-session device batches (default 64, the
+    CPU_BASELINE.md keygen pin's K).  The serving backend is the host
+    path (default ``numpy``; the bench measures PROVISIONING, not eval
+    throughput — ``serve_bench`` owns that).  Four phases:
+
+    1. **Parity gates** — a pool-hit key AND a pool-exhaustion
+       fallback key (the miss counter pinned to prove which path ran)
+       each serve a bit-exact two-party reconstruction through the
+       service, including x = alpha.  Exit != 0 on any mismatch.
+    2. **Sustained publish-to-servable** — repeated full refills of a
+       durable pool (mint K-packed on device + batched atomic manifest
+       flip + pooled), median keys/s across ``--reps`` fills, with
+       ``vs_baseline`` against the pinned single-core numpy keygen
+       denominator (``keygen.lam*``).  Any device→host keygen fallback
+       during the timed fills fails the run non-zero (host rates must
+       not publish labeled "device").
+    3. **Registration latency** — median ``register_key(pool=...)``
+       latency with a warm pool (pool HIT: a pop) vs a deliberately
+       empty, never-refilled pool (the synchronous-mint fallback path)
+       at the same (lam, K=1) session shape.  The line records both
+       and ``pool_hit_speedup``; the run FAILS unless the pool hit is
+       >= 10x faster — the acceptance claim, falsifiable in one
+       command.
+    4. **Session churn** — ``serve.loadgen.session_churn`` drives the
+       started service + refill worker with fresh-key-per-session
+       traffic (register -> evaluate both parties -> unregister) for
+       ``--duration`` seconds; the line records sessions/s, the
+       under-churn registration quantiles and the pool hit rate.
+
+    Off TPU the device refills run the Pallas interpreter — disclosed
+    in-line; the committed one-command chip repro is the ``repro``
+    field.  ``--host-refill`` routes refills through the host pipeline
+    instead (an explicit host measurement, not a silent fallback).
+    """
+    import shutil
+    import tempfile
+
+    from dcf_tpu import Dcf
+    from dcf_tpu.gen import device_fallback_count
+    from dcf_tpu.serve import PoolSpec
+    from dcf_tpu.serve.loadgen import session_churn
+    from dcf_tpu.utils.benchtime import monotonic
+
+    nb = 16
+    lam = args.lam or 128
+    if lam < 16:
+        raise SystemExit(
+            f"keyfactory_bench wants lam >= 16, got --lam={lam}")
+    backend = args.backend
+    if backend == "cpu":
+        # The global argparse default; the bench measures provisioning
+        # through the host serve path — route to numpy unless the user
+        # chose a backend explicitly.
+        backend = "numpy"
+        log("keyfactory_bench measures provisioning; defaulting "
+            "--backend to numpy (the host serve path)")
+    if backend not in ("numpy", "bitsliced", "jax", "hybrid"):
+        raise SystemExit(
+            "keyfactory_bench serves through numpy/bitsliced/jax/"
+            f"hybrid, got {backend!r}")
+    if backend == "hybrid" and (lam < 48 or lam % 16):
+        raise SystemExit(
+            f"--backend=hybrid wants lam >= 48, a multiple of 16 "
+            f"(got {lam})")
+    refill_batch = args.keys or 64
+    use_device = not args.host_refill
+    rng = np.random.default_rng(args.seed)
+    ck = _cipher_keys(lam, rng)
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore")
+        dcf = Dcf(nb, lam, ck, backend=backend)
+    import jax
+
+    platform = jax.devices()[0].platform
+    interp = platform != "tpu"
+    store_dir = args.store_dir or tempfile.mkdtemp(prefix="dcf-kf-")
+    cleanup = not args.store_dir
+    try:
+        svc = dcf.serve(max_batch=256, store_dir=store_dir)
+        alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
+        betas = rng.integers(1, 256, (1, lam), dtype=np.uint8)
+
+        def pool(name, **kw):
+            base = dict(name=name, alphas=alphas, betas=betas,
+                        device=use_device)
+            return svc.add_pool(PoolSpec(**{**base, **kw}))
+
+        # -- phase 1: parity gates (before any timing) ------------------
+        pool("gate", target_depth=2, low_water=2, refill_batch=2)
+        svc.keyfactory.pump()
+
+        def gate(key_id, tag):
+            xs = rng.integers(0, 256, (8, nb), dtype=np.uint8)
+            xs[0] = alphas[0]  # exact boundary
+            f0 = svc.submit(key_id, xs, b=0)
+            f1 = svc.submit(key_id, xs, b=1)
+            svc.pump()
+            recon = f0.result() ^ f1.result()
+            a = alphas[0].tobytes()
+            for j in range(xs.shape[0]):
+                want = (betas[0].tobytes() if xs[j].tobytes() < a
+                        else bytes(lam))
+                if recon[0, j].tobytes() != want:
+                    raise SystemExit(
+                        f"keyfactory_bench gate: two-party "
+                        f"reconstruction mismatch on the {tag} path "
+                        f"(lam={lam}, point {j})")
+
+        snap0 = svc.metrics_snapshot()
+        svc.register_key("gate-hit", pool="gate")
+        gate("gate-hit", "pool-hit")
+        while svc.keyfactory.depth("gate"):
+            svc.register_key("gate-drain", pool="gate")
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            svc.register_key("gate-miss", pool="gate")  # exhausted
+        gate("gate-miss", "sync-fallback")
+        snap1 = svc.metrics_snapshot()
+        miss_delta = (snap1["keyfactory_pool_misses_total"]
+                      - snap0.get("keyfactory_pool_misses_total", 0))
+        if miss_delta != 1:
+            raise SystemExit(
+                "keyfactory_bench gate: the fallback leg recorded "
+                f"{miss_delta} pool misses (want exactly 1) — the "
+                "parity claim must name the path that served it")
+        log(f"gate: pool-hit AND sync-fallback keys reconstruct "
+            f"bit-exactly (lam={lam}, x=alpha included; fallback "
+            f"counted)")
+
+        # -- phase 2: sustained publish-to-servable ---------------------
+        pool("supply", target_depth=refill_batch,
+             low_water=refill_batch, refill_batch=refill_batch)
+        svc.keyfactory.pump()  # warm the compiled keygen shapes
+        fallbacks_mid = device_fallback_count()
+        fill_rates = []
+        for _ in range(max(args.reps, 1)):
+            while svc.keyfactory.depth("supply"):  # drain: all hits
+                svc.register_key("supply-drain", pool="supply")
+            # Flush the drained claims' reclaim flip OUTSIDE the timed
+            # region: the line claims the PUBLISH rate (mint + ONE
+            # manifest flip), and the spent reclaim is a separate flip
+            # that normally amortizes across worker sweeps.
+            svc.keyfactory.reclaim_spent()
+            t0 = monotonic()
+            svc.keyfactory.pump()  # mint + publish (one manifest flip)
+            dt = monotonic() - t0
+            fill_rates.append(refill_batch / dt)
+        keys_per_sec = float(np.median(fill_rates))
+        refill_fallbacks = device_fallback_count() - fallbacks_mid
+        log(f"publish-to-servable: {keys_per_sec:,.1f} keys/s sustained "
+            f"(K={refill_batch} per batch, {len(fill_rates)} fills, "
+            f"durable batched manifest flips)")
+
+        # -- phase 3: registration latency, hit vs sync -----------------
+        # low_water > 0 matters only once the refill worker runs (the
+        # churn phase); the latency legs below pump nothing, so the
+        # hit-leg pool depth stays exactly what this fill leaves.
+        hit_n = min(100, refill_batch * 2)
+        pool("sess", target_depth=max(hit_n, refill_batch),
+             low_water=max(refill_batch // 2, 1),
+             refill_batch=refill_batch)
+        while svc.keyfactory.depth("sess") < hit_n:
+            svc.keyfactory.pump()
+        hit_lat = []
+        for i in range(hit_n):
+            t0 = monotonic()
+            svc.register_key(f"lat-{i}", pool="sess")
+            hit_lat.append(monotonic() - t0)
+            svc.unregister_key(f"lat-{i}")
+        pool("never-filled", target_depth=1, low_water=0,
+             refill_batch=1)
+        sync_n = max(args.reps * 4, 12)
+        sync_lat = []
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            for i in range(sync_n):
+                t0 = monotonic()
+                svc.register_key(f"sync-{i}", pool="never-filled")
+                sync_lat.append(monotonic() - t0)
+                svc.unregister_key(f"sync-{i}")
+        hit_med = float(np.median(hit_lat))
+        sync_med = float(np.median(sync_lat))
+        speedup = sync_med / max(hit_med, 1e-9)
+        log(f"registration latency: pool hit {hit_med * 1e6:,.1f} us "
+            f"vs synchronous keygen {sync_med * 1e6:,.1f} us "
+            f"({speedup:,.1f}x) at the same (lam={lam}, K=1) shape")
+
+        # -- phase 4: session churn -------------------------------------
+        churn = None
+        if args.duration > 0:
+            with svc:  # worker + refill worker
+                churn = session_churn(
+                    svc, pool="sess", duration_s=float(args.duration),
+                    concurrency=args.concurrency,
+                    min_points=args.min_req_points or 8,
+                    max_points=args.max_req_points or 64,
+                    seed=args.seed)
+            log(f"churn: {churn.sessions_ok} sessions in "
+                f"{churn.duration_s:.1f}s "
+                f"({churn.sessions_per_sec:,.1f} sessions/s, "
+                f"{churn.sessions_failed} failed)")
+        snap = svc.metrics_snapshot()
+        hits = snap.get("keyfactory_pool_hits_total", 0)
+        misses = snap.get("keyfactory_pool_misses_total", 0)
+
+        extra = {
+            "lam": lam,
+            "n_bytes": nb,
+            "refill_batch": refill_batch,
+            "device_refill": use_device,
+            "device_fallbacks": refill_fallbacks,
+            "fills": len(fill_rates),
+            "pool_hit_register_s": round(hit_med, 9),
+            "sync_register_s": round(sync_med, 9),
+            "pool_hit_speedup": round(speedup, 1),
+            "pool_hits": hits,
+            "pool_misses": misses,
+            "pool_hit_rate": round(hits / max(hits + misses, 1), 4),
+            "store_writes": snap.get("serve_store_writes_total", 0),
+            "platform": platform,
+            "interpreted": interp and use_device,
+            "repro": (f"python -m dcf_tpu.cli keyfactory_bench "
+                      f"--lam {lam} --keys {refill_batch} "
+                      f"--seed {args.seed}"),
+            **_pinned_ratio(nb, refill_batch, keys_per_sec,
+                            interpreted=interp and use_device, lam=lam,
+                            keygen=True),
+        }
+        if churn is not None:
+            extra.update({
+                "churn_duration_s": round(churn.duration_s, 3),
+                "churn_concurrency": args.concurrency,
+                "churn_sessions_ok": churn.sessions_ok,
+                "churn_sessions_failed": churn.sessions_failed,
+                "churn_sessions_per_sec":
+                    round(churn.sessions_per_sec, 2),
+                **churn.register_quantiles(),
+                **churn.session_quantiles(),
+            })
+        unit = (f"keys/s publish-to-servable (K={refill_batch} "
+                f"{'device' if use_device else 'host'} batches, "
+                f"durable, N={nb}B domain)")
+        if interp and use_device:
+            unit += (" [no TPU this session: Pallas interpret mode, "
+                     "disclosed; see repro]")
+        _emit("keyfactory_bench", backend, "keys_per_sec", keys_per_sec,
+              unit, extra_fields=extra)
+
+        # Emitted-then-asserted (the serve_bench --skew discipline): the
+        # JSONL line survives a failure, the exit code makes the claims
+        # falsifiable in CI / on chip.
+        failures = []
+        if speedup < 10:
+            failures.append(
+                f"pool-hit registration is only {speedup:.1f}x faster "
+                "than the synchronous path (acceptance wants >= 10x)")
+        if use_device and refill_fallbacks:
+            failures.append(
+                f"{refill_fallbacks} device-keygen call(s) in the "
+                "timed fills fell back to the host walk — the emitted "
+                "keys/s is NOT a device rate; fix the device path or "
+                "pass --host-refill")
+        if churn is not None and churn.sessions_ok == 0:
+            failures.append("session churn completed zero sessions")
+        if failures:
+            raise SystemExit("keyfactory_bench: " + "; ".join(failures))
+    finally:
+        if cleanup:
+            shutil.rmtree(store_dir, ignore_errors=True)
+
+
 def _parse_skew(value, flag: str = "--skew") -> float:
     """Zipf-exponent validation shared by serve_bench / mic_bench /
     chaos_bench (the ``_parse_priority_mix`` discipline: reject a bad
@@ -1637,6 +1916,48 @@ def _chaos_flags(args) -> tuple:
     return max_batch, min_req, max_req, window
 
 
+def _chaos_keyfactory_kill(svc, rng, nb, lam) -> tuple:
+    """chaos_bench --crash-restart --keyfactory, the pre-kill half
+    (ISSUE 11): declare a pool, refill it durably (batched atomic
+    manifest flips), claim two sessions, then KILL the next refill
+    between its frame writes and the manifest flip (armed
+    ``store.manifest`` seam — the exact crash window batched publish
+    must survive).  Returns ``(spec, pre_pool, claimed_ids)`` for the
+    post-restart assertions."""
+    import warnings
+
+    from dcf_tpu.serve import PoolSpec
+    from dcf_tpu.testing import faults
+
+    alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
+    betas = rng.integers(1, 256, (1, lam), dtype=np.uint8)
+    spec = svc.add_pool(PoolSpec(
+        name="chaos-pool", alphas=alphas, betas=betas,
+        target_depth=6, low_water=6, refill_batch=3))
+    svc.keyfactory.pump()
+    pre_pool = svc.keyfactory.pool_manifest("chaos-pool")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        svc.register_key("pool-sess-0", pool="chaos-pool")
+        svc.register_key("pool-sess-1", pool="chaos-pool")
+    claimed = set(pre_pool) - set(
+        svc.keyfactory.pool_manifest("chaos-pool"))
+    try:
+        with faults.inject("store.manifest"):
+            svc.keyfactory.pump()  # the refill batch writes its
+            # frames and dies before the flip (contained, counted);
+            # the spent-frame reclaim flip dies too and re-queues
+        raise SystemExit(
+            "chaos_bench --keyfactory: the armed store.manifest fault "
+            "never fired — the kill scenario did not run")
+    except faults.InjectedFault:
+        pass
+    # The reclaim of the two claimed frames retries on a healthy store
+    # (the scenario's kill is the refill window, not the reclaim).
+    svc.keyfactory.close()
+    return spec, pre_pool, claimed
+
+
 def _chaos_crash_restart(args) -> None:
     """``chaos_bench --crash-restart`` (ISSUE 8): the durable-store
     process-lifecycle scenario.  A service with a key store registers
@@ -1711,11 +2032,19 @@ def _chaos_crash_restart(args) -> None:
                 k, rng.integers(0, 256, (min_req, nb), dtype=np.uint8))
                 for k in sorted(bundles)]
             svc.close(drain=False)
+        pool_state = None
+        if args.keyfactory:
+            # ISSUE 11: the key-factory half — batched durable refills
+            # + a kill between the frame writes and the manifest flip.
+            pool_state = _chaos_keyfactory_kill(svc, rng, nb, lam)
         del svc  # abandoned, as a killed process would be
 
         # Warm restart: fresh facade state, same store directory.
         svc2 = dcf.serve(max_batch=max_batch, retries=1,
                          store_dir=store_dir)
+        if pool_state is not None:
+            svc2.add_pool(pool_state[0])  # declared before restore, so
+            # restored ~pool/ frames adopt straight into the pool
         report = svc2.restore_keys()
         failures = []
         regen = sorted(set(bundles) - set(report.restored))
@@ -1733,6 +2062,49 @@ def _chaos_crash_restart(args) -> None:
             failures.append(
                 f"generations drifted across restart: {gens_pre} -> "
                 f"{gens_post}")
+        pool_extra = {}
+        if pool_state is not None:
+            spec, pre_pool, claimed = pool_state
+            post_pool = svc2.keyfactory.pool_manifest(spec.name)
+            want_pool = {k: g for k, g in pre_pool.items()
+                         if k not in claimed}
+            if post_pool != want_pool:
+                failures.append(
+                    f"pool supply drifted across restart: "
+                    f"{sorted(want_pool)} -> {sorted(post_pool)} "
+                    "(torn entries, lost generations, or resurrected "
+                    "claims)")
+            minted_post = svc2.metrics_snapshot().get(
+                "keyfactory_minted_keys_total", 0)
+            if minted_post:
+                failures.append(
+                    f"restore minted {minted_post} pool keys — "
+                    "already-published supply must restore with ZERO "
+                    "re-keygen")
+            if not failures:
+                # A restored pool entry must still serve bit-exactly.
+                import warnings as _w
+
+                with _w.catch_warnings():
+                    _w.simplefilter("ignore")
+                    kb_pool = svc2.register_key("post-pool-sess",
+                                                pool=spec.name)
+                xs_p = rng.integers(0, 256, (32, nb), dtype=np.uint8)
+                f0 = svc2.submit("post-pool-sess", xs_p, b=0)
+                f1 = svc2.submit("post-pool-sess", xs_p, b=1)
+                svc2.pump()
+                want = (native.eval(0, kb_pool, xs_p)
+                        ^ native.eval(1, kb_pool, xs_p))
+                if not np.array_equal(f0.result(30) ^ f1.result(30),
+                                      want):
+                    failures.append(
+                        "restored pool key served a wrong two-party "
+                        "reconstruction vs the C++ core")
+            pool_extra = {
+                "pool_published": len(pre_pool),
+                "pool_claimed_pre_kill": len(claimed),
+                "pool_restored": len(post_pool),
+            }
         if not failures:
             _serve_parity_gate(svc2, native, bundles, rng, nb,
                                points=64, bench="chaos_bench",
@@ -1742,6 +2114,7 @@ def _chaos_crash_restart(args) -> None:
         snap = svc2.metrics_snapshot()
         extra = {
             "scenario": "crash-restart",
+            **pool_extra,
             "duration_s": round(res.duration_s, 3),
             "concurrency": args.concurrency,
             "max_batch": max_batch,
@@ -1809,6 +2182,10 @@ def bench_chaos(args) -> None:
     if args.crash_restart:
         _chaos_crash_restart(args)
         return
+    if args.keyfactory:
+        raise SystemExit(
+            "--keyfactory extends the durable-store scenario; pass it "
+            "with --crash-restart")
     lam, nb = 16, 16
     max_batch, min_req, max_req, window = _chaos_flags(args)
     mix = _parse_priority_mix(args.priority_mix)  # bad flags fail fast,
@@ -1995,6 +2372,7 @@ BENCHES = {
     "mic_bench": bench_mic,
     "chaos_bench": bench_chaos,
     "keygen_bench": bench_keygen,
+    "keyfactory_bench": bench_keyfactory,
 }
 
 
@@ -2040,7 +2418,9 @@ def main(argv=None) -> None:
     p.add_argument("--keys", type=int, default=0,
                    help="key count for secure_relu / dcf_large_lambda "
                         "(0 = bench default); keygen_bench: replace "
-                        "the K sweep with this single K")
+                        "the K sweep with this single K; "
+                        "keyfactory_bench: the per-refill session "
+                        "batch (0 = 64, the pinned keygen-baseline K)")
     p.add_argument("--reps", type=int, default=3)
     p.add_argument("--seed", type=int, default=2026)
     p.add_argument("--check", action="store_true",
@@ -2057,7 +2437,8 @@ def main(argv=None) -> None:
     p.add_argument("--lam", type=int, default=0,
                    help="range bytes for dcf_large_lambda (0 = 16384; "
                         "256 = BASELINE config 4) / keygen_bench "
-                        "(0 = both 128 and 256)")
+                        "(0 = both 128 and 256) / keyfactory_bench "
+                        "(0 = 128)")
     p.add_argument("--prefix-levels", type=int, default=0,
                    help="dcf_large_lambda --backend=hybrid: expand the "
                         "top k narrow-walk levels once per (key, party) "
@@ -2117,9 +2498,21 @@ def main(argv=None) -> None:
                         "warm restart, bit-exact post-restart parity "
                         "vs the C++ core with zero re-keygen")
     p.add_argument("--store-dir", default="",
-                   help="chaos_bench --crash-restart: durable key "
-                        "store directory (default: a fresh temp dir, "
-                        "removed afterwards; an explicit dir is kept)")
+                   help="chaos_bench --crash-restart / "
+                        "keyfactory_bench: durable key store directory "
+                        "(default: a fresh temp dir, removed "
+                        "afterwards; an explicit dir is kept)")
+    p.add_argument("--host-refill", action="store_true",
+                   help="keyfactory_bench: refill pools through the "
+                        "host keygen pipeline instead of the on-device "
+                        "walk (an explicit host measurement)")
+    p.add_argument("--keyfactory", action="store_true",
+                   help="chaos_bench --crash-restart: also run the "
+                        "key-factory pool scenario — batched durable "
+                        "refills, a kill between the frame writes and "
+                        "the manifest flip, warm restart with the "
+                        "un-claimed pool supply restored (zero torn "
+                        "entries, zero re-keygen, generations held)")
     p.add_argument("--full", action="store_true",
                    help="baseline: run config 5 at the literal 10^6-key "
                         "scale (~20 min report)")
@@ -2129,11 +2522,12 @@ def main(argv=None) -> None:
         raise SystemExit(
             "--backend=tree is the full-domain tree evaluator; it only "
             "applies to the full_domain bench (and baseline)")
-    if args.backend == "hybrid" and args.bench not in ("dcf_large_lambda",
-                                                       "baseline"):
+    if args.backend == "hybrid" and args.bench not in (
+            "dcf_large_lambda", "keyfactory_bench", "baseline"):
         raise SystemExit(
             "--backend=hybrid is the large-lambda evaluator; it only "
-            "applies to the dcf_large_lambda bench (and baseline)")
+            "applies to the dcf_large_lambda and keyfactory_bench "
+            "benches (and baseline)")
     if args.prefix_levels and args.backend not in ("hybrid", "prefix"):
         raise SystemExit(
             "--prefix-levels configures the prefix-shared narrow walk; "
@@ -2148,8 +2542,9 @@ def main(argv=None) -> None:
             log(f"skipping {name} (a timed load test, not a "
                 "criterion analog; run it explicitly)")
             continue
-        if args.bench == "all" and name == "keygen_bench":
-            log("skipping keygen_bench (device-keygen sweep with its "
+        if args.bench == "all" and name in ("keygen_bench",
+                                            "keyfactory_bench"):
+            log(f"skipping {name} (device-keygen harness with its "
                 "own backend routing; run it explicitly)")
             continue
         if args.bench == "all" and name == "dcf_large_lambda" and \

@@ -51,8 +51,8 @@ from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.prg import HirosePrgNp
 from dcf_tpu.spec import Bound
 
-__all__ = ["gen_batch", "gen_on_device", "random_s0s",
-           "device_fallback_count"]
+__all__ = ["gen_batch", "gen_on_device", "gen_on_device_with_planes",
+           "random_s0s", "device_fallback_count"]
 
 
 def random_s0s(num_keys: int, lam: int, rng: np.random.Generator) -> np.ndarray:
@@ -217,6 +217,43 @@ def gen_on_device(
     silent-correct, counted (``device_fallback_count``), warned once per
     call via ``BackendFallbackWarning``.
     """
+    return _gen_on_device(lam, cipher_keys, alphas, betas, s0s, bound,
+                          interpret=interpret, tile_words=tile_words,
+                          want_planes=False)[0]
+
+
+def gen_on_device_with_planes(
+    lam: int,
+    cipher_keys,
+    alphas: np.ndarray,
+    betas: np.ndarray,
+    s0s: np.ndarray,
+    bound: Bound,
+    *,
+    interpret: bool | None = None,
+    tile_words: int = 128,
+) -> tuple[KeyBundle, dict | None]:
+    """``gen_on_device`` plus the staged narrow image: returns
+    ``(bundle, planes)`` where ``planes`` is ``{party: staged plane
+    dict}`` for BOTH parties from the SAME kernel walk
+    (``ops.pallas_keygen.PallasKeyGen.gen_with_planes_pair``) — the
+    key factory hands the pair to the serving registry so a claimed
+    key's image stages with zero host round-trip (ISSUE 11).
+
+    ``planes`` is ``None`` whenever the staged layout does not apply:
+    the keys-in-lanes route (lam < 48 has no hybrid staged layout) and
+    ANY fallback to the host walk (which is counted and warned exactly
+    like ``gen_on_device``'s).  Callers must treat a ``None`` as "stage
+    from the host bundle" — the bundle itself is byte-identical either
+    way."""
+    return _gen_on_device(lam, cipher_keys, alphas, betas, s0s, bound,
+                          interpret=interpret, tile_words=tile_words,
+                          want_planes=True)
+
+
+def _gen_on_device(lam, cipher_keys, alphas, betas, s0s, bound, *,
+                   interpret, tile_words, want_planes
+                   ) -> tuple[KeyBundle, dict | None]:
     _check_gen_inputs(alphas, betas, s0s, lam)
     global _DEVICE_FALLBACKS
     try:
@@ -229,8 +266,11 @@ def gen_on_device(
             interpret = jax.devices()[0].platform != "tpu"
         kg = _device_gen_for(lam, cipher_keys, bool(interpret), tile_words)
         if hasattr(kg, "to_host_bundle"):  # keys-in-lanes generator
-            return kg.to_host_bundle(kg.gen(alphas, betas, s0s, bound))
-        return kg.gen(alphas, betas, s0s, bound)
+            return kg.to_host_bundle(
+                kg.gen(alphas, betas, s0s, bound)), None
+        if want_planes:
+            return kg.gen_with_planes_pair(alphas, betas, s0s, bound)
+        return kg.gen(alphas, betas, s0s, bound), None
     except Exception as e:  # fallback-ok: keygen must never fail for a
         # device-side reason — the host walk is always correct, and the
         # caller asked for keys, not for a particular pipeline.  The
@@ -255,10 +295,12 @@ def gen_on_device(
                 "device-keygen",
                 "native gen_batch" if native is not None else "gen_batch",
                 e),
-            stacklevel=2)
+            stacklevel=3)  # through the gen_on_device[_with_planes]
+        # wrapper: the warning must attribute to the CALLER's line, or
+        # per-location dedup collapses distinct call sites
         if native is not None:
-            return native.gen_batch(alphas, betas, s0s, bound)
+            return native.gen_batch(alphas, betas, s0s, bound), None
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")
             prg = HirosePrgNp(lam, cipher_keys)
-        return gen_batch(prg, alphas, betas, s0s, bound)
+        return gen_batch(prg, alphas, betas, s0s, bound), None

@@ -367,6 +367,24 @@ class PallasKeyGen:
         return (self._assemble_bundle(out, padded, s0s, bound, k),
                 self._assemble_planes(out, padded[2], k, b))
 
+    def gen_with_planes_pair(self, alphas: np.ndarray, betas: np.ndarray,
+                             s0s: np.ndarray, bound: Bound):
+        """ONE walk, three outputs: ``(host KeyBundle, {0: planes,
+        1: planes})`` — BOTH parties' staged plane dicts from a single
+        kernel walk (ISSUE 11, the key-factory registration flow: the
+        serving registry stages either party's image with zero host
+        round-trip).  The correction-word planes are party-independent,
+        so the two dicts share every array except the per-party seed
+        planes — no duplicated device state, no second walk, and no
+        key-material memo (same rule as ``gen_with_planes``)."""
+        k = self._check(alphas, betas, s0s)
+        out, padded = self._walk(alphas, betas, s0s, bound)
+        s0s_p = padded[2]
+        shared = self._shared_planes(out, k)
+        planes = {b: dict(shared, **self._party_seed_planes(s0s_p, k, b))
+                  for b in (0, 1)}
+        return self._assemble_bundle(out, padded, s0s, bound, k), planes
+
     def _assemble_bundle(self, out, padded, s0s, bound: Bound,
                          k: int) -> KeyBundle:
         cs0, cs1, cv0, cv1, tl, tr, np10, np11, tr_a, tr_b = out
@@ -422,15 +440,27 @@ class PallasKeyGen:
             alphas, betas, s0s, bound)
         return self._assemble_planes(out, s0s_p, k, b)
 
-    def _assemble_planes(self, out, s0s_p, k: int, b: int) -> dict:
+    def _shared_planes(self, out, k: int) -> dict:
+        """The party-INDEPENDENT half of the staged plane dict (the
+        correction-word image is one image for both parties) — the one
+        construction every planes producer shares, so the staged
+        layout cannot silently fork between the single-party and
+        pair paths."""
         cs0, cs1, cv0, cv1, tl, tr, np10, np11, _tr_a, _tr_b = out
         km = partial(_lanes_to_key_masks, k_num=k)
         # km on the [n, 1, W] t planes gives [K, n, 1, 1] masks each
-        cw_t = jnp.concatenate(
-            [km(tl), km(tr)], axis=2)[..., 0]  # [K, n, 2] int32 0/-1
+        return dict(
+            cs0=km(cs0), cs1=km(cs1), cv0=km(cv0), cv1=km(cv1),
+            np1a=km(np10), np1b=km(np11),
+            cw_t=jnp.concatenate(
+                [km(tl), km(tr)], axis=2)[..., 0])  # [K, n, 2] 0/-1
+
+    def _party_seed_planes(self, s0s_p, k: int, b: int) -> dict:
+        km = partial(_lanes_to_key_masks, k_num=k)
         return dict(
             s0a=km(self._block_planes(s0s_p[:, b, :16])),
-            s0b=km(self._block_planes(s0s_p[:, b, 16:32])),
-            cs0=km(cs0), cs1=km(cs1), cv0=km(cv0), cv1=km(cv1),
-            np1a=km(np10), np1b=km(np11), cw_t=cw_t,
-        )
+            s0b=km(self._block_planes(s0s_p[:, b, 16:32])))
+
+    def _assemble_planes(self, out, s0s_p, k: int, b: int) -> dict:
+        return dict(self._shared_planes(out, k),
+                    **self._party_seed_planes(s0s_p, k, b))

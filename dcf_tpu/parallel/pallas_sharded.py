@@ -329,6 +329,10 @@ class ShardedLargeLambdaBackend(LargeLambdaBackend):
         super().__init__(lam, cipher_keys, col_chunk=col_chunk,
                          narrow="pallas", interpret=interpret,
                          prefix_levels=prefix_levels)
+        # A single-device planes dict has no shard placement: the serve
+        # registry must stage this backend from the host bundle (the
+        # put_bundle override below also rejects dev_planes typed).
+        self.accepts_dev_planes = False
         self.mesh = mesh
         kaxis, paxis = mesh.axis_names
         self._ksize = mesh.shape[kaxis]

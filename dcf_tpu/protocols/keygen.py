@@ -69,6 +69,7 @@ __all__ = [
     "ProtocolBundle",
     "gen_interval_bundle",
     "interval_bound_alphas",
+    "interval_session_material",
 ]
 
 #: proto header values.  0 is reserved for "plain DCF" (decoded by
@@ -300,8 +301,26 @@ def gen_interval_bundle(
         raise ShapeError("need at least one interval")
     if betas.ndim != 2 or betas.shape[0] != m:
         raise ShapeError(f"betas must be [{m}, lam], got {betas.shape}")
-    alphas, pub = interval_bound_alphas(intervals, n_bytes, bound)
-    keys = gen_fn(alphas, np.repeat(betas, 2, axis=0), bound)
-    masks = np.zeros((2, m, betas.shape[1]), dtype=np.uint8)
-    masks[0] = betas * pub[:, None]  # party-0 public correction
+    alphas, key_betas, masks = interval_session_material(
+        intervals, betas, n_bytes, bound)
+    keys = gen_fn(alphas, key_betas, bound)
     return ProtocolBundle(keys=keys, combine_masks=masks, bound=bound)
+
+
+def interval_session_material(
+    intervals: Sequence[tuple[int, int]],
+    betas: np.ndarray,
+    n_bytes: int,
+    bound: Bound = Bound.LT_BETA,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """The ONE per-session MIC key-material derivation: intervals ->
+    ``(alphas uint8 [2m, n_bytes], key_betas uint8 [2m, lam],
+    combine_masks uint8 [2, m, lam])``.  Shared by
+    ``gen_interval_bundle`` (host/device single-session gen) and the
+    key factory's batched refill (ISSUE 11, which tiles the triple
+    across a refill batch) — the combine convention must not be able
+    to fork between a pooled MIC key and the sync-mint fallback."""
+    alphas, pub = interval_bound_alphas(intervals, n_bytes, bound)
+    masks = np.zeros((2,) + betas.shape, dtype=np.uint8)
+    masks[0] = betas * pub[:, None]  # party-0 public correction
+    return alphas, np.repeat(betas, 2, axis=0), masks

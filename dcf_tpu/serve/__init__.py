@@ -26,6 +26,12 @@ keys":
   typed quarantine of damaged frames (``KeyQuarantinedError``) and the
   warm-restart path ``KeyRegistry.restore`` /
   ``DcfService.restore_keys`` preserving generations;
+- ``serve.keyfactory`` ahead-of-demand keygen pools (ISSUE 11):
+  per-(function, priority) pools of pre-minted two-party session
+  bundles topped up on device in K-packed batches, published to the
+  store in batched atomic manifest flips, claimed by
+  ``register_key(key_id, pool=...)`` at pool-pop latency with a
+  counted, warned synchronous-mint fallback on exhaustion;
 - ``serve.metrics``   dependency-free counters/gauges/histograms with a
   deterministic snapshot (embedded in RESULTS_serve JSONL lines);
 - ``serve.service``   ``DcfService``: the worker loop tying it together,
@@ -40,11 +46,12 @@ Entry point: ``Dcf.serve(...)`` (see ``dcf_tpu.api``).
 from dcf_tpu.serve.admission import Priority, ServeFuture  # noqa: F401
 from dcf_tpu.serve.breaker import BreakerBoard  # noqa: F401
 from dcf_tpu.serve.frontier_cache import FrontierCache  # noqa: F401
+from dcf_tpu.serve.keyfactory import KeyFactory, PoolSpec  # noqa: F401
 from dcf_tpu.serve.metrics import Metrics  # noqa: F401
 from dcf_tpu.serve.registry import KeyRegistry  # noqa: F401
 from dcf_tpu.serve.service import DcfService, ServeConfig  # noqa: F401
 from dcf_tpu.serve.store import KeyStore, RestoreReport  # noqa: F401
 
 __all__ = ["DcfService", "ServeConfig", "ServeFuture", "Priority",
-           "BreakerBoard", "FrontierCache", "Metrics", "KeyRegistry",
-           "KeyStore", "RestoreReport"]
+           "BreakerBoard", "FrontierCache", "KeyFactory", "Metrics",
+           "KeyRegistry", "KeyStore", "PoolSpec", "RestoreReport"]

@@ -17,6 +17,12 @@ Timing uses the SAME injectable clock as the service, so the module
 stays clean under the dcflint determinism pass; it is the one
 measurement harness allowed to loop on the clock, and the loop bound is
 wall duration by design.
+
+``session_churn`` (ISSUE 11) is the fresh-key-per-session variant:
+each client registers a fresh key from a key-factory pool, evaluates
+one request for both parties, and unregisters — the provisioning-bound
+arrival pattern ``keyfactory_bench`` measures, as opposed to
+``closed_loop``'s eval-bound re-use of a static key set.
 """
 
 from __future__ import annotations
@@ -29,7 +35,8 @@ import numpy as np
 from dcf_tpu.serve.admission import parse_priority
 from dcf_tpu.utils.benchtime import monotonic
 
-__all__ = ["LoadgenResult", "closed_loop"]
+__all__ = ["LoadgenResult", "closed_loop", "ChurnResult",
+           "session_churn"]
 
 
 @dataclass
@@ -60,15 +67,7 @@ class LoadgenResult:
         return self.points_ok / self.duration_s if self.duration_s else 0.0
 
     def latency_quantiles(self) -> dict:
-        if not self.latencies_s:
-            return {}
-        arr = np.sort(np.asarray(self.latencies_s))
-
-        def q(p):
-            return float(arr[min(int(p * len(arr)), len(arr) - 1)])
-
-        return {"p50_s": round(q(0.50), 6), "p90_s": round(q(0.90), 6),
-                "p99_s": round(q(0.99), 6)}
+        return _quantiles(self.latencies_s, "")
 
 
 def _client(service, key_ids, stop: threading.Event, res: LoadgenResult,
@@ -110,6 +109,129 @@ def _client(service, key_ids, stop: threading.Event, res: LoadgenResult,
             res.points_ok += m
             res.latencies_s.append(dt)
             res._count(pr, "ok")
+
+
+@dataclass
+class ChurnResult:
+    """One session-churn run (ISSUE 11): per-session outcomes plus the
+    two latency populations the key factory exists to separate —
+    registration (pool pop vs synchronous keygen) and evaluation."""
+
+    duration_s: float
+    sessions_ok: int = 0
+    sessions_failed: int = 0
+    points_ok: int = 0
+    register_latencies_s: list = field(default_factory=list)
+    session_latencies_s: list = field(default_factory=list)
+
+    @property
+    def sessions_per_sec(self) -> float:
+        return (self.sessions_ok / self.duration_s if self.duration_s
+                else 0.0)
+
+    def register_quantiles(self) -> dict:
+        return _quantiles(self.register_latencies_s, "register_")
+
+    def session_quantiles(self) -> dict:
+        return _quantiles(self.session_latencies_s, "session_")
+
+
+def _quantiles(values, prefix: str) -> dict:
+    """The ONE p50/p90/p99 extraction both result types report
+    (``prefix`` e.g. ``"register_"``; empty = the plain ``p50_s``
+    keys ``LoadgenResult`` has always emitted)."""
+    if not values:
+        return {}
+    arr = np.sort(np.asarray(values))
+
+    def q(p):
+        return float(arr[min(int(p * len(arr)), len(arr) - 1)])
+
+    return {f"{prefix}p50_s": round(q(0.50), 6),
+            f"{prefix}p90_s": round(q(0.90), 6),
+            f"{prefix}p99_s": round(q(0.99), 6)}
+
+
+def _session_client(service, pool: str, stop: threading.Event,
+                    res: ChurnResult, lock: threading.Lock,
+                    rng: np.random.Generator, min_points: int,
+                    max_points: int, clock, tid: int,
+                    durable: bool) -> None:
+    nb = service._dcf.n_bytes
+    n = 0
+    while not stop.is_set():
+        key_id = f"~sess/{tid}/{n}"
+        n += 1
+        m = int(rng.integers(min_points, max_points + 1))
+        xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
+        t0 = clock()
+        try:
+            service.register_key(key_id, pool=pool, durable=durable)
+            t_reg = clock()
+            f0 = service.submit(key_id, xs, b=0)
+            f1 = service.submit(key_id, xs, b=1)
+            f0.result()
+            f1.result()
+        except Exception:  # fallback-ok: a churn client must survive
+            # ANY delivered failure (sheds, injected refill/eval faults,
+            # retries-exhausted errors) — a dead client thread silently
+            # halves the offered session arrival
+            with lock:
+                res.sessions_failed += 1
+            try:
+                service.unregister_key(key_id)
+            except Exception:  # fallback-ok: best-effort cleanup of a
+                # session that may never have registered
+                pass
+            continue
+        service.unregister_key(key_id)
+        dt = clock() - t0
+        with lock:
+            res.sessions_ok += 1
+            res.points_ok += 2 * m
+            res.register_latencies_s.append(max(t_reg - t0, 0.0))
+            res.session_latencies_s.append(dt)
+
+
+def session_churn(service, *, pool: str, duration_s: float,
+                  concurrency: int, min_points: int, max_points: int,
+                  seed: int = 2026, clock=monotonic,
+                  durable: bool = False) -> ChurnResult:
+    """Fresh-key-per-session churn (ISSUE 11): each closed-loop client
+    repeatedly REGISTERS a fresh session key from the key-factory
+    ``pool`` (``register_key(key_id, pool=...)``), evaluates one
+    ragged request for BOTH parties, and unregisters — the arrival
+    pattern that provisions keys instead of re-using a static set, so
+    ``keyfactory_bench``/``serve_bench`` can drive the pool the way
+    session traffic does.  The service must be started.  Same seeding
+    and clock discipline as ``closed_loop``."""
+    if min_points < 1 or min_points > max_points:
+        # api-edge: loadgen config contract at the harness edge
+        raise ValueError(
+            f"bad request-size range [{min_points}, {max_points}]")
+    res = ChurnResult(duration_s=0.0)
+    lock = threading.Lock()
+    stop = threading.Event()
+    threads = [
+        threading.Thread(
+            target=_session_client,
+            args=(service, pool, stop, res, lock,
+                  np.random.default_rng(seed + 13 * i), min_points,
+                  max_points, clock, i, durable),
+            name=f"churn-{i}", daemon=True)
+        for i in range(concurrency)
+    ]
+    t0 = clock()
+    for t in threads:
+        t.start()
+    # The generator loops on the clock by design: duration IS the bound.
+    while clock() - t0 < duration_s:
+        stop.wait(0.05)
+    stop.set()
+    for t in threads:
+        t.join()
+    res.duration_s = clock() - t0
+    return res
 
 
 def closed_loop(service, key_ids, *, duration_s: float, concurrency: int,

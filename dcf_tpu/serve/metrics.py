@@ -38,6 +38,23 @@ re-registered with their generations preserved), and the
 ``serve_batch_timeouts_total`` (batches failed typed with
 ``BatchTimeoutError`` for overrunning ``batch_timeout_s``).
 
+Key-factory series (ISSUE 11, recorded by
+``serve.keyfactory.KeyFactory``): ``keyfactory_pool_depth{pool=...}``
+(per-pool gauge), ``keyfactory_pool_hits_total`` /
+``keyfactory_pool_misses_total`` (claims: a miss is the counted
+synchronous-mint fallback), ``keyfactory_minted_keys_total`` (DCF
+keys minted, K-packed), ``keyfactory_published_total`` (pool frames
+made durable — one manifest flip per refill batch),
+``keyfactory_refills_total`` / ``keyfactory_refill_failures_total``,
+``keyfactory_restored_total`` (entries re-pooled at warm restart),
+``keyfactory_spent_reclaimed_total`` (claimed frames dropped by the
+batched reclaim — durable claims reclaim atomically inside the
+session frame's own publish flip instead) and
+``keyfactory_worker_errors_total`` (refill-worker sweep failures that
+escaped per-pool containment, e.g. a dying store's reclaim flip —
+counted, never silently swallowed).  Pool-hit rate =
+hits / (hits + misses); ``keyfactory_bench`` reports it per run.
+
 Secret hygiene: metric NAMES are static strings and metric values are
 scalars; key ids chosen by callers become label values via ``labeled``
 and must never be derived from key material (the dcflint secret-hygiene

@@ -89,19 +89,34 @@ class _Entry:
     registered as, or None for a plain DCF key.  The DEVICE image is
     always the inner ``KeyBundle`` (the residency machinery is
     protocol-agnostic); the protocol record tells the service to apply
-    the per-interval share combine when it fetches a batch."""
+    the per-interval share combine when it fetches a batch.
 
-    __slots__ = ("bundle", "generation", "residents", "protocol")
+    ``planes`` (ISSUE 11): ``{party: staged plane dict}`` from the
+    on-device keygen (``gen.gen_on_device_with_planes``), or None.
+    When present and the backend advertises ``accepts_dev_planes``,
+    ``resident`` stages through ``put_bundle(kb, dev_planes=...)`` —
+    the narrow image never round-trips through the host bit-plane
+    expansion, which is the key factory's zero-copy registration flow.
+    Budget (LRU) evictions keep the planes (a re-stage reuses them —
+    that is the amortization); the entry-invalidation hook drops them
+    (hot-swap/unregister supersede the key, and a failure eviction
+    must not re-feed state from the path that just died)."""
 
-    def __init__(self, bundle: KeyBundle, generation: int, protocol=None):
+    __slots__ = ("bundle", "generation", "residents", "protocol",
+                 "planes")
+
+    def __init__(self, bundle: KeyBundle, generation: int, protocol=None,
+                 planes: dict | None = None):
         self.bundle = bundle
         self.generation = generation
         self.protocol = protocol
+        self.planes = planes
         self.residents: dict = {}  # slot (party int | "kl") -> _Resident
 
     def __repr__(self) -> str:  # never the bundle's bytes — shapes only
         return (f"_Entry(gen={self.generation}, "
                 f"proto={self.protocol is not None}, "
+                f"planes={self.planes is not None}, "
                 f"resident_slots={sorted(map(str, self.residents))})")
 
 
@@ -176,7 +191,7 @@ class KeyRegistry:
     # -- registration -------------------------------------------------------
 
     def register(self, key_id: str, bundle: KeyBundle,
-                 protocol=None) -> int:
+                 protocol=None, dev_planes: dict | None = None) -> int:
         """Register (or replace) the bundle served under ``key_id``;
         returns the entry's generation (the durable write-through path
         publishes the frame under it).
@@ -189,6 +204,8 @@ class KeyRegistry:
         when ``bundle`` is a protocol key's inner bundle — recorded so
         the service applies the share combine at fetch time
         (``DcfService.register_key`` unwraps and passes both).
+        ``dev_planes`` (ISSUE 11): both parties' staged plane dicts
+        from the on-device keygen — see ``_Entry.planes``.
         """
         if bundle.s0s.shape[1] != 2:
             raise ShapeError(
@@ -205,9 +222,25 @@ class KeyRegistry:
             if prev is not None:
                 self._evict_entry(key_id, prev)
             self._entries[key_id] = _Entry(bundle, self._generation,
-                                           protocol)
+                                           protocol, dev_planes)
             self._g_registered.set(len(self._entries))
             return self._generation
+
+    def mint_generations(self, count: int) -> range:
+        """Reserve ``count`` fresh generations from the shared counter
+        (ISSUE 11: the key factory publishes pool frames under real
+        registry generations, so pool entries live in the same total
+        order as registrations — ``sync_generation_floor`` at the next
+        restart then floors past them like any other, and no later
+        hot-swap can mint a generation a pooled durable frame already
+        carries)."""
+        if count < 1:
+            # api-edge: reservation contract (programmer error)
+            raise ValueError(f"count must be >= 1, got {count}")
+        with self._lock:
+            lo = self._generation + 1
+            self._generation += count
+            return range(lo, self._generation + 1)
 
     def unregister(self, key_id: str) -> None:
         with self._lock:
@@ -341,7 +374,20 @@ class KeyRegistry:
                 return None
             kb = (entry.bundle if self._shared_image
                   else entry.bundle.for_party(b))
-            be.put_bundle(kb)
+            planes = (entry.planes.get(int(b))
+                      if entry.planes is not None
+                      and not self._shared_image else None)
+            if planes is not None \
+                    and getattr(be, "accepts_dev_planes", False):
+                # ISSUE 11: the on-device keygen already staged this
+                # party's narrow image — hand it over instead of
+                # re-expanding host bit planes.  Guarded by the
+                # backend's capability flag: after an auto-facade
+                # demotion the fresh instance may be a different
+                # family, which stages from the host bundle as usual.
+                be.put_bundle(kb, dev_planes=planes)
+            else:
+                be.put_bundle(kb)
             self._c_stagings.inc()
             res = _Resident(be, device_image_bytes(be), self._ticks.next(),
                             entry.generation)
@@ -471,6 +517,12 @@ class KeyRegistry:
             if hasattr(res.be, "invalidate_frontier"):
                 res.be.invalidate_frontier()
         entry.residents.clear()
+        # Keygen-staged planes die with the entry: a hot-swap/unregister
+        # superseded the key they image, and a failure eviction must not
+        # re-stage from the device state that just failed (the re-stage
+        # then runs the host path — slower, known-good).  Budget (LRU)
+        # evictions do NOT route here and deliberately keep them.
+        entry.planes = None
         if n:
             self._c_evictions.inc(n)
         if self._frontier_cache is not None:

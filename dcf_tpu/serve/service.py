@@ -74,6 +74,13 @@ Load-bearing knobs (``ServeConfig``):
   backend that wedges instead of crashing still demotes and still
   stops stalling the worker while the queue sheds behind it.  0 (the
   default) disables the watchdog.
+* ``keyfactory_refill_interval_s`` — the key factory's worker-poll
+  backstop (ISSUE 11, ``serve.keyfactory``): pools are refilled
+  immediately when a claim drops them below their low-water mark (the
+  claim nudges the worker), and at worst every this-many seconds.
+  Declare pools with ``add_pool(PoolSpec(...))`` and mint fresh
+  session keys with ``register_key(key_id, pool=...)`` — registration
+  then costs a pool pop, not an n-level GGM keygen walk.
 
 Pipelining: within a batch run, host->device staging of batch N+1
 overlaps the (async) device eval of batch N — the worker dispatches
@@ -128,6 +135,7 @@ from dcf_tpu.serve.batcher import (
     scatter_batch,
 )
 from dcf_tpu.serve.frontier_cache import FrontierCache
+from dcf_tpu.serve.keyfactory import KeyFactory, PoolSpec
 from dcf_tpu.serve.metrics import Metrics, OCCUPANCY_BOUNDS
 from dcf_tpu.serve.registry import KeyRegistry
 from dcf_tpu.serve.store import KeyStore
@@ -155,6 +163,7 @@ class ServeConfig:
     brownout_clear_s: float = 1.0
     store_dir: str = ""
     batch_timeout_s: float = 0.0
+    keyfactory_refill_interval_s: float = 0.05
 
     def __post_init__(self):
         if self.max_batch < 1 or self.max_batch & (self.max_batch - 1):
@@ -197,6 +206,12 @@ class ServeConfig:
             # api-edge: config contract (0 disables the watchdog)
             raise ValueError(
                 f"batch_timeout_s must be >= 0, got {self.batch_timeout_s}")
+        if self.keyfactory_refill_interval_s <= 0:
+            # api-edge: config contract (the worker needs a finite,
+            # positive poll backstop)
+            raise ValueError(
+                "keyfactory_refill_interval_s must be > 0, got "
+                f"{self.keyfactory_refill_interval_s}")
 
 
 class _Batch:
@@ -270,6 +285,19 @@ class DcfService:
             # stays exact either way.
             self.registry.sync_generation_floor(
                 self.store.max_generation())
+        # The key factory (ISSUE 11): ahead-of-demand keygen pools.
+        # Inert until a pool is declared (``add_pool``); its refill
+        # breakers live on its OWN board, so a dying keygen pipeline
+        # never counts as serving-brownout pressure.  breaker_failures=0
+        # disables only the SERVING breakers — refills keep the default
+        # threshold (there is no un-gated mode for a background minter).
+        self.keyfactory = KeyFactory(
+            dcf, registry=self.registry, store=self.store,
+            metrics=self.metrics, clock=clock,
+            brownout=lambda: self.queue.brownout,
+            refill_interval_s=self.config.keyfactory_refill_interval_s,
+            breaker_failures=self.config.breaker_failures or 3,
+            breaker_cooldown_s=self.config.breaker_cooldown_s)
         self._worker: threading.Thread | None = None
         self._pump_lock = threading.Lock()  # one batch runner at a time
         self._pump_owner: int | None = None  # thread id holding the lock
@@ -313,10 +341,37 @@ class DcfService:
 
     # -- key management -----------------------------------------------------
 
-    def register_key(self, key_id: str, bundle,
-                     durable: bool = False) -> None:
+    def add_pool(self, spec: PoolSpec) -> PoolSpec:
+        """Declare a key-factory pool (ISSUE 11, ``serve.keyfactory``)
+        and start the refill worker if this service's worker is already
+        running — fresh session keys then register via
+        ``register_key(key_id, pool=spec.name)`` at pool-pop latency."""
+        spec = self.keyfactory.add_pool(spec)
+        if self._worker is not None and self._worker.is_alive():
+            self.keyfactory.start()
+        return spec
+
+    def register_key(self, key_id: str, bundle=None,
+                     durable: bool = False, *, pool: str | None = None):
         """Register (or hot-swap) the two-party bundle ``key_id`` serves.
-        Swapping evicts the old device residencies atomically.
+        Swapping evicts the old device residencies atomically.  Returns
+        the registered bundle (the ``ProtocolBundle`` for protocol
+        keys).
+
+        ``pool`` (ISSUE 11, with ``bundle=None``): mint a FRESH session
+        key from the named key-factory pool instead of accepting a
+        caller-generated bundle — the ahead-of-demand path.  A pool hit
+        registers a pre-minted bundle (registration latency is a pool
+        pop plus this method's bookkeeping, not a keygen walk), carrying
+        the on-device staged narrow image into the registry when the
+        factory minted one (zero host round-trip staging on the hybrid
+        family).  Pool exhaustion falls back to a SYNCHRONOUS host mint
+        on this call's clock — counted
+        (``keyfactory_pool_misses_total``) and warned
+        (``BackendFallbackWarning``), bit-exact in every observable
+        (same function, fresh seeds), never silent.  The returned
+        bundle is the dealer's copy: ship ``for_party(b)`` shares to
+        the session's parties.
 
         ``bundle`` may be a plain ``KeyBundle`` OR a
         ``protocols.ProtocolBundle`` (PR 5): protocol keys serve MIC/
@@ -347,6 +402,28 @@ class DcfService:
         deliberately leaves the previous durable snapshot in the store
         (durability is opt-in per write; a crash then restores the
         last DURABLE generation)."""
+        dev_planes = None
+        claimed_pool_id = ""
+        if bundle is None:
+            if pool is None:
+                # api-edge: registration contract — either a bundle or
+                # a pool to mint from, never neither
+                raise ValueError(
+                    f"register_key({key_id!r}) needs a bundle or a "
+                    "pool= to mint a fresh session key from")
+            minted = self.keyfactory.claim(pool)
+            bundle = (minted.protocol if minted.protocol is not None
+                      else minted.bundle)
+            dev_planes = minted.planes
+            claimed_pool_id = minted.pool_id  # "" for fallback mints
+        elif pool is not None:
+            # api-edge: registration contract (an explicit bundle and a
+            # pool mint are different provenances; passing both hides
+            # which one actually serves)
+            raise ValueError(
+                f"register_key({key_id!r}): pass a bundle OR pool=, "
+                "not both")
+        registered = bundle
         protocol = None
         if isinstance(bundle, ProtocolBundle):
             protocol, bundle = bundle, bundle.keys
@@ -365,10 +442,21 @@ class DcfService:
                 f"register_key({key_id!r}, durable=True) needs a "
                 "configured store (ServeConfig.store_dir)")
         generation = self.registry.register(key_id, bundle,
-                                            protocol=protocol)
+                                            protocol=protocol,
+                                            dev_planes=dev_planes)
         if durable:
+            # A durable POOL claim folds the spent ~pool/ frame's
+            # delete into the same manifest flip that publishes the
+            # session frame: no crash window may leave both visible,
+            # or a restore would re-pool key material a restored
+            # session key already serves (cross-session reuse).  The
+            # factory's lazy batched reclaim then finds the id gone —
+            # a no-op.
             self.store.put(key_id, bundle, protocol=protocol,
-                           generation=generation)
+                           generation=generation,
+                           drop=(claimed_pool_id,) if claimed_pool_id
+                           else ())
+        return registered
 
     def unregister_key(self, key_id: str) -> None:
         """Forget ``key_id`` entirely: registry entry, residencies,
@@ -383,13 +471,19 @@ class DcfService:
         """Warm restart (ISSUE 8): re-register every key the durable
         store holds, preserving generations (zero re-keygen; damaged
         frames quarantined typed, never fatal to the rest — see
-        ``KeyRegistry.restore``).  Returns the ``RestoreReport``."""
+        ``KeyRegistry.restore``).  Restored ``~pool/...`` frames route
+        back into their key-factory pools instead of the serving
+        registry (ISSUE 11) — the report moves them from ``restored``
+        to ``repooled``, generations preserved.  Returns the
+        ``RestoreReport``."""
         if self.store is None:
             # api-edge: config contract (restore needs a store)
             raise ValueError(
                 "restore_keys() needs a configured store "
                 "(ServeConfig.store_dir)")
-        return self.registry.restore(self.store)
+        report = self.registry.restore(self.store)
+        self.keyfactory.adopt_restored(report, self.registry)
+        return report
 
     def key_ids(self) -> list[str]:
         return self.registry.key_ids()
@@ -862,11 +956,14 @@ class DcfService:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "DcfService":
-        """Spawn the worker thread (idempotent)."""
+        """Spawn the worker thread (idempotent), and the key factory's
+        refill worker when pools are declared."""
         if self._worker is None or not self._worker.is_alive():
             self._worker = threading.Thread(
                 target=self._worker_loop, name="dcf-serve", daemon=True)
             self._worker.start()
+        if self.keyfactory.pool_names():
+            self.keyfactory.start()
         return self
 
     def _worker_loop(self) -> None:
@@ -905,6 +1002,19 @@ class DcfService:
         the pump that owns them (its retry loop is bounded, so the join
         is too)."""
         self.queue.close()
+        # Stop refilling first: a factory minting into a closing
+        # service is wasted device work (and its close flushes the
+        # batched spent-frame reclaim while the store is still owned).
+        # A FAILING flush (dying disk at shutdown) is deferred, not
+        # propagated here: the futures below must be failed/drained
+        # first — close()'s never-leave-a-future-hanging contract
+        # outranks surfacing the reclaim error promptly.
+        keyfactory_error: BaseException | None = None
+        try:
+            self.keyfactory.close()
+        except Exception as e:  # fallback-ok: re-raised at the end of
+            # close(), after every queued future has been completed
+            keyfactory_error = e
         if not drain:
             self.queue.fail_all(lambda: BackendUnavailableError(
                 "service closed without draining"))
@@ -916,6 +1026,8 @@ class DcfService:
             self.pump()  # no worker: drain inline
         if drain:
             self.pump()  # belt-and-braces: nothing may stay queued
+        if keyfactory_error is not None:
+            raise keyfactory_error
 
     def __enter__(self) -> "DcfService":
         return self.start()

@@ -30,7 +30,12 @@ On-disk layout (one directory, created ``0o700``)::
   on disk are key material), is flushed and fsynced, and only then
   atomically renamed over the destination; the directory is fsynced
   after.  A crash at ANY point leaves either the old state or the new
-  state, never a torn visible file.  The ``store.write`` /
+  state, never a torn visible file.  ``put_many``/``delete_many``
+  (ISSUE 11) batch the manifest side: every frame in a refill batch is
+  still published individually, but ONE manifest flip makes the whole
+  batch visible — the key factory's amortization of the fsync cost
+  without giving up the crash guarantee (a kill mid-batch leaves the
+  previous manifest and some orphan frames, never a torn pool).  The ``store.write`` /
   ``store.manifest`` fault seams fire between fsync and rename
   (``testing.faults``: raise = crash pre-publish, ``torn_write`` =
   a partial write made durable for the quarantine path to find).
@@ -89,14 +94,20 @@ _FRAME_SUFFIX = ".dcfk"
 class RestoreReport:
     """What a warm restart brought back: ``restored`` maps key_id to
     its preserved generation; ``quarantined`` maps key_id to the typed
-    failure message of the frame that was set aside."""
+    failure message of the frame that was set aside; ``repooled``
+    (ISSUE 11) maps ``~pool/...`` frame ids to their preserved
+    generations — un-claimed key-factory supply routed back into its
+    pools by ``DcfService.restore_keys`` instead of the serving
+    registry."""
 
     restored: dict = field(default_factory=dict)
     quarantined: dict = field(default_factory=dict)
+    repooled: dict = field(default_factory=dict)
 
     def __repr__(self) -> str:  # names and counts only, never contents
         return (f"RestoreReport(restored={sorted(self.restored)}, "
-                f"quarantined={sorted(self.quarantined)})")
+                f"quarantined={sorted(self.quarantined)}, "
+                f"repooled={sorted(self.repooled)})")
 
 
 def _frame_name(key_id: str, generation: int) -> str:
@@ -271,7 +282,7 @@ class KeyStore:
     # -- the write-through surface ------------------------------------------
 
     def put(self, key_id: str, bundle: KeyBundle, protocol=None,
-            generation: int = 0) -> None:
+            generation: int = 0, drop=()) -> None:
         """Persist ``key_id``'s frame durably (frame first, manifest
         second — a crash between the two leaves the previous manifest
         pointing at the previous file: consistent old state, one
@@ -280,7 +291,16 @@ class KeyStore:
         v3 frame then carries the combine masks; ``bundle`` must be
         its inner ``KeyBundle``).  ``generation``: the registry
         generation the frame is published under — restore hands it
-        back verbatim."""
+        back verbatim.
+
+        ``drop`` (ISSUE 11): key ids whose entries are removed in the
+        SAME manifest flip that publishes this one — the durable pool
+        CLAIM path folds the spent ``~pool/...`` frame's delete into
+        the session key's publish, so no crash window exists in which
+        both the claimed pool frame and its durable session copy are
+        manifest-visible (restoring both would hand the same key
+        material to a second session — cross-session reuse, not a
+        hygiene cost).  Unknown ids are ignored."""
         if bundle.s0s.shape[1] != 2:
             raise ShapeError(
                 f"put({key_id!r}) wants the full two-party bundle — a "
@@ -307,6 +327,8 @@ class KeyStore:
                 # restore.  Generations are the registry's total order
                 # per key — the newest durable publish wins, always.
                 return
+            dropped = [entries.pop(d) for d in dict.fromkeys(drop)
+                       if d != key_id and d in entries]
             self._publish(fname, payload, "store.write", key_id)
             entries[key_id] = {
                 "file": fname,
@@ -318,6 +340,89 @@ class KeyStore:
             self._c_writes.inc()
             if prev is not None and prev["file"] != fname:
                 self._unlink_quiet(prev["file"])
+            for ent in dropped:
+                if ent["file"] != fname:
+                    self._unlink_quiet(ent["file"])
+                    self._c_deletes.inc()
+
+    def put_many(self, items) -> int:
+        """Batched durable publish (ISSUE 11, the key-factory refill
+        path): persist every ``(key_id, bundle, protocol, generation)``
+        in ``items`` with ONE manifest flip — each frame is still
+        written write-fsync-rename individually (the ``store.write``
+        seam fires per frame), but the batch becomes visible atomically
+        when the single manifest publish renames into place.  A crash
+        anywhere between the first frame write and the manifest flip
+        leaves the PREVIOUS manifest intact: old state, a few orphan
+        frames for ``sweep_orphans`` — never a torn pool.  Per-key
+        semantics match ``put`` exactly (two-party contract, protocol
+        desync check, the monotonic-generation guard: a stale item is
+        skipped, not rolled back).  Returns the number of keys
+        actually published (stale items excluded)."""
+        staged = []
+        for key_id, bundle, protocol, generation in items:
+            if bundle.s0s.shape[1] != 2:
+                raise ShapeError(
+                    f"put_many({key_id!r}) wants the full two-party "
+                    "bundle — a restored service serves both parties")
+            if protocol is not None and protocol.keys is not bundle:
+                raise ShapeError(
+                    f"put_many({key_id!r}): protocol.keys is not the "
+                    "bundle being persisted — the frame would desync "
+                    "from the registry entry")
+            if not key_id:
+                # api-edge: store naming contract at the serve edge
+                raise ValueError("key_id must be a non-empty string")
+            payload = (protocol.to_bytes() if protocol is not None
+                       else bundle.to_bytes())
+            staged.append((key_id, payload, protocol is not None,
+                           int(generation)))
+        if not staged:
+            return 0
+        with self._lock:
+            entries = self._read_manifest()
+            replaced, published = [], 0
+            for key_id, payload, is_proto, generation in staged:
+                prev = entries.get(key_id)
+                if prev is not None and prev["generation"] > generation:
+                    continue  # the monotonic guard, per key (see put)
+                fname = _frame_name(key_id, generation)
+                self._publish(fname, payload, "store.write", key_id)
+                if prev is not None and prev["file"] != fname:
+                    replaced.append(prev["file"])
+                entries[key_id] = {
+                    "file": fname,
+                    "generation": generation,
+                    "proto": is_proto,
+                    "parties": 2,
+                }
+                published += 1
+            if published:
+                self._write_manifest(entries)  # ONE flip for the batch
+                self._c_writes.inc(published)
+                for fname in replaced:
+                    self._unlink_quiet(fname)
+            return published
+
+    def delete_many(self, key_ids) -> int:
+        """Drop many keys' durable frames with ONE manifest flip (the
+        key factory's batched reclaim of claimed pool frames — a
+        per-claim ``delete`` would put a manifest fsync on every
+        registration).  Same ordering rule as ``delete``: manifest
+        first, then the unlinks, so the published state never
+        references a missing file.  Unknown ids are ignored.  Returns
+        the number of keys removed."""
+        with self._lock:
+            entries = self._read_manifest()
+            dropped = [entries.pop(key_id) for key_id in dict.fromkeys(
+                key_ids) if key_id in entries]
+            if not dropped:
+                return 0
+            self._write_manifest(entries)
+            for ent in dropped:
+                self._unlink_quiet(ent["file"])
+            self._c_deletes.inc(len(dropped))
+            return len(dropped)
 
     def delete(self, key_id: str) -> bool:
         """Drop ``key_id``'s durable frame (manifest first — a crash

@@ -115,6 +115,16 @@ POINTS = (
     #                     silent-correct, counted by
     #                     gen.device_fallback_count, warned via
     #                     BackendFallbackWarning)
+    "keyfactory.refill",  # key-factory pool refill (serve/keyfactory.py
+    #                     — fires at the start of one refill batch,
+    #                     before any key is minted; handler args:
+    #                     pool_name, batch_count.  A raising handler
+    #                     models a dead keygen pipeline: the refill
+    #                     fails contained (counted, the worker
+    #                     survives), repeated failures open the
+    #                     factory's per-pool breaker, and claims serve
+    #                     from the remaining pool / the counted
+    #                     synchronous-mint fallback)
 )
 
 _ACTIVE: dict[str, Callable] = {}

@@ -138,6 +138,77 @@ def test_pinned_ratio_keygen_shapes(tmp_path):
                          baseline_path=str(corrupt)) == {}
 
 
+@pytest.mark.keyfactory
+def test_cli_keyfactory_bench_validates_flags_fast():
+    """keyfactory_bench's flag contracts die loudly BEFORE the pool
+    fills and parity gates spend real time (the _parse_priority_mix
+    discipline), and --keyfactory without --crash-restart is refused
+    by chaos_bench."""
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="lam >= 16"):
+        cli.main(["keyfactory_bench", "--lam=8"])
+    with pytest.raises(SystemExit, match="serves through"):
+        cli.main(["keyfactory_bench", "--backend=pallas"])
+    with pytest.raises(SystemExit, match="lam >= 48"):
+        cli.main(["keyfactory_bench", "--backend=hybrid", "--lam=16"])
+    with pytest.raises(SystemExit, match="crash-restart"):
+        cli.main(["chaos_bench", "--backend=numpy", "--keyfactory",
+                  "--duration=1"])
+
+
+@pytest.mark.slow
+@pytest.mark.keyfactory
+def test_cli_keyfactory_bench_smoke(capsys, tmp_path):
+    """The slow serial-leg CLI smoke (ISSUE 11): keyfactory_bench end
+    to end at a small host-refill shape — both parity gates, the
+    sustained publish-to-servable fills, the >= 10x pool-hit latency
+    acceptance assertion (SystemExit if violated), and a short churn
+    leg."""
+    recs = run_cli(
+        capsys,
+        ["keyfactory_bench", "--lam=128", "--keys=8", "--reps=2",
+         "--duration=2", "--concurrency=2", "--host-refill",
+         "--min-req-points=2", "--max-req-points=8",
+         f"--store-dir={tmp_path / 'kf'}", "--seed=11"],
+    )
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["bench"] == "keyfactory_bench"
+    assert rec["metric"] == "keys_per_sec" and rec["value"] > 0
+    assert rec["pool_hit_speedup"] >= 10
+    assert rec["device_fallbacks"] == 0
+    assert rec["pool_misses"] >= 1  # the fallback gate leg is counted
+    assert rec["churn_sessions_ok"] >= 1
+    assert "repro" in rec
+    assert (tmp_path / "kf" / "MANIFEST.dcfm").exists()
+
+
+@pytest.mark.slow
+@pytest.mark.keyfactory
+def test_cli_chaos_crash_restart_keyfactory_smoke(capsys, tmp_path):
+    """ISSUE 11: chaos_bench --crash-restart --keyfactory end to end —
+    batched durable refills, a kill between the frame writes and the
+    manifest flip, and a warm restart restoring the un-claimed pool
+    supply with zero torn entries, zero re-keygen and generations
+    held (the harness raises SystemExit otherwise)."""
+    recs = run_cli(
+        capsys,
+        ["chaos_bench", "--backend=numpy", "--crash-restart",
+         "--keyfactory", "--duration=2", "--max-batch=64",
+         "--concurrency=2", "--fault-window=6",
+         "--breaker-cooldown=0.05",
+         f"--store-dir={tmp_path / 'store'}"],
+    )
+    rec = recs[0]
+    assert rec["scenario"] == "crash-restart"
+    assert rec["assertions_failed"] == []
+    assert rec["regen_count"] == 0 and rec["quarantined"] == 0
+    assert rec["pool_published"] == 6
+    assert rec["pool_claimed_pre_kill"] == 2
+    assert rec["pool_restored"] == 4
+
+
 @pytest.mark.slow
 @pytest.mark.keygen
 def test_cli_keygen_bench_smoke(capsys):

@@ -296,6 +296,19 @@ def test_keygen_layer_lint_clean():
     assert run_path(REPO / "dcf_tpu" / "gen.py") == []
 
 
+def test_keyfactory_layer_lint_clean():
+    """The ISSUE-11 CI satellite: the key-factory layer —
+    ``serve/keyfactory.py`` (pools, claims, batched refill) and the
+    churn mode in ``serve/loadgen.py`` — sweeps clean under ALL six
+    passes.  Secret-hygiene and determinism are the load-bearing ones:
+    pool entries hold bundles (key material — redacting reprs, no
+    sink leaks), and the ONE sanctioned entropy source (fresh mint
+    seeds) carries its mandatory suppression reason while everything
+    else runs on seeded rngs and the injectable clock."""
+    assert run_path(REPO / "dcf_tpu" / "serve" / "keyfactory.py") == []
+    assert run_path(REPO / "dcf_tpu" / "serve" / "loadgen.py") == []
+
+
 def test_store_layer_lint_clean():
     """The ISSUE-8 CI satellite: the durable store module sweeps clean
     under ALL six passes — in particular secret-hygiene (no

@@ -221,6 +221,90 @@ def test_stale_put_cannot_roll_back_newer_generation(dcf, rng,
     assert store.load("k")[2] == 6
 
 
+def test_put_many_one_flip_and_per_key_monotonic(dcf, rng, tmp_path):
+    """ISSUE 11 batched publish: N frames, ONE manifest flip; the
+    per-key monotonic guard skips stale items without touching the
+    rest of the batch; delete_many drops many entries in one flip."""
+    store = KeyStore(str(tmp_path))
+    store.put("b", gen_one(dcf, rng), generation=9)
+    items = [(f"k{i}", gen_one(dcf, rng), None, i + 1)
+             for i in range(4)]
+    flips = []
+    with faults.inject("store.manifest",
+                       handler=lambda *a: flips.append(a)):
+        assert store.put_many(items) == 4
+    assert len(flips) == 1
+    # stale item ("b" at gen 3 < stored 9) skipped, fresh one lands
+    old_b = store.load("b")[0]
+    assert store.put_many([("b", gen_one(dcf, rng), None, 3),
+                           ("k9", gen_one(dcf, rng), None, 9)]) == 1
+    assert store.load("b")[0].to_bytes() == old_b.to_bytes()
+    assert store.load("b")[2] == 9
+    flips.clear()
+    with faults.inject("store.manifest",
+                       handler=lambda *a: flips.append(a)):
+        assert store.delete_many(["k0", "k1", "gone", "k0"]) == 2
+    assert len(flips) == 1
+    assert store.key_ids() == ["b", "k2", "k3", "k9"]
+    with pytest.raises(ShapeError, match="two-party"):
+        store.put_many([("p", gen_one(dcf, rng).for_party(0), None, 1)])
+
+
+def test_put_many_crash_fuzz_never_tears_the_batch(dcf, rng, tmp_path):
+    """The ISSUE 11 acceptance fuzz: kill a batched publish at EVERY
+    frame write and at the manifest flip — after each kill the
+    manifest is readable and consistent (the OLD state, exactly),
+    every referenced frame loads, and the debris sweeps.  Then a torn
+    frame write that survives to the flip quarantines exactly its own
+    key at read time."""
+    store = KeyStore(str(tmp_path))
+    base = [(f"base{i}", gen_one(dcf, rng), None, i + 1)
+            for i in range(2)]
+    store.put_many(base)
+    before = store.key_ids()
+    batch = [(f"n{i}", gen_one(dcf, rng), None, 10 + i)
+             for i in range(4)]
+    for kill_at in range(1, 5):  # die on the kill_at-th frame write
+
+        def kill_nth(*_a, n=[0], k=kill_at):
+            n[0] += 1
+            if n[0] == k:
+                raise faults.InjectedFault(f"kill at frame {k}")
+
+        with pytest.raises(faults.InjectedFault):
+            with faults.inject("store.write", handler=kill_nth):
+                store.put_many(batch)
+        assert store.key_ids() == before, kill_at  # OLD state, whole
+        for key_id in before:  # every referenced frame still loads
+            store.load(key_id)
+        # kill_at - 1 published frames + the killed write's temp file
+        assert store.sweep_orphans() == kill_at
+    # kill at the manifest flip: all frames written, still OLD state
+    with pytest.raises(faults.InjectedFault):
+        with faults.inject("store.manifest"):
+            store.put_many(batch)
+    assert store.key_ids() == before
+    assert store.sweep_orphans() == 5  # 4 frames + the manifest tmp
+    # a torn FRAME made durable: the flip lands, the torn key (and
+    # only it) quarantines at read time
+    torn = {"n": 0}
+
+    def tear_second(_key_id, path):
+        torn["n"] += 1
+        if torn["n"] == 2:
+            with open(path, "r+b") as fh:
+                fh.truncate(30)
+
+    with faults.inject("store.write", handler=tear_second):
+        assert store.put_many(batch) == 4
+    with pytest.raises(KeyQuarantinedError):
+        store.load("n1")
+    for key_id in ("n0", "n2", "n3"):
+        store.load(key_id)
+    assert store._metrics.snapshot()[
+        "serve_store_quarantined_total"] == 1
+
+
 def test_quarantine_survives_manifest_publish_failure(dcf, rng,
                                                       tmp_path):
     """Review regression: the quarantine path must never raise — if
