@@ -734,6 +734,19 @@ class Dcf:
         (``serve.keyfactory``, README "Key factory") pre-mints session
         keys in K-packed device batches so registration is a pool pop,
         not a keygen walk.
+
+        Network traffic (ISSUE 12, README "Network edge"): front the
+        service with ``serve.EdgeServer(svc).start()`` — a stdlib-only
+        length-prefixed binary protocol whose ingest path goes
+        buffer-protocol straight into the batcher (zero per-point
+        Python objects; ``submit_bytes`` is the shared entry).
+        ``tenants=(serve.TenantSpec(name, priority, points_per_sec,
+        burst_points), ...)`` maps edge tenants onto the SAME
+        CRITICAL/NORMAL/BATCH classes (never a second policy) and
+        arms a per-tenant token bucket on the injectable clock;
+        refusals cross the wire as typed error frames carrying
+        ``retry_after_s`` (breaker cooldown / brownout hysteresis /
+        exact bucket refill).
         """
         from dcf_tpu.serve import DcfService, ServeConfig
 

@@ -23,6 +23,17 @@ records the served closed-loop throughput next to the equivalent
 staged-path batch rate (same backend, same ``--max-batch`` shape) with
 the full metrics snapshot (queue depth, batch occupancy, latencies).
 
+plus ``edge_bench`` — the network edge (``dcf_tpu.serve.edge``, ISSUE
+12): the zero-copy DCFE wire path measured against the in-process
+serving rate at the same shape (interleaved closed-loop legs over
+``--connections`` TCP connections), plus the 8+-connection soak under
+injected ``edge.read`` faults (bit-exact reconstruction, reconnecting
+clients), a rate-limited-tenant refusal leg asserting every refusal
+carries a typed retry-after hint, and an open-loop (Poisson) latency
+leg — exit-code gates on wire_vs_inprocess >= 0.8, the single-feed
+ingest probe, soak parity, and hint coverage
+(``benchmarks/RESULTS_edge.jsonl``).
+
 plus ``mic_bench`` — the protocol layer (``dcf_tpu.protocols``, ISSUE
 5): an m-interval MIC bundle (2m K-packed DCF keys) served closed-loop
 with the share combine applied server-side; the ``RESULTS_protocols``
@@ -1027,7 +1038,12 @@ def bench_serve(args) -> None:
     platform = jax.devices()[0].platform
     interp = (platform != "tpu"
               or bool(getattr(dcf.eval_backend(0), "interpret", False)))
-    res_cold = cold_snap = None
+    res_cold = cold_snap = wire_res = None
+    if args.edge and skew > 0:
+        raise SystemExit(
+            "serve_bench --edge is the wire-path comparison leg; the "
+            "--skew frontier experiment already runs two legs — run "
+            "them separately")
     if skew > 0:
         # The COLD-frontier comparison leg: same backend, same bundles,
         # same budget/shape/seeds, frontier_cache=False — every budget
@@ -1084,6 +1100,32 @@ def bench_serve(args) -> None:
                 concurrency=args.concurrency,
                 min_points=min_req, max_points=max_req,
                 seed=args.seed, skew=skew)
+            if args.edge:
+                # The --edge leg (ISSUE 12): the same closed-loop
+                # shape over the DCFE wire path — one TCP connection
+                # per client — so the serve line carries the wire/
+                # in-process ratio next to the staged-path one.
+                # edge_bench is the full acceptance harness; this leg
+                # is the one-flag comparison.
+                from dcf_tpu.serve.edge import EdgeServer
+
+                with EdgeServer(svc) as edge_srv:
+                    clients = _edge_clients(*edge_srv.address,
+                                            args.concurrency, nb, "")
+                    try:
+                        wire_res = closed_loop(
+                            svc, sorted(bundles),
+                            duration_s=float(args.duration),
+                            concurrency=args.concurrency,
+                            min_points=min_req, max_points=max_req,
+                            seed=args.seed, skew=skew,
+                            clients=clients)
+                    finally:
+                        for c in clients:
+                            c.close()
+                log(f"edge leg: {wire_res.throughput:,.1f} evals/s "
+                    f"over the wire vs {res.throughput:,.1f} "
+                    "in-process")
         snap = svc.metrics_snapshot()
 
     # Staged-path equivalent: same backend, one staged max_batch batch,
@@ -1124,6 +1166,11 @@ def bench_serve(args) -> None:
     if staged_rate is not None:
         extra["staged_path_evals_per_sec"] = round(staged_rate, 1)
         extra["serve_vs_staged"] = round(res.throughput / staged_rate, 3)
+    if wire_res is not None:
+        extra["wire_evals_per_sec"] = round(wire_res.throughput, 1)
+        extra["wire_requests_ok"] = wire_res.requests_ok
+        extra["wire_vs_inprocess"] = round(
+            wire_res.throughput / max(res.throughput, 1e-9), 3)
     hit_rate = None
     if skew > 0:
         fr_hits = snap.get("serve_frontier_hits_total", 0)
@@ -1214,6 +1261,435 @@ def _serve_pinned_ratio(rate: float, platform: str,
                         f"({pinned['evals_per_sec']:,.0f} evals/s, "
                         f"CPU_BASELINE.md protocol; serving platform "
                         f"{platform})"}
+
+
+def _edge_clients(host: str, port: int, n: int, nb: int,
+                  tenant: str) -> list:
+    from dcf_tpu.serve.edge import EdgeClient
+
+    return [EdgeClient(host, port, n_bytes=nb, tenant=tenant)
+            for _ in range(n)]
+
+
+def _edge_soak(addr, native, bundles, nb, *, conns: int,
+               duration_s: float, tenant: str, seed: int,
+               fault_every: int) -> dict:
+    """The edge acceptance soak (ISSUE 12): ``conns`` concurrent
+    connections, each a closed-loop session client evaluating BOTH
+    parties of a ragged request and reconstructing, with an
+    ``edge.read`` fault killing whichever connection owns every
+    ``fault_every``-th server recv (deterministic, so the failure path
+    is GUARANTEED to be exercised) — dead connections reconnect, every
+    delivered reconstruction is checked bit-exact against the C++
+    anchor (the test suite pins the same walk against the numpy
+    oracle), and every typed refusal must carry a retry-after hint."""
+    import threading
+
+    from dcf_tpu.errors import QueueFullError
+    from dcf_tpu.serve.edge import EdgeClient
+    from dcf_tpu.testing import faults
+    from dcf_tpu.utils.benchtime import monotonic
+
+    host, port = addr
+    names = sorted(bundles)
+    stats = {"sessions_ok": 0, "points_ok": 0, "mismatches": 0,
+             "reconnects": 0, "refusals": 0, "refusals_hinted": 0,
+             "other_failures": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(i: int) -> None:
+        rng = np.random.default_rng(seed + 101 * i)
+        conn = None
+        while not stop.is_set():
+            if conn is None:
+                try:
+                    conn = EdgeClient(host, port, n_bytes=nb,
+                                      tenant=tenant)
+                except OSError:
+                    continue  # server busy accepting; retry
+            name = names[int(rng.integers(0, len(names)))]
+            m = int(rng.integers(1, 257))
+            xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
+            try:
+                f0 = conn.submit(name, xs, b=0)
+                f1 = conn.submit(name, xs, b=1)
+                got = f0.result(120) ^ f1.result(120)
+            except QueueFullError as e:
+                with lock:
+                    stats["refusals"] += 1
+                    if e.retry_after_s is not None:
+                        stats["refusals_hinted"] += 1
+                continue
+            except Exception:  # fallback-ok: the injected edge.read
+                # fault kills this client's CONNECTION typed; the soak
+                # client reconnects — that recovery loop is the thing
+                # under test.  Only an actually-DEAD connection counts
+                # as a reconnect: a request-level typed failure leaves
+                # the connection open and must not inflate the
+                # reconnects gate the deterministic fault exists for.
+                if not conn.closed:
+                    with lock:
+                        stats["other_failures"] += 1
+                    continue
+                with lock:
+                    stats["reconnects"] += 1
+                try:
+                    conn.close()
+                except Exception:  # fallback-ok: best-effort teardown
+                    pass
+                conn = None
+                continue
+            want = native.eval(0, bundles[name], xs) ^ \
+                native.eval(1, bundles[name], xs)
+            with lock:
+                if np.array_equal(got, want):
+                    stats["sessions_ok"] += 1
+                    stats["points_ok"] += m
+                else:
+                    stats["mismatches"] += 1
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # fallback-ok: best-effort teardown
+                pass
+
+    threads = [threading.Thread(target=client, args=(i,),
+                                name=f"edge-soak-{i}", daemon=True)
+               for i in range(conns)]
+    fires = {"n": 0}
+
+    def every_nth(*_args):
+        fires["n"] += 1
+        if fires["n"] % fault_every == 0:
+            # dcflint: disable=typed-error this IS the fault-injection
+            # handler (testing.faults raises InjectedFault by design;
+            # the harness modules are exempt, this handler just lives
+            # in the bench that arms it)
+            raise faults.InjectedFault(
+                f"injected edge.read fault (fire #{fires['n']})")
+
+    with faults.inject("edge.read", handler=every_nth):
+        t0 = monotonic()
+        for t in threads:
+            t.start()
+        while monotonic() - t0 < duration_s:
+            stop.wait(0.05)
+        stop.set()
+        for t in threads:
+            t.join()
+    return stats
+
+
+def bench_edge(args) -> None:
+    """The network-edge acceptance bench (ISSUE 12): the zero-copy
+    DCFE wire path vs the in-process serving rate, at the same shape.
+
+    Legs, one service end to end (flagship N=16/lam=16 shape):
+
+    1. parity gates — every bundle, both parties, through the
+       IN-PROCESS path and through the WIRE path, XOR reconstruction
+       vs the C++ anchor;
+    2. ingest probe — a counted wrap of ``batcher.ingest_points``
+       proves the bytes-ingest entry is the ONLY batcher feed on both
+       paths (the zero-per-point-object claim, asserted, on the line);
+    3. throughput — in-process vs wire closed-loop legs INTERLEAVED in
+       3 alternating segments (shared-host drift cancels out of the
+       ratio), ``--connections`` wire clients each on their own TCP
+       connection; the emitted ``wire_vs_inprocess`` must be >= 0.8
+       (exit != 0 below: the zero-copy claim, falsified by
+       measurement);
+    4. the 8+-connection soak under a seeded ``edge.read`` fault —
+       connections die typed and reconnect, every delivered
+       reconstruction bit-exact vs the C++ anchor, zero tolerated
+       mismatches;
+    5. refusals — a burst through the rate-limited BATCH tenant; every
+       refusal must arrive as a typed wire error CARRYING a
+       retry-after hint (asserted);
+    6. open-loop latency — a Poisson-arrival leg at 60% of the
+       measured wire request rate (``serve.loadgen.open_loop``:
+       latency from SCHEDULED arrival, no coordinated omission), with
+       sent/shed/expired reconciled against the service metrics.
+
+    Emits one ``RESULTS_edge`` JSONL line (interpret/CPU disclosed
+    in-line; the same command on a chip is the repro), with
+    ``vs_baseline`` against the pinned single-core C++ flagship
+    denominator (CPU_BASELINE.md protocol), then applies the exit-code
+    gates."""
+    from dcf_tpu import Dcf
+    from dcf_tpu.native import NativeDcf
+    from dcf_tpu.serve import TenantSpec
+    from dcf_tpu.serve import batcher as batcher_mod
+    from dcf_tpu.serve import service as service_mod
+    from dcf_tpu.serve.edge import EdgeServer
+    from dcf_tpu.serve.loadgen import closed_loop, open_loop
+
+    lam, nb = 16, 16
+    backend = args.backend
+    if backend == "cpu":
+        backend = "bitsliced"  # the no-TPU serving default, as in
+        # serve_bench's skew mode: "cpu" is the global argparse default
+    if backend not in ("numpy", "jax", "bitsliced", "pallas", "prefix"):
+        raise SystemExit(
+            f"edge_bench serves lam=16 single-device facade backends "
+            f"(numpy/jax/bitsliced/pallas/prefix), got {backend!r}")
+    conns = args.connections
+    if conns < 1:
+        raise SystemExit(f"--connections must be >= 1, got {conns}")
+    max_batch = args.max_batch or (1 << 14)
+    min_req = args.min_req_points or (max_batch * 3 // 8)
+    max_req = args.max_req_points or (max_batch // 2)
+    if not 1 <= min_req <= max_req:
+        # fail fast, before the bundle gen / warmup ladder spend time
+        raise SystemExit(f"bad request-size range [{min_req}, {max_req}]")
+    n_bundles = args.bundles or 3
+    rng = np.random.default_rng(args.seed)
+    ck = _cipher_keys(lam, rng)
+    native = NativeDcf(lam, ck)
+    dcf = Dcf(nb, lam, ck, backend=backend)
+    # The tenant table (ServeConfig.tenants -> the PR 6 classes):
+    # throughput/soak traffic rides "silver" (NORMAL, unlimited); the
+    # refusal leg bursts through "bronze" (BATCH, rate-limited so the
+    # bucket demonstrably refuses with its exact time-to-refill).
+    bronze_rate = float(max_batch)
+    svc = dcf.serve(
+        max_batch=max_batch, max_delay_ms=args.max_delay_ms,
+        tenants=(TenantSpec("gold", "critical"),
+                 TenantSpec("silver", "normal"),
+                 TenantSpec("bronze", "batch",
+                            points_per_sec=bronze_rate,
+                            burst_points=max_batch // 2)))
+    log(f"gen {n_bundles} bundles ...")
+    bundles = _gen_serve_bundles(svc, native, rng, n_bundles, nb, lam)
+    _serve_parity_gate(svc, native, bundles, rng, nb, points=256,
+                       bench="edge_bench", tag="in-process")
+
+    # Warm every padded batch shape both the ragged legs and the soak
+    # (m in [1, 256]) can produce — same ladder rule as serve_bench,
+    # but for BOTH parties: the soak reconstructs two-party, and the
+    # party-1 eval graphs are their own compiles.
+    xs_warm = rng.integers(0, 256, (max_batch, nb), dtype=np.uint8)
+    m = 1
+    while m <= max_batch:
+        log(f"warming batch shape {m} (both parties) ...")
+        svc.submit("key-0", xs_warm[:m], b=0)
+        svc.submit("key-0", xs_warm[:m], b=1)
+        svc.pump()
+        m *= 2
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    interp = (platform != "tpu"
+              or bool(getattr(dcf.eval_backend(0), "interpret", False)))
+
+    svc.start()
+    edge = EdgeServer(svc).start()
+    addr = edge.address
+    log(f"edge listening on {addr[0]}:{addr[1]}")
+
+    # Wire parity gate: same bundles, both parties, over TCP.
+    wire_gate = _edge_clients(*addr, 1, nb, "silver")[0]
+    xs_gate = rng.integers(0, 256, (256, nb), dtype=np.uint8)
+    for name, bundle in bundles.items():
+        got = wire_gate.evaluate(name, xs_gate, b=0, timeout=300) ^ \
+            wire_gate.evaluate(name, xs_gate, b=1, timeout=300)
+        want = native.eval(0, bundle, xs_gate) ^ \
+            native.eval(1, bundle, xs_gate)
+        if not np.array_equal(got, want):
+            raise SystemExit(
+                f"edge_bench parity mismatch vs C++ on {name} (wire)")
+    log(f"parity vs C++ core (wire): OK ({len(bundles)} bundles x "
+        "256 pts, two-party)")
+
+    # Ingest probe: ingest_points is the ONE batcher feed — count its
+    # calls across an in-process and a wire submit burst and require
+    # exactly one call per request (zero per-point Python objects by
+    # construction: the entry wraps the frame buffer, never iterates
+    # points).
+    real_ingest = batcher_mod.ingest_points
+    probe = {"calls": 0}
+
+    def counting_ingest(data, n_bytes, m=None):
+        probe["calls"] += 1
+        return real_ingest(data, n_bytes, m)
+
+    service_mod.ingest_points = counting_ingest
+    try:
+        xs_probe = rng.integers(0, 256, (64, nb), dtype=np.uint8)
+        for _ in range(4):
+            svc.evaluate("key-0", xs_probe, timeout=120)
+        for _ in range(4):
+            wire_gate.evaluate("key-0", xs_probe, timeout=120)
+    finally:
+        service_mod.ingest_points = real_ingest
+    ingest_single_feed = probe["calls"] == 8
+    log(f"ingest probe: {probe['calls']} ingest_points calls for 8 "
+        f"requests (single-feed={ingest_single_feed})")
+    wire_gate.close()
+
+    # Throughput: interleaved in-process / wire closed-loop segments.
+    segs = 3
+    seg_s = float(args.duration) / (2 * segs)
+    clients = _edge_clients(*addr, conns, nb, "silver")
+    runs = {"inproc": [], "wire": []}
+    try:
+        for i in range(2 * segs):
+            leg = "inproc" if i % 2 == 0 else "wire"
+            kw = dict(duration_s=seg_s, concurrency=conns,
+                      min_points=min_req, max_points=max_req,
+                      seed=args.seed + i // 2)
+            if leg == "wire":
+                kw["clients"] = clients
+            runs[leg].append(closed_loop(svc, sorted(bundles), **kw))
+    finally:
+        pass  # clients stay up for the open-loop leg below
+    res_in = _merge_loadgen(runs["inproc"])
+    res_wire = _merge_loadgen(runs["wire"])
+    wire_vs_inprocess = res_wire.throughput / max(res_in.throughput,
+                                                  1e-9)
+    log(f"throughput: wire {res_wire.throughput:,.1f} vs in-process "
+        f"{res_in.throughput:,.1f} evals/s "
+        f"(wire_vs_inprocess={wire_vs_inprocess:.3f})")
+
+    # Open-loop latency leg: 60% of the measured wire request rate.
+    snap_before = svc.metrics_snapshot()
+    wire_rps = res_wire.requests_ok / max(res_wire.duration_s, 1e-9)
+    open_rate = max(0.6 * wire_rps, 1.0)
+    res_open = open_loop(
+        clients[0], sorted(bundles), rate_rps=open_rate,
+        duration_s=min(float(args.duration) / 3, 10.0),
+        min_points=min_req, max_points=max_req, seed=args.seed + 17)
+    snap_after = svc.metrics_snapshot()
+    open_reconciled = (
+        res_open.sent == snap_after["serve_requests_total"]
+        - snap_before["serve_requests_total"]
+        and res_open.expired == snap_after["serve_deadline_expired_total"]
+        - snap_before["serve_deadline_expired_total"])
+    log(f"open-loop @ {open_rate:,.1f} req/s: ok={res_open.ok} "
+        f"shed={res_open.shed} expired={res_open.expired} "
+        f"{res_open.latency_quantiles()} (reconciled={open_reconciled})")
+    for c in clients:
+        c.close()
+
+    # Refusal leg: burst the rate-limited BATCH tenant until the
+    # bucket refuses; every refusal must carry a retry-after hint.
+    from dcf_tpu.errors import QueueFullError
+
+    bronze = _edge_clients(*addr, 1, nb, "bronze")[0]
+    refusals = refusals_hinted = 0
+    xs_burst = rng.integers(0, 256, (max_batch // 2, nb),
+                            dtype=np.uint8)
+    # Submit the whole burst CONCURRENTLY (pipelined on one
+    # connection) so the bucket sees it inside one refill window —
+    # sequential blocking round trips would let a slow interpret-mode
+    # host refill the bucket between attempts and flake the
+    # refusals>=1 gate on a healthy edge.
+    burst = [bronze.submit("key-0", xs_burst) for _ in range(6)]
+    for f in burst:
+        try:
+            f.result(300)
+        except QueueFullError as e:
+            refusals += 1
+            if e.retry_after_s is not None:
+                refusals_hinted += 1
+    bronze.close()
+    log(f"refusal leg: {refusals} rate-limit refusals, "
+        f"{refusals_hinted} carried retry_after_s")
+
+    # The soak: 8+ connections under a deterministic edge.read fault.
+    soak_s = max(float(args.duration) / 4, 3.0)
+    soak = _edge_soak(addr, native, bundles, nb,
+                      conns=max(conns, 8), duration_s=soak_s,
+                      tenant="silver", seed=args.seed,
+                      fault_every=25)
+    log(f"soak: {soak}")
+
+    snap = svc.metrics_snapshot()
+    edge.close()
+    svc.close()
+
+    extra = {
+        "duration_s": round(res_wire.duration_s, 3),
+        "connections": conns,
+        "max_batch": max_batch,
+        "req_points": [min_req, max_req],
+        "bundles": n_bundles,
+        "segments_per_leg": segs,
+        "wire_requests_ok": res_wire.requests_ok,
+        "inprocess_evals_per_sec": round(res_in.throughput, 1),
+        "wire_vs_inprocess": round(wire_vs_inprocess, 3),
+        "ingest_single_feed": ingest_single_feed,
+        "ingest_probe_calls": probe["calls"],
+        **res_wire.latency_quantiles(),
+        "open_loop_rate_rps": round(open_rate, 1),
+        "open_loop_ok": res_open.ok,
+        "open_loop_shed": res_open.shed,
+        "open_loop_expired": res_open.expired,
+        "open_loop_reconciled": open_reconciled,
+        **{f"open_loop_{k}": v
+           for k, v in res_open.latency_quantiles().items()},
+        "refusals": refusals,
+        "refusals_hinted": refusals_hinted,
+        "soak_connections": max(conns, 8),
+        "soak_sessions_ok": soak["sessions_ok"],
+        "soak_mismatches": soak["mismatches"],
+        "soak_reconnects": soak["reconnects"],
+        "soak_refusals": soak["refusals"],
+        "soak_refusals_hinted": soak["refusals_hinted"],
+        "soak_other_failures": soak["other_failures"],
+        "edge_frames_total": snap.get("edge_frames_total", 0),
+        "edge_connection_errors_total":
+            snap.get("edge_connection_errors_total", 0),
+        "platform": platform,
+        "interpreted": interp,
+        "repro": (f"python -m dcf_tpu.cli edge_bench "
+                  f"--duration {float(args.duration):g} "
+                  f"--max-batch {max_batch} --connections {conns} "
+                  f"--seed {args.seed}"),
+    }
+    extra.update(_serve_pinned_ratio(res_wire.throughput, platform))
+    unit = "evals/s (closed-loop served over TCP, party 0)"
+    if interp:
+        unit += " [no TPU this session: interpret/CPU mode, disclosed]"
+    _emit("edge_bench", backend, "evals_per_sec",
+          res_wire.throughput, unit, extra_fields=extra)
+
+    # Emitted-then-asserted, chaos_bench style: the JSONL line
+    # survives a failure, the exit code makes each claim falsifiable.
+    failures = []
+    if wire_vs_inprocess < 0.8:
+        failures.append(
+            f"wire path served {wire_vs_inprocess:.3f}x the in-process "
+            "rate at the same shape (< 0.8: the zero-copy wire path is "
+            "not holding)")
+    if not ingest_single_feed:
+        failures.append(
+            f"ingest probe saw {probe['calls']} ingest_points calls "
+            "for 8 requests — the bytes-ingest entry is not the only "
+            "batcher feed")
+    if soak["mismatches"]:
+        failures.append(
+            f"{soak['mismatches']} soak reconstructions mismatched the "
+            "C++ anchor")
+    if soak["sessions_ok"] < 8:
+        failures.append(
+            f"soak delivered only {soak['sessions_ok']} sessions")
+    if soak["reconnects"] < 1:
+        failures.append(
+            "the injected edge.read fault never killed a connection — "
+            "the soak did not exercise the failure path")
+    if refusals < 1:
+        failures.append("the refusal leg never saw a refusal")
+    hinted_ok = (refusals_hinted == refusals and
+                 soak["refusals_hinted"] == soak["refusals"])
+    if not hinted_ok:
+        failures.append(
+            "a refusal reached a client WITHOUT a typed retry-after "
+            f"hint (leg {refusals_hinted}/{refusals}, soak "
+            f"{soak['refusals_hinted']}/{soak['refusals']})")
+    if failures:
+        raise SystemExit("edge_bench: " + "; ".join(failures))
 
 
 def _protocols_pinned_ratio(m_int: int, rate: float,
@@ -2369,6 +2845,7 @@ BENCHES = {
     "secure_relu": bench_secure_relu,
     "full_domain": bench_full_domain,
     "serve_bench": bench_serve,
+    "edge_bench": bench_edge,
     "mic_bench": bench_mic,
     "chaos_bench": bench_chaos,
     "keygen_bench": bench_keygen,
@@ -2462,6 +2939,14 @@ def main(argv=None) -> None:
     p.add_argument("--device-bytes-budget", type=int, default=0,
                    help="serve_bench: LRU device-residency budget "
                         "(0 = uncapped)")
+    p.add_argument("--edge", action="store_true",
+                   help="serve_bench: also drive the same closed-loop "
+                        "shape over the DCFE wire path (serve/edge.py) "
+                        "and record wire_vs_inprocess on the line "
+                        "(edge_bench is the full acceptance harness)")
+    p.add_argument("--connections", type=int, default=8,
+                   help="edge_bench: concurrent TCP connections for "
+                        "the wire legs (the soak always uses >= 8)")
     p.add_argument("--min-req-points", type=int, default=0,
                    help="serve_bench/mic_bench: request-size range lower "
                         "bound (0 = 3/8 of --max-batch)")
@@ -2537,8 +3022,8 @@ def main(argv=None) -> None:
         bench_baseline(args)
         return
     for name in BENCHES if args.bench == "all" else [args.bench]:
-        if args.bench == "all" and name in ("serve_bench", "mic_bench",
-                                            "chaos_bench"):
+        if args.bench == "all" and name in ("serve_bench", "edge_bench",
+                                            "mic_bench", "chaos_bench"):
             log(f"skipping {name} (a timed load test, not a "
                 "criterion analog; run it explicitly)")
             continue

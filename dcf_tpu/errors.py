@@ -101,11 +101,34 @@ class NativeBuildError(DcfError, RuntimeError):
 class QueueFullError(DcfError, RuntimeError):
     """The serving layer's admission control shed a request: the
     queued-points bound was hit (overload — back off and retry), the
-    service is in brownout and refused a low-priority class, or the
-    service is draining/closed.  Usually raised at ``submit`` time; the
-    one post-acceptance spelling is eviction — an already-queued
-    lower-priority request completed with this error through its future
-    because a higher-priority submit needed its room."""
+    service is in brownout and refused a low-priority class, a
+    per-tenant token bucket at the network edge refused the points
+    (``serve.edge``), or the service is draining/closed.  Usually
+    raised at ``submit`` time; the one post-acceptance spelling is
+    eviction — an already-queued lower-priority request completed with
+    this error through its future because a higher-priority submit
+    needed its room.
+
+    ``retry_after_s`` (ISSUE 12): the caller-facing backoff hint, or
+    ``None`` when no principled one exists (a draining service never
+    comes back).  Populated from the refusal's own state — brownout
+    hysteresis (``brownout_clear_s``: the calm the controller needs
+    before it re-admits BATCH), queue pressure (about one coalescing
+    drain), or the token bucket's exact time-to-refill — so the network
+    edge serializes a number, not a bare "try later" string.
+
+    ``evicted``: True for the post-acceptance spelling (the request
+    WAS admitted — and counted — before a higher-priority submit took
+    its room).  Load accounting needs the distinction: an evicted
+    request appears in ``serve_requests_total``, a submit-time shed
+    does not.  The network edge preserves it across the wire
+    (``E_EVICTED``)."""
+
+    def __init__(self, *args, retry_after_s: float | None = None,
+                 evicted: bool = False):
+        super().__init__(*args)
+        self.retry_after_s = retry_after_s
+        self.evicted = evicted
 
 
 class DeadlineExceededError(DcfError, TimeoutError):
@@ -124,7 +147,17 @@ class CircuitOpenError(DcfError, RuntimeError):
     request behind it).  CRITICAL-priority traffic bypasses the open
     state; after the cooldown one probe half-opens the breaker and its
     outcome decides between closing and re-opening.  Surfaces through
-    the request's result handle (``serve.breaker``)."""
+    the request's result handle (``serve.breaker``).
+
+    ``retry_after_s`` (ISSUE 12): the remaining cooldown of the open
+    breaker (``BreakerBoard.retry_after`` — when it elapses the next
+    request becomes the half-open probe), so the network edge can
+    serialize a hint that tracks the actual recovery schedule instead
+    of a guess.  ``None`` when the breaker state was not consulted."""
+
+    def __init__(self, *args, retry_after_s: float | None = None):
+        super().__init__(*args)
+        self.retry_after_s = retry_after_s
 
 
 class KeyQuarantinedError(DcfError, RuntimeError):

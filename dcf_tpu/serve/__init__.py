@@ -32,19 +32,34 @@ keys":
   store in batched atomic manifest flips, claimed by
   ``register_key(key_id, pool=...)`` at pool-pop latency with a
   counted, warned synchronous-mint fallback on exhaustion;
+- ``serve.edge``      the network edge (ISSUE 12): a stdlib-only
+  length-prefixed binary protocol over TCP carrying DCFE-framed
+  requests with a zero-copy ingest path (received point bytes go
+  buffer-protocol straight into the batcher's staged layout via the
+  ONE ``batcher.ingest_points`` feed), tenant->priority-class mapping
+  with per-tenant token buckets (``TenantSpec`` in
+  ``ServeConfig.tenants``), and typed wire error frames carrying
+  retry-after hints; ``EdgeClient`` is the pipelining counterpart;
 - ``serve.metrics``   dependency-free counters/gauges/histograms with a
   deterministic snapshot (embedded in RESULTS_serve JSONL lines);
 - ``serve.service``   ``DcfService``: the worker loop tying it together,
   with a stage-ahead double-buffered dispatch pipeline and the
   ``serve.stage``/``serve.eval`` fault seams;
 - ``serve.loadgen``   the closed-loop load generator behind the
-  ``serve_bench`` CLI subcommand.
+  ``serve_bench`` CLI subcommand, plus the open-loop (Poisson) mode
+  the edge latency quantiles need (ISSUE 12: no coordinated
+  omission).
 
 Entry point: ``Dcf.serve(...)`` (see ``dcf_tpu.api``).
 """
 
-from dcf_tpu.serve.admission import Priority, ServeFuture  # noqa: F401
+from dcf_tpu.serve.admission import (  # noqa: F401
+    Priority,
+    ServeFuture,
+    TenantSpec,
+)
 from dcf_tpu.serve.breaker import BreakerBoard  # noqa: F401
+from dcf_tpu.serve.edge import EdgeClient, EdgeServer  # noqa: F401
 from dcf_tpu.serve.frontier_cache import FrontierCache  # noqa: F401
 from dcf_tpu.serve.keyfactory import KeyFactory, PoolSpec  # noqa: F401
 from dcf_tpu.serve.metrics import Metrics  # noqa: F401
@@ -53,5 +68,6 @@ from dcf_tpu.serve.service import DcfService, ServeConfig  # noqa: F401
 from dcf_tpu.serve.store import KeyStore, RestoreReport  # noqa: F401
 
 __all__ = ["DcfService", "ServeConfig", "ServeFuture", "Priority",
+           "TenantSpec", "EdgeServer", "EdgeClient",
            "BreakerBoard", "FrontierCache", "KeyFactory", "Metrics",
            "KeyRegistry", "KeyStore", "PoolSpec", "RestoreReport"]

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import enum
 import threading
+from dataclasses import dataclass
 from typing import Callable
 
 import numpy as np
@@ -54,8 +55,8 @@ import numpy as np
 from dcf_tpu.errors import DeadlineExceededError, QueueFullError, ShapeError
 from dcf_tpu.serve.metrics import Metrics, labeled
 
-__all__ = ["Priority", "parse_priority", "ServeFuture", "Request",
-           "AdmissionQueue", "expire"]
+__all__ = ["Priority", "parse_priority", "TenantSpec", "ServeFuture",
+           "Request", "AdmissionQueue", "expire"]
 
 
 class Priority(enum.IntEnum):
@@ -81,6 +82,52 @@ def parse_priority(p) -> Priority:
     raise ValueError(
         f"priority must be a Priority or one of "
         f"{[x.name.lower() for x in Priority]}, got {p!r}")
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One network-edge tenant and its admission policy (ISSUE 12).
+
+    Tenants map onto the EXISTING priority classes — the tenant table
+    is a naming layer over the PR 6 shed/brownout policy, never a
+    second policy: ``priority`` is the class every request from this
+    tenant is admitted as (a request frame may self-DEMOTE below it —
+    a gold tenant running an offline sweep can mark it BATCH — but can
+    never self-promote above its tenant class).
+
+    ``points_per_sec`` / ``burst_points`` configure the per-tenant
+    token bucket the edge applies BEFORE the request touches the shared
+    queue (``serve.edge.TokenBucket``): 0 points/s disables rate
+    limiting for the tenant; ``burst_points`` is the bucket capacity
+    (0 = one second of rate — a full-rate burst).  The bucket refuses
+    with ``QueueFullError`` carrying the exact time-to-refill as its
+    ``retry_after_s``.
+    """
+
+    name: str
+    priority: Priority | str = Priority.NORMAL
+    points_per_sec: float = 0.0
+    burst_points: int = 0
+
+    def __post_init__(self):
+        if not self.name:
+            # api-edge: tenant-table contract (the empty name is the
+            # anonymous default-tenant spelling on the wire, never a
+            # declarable tenant)
+            raise ValueError("tenant name must be non-empty")
+        # Normalize the class eagerly so a typo'd name dies at config
+        # time, not per-request on a serving thread.
+        object.__setattr__(self, "priority", parse_priority(self.priority))
+        if self.points_per_sec < 0:
+            # api-edge: tenant-table contract (0 = unlimited)
+            raise ValueError(
+                f"tenant {self.name!r}: points_per_sec must be >= 0, "
+                f"got {self.points_per_sec}")
+        if self.burst_points < 0:
+            # api-edge: tenant-table contract (0 = one second of rate)
+            raise ValueError(
+                f"tenant {self.name!r}: burst_points must be >= 0, "
+                f"got {self.burst_points}")
 
 
 class ServeFuture:
@@ -155,12 +202,24 @@ class AdmissionQueue:
     """
 
     def __init__(self, max_queued_points: int,
-                 metrics: Metrics | None = None):
+                 metrics: Metrics | None = None, *,
+                 shed_retry_after_s: float | None = None,
+                 brownout_retry_after_s: float | None = None):
         if max_queued_points < 1:
             # api-edge: constructor bound contract
             raise ValueError(
                 f"max_queued_points must be >= 1, got {max_queued_points}")
         self.max_queued_points = int(max_queued_points)
+        # Retry-after hints (ISSUE 12): what a shed caller is told to
+        # back off for.  Overload sheds carry ``shed_retry_after_s``
+        # (the service passes ~one coalescing drain interval — the
+        # soonest the queue could plausibly have room again); brownout
+        # refusals carry ``brownout_retry_after_s`` (the service passes
+        # ``brownout_clear_s`` — the calm the hysteresis controller
+        # needs before it re-admits BATCH).  Draining/closed refusals
+        # carry no hint: the service is not coming back.
+        self.shed_retry_after_s = shed_retry_after_s
+        self.brownout_retry_after_s = brownout_retry_after_s
         self._metrics = metrics if metrics is not None else Metrics()
         self.cond = threading.Condition()
         self._reqs: list[Request] = []
@@ -258,7 +317,8 @@ class AdmissionQueue:
                 raise QueueFullError(
                     "brownout: the service is shedding BATCH-class load "
                     "(sustained queue pressure or an open circuit "
-                    "breaker); back off and retry, or raise the class")
+                    "breaker); back off and retry, or raise the class",
+                    retry_after_s=self.brownout_retry_after_s)
             if self._points + req.m > self.max_queued_points:
                 picked = self._pick_victims(req)
                 if picked is None:
@@ -266,7 +326,8 @@ class AdmissionQueue:
                     raise QueueFullError(
                         f"admission queue full: {self._points} points "
                         f"queued + {req.m} requested > bound "
-                        f"{self.max_queued_points}; back off and retry")
+                        f"{self.max_queued_points}; back off and retry",
+                        retry_after_s=self.shed_retry_after_s)
                 victims = picked
                 evicted = set(map(id, victims))
                 self._reqs = [r for r in self._reqs
@@ -289,7 +350,8 @@ class AdmissionQueue:
         for r in victims:
             r.future.set_exception(QueueFullError(
                 f"evicted from the admission queue: a higher-priority "
-                f"submit needed the room ({r!r})"))
+                f"submit needed the room ({r!r})",
+                retry_after_s=self.shed_retry_after_s, evicted=True))
 
     def close(self) -> None:
         """Stop admitting; queued requests remain for draining."""

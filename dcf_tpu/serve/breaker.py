@@ -248,6 +248,25 @@ class BreakerBoard:
             br = self._breakers.get((key_id, family))
             return br.state if br is not None else CLOSED
 
+    def retry_after(self, key_id: str, family: str) -> float | None:
+        """The backoff hint for a caller refused by this pairing
+        (ISSUE 12): OPEN -> the remaining cooldown (when it elapses the
+        next allow becomes the half-open probe, so retrying then is not
+        a guess but the sanctioned schedule); HALF_OPEN -> the full
+        cooldown (a probe is in flight; if it fails the cooldown
+        restarts, so anything shorter invites a thundering re-try at a
+        breaker that may just have re-opened); CLOSED/unknown ->
+        ``None`` (nothing to wait out).  Clamped at 0: a probe-ready
+        breaker means "retry now"."""
+        with self._lock:
+            br = self._breakers.get((key_id, family))
+            if br is None or br.state == CLOSED:
+                return None
+            if br.state == HALF_OPEN:
+                return br.cooldown_s
+            return max(0.0,
+                       br.cooldown_s - (self._clock() - br.opened_at))
+
     def any_open(self) -> bool:
         """An open breaker still inside its cooldown — one of the
         brownout controller's two pressure signals (a failing backend

@@ -1,0 +1,1103 @@
+"""Network edge: a zero-copy DCFK wire path for the serving tier
+(ISSUE 12).
+
+Every request so far entered ``DcfService`` as an in-process Python
+call; real traffic arrives over a socket.  This module is the
+dependency-light TCP front: a length-prefixed binary protocol (stdlib
+``socket`` + ``threading`` only — the container bakes nothing else)
+that carries DCFE-framed evaluation requests end to end, with the
+ingest path going buffer-protocol straight into the batcher's staged
+layout:
+
+    socket ──recv_into──► per-frame bytearray ──memoryview──►
+    batcher.ingest_points ──► Request.xs (a VIEW, no copy) ──►
+    gather_batch ──► the padded pow-2 device batch
+
+Zero per-point Python objects exist anywhere on that path: the one
+host copy is the socket read into the frame buffer, the next is the
+span gather into the padded batch (which the in-process path pays
+too).  Responses are serialized straight from the fetched result
+planes — the uint8 [K, M, lam] array's own buffer is handed to
+``sendmsg`` behind an incremental CRC, never an intermediate
+list-of-ints.
+
+Wire format (all integers little-endian; every frame is a ``u32``
+body-length envelope followed by the body)::
+
+    0   4   magic  b"DCFE"
+    4   2   version (u16, currently 1)
+    6   1   type    (u8: 1=REQUEST  2=SHARE  3=ERROR)
+
+    REQUEST body (type 1):
+    7   8   req_id      u64  client-chosen; responses echo it
+    15  1   party       u8   0 or 1
+    16  1   priority    u8   0/1/2 = CRITICAL/NORMAL/BATCH,
+                             255 = the tenant's class (the default)
+    17  8   deadline_ms f64  <= 0 = none (relative, like ``submit``)
+    25  4   m           u32  points in this request
+    29  2   n_bytes     u16  bytes per point (must match the service)
+    31  1   tenant_len  u8
+    32  1   key_len     u8
+    33      tenant      utf-8 [tenant_len]
+    ..      key_id      utf-8 [key_len]
+    ..      xs payload  raw packed points, m * n_bytes
+    end-4   crc32       u32 of ALL prior body bytes (zlib.crc32)
+
+    SHARE body (type 2):
+    7   8   req_id  u64
+    15  2   k       u16  output rows (K keys, or m intervals)
+    17  4   m       u32
+    21  2   lam     u16
+    23      share bytes  k * m * lam (C order)
+    end-4   crc32   u32 of all prior body bytes
+
+    ERROR body (type 3):
+    7   8   req_id        u64  0 = connection-level (not a request)
+    15  2   code          u16  see WIRE_CODES
+    17  8   retry_after_s f64  < 0 = no hint
+    25  2   msg_len       u16
+    27      message       utf-8
+    end-4   crc32         u32 of all prior body bytes
+
+Decoding is strict, DCFK-style: bounds-checked field by field, exact
+total size, CRC verified — any violation is ``KeyFormatError`` naming
+the field.  A FRAMING violation (bad magic/length/CRC) additionally
+closes the connection: after it the byte stream cannot be trusted to
+re-synchronize.  Request-level refusals (unknown key, shed load, rate
+limit) keep the connection — framing was intact.
+
+Tenancy (the tenant table lives in ``ServeConfig.tenants``, a tuple of
+``serve.TenantSpec`` — it maps tenants onto the EXISTING CRITICAL/
+NORMAL/BATCH classes, never a second policy): a request's effective
+class is its tenant's class, demotable per request but never
+promotable above it.  Per-tenant admission is a points-per-second
+token bucket on the injectable clock, applied BEFORE the shared queue:
+a refusal costs the shared service nothing and carries the exact
+time-to-refill as its ``retry_after_s``.  An empty table (the
+default) admits every tenant as NORMAL, unlimited — the open edge the
+benches drive; a configured table refuses unknown tenants typed.
+
+Refusals and failures cross the wire as typed ERROR frames: the code
+maps back to the ``dcf_tpu.errors`` class on the client
+(``EdgeClient`` re-raises the real ``QueueFullError`` /
+``CircuitOpenError`` / ``DeadlineExceededError`` ... with
+``retry_after_s`` attached), so a remote caller sees exactly the typed
+taxonomy an in-process caller sees.
+
+Failure injection: the ``edge.accept`` / ``edge.read`` seams
+(``dcf_tpu.testing.faults``) fire before each accept and each
+connection recv — a raising read handler kills ONE connection typed
+(the accept loop and every other tenant's connection survive), and
+``faults.latency`` armed at ``edge.read`` is the slow-client seam:
+each blocking read advances the injectable clock, so a stalled sender
+trips the existing deadline/watchdog path instead of wedging the
+worker.
+
+Clocking: admission math (buckets, deadlines) uses the service's
+injectable clock, never ``time.*`` (dcflint determinism).  Server-side
+socket reads BLOCK by default — the right behavior for trusted/idle
+keep-alive peers; against hostile ones, ``EdgeServer(read_timeout_s=N)``
+bounds every recv (wall-clock by nature, like any socket timeout), so
+a slow-loris peer holding a half-sent frame costs at most N seconds of
+one reader thread before its connection dies typed and counted.  The
+per-connection response backlog is bounded either way
+(``_Conn.MAX_PENDING_RESPONSES``), and a frame buffer is at most
+``max_frame_bytes``.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import zlib
+
+import numpy as np
+
+from dcf_tpu.errors import (
+    BackendUnavailableError,
+    BatchTimeoutError,
+    CircuitOpenError,
+    DcfError,
+    DeadlineExceededError,
+    KeyFormatError,
+    QueueFullError,
+    ShapeError,
+    StaleStateError,
+)
+from dcf_tpu.serve.admission import (
+    Priority,
+    ServeFuture,
+    TenantSpec,
+    parse_priority,
+)
+from dcf_tpu.serve.metrics import Metrics, labeled
+from dcf_tpu.testing.faults import fire
+
+__all__ = ["EdgeServer", "EdgeClient", "TokenBucket", "WIRE_CODES",
+           "MAGIC", "VERSION", "T_REQUEST", "T_SHARE", "T_ERROR",
+           "encode_request", "encode_error"]
+
+MAGIC = b"DCFE"
+VERSION = 1
+
+T_REQUEST = 1
+T_SHARE = 2
+T_ERROR = 3
+
+_PREFIX = struct.Struct("<I")        # the length envelope
+_FRAME_HEAD = struct.Struct("<HB")   # version, type (after the magic)
+_BODY_MIN = 4 + _FRAME_HEAD.size     # magic + version + type
+_REQ_HEAD = struct.Struct("<QBBdIHBB")
+_RES_HEAD = struct.Struct("<QHIH")
+_ERR_HEAD = struct.Struct("<QHdH")
+_CRC = struct.Struct("<I")
+_PRI_DEFAULT = 255  # "the tenant's class" priority byte
+
+# Typed wire error codes <-> the dcf_tpu.errors taxonomy.  The server
+# serializes the code for the exception it caught; the client
+# re-raises the mapped class (retry_after_s re-attached where the
+# class carries one).  E_RATE_LIMITED is a QueueFullError flavor —
+# the refusal happened at the tenant bucket, before the shared queue.
+E_INTERNAL = 1
+E_WIRE = 2
+E_SHAPE = 3
+E_BAD_REQUEST = 4
+E_QUEUE_FULL = 5
+E_RATE_LIMITED = 6
+E_DEADLINE = 7
+E_CIRCUIT_OPEN = 8
+E_UNAVAILABLE = 9
+E_UNKNOWN_TENANT = 10
+E_TIMEOUT = 11
+E_EVICTED = 12  # QueueFullError's post-ACCEPTANCE spelling: the
+#                 request was admitted (and counted) before a
+#                 higher-priority submit took its room — load
+#                 accounting must not retract a "sent" for it
+
+#: code -> exception class the client raises (see ``_raise_wire``).
+WIRE_CODES = {
+    E_INTERNAL: DcfError,
+    E_WIRE: KeyFormatError,
+    E_SHAPE: ShapeError,
+    E_BAD_REQUEST: ValueError,
+    E_QUEUE_FULL: QueueFullError,
+    E_RATE_LIMITED: QueueFullError,
+    E_DEADLINE: DeadlineExceededError,
+    E_CIRCUIT_OPEN: CircuitOpenError,
+    E_UNAVAILABLE: BackendUnavailableError,
+    E_UNKNOWN_TENANT: ValueError,
+    E_TIMEOUT: BatchTimeoutError,
+    E_EVICTED: QueueFullError,
+}
+
+_EXC_CODES = (
+    # Order matters: first match wins, subclasses before bases.
+    (QueueFullError, E_QUEUE_FULL),
+    (DeadlineExceededError, E_DEADLINE),
+    (CircuitOpenError, E_CIRCUIT_OPEN),
+    (BatchTimeoutError, E_TIMEOUT),
+    (KeyFormatError, E_WIRE),
+    (ShapeError, E_SHAPE),
+    (StaleStateError, E_UNAVAILABLE),
+    (BackendUnavailableError, E_UNAVAILABLE),
+    (DcfError, E_INTERNAL),
+    (ValueError, E_BAD_REQUEST),
+)
+
+
+def _code_for(exc: BaseException) -> int:
+    if isinstance(exc, QueueFullError) and getattr(exc, "evicted",
+                                                   False):
+        return E_EVICTED
+    for cls, code in _EXC_CODES:
+        if isinstance(exc, cls):
+            return code
+    return E_INTERNAL
+
+
+class _Disconnect(DcfError, ConnectionError):
+    """A peer vanished mid-frame (EOF inside an envelope or body) —
+    a per-connection event, typed so the containment handlers can
+    tell it from a framing violation."""
+
+
+def _sendmsg_all(sock: socket.socket, parts: list) -> None:
+    """``sendmsg`` with the ``sendall`` guarantee: a blocking TCP
+    socket may still accept only part of a large gather write, so loop
+    over the remainder without flattening the parts (the share payload
+    is referenced by buffer the whole way — no intermediate copy
+    unless the kernel short-writes)."""
+    views = [memoryview(p).cast("B") if not isinstance(p, memoryview)
+             else p.cast("B") for p in parts]
+    total = sum(v.nbytes for v in views)
+    sent = sock.sendmsg(views)
+    while sent < total:
+        total -= sent
+        while sent:
+            if sent >= views[0].nbytes:
+                sent -= views[0].nbytes
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
+        sent = sock.sendmsg(views)
+
+
+# ------------------------------------------------------------ codecs
+
+
+def _frame(body_parts) -> bytes:
+    """Envelope + body + CRC from byte pieces (one join, no
+    re-serialization of the pieces themselves).  Pieces are flattened
+    to byte views first — ``len()`` of a 2D memoryview counts rows,
+    not bytes."""
+    views = [memoryview(p).cast("B") for p in body_parts]
+    crc = 0
+    for v in views:
+        crc = zlib.crc32(v, crc)
+    body_len = sum(v.nbytes for v in views) + _CRC.size
+    return b"".join([_PREFIX.pack(body_len), *views,
+                     _CRC.pack(crc)])
+
+
+def encode_request(req_id: int, tenant: str, key_id: str, party: int,
+                   priority: int, deadline_ms: float | None,
+                   payload, n_bytes: int, m: int) -> bytes:
+    """One REQUEST frame (envelope included).  ``payload`` is any
+    buffer-protocol object of ``m * n_bytes`` packed point bytes."""
+    tb = tenant.encode("utf-8")
+    kb_name = key_id.encode("utf-8")
+    if len(tb) > 255 or len(kb_name) > 255:
+        raise ShapeError("tenant/key_id must encode to <= 255 bytes")
+    head = MAGIC + _FRAME_HEAD.pack(VERSION, T_REQUEST) + _REQ_HEAD.pack(
+        req_id, party, priority,
+        -1.0 if deadline_ms is None else float(deadline_ms),
+        m, n_bytes, len(tb), len(kb_name))
+    return _frame([head, tb, kb_name, memoryview(payload)])
+
+
+def encode_share(req_id: int, y: np.ndarray) -> list[bytes]:
+    """SHARE frame pieces for ``sendmsg``: the fetched uint8
+    [k, m, lam] planes are referenced by buffer — no intermediate
+    list-of-ints, no payload copy (the kernel gathers the pieces)."""
+    k, m, lam = y.shape
+    if y.dtype != np.uint8:
+        raise ShapeError(f"share planes must be uint8, got {y.dtype}")
+    view = memoryview(np.ascontiguousarray(y)).cast("B")
+    head = MAGIC + _FRAME_HEAD.pack(VERSION, T_SHARE) + _RES_HEAD.pack(
+        req_id, k, m, lam)
+    crc = zlib.crc32(view, zlib.crc32(head))
+    body_len = len(head) + view.nbytes + _CRC.size
+    return [_PREFIX.pack(body_len), head, view, _CRC.pack(crc)]
+
+
+def encode_error(req_id: int, code: int, message: str,
+                 retry_after_s: float | None = None) -> bytes:
+    mb = message.encode("utf-8")[:4096]
+    head = MAGIC + _FRAME_HEAD.pack(VERSION, T_ERROR) + _ERR_HEAD.pack(
+        req_id, code,
+        -1.0 if retry_after_s is None else float(retry_after_s),
+        len(mb))
+    return _frame([head, mb])
+
+
+def _check_body(body, claims: str) -> memoryview:
+    """Shared strict-decode head: magic, version, CRC over the whole
+    body — ``KeyFormatError`` naming the field, DCFK discipline."""
+    view = memoryview(body)
+    if view.nbytes < _BODY_MIN + _CRC.size:
+        raise KeyFormatError(
+            f"truncated frame: {view.nbytes} bytes cannot hold the "
+            f"DCFE header and CRC ({claims})")
+    if bytes(view[:4]) != MAGIC:
+        raise KeyFormatError(
+            f"bad magic: expected {MAGIC!r}, got {bytes(view[:4])!r} "
+            "(not a DCFE frame)")
+    version, _ = _FRAME_HEAD.unpack_from(view, 4)
+    if version != VERSION:
+        raise KeyFormatError(
+            f"unsupported DCFE version {version} (this reader handles "
+            f"{VERSION})")
+    (crc_stored,) = _CRC.unpack_from(view, view.nbytes - _CRC.size)
+    crc_actual = zlib.crc32(view[:view.nbytes - _CRC.size])
+    if crc_stored != crc_actual:
+        raise KeyFormatError(
+            f"crc32 mismatch: trailer records {crc_stored:#010x}, frame "
+            f"hashes to {crc_actual:#010x} — the wire bytes are corrupt")
+    return view
+
+
+def decode_request(body) -> dict:
+    """Strict REQUEST decode.  Returns the header fields plus
+    ``payload``: a zero-copy ``memoryview`` of the packed xs bytes
+    inside ``body`` (the caller owns the buffer's lifetime)."""
+    view = _check_body(body, "a request")
+    _, ftype = _FRAME_HEAD.unpack_from(view, 4)
+    if ftype != T_REQUEST:
+        raise KeyFormatError(
+            f"frame type {ftype} is not a request (server side only "
+            "accepts type 1)")
+    if view.nbytes < _BODY_MIN + _REQ_HEAD.size + _CRC.size:
+        raise KeyFormatError(
+            f"truncated frame: {view.nbytes} bytes cannot hold a "
+            "request header")
+    (req_id, party, priority, deadline_ms, m, n_bytes, tenant_len,
+     key_len) = _REQ_HEAD.unpack_from(view, _BODY_MIN)
+    off = _BODY_MIN + _REQ_HEAD.size
+    end = view.nbytes - _CRC.size
+    claims = f"m={m}, n_bytes={n_bytes}"
+    for name, size in (("tenant", tenant_len), ("key_id", key_len),
+                       ("xs payload", m * n_bytes)):
+        if off + size > end:
+            raise KeyFormatError(
+                f"truncated frame: section {name!r} needs bytes "
+                f"[{off}, {off + size}) but the payload ends at {end} "
+                f"(header claims {claims})")
+        off += size
+    if off != end:
+        raise KeyFormatError(
+            f"oversized frame: {end - off} trailing bytes after the xs "
+            "payload (corrupt header or concatenated frames)")
+    off = _BODY_MIN + _REQ_HEAD.size
+    tenant = bytes(view[off:off + tenant_len]).decode("utf-8",
+                                                      "replace")
+    off += tenant_len
+    key_id = bytes(view[off:off + key_len]).decode("utf-8", "replace")
+    off += key_len
+    return {
+        "req_id": req_id, "tenant": tenant, "key_id": key_id,
+        "party": party, "priority": priority,
+        "deadline_ms": deadline_ms if deadline_ms > 0 else None,
+        "m": m, "n_bytes": n_bytes,
+        "payload": view[off:end],
+    }
+
+
+def decode_response(body) -> tuple:
+    """Client-side strict decode: ``("share", req_id, y)`` or
+    ``("error", req_id, code, retry_after_s, message)``."""
+    view = _check_body(body, "a response")
+    _, ftype = _FRAME_HEAD.unpack_from(view, 4)
+    end = view.nbytes - _CRC.size
+    if ftype == T_SHARE:
+        if view.nbytes < _BODY_MIN + _RES_HEAD.size + _CRC.size:
+            raise KeyFormatError("truncated frame: no share header")
+        req_id, k, m, lam = _RES_HEAD.unpack_from(view, _BODY_MIN)
+        off = _BODY_MIN + _RES_HEAD.size
+        if off + k * m * lam != end:
+            raise KeyFormatError(
+                f"share payload size mismatch: header claims "
+                f"k={k}, m={m}, lam={lam} but {end - off} bytes follow")
+        y = np.frombuffer(view[off:end], dtype=np.uint8)
+        return ("share", req_id, y.reshape(k, m, lam))
+    if ftype == T_ERROR:
+        if view.nbytes < _BODY_MIN + _ERR_HEAD.size + _CRC.size:
+            raise KeyFormatError("truncated frame: no error header")
+        req_id, code, retry, msg_len = _ERR_HEAD.unpack_from(
+            view, _BODY_MIN)
+        off = _BODY_MIN + _ERR_HEAD.size
+        if off + msg_len != end:
+            raise KeyFormatError(
+                f"error message size mismatch: header claims "
+                f"{msg_len} bytes but {end - off} follow")
+        msg = bytes(view[off:end]).decode("utf-8", "replace")
+        return ("error", req_id, code,
+                retry if retry >= 0 else None, msg)
+    raise KeyFormatError(
+        f"frame type {ftype} is not a response (client side accepts "
+        "types 2 and 3)")
+
+
+# ------------------------------------------------------ admission
+
+
+class TokenBucket:
+    """Per-tenant points-per-second admission on the injectable clock.
+
+    ``admit(points, now)`` returns 0.0 when admitted (tokens consumed)
+    or the retry-after hint in seconds — the EXACT time until the
+    bucket would hold ``points`` tokens, so a refused client's backoff
+    is a schedule, not a guess.  A request larger than the bucket
+    capacity is refused UNCONDITIONALLY — ``points > burst`` can never
+    be admitted, full bucket or not, or an oversized request would
+    bypass the rate limit entirely; its hint is the (unreachable)
+    time-to-``points``, always positive: split the request or raise
+    the burst.  Thread-safe: several connections may serve one tenant.
+    """
+
+    __slots__ = ("rate", "burst", "_tokens", "_last", "_lock")
+
+    def __init__(self, points_per_sec: float, burst_points: float,
+                 now: float):
+        if points_per_sec < 0:
+            # api-edge: bucket contract (0 disables rate limiting)
+            raise ValueError(
+                f"points_per_sec must be >= 0, got {points_per_sec}")
+        self.rate = float(points_per_sec)
+        self.burst = float(burst_points) if burst_points > 0 \
+            else max(self.rate, 1.0)
+        self._tokens = self.burst
+        self._last = float(now)
+        self._lock = threading.Lock()
+
+    def admit(self, points: int, now: float) -> float:
+        if self.rate <= 0:
+            return 0.0
+        with self._lock:
+            elapsed = max(now - self._last, 0.0)
+            self._tokens = min(self.burst,
+                               self._tokens + elapsed * self.rate)
+            self._last = now
+            if points <= self._tokens:
+                self._tokens -= points
+                return 0.0
+            # points > tokens: refused.  For points > burst this is
+            # ALWAYS positive even against a full bucket — clamping
+            # the hint at capacity would return 0.0 there, which the
+            # caller reads as "admitted": a zero-token rate-limit
+            # bypass for any request sized above the burst.
+            return (points - self._tokens) / self.rate
+
+
+class _Tenant:
+    """One resolved tenant: its class, its bucket, its metric series."""
+
+    __slots__ = ("spec", "bucket", "c_requests", "c_points",
+                 "c_refusals")
+
+    def __init__(self, spec: TenantSpec, metrics: Metrics, now: float):
+        self.spec = spec
+        self.bucket = TokenBucket(spec.points_per_sec,
+                                  spec.burst_points, now)
+        name = spec.name
+        self.c_requests = metrics.counter(labeled(
+            "edge_tenant_requests_total", tenant=name))
+        self.c_points = metrics.counter(labeled(
+            "edge_tenant_points_total", tenant=name))
+        self.c_refusals = metrics.counter(labeled(
+            "edge_tenant_refusals_total", tenant=name))
+
+
+# ------------------------------------------------------ the server
+
+
+class _Conn:
+    """One accepted connection: a reader thread decoding frames and
+    submitting, a writer thread streaming completions back.  All
+    failures are PER-CONNECTION: they end these two threads, never the
+    accept loop or another connection."""
+
+    #: Response-backlog bound per connection: a peer that pipelines
+    #: requests but never reads responses would otherwise grow the
+    #: out-queue (completed futures + their frame buffers) without
+    #: limit while the writer sits in ``sendall`` on the full socket.
+    #: At the bound the READER blocks instead — TCP backpressure
+    #: propagates to the slow peer, and memory per connection stays
+    #: bounded.  (The admission queue bounds only UNSERVED points, so
+    #: it cannot provide this.)
+    MAX_PENDING_RESPONSES = 256
+
+    def __init__(self, server: "EdgeServer", sock: socket.socket,
+                 peer: str):
+        self._srv = server
+        self._sock = sock
+        self._peer = peer
+        self._out: queue.Queue = queue.Queue(self.MAX_PENDING_RESPONSES)
+        self._closing = False
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"edge-read-{peer}",
+            daemon=True)
+        self._writer = threading.Thread(
+            target=self._write_loop, name=f"edge-write-{peer}",
+            daemon=True)
+
+    def start(self) -> None:
+        self._reader.start()
+        self._writer.start()
+
+    def close(self) -> None:
+        """Server-initiated shutdown: unblock both threads."""
+        self._closing = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # already gone
+        self._sock.close()
+        try:
+            self._out.put_nowait(None)
+        except queue.Full:
+            pass  # the writer is mid-backlog; the closed socket ends it
+
+    def _enqueue(self, item) -> None:
+        """Reader-side put honouring the backlog bound: blocks in
+        slices so a server/connection close can always free the reader
+        (the closed socket ends the writer, which may never drain)."""
+        while not self._closing:
+            try:
+                self._out.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def join(self, timeout: float | None = None) -> None:
+        self._reader.join(timeout)
+        self._writer.join(timeout)
+
+    # -- receive path ------------------------------------------------
+
+    def _recv_into(self, view: memoryview) -> None:
+        got = 0
+        while got < len(view):
+            fire("edge.read", self._peer, len(view) - got)
+            n = self._sock.recv_into(view[got:], len(view) - got)
+            if n == 0:
+                # dcflint: disable=typed-error _Disconnect IS a
+                # DcfError subclass (module-local, so the containment
+                # handler can tell a vanished peer from a framing
+                # violation without string matching)
+                raise _Disconnect(
+                    f"peer {self._peer} disconnected mid-frame "
+                    f"({got}/{len(view)} bytes of the section)")
+            got += n
+
+    def _read_frame(self) -> bytearray | None:
+        """One envelope + body; ``None`` on a clean EOF between
+        frames.  The body buffer is FRESH per frame: the decoded xs
+        payload stays aliased by the queued request until its batch
+        is gathered, so the buffer must never be reused."""
+        prefix = bytearray(_PREFIX.size)
+        fire("edge.read", self._peer, _PREFIX.size)
+        n = self._sock.recv_into(prefix, _PREFIX.size)
+        if n == 0:
+            return None  # clean close between frames
+        if n < _PREFIX.size:
+            self._recv_into(memoryview(prefix)[n:])
+        (body_len,) = _PREFIX.unpack(prefix)
+        if not _BODY_MIN + _CRC.size <= body_len \
+                <= self._srv.max_frame_bytes:
+            raise KeyFormatError(
+                f"length prefix {body_len} outside "
+                f"[{_BODY_MIN + _CRC.size}, "
+                f"{self._srv.max_frame_bytes}] (oversized or mangled "
+                "envelope)")
+        body = bytearray(body_len)
+        self._recv_into(memoryview(body))
+        return body
+
+    def _read_loop(self) -> None:
+        srv = self._srv
+        try:
+            while not self._closing:
+                body = self._read_frame()
+                if body is None:
+                    break
+                srv._c_frames.inc()
+                self._handle_request(body)
+        except KeyFormatError as e:
+            # Framing violation (bad magic/length/CRC, from the
+            # envelope read or the frame decode): answer typed, then
+            # hang up — after a mangled frame the stream cannot be
+            # trusted to re-synchronize on the next envelope.
+            srv._c_wire_errors.inc()
+            self._enqueue(encode_error(0, E_WIRE, str(e)))
+        except _Disconnect:
+            srv._c_conn_errors.inc()
+        except OSError:
+            # fallback-ok: socket teardown (server close or peer
+            # reset) ends the connection; the accept loop and the
+            # other connections are untouched.
+            if not self._closing:
+                srv._c_conn_errors.inc()
+        except Exception as e:  # fallback-ok: ANY per-connection
+            # failure (e.g. an armed edge.read fault) must end THIS
+            # connection typed, never the accept loop or another
+            # tenant's connection.
+            srv._c_conn_errors.inc()
+            self._enqueue(encode_error(0, E_INTERNAL,
+                                       f"{type(e).__name__}: {e}"))
+        finally:
+            self._enqueue(None)  # writer drains what is queued, then
+            srv._forget(self)   # the connection is gone
+
+    def _handle_request(self, body: bytearray) -> None:
+        req = decode_request(body)
+        srv = self._srv
+        req_id = req["req_id"]
+
+        def refuse(code: int, msg: str,
+                   retry_after_s: float | None = None) -> None:
+            srv._c_refused.inc()
+            self._enqueue(encode_error(req_id, code, msg,
+                                       retry_after_s))
+
+        if req["n_bytes"] != srv.n_bytes:
+            refuse(E_SHAPE,
+                   f"point width {req['n_bytes']} != service domain "
+                   f"{srv.n_bytes} bytes")
+            return
+        if req["party"] not in (0, 1):
+            refuse(E_BAD_REQUEST,
+                   f"party must be 0 or 1, got {req['party']}")
+            return
+        tenant = srv._resolve_tenant(req["tenant"])
+        if tenant is None:
+            refuse(E_UNKNOWN_TENANT,
+                   f"unknown tenant {req['tenant']!r}: the service's "
+                   "tenant table does not name it")
+            return
+        pri = req["priority"]
+        if pri == _PRI_DEFAULT:
+            eff = tenant.spec.priority
+        elif pri in (0, 1, 2):
+            # A request may demote below its tenant class, never
+            # promote above it (larger enum value = lower class).
+            eff = Priority(max(pri, tenant.spec.priority.value))
+        else:
+            refuse(E_BAD_REQUEST,
+                   f"priority byte must be 0/1/2 or 255, got {pri}")
+            return
+        tenant.c_requests.inc()
+        now = srv._clock()
+        retry = tenant.bucket.admit(req["m"], now)
+        if retry > 0:
+            tenant.c_refusals.inc()
+            refuse(E_RATE_LIMITED,
+                   f"tenant {tenant.spec.name!r} over its "
+                   f"{tenant.bucket.rate:g} points/s admission rate",
+                   retry_after_s=retry)
+            return
+        try:
+            fut = srv._service.submit_bytes(
+                req["key_id"], req["payload"], b=req["party"],
+                deadline_ms=req["deadline_ms"], priority=eff)
+        except Exception as e:  # fallback-ok: a refused submit
+            # (QueueFullError, unknown key, shape violation) is a
+            # REQUEST-level outcome — answer typed, keep the
+            # connection (framing was intact).
+            srv._c_refused.inc()
+            self._enqueue(encode_error(
+                req_id, _code_for(e), str(e),
+                getattr(e, "retry_after_s", None)))
+            return
+        tenant.c_points.inc(req["m"])
+        # The frame buffer rides with the future: the payload view
+        # aliases it until the batch gather copies the spans out.
+        self._enqueue((req_id, fut, body))
+
+    # -- response path -----------------------------------------------
+
+    def _write_loop(self) -> None:
+        srv = self._srv
+        try:
+            while True:
+                item = self._out.get()
+                if item is None:
+                    break
+                if isinstance(item, (bytes, bytearray)):
+                    self._sock.sendall(item)
+                    srv._c_errors_sent.inc()
+                    continue
+                req_id, fut, _body = item
+                try:
+                    y = fut.result()
+                except Exception as e:  # fallback-ok: a failed
+                    # request (deadline, breaker, retries exhausted)
+                    # crosses the wire as a typed ERROR frame; the
+                    # connection survives.
+                    self._sock.sendall(encode_error(
+                        req_id, _code_for(e), str(e),
+                        getattr(e, "retry_after_s", None)))
+                    srv._c_errors_sent.inc()
+                    continue
+                _sendmsg_all(self._sock, encode_share(req_id, y))
+                srv._c_responses.inc()
+        except OSError:
+            # fallback-ok: the peer stopped reading (reset/close) —
+            # per-connection, contained; queued futures complete in
+            # the service regardless (results are simply undeliverable)
+            if not self._closing:
+                srv._c_conn_errors.inc()
+        finally:
+            # The writer IS the out-queue's only consumer: mark the
+            # connection closing so a reader blocked in _enqueue on a
+            # full backlog (slow peer that then died) exits its slice
+            # loop instead of spinning forever against a queue nobody
+            # will ever drain.
+            self._closing = True
+            self._sock.close()
+
+
+class EdgeServer:
+    """The serving tier's TCP front (see the module docstring).
+
+    ``EdgeServer(service).start()`` binds and spawns the accept loop;
+    ``address`` is the bound ``(host, port)`` (port 0 picks a free
+    one).  Tenancy and rate limits come from the service's
+    ``ServeConfig.tenants``; all admission math runs on the service's
+    injectable clock.  ``close()`` stops accepting, hangs up every
+    connection, and joins the threads.
+    """
+
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
+                 *, max_frame_bytes: int = 64 << 20, backlog: int = 64,
+                 read_timeout_s: float = 0.0):
+        if max_frame_bytes < _BODY_MIN + _CRC.size:
+            # api-edge: config contract — a bound below one empty
+            # frame refuses everything
+            raise ValueError(
+                f"max_frame_bytes must be >= {_BODY_MIN + _CRC.size}, "
+                f"got {max_frame_bytes}")
+        if read_timeout_s < 0:
+            # api-edge: config contract (0 = block forever, the
+            # trusted-peer default; a positive bound is the
+            # slow-loris guard — note it also hangs up idle
+            # keep-alive connections at the same horizon)
+            raise ValueError(
+                f"read_timeout_s must be >= 0, got {read_timeout_s}")
+        self.read_timeout_s = float(read_timeout_s)
+        self._service = service
+        self._host = host
+        self._port = port
+        self.max_frame_bytes = int(max_frame_bytes)
+        self._backlog = int(backlog)
+        self.n_bytes = service._dcf.n_bytes
+        self._clock = service._clock
+        self.metrics = service.metrics
+        self._lock = threading.Lock()
+        self._conns: set[_Conn] = set()
+        self._listener: socket.socket | None = None
+        self._acceptor: threading.Thread | None = None
+        self._closing = False
+        now = self._clock()
+        self._tenants = {
+            spec.name: _Tenant(spec, self.metrics, now)
+            for spec in service.config.tenants}
+        # The open-edge default: no table -> every tenant serves as
+        # NORMAL, unlimited, under one shared metric identity.
+        self._default_tenant = (None if self._tenants else _Tenant(
+            TenantSpec(name="default"), self.metrics, now))
+        m = self.metrics
+        self._c_connections = m.counter("edge_connections_total")
+        self._g_open = m.gauge("edge_connections_open")
+        self._c_accept_errors = m.counter("edge_accept_errors_total")
+        self._c_conn_errors = m.counter("edge_connection_errors_total")
+        self._c_wire_errors = m.counter("edge_wire_errors_total")
+        self._c_frames = m.counter("edge_frames_total")
+        self._c_refused = m.counter("edge_refused_total")
+        self._c_responses = m.counter("edge_responses_total")
+        self._c_errors_sent = m.counter("edge_errors_sent_total")
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "EdgeServer":
+        if self._listener is not None:
+            return self
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self._host, self._port))
+        sock.listen(self._backlog)
+        self._listener = sock
+        self._acceptor = threading.Thread(
+            target=self._accept_loop, name="edge-accept", daemon=True)
+        self._acceptor.start()
+        return self
+
+    @property
+    def address(self) -> tuple[str, int]:
+        if self._listener is None:
+            raise StaleStateError("edge server not started")
+        return self._listener.getsockname()[:2]
+
+    def close(self) -> None:
+        self._closing = True
+        listener = self._listener
+        if listener is not None:
+            try:
+                listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass  # never connected / already down
+            listener.close()
+        if self._acceptor is not None:
+            self._acceptor.join(5.0)
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            c.close()
+        for c in conns:
+            c.join(5.0)
+
+    def __enter__(self) -> "EdgeServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # -- internals ----------------------------------------------------
+
+    def _resolve_tenant(self, name: str) -> _Tenant | None:
+        if self._default_tenant is not None:
+            return self._default_tenant
+        return self._tenants.get(name)
+
+    def _forget(self, conn: _Conn) -> None:
+        with self._lock:
+            self._conns.discard(conn)
+            self._g_open.set(len(self._conns))
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                fire("edge.accept")
+                sock, addr = self._listener.accept()
+            except OSError:
+                # fallback-ok: close() shut the listener down, or a
+                # transient accept failure — the loop survives the
+                # latter and exits on the former.
+                if self._closing:
+                    return
+                self._c_accept_errors.inc()
+                continue
+            except Exception:  # fallback-ok: an armed edge.accept
+                # fault models EMFILE-style accept errors; count and
+                # keep accepting — live connections are untouched.
+                self._c_accept_errors.inc()
+                continue
+            conn = None
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
+                                1)
+                if self.read_timeout_s:
+                    # The slow-loris bound: a recv blocking past this
+                    # dies as a per-connection OSError (counted,
+                    # contained) — a half-sent frame cannot pin a
+                    # reader thread and its frame buffer forever.
+                    sock.settimeout(self.read_timeout_s)
+                conn = _Conn(self, sock, f"{addr[0]}:{addr[1]}")
+                with self._lock:
+                    if self._closing:
+                        sock.close()
+                        return
+                    self._conns.add(conn)
+                self._c_connections.inc()
+                self._g_open.set(len(self._conns))
+                conn.start()
+            except Exception:  # fallback-ok: a peer that reset before
+                # setup, or thread/fd pressure at conn.start() — one
+                # bad accepted socket is a per-connection failure, and
+                # the accept loop must outlive it ('never a dead
+                # accept loop', same contract the edge.accept seam
+                # pins).
+                self._c_accept_errors.inc()
+                if conn is not None:
+                    with self._lock:
+                        self._conns.discard(conn)
+                        self._g_open.set(len(self._conns))
+                try:
+                    sock.close()
+                except OSError:
+                    pass  # already gone
+
+
+# ------------------------------------------------------ the client
+
+
+def _raise_wire(code: int, retry_after_s: float | None, msg: str):
+    cls = WIRE_CODES.get(code, DcfError)
+    if cls is QueueFullError:
+        return cls(msg, retry_after_s=retry_after_s,
+                   evicted=code == E_EVICTED)
+    if cls is CircuitOpenError:
+        return cls(msg, retry_after_s=retry_after_s)
+    if cls is ValueError:
+        # api-edge: the server flagged a request-contract violation
+        # (unknown key/tenant, bad party) — builtin semantics, exactly
+        # what the in-process call site would have raised.
+        return ValueError(msg)
+    return cls(msg)
+
+
+class EdgeClient:
+    """A pipelining DCFE client: ``submit`` returns a ``ServeFuture``
+    completed by a reader thread matching ``req_id``s, so one
+    connection can carry many requests in flight (the open-loop
+    loadgen's shape) or be driven closed-loop (submit -> result).
+    Typed failures arrive as the real ``dcf_tpu.errors`` classes, with
+    ``retry_after_s`` re-attached where the taxonomy carries one.
+
+    Not a pool: one instance = one TCP connection.  ``n_bytes`` is the
+    service's point width (the client cannot discover it over the
+    wire; passing the wrong one is refused typed by the server).
+    """
+
+    def __init__(self, host: str, port: int, *, n_bytes: int,
+                 tenant: str = "", connect_timeout: float = 30.0,
+                 max_frame_bytes: int = 256 << 20):
+        self.n_bytes = int(n_bytes)
+        self.tenant = tenant
+        # Response-frame sanity bound (mirrors the server's request
+        # knob): a SHARE payload is k*m*lam — raise this when a
+        # large-lambda service legitimately returns more than 256 MiB
+        # per response, or an oversized VALID share would tear the
+        # connection down as a framing error.
+        self.max_frame_bytes = int(max_frame_bytes)
+        self._sock = socket.create_connection(
+            (host, port), timeout=connect_timeout)
+        # Blocking from here on: the reader parks in recv between
+        # responses (close() unblocks it); waiting bounds belong to
+        # ``ServeFuture.result(timeout)``, not the transport.
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()       # id/pending/closed state
+        self._send_lock = threading.Lock()  # frame writes stay whole
+        self._pending: dict[int, ServeFuture] = {}
+        self._next_id = 1
+        self._closed = False
+        self._reader = threading.Thread(
+            target=self._read_loop, name="edge-client-read",
+            daemon=True)
+        self._reader.start()
+
+    # -- submission ---------------------------------------------------
+
+    def submit(self, key_id: str, xs, b: int = 0,
+               deadline_ms: float | None = None,
+               priority=None) -> ServeFuture:
+        """Wire twin of ``DcfService.submit`` (``priority=None`` =
+        the tenant's class).  Thread-safe."""
+        xs = np.ascontiguousarray(np.asarray(xs, dtype=np.uint8))
+        if xs.ndim != 2 or xs.shape[1] != self.n_bytes:
+            raise ShapeError(
+                f"xs must be [M, {self.n_bytes}], got {xs.shape}")
+        if xs.shape[0] < 1:
+            raise ShapeError("cannot submit an empty request")
+        pri = _PRI_DEFAULT if priority is None \
+            else parse_priority(priority).value
+        fut = ServeFuture()
+        with self._lock:
+            if self._closed:
+                raise BackendUnavailableError(
+                    "edge connection is closed")
+            req_id = self._next_id
+            self._next_id += 1
+        # Encode BEFORE registering: an encoding failure (e.g. a
+        # key_id over the 255-byte field) must not leave an orphaned
+        # never-completed future in _pending for the connection's
+        # lifetime.  The burned req_id is harmless.
+        frame = encode_request(req_id, self.tenant, key_id, b, pri,
+                               deadline_ms, xs.data, self.n_bytes,
+                               xs.shape[0])
+        with self._lock:
+            if self._closed:
+                raise BackendUnavailableError(
+                    "edge connection is closed")
+            self._pending[req_id] = fut
+        try:
+            with self._send_lock:
+                self._sock.sendall(frame)
+        except OSError as e:
+            # A failed send means the TRANSPORT is gone, not just this
+            # request: mark the connection closed and fail every
+            # pending future typed, or a pooled caller would keep
+            # retrying a dead connection forever (``closed`` stays the
+            # reliable reconnect signal).
+            err = BackendUnavailableError(
+                f"edge connection lost on send: {e}")
+            self._fail_pending(err)
+            raise err from e
+        return fut
+
+    def evaluate(self, key_id: str, xs, b: int = 0,
+                 deadline_ms: float | None = None,
+                 timeout: float | None = None,
+                 priority=None) -> np.ndarray:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(key_id, xs, b, deadline_ms,
+                           priority).result(timeout)
+
+    # -- the reader ---------------------------------------------------
+
+    def _recv_into(self, view: memoryview) -> int:
+        got = 0
+        while got < len(view):
+            n = self._sock.recv_into(view[got:], len(view) - got)
+            if n == 0:
+                return got
+            got += n
+        return got
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                prefix = bytearray(_PREFIX.size)
+                if self._recv_into(memoryview(prefix)) < _PREFIX.size:
+                    break  # server hung up
+                (body_len,) = _PREFIX.unpack(prefix)
+                if not _BODY_MIN + _CRC.size <= body_len \
+                        <= self.max_frame_bytes:
+                    raise KeyFormatError(
+                        f"length prefix {body_len} is not a frame "
+                        f"(bound {self.max_frame_bytes})")
+                body = bytearray(body_len)
+                if self._recv_into(memoryview(body)) < body_len:
+                    break  # mid-frame EOF: fail pending below
+                kind, req_id, *rest = decode_response(body)
+                fut = self._pending.pop(req_id, None)
+                if kind == "share":
+                    if fut is not None:
+                        fut.set_result(rest[0])
+                elif fut is not None:
+                    code, retry, msg = rest
+                    fut.set_exception(_raise_wire(code, retry, msg))
+                elif req_id == 0:
+                    # A connection-level error frame: the server is
+                    # about to hang up; every pending request dies
+                    # with the typed cause.
+                    code, retry, msg = rest
+                    self._fail_pending(_raise_wire(code, retry, msg))
+        except Exception as e:  # fallback-ok: the reader must fail
+            # every pending future on ANY teardown (socket error,
+            # mangled frame) instead of leaving waiters hanging.
+            self._fail_pending(BackendUnavailableError(
+                f"edge connection lost: {type(e).__name__}: {e}"))
+            return
+        finally:
+            self._fail_pending(BackendUnavailableError(
+                "edge connection closed"))
+
+    @property
+    def closed(self) -> bool:
+        """True once the connection is dead (peer/server hung up, a
+        wire error, or ``close()``): pending futures have been failed
+        typed and further ``submit`` calls raise.  The reconnect
+        signal for pooled clients — a request-level typed failure
+        (deadline, shed, breaker) leaves the connection OPEN and this
+        False."""
+        return self._closed
+
+    def _fail_pending(self, error: BaseException) -> None:
+        with self._lock:
+            self._closed = True
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(error)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # already gone
+        self._sock.close()
+        self._reader.join(5.0)
+
+    def __enter__(self) -> "EdgeClient":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -1,10 +1,24 @@
-"""Closed-loop load generator for the serving layer (``serve_bench``).
+"""Load generators for the serving layer (``serve_bench`` /
+``edge_bench``): closed-loop for throughput, open-loop for latency.
 
 Closed-loop means each client thread keeps exactly one request in
 flight: submit -> wait -> submit.  Offered load therefore tracks service
 capacity instead of running away from it, which makes the headline
 number a genuine sustainable throughput (an open-loop generator against
 a saturated service measures its own queue, not the server).
+
+``open_loop`` (ISSUE 12) is the complement the EDGE latency quantiles
+need: arrivals are a seeded Poisson process at a FIXED offered rate,
+independent of completions.  A closed-loop client that gets stuck
+behind a queue simply stops offering load — the classic *coordinated
+omission*: the latencies it records are exactly the ones the queueing
+delay did not inflate.  The open-loop generator keeps submitting on
+schedule and measures each request's latency from its SCHEDULED
+arrival time, so queueing delay (and shed/expired outcomes) land in
+the numbers instead of disappearing from them.  Refusals record their
+typed ``retry_after_s`` hints (``hinted`` per class), and the result's
+``sent/ok/shed/expired/failed`` counts reconcile against the service
+metrics exactly like ``by_class`` does in the chaos harness.
 
 Clients pick key ids from a seeded RNG over the registered set —
 uniformly by default, or Zipf-weighted with ``skew`` > 0 (``key_ids``
@@ -36,7 +50,7 @@ from dcf_tpu.serve.admission import parse_priority
 from dcf_tpu.utils.benchtime import monotonic
 
 __all__ = ["LoadgenResult", "closed_loop", "ChurnResult",
-           "session_churn"]
+           "session_churn", "OpenLoopResult", "open_loop"]
 
 
 @dataclass
@@ -70,13 +84,21 @@ class LoadgenResult:
         return _quantiles(self.latencies_s, "")
 
 
+def _n_bytes_of(target) -> int:
+    """The point width of any submit target: a ``DcfService`` (via its
+    facade) or an ``EdgeClient`` (which carries ``n_bytes`` itself —
+    the wire client cannot reach through the socket)."""
+    nb = getattr(target, "n_bytes", None)
+    return int(nb) if nb is not None else int(target._dcf.n_bytes)
+
+
 def _client(service, key_ids, stop: threading.Event, res: LoadgenResult,
             lock: threading.Lock, rng: np.random.Generator,
             min_points: int, max_points: int, b: int, clock,
             priorities, weights, key_probs) -> None:
     from dcf_tpu.errors import QueueFullError
 
-    nb = service._dcf.n_bytes
+    nb = _n_bytes_of(service)
     while not stop.is_set():
         m = int(rng.integers(min_points, max_points + 1))
         if key_probs is None:
@@ -234,11 +256,237 @@ def session_churn(service, *, pool: str, duration_s: float,
     return res
 
 
+@dataclass
+class OpenLoopResult:
+    """One open-loop (Poisson-arrival) run (ISSUE 12).
+
+    ``sent`` counts submits the service ACCEPTED (they reached the
+    queue); ``shed`` counts typed refusals at submit (``shed_hinted``
+    of which carried a ``retry_after_s``); accepted requests complete
+    as ``ok`` / ``expired`` (``DeadlineExceededError``) / ``failed``
+    (``failed`` also absorbs any non-shed submit-time error, so every
+    arrival lands in exactly one bucket:
+    ``attempts == shed + ok + expired + failed`` after the drain).
+    The counts reconcile against the service's
+    ``serve_requests_total`` / ``serve_shed_total`` /
+    ``serve_deadline_expired_total`` — the same both-sides-of-the-door
+    discipline as ``by_class``.
+
+    ``latencies_s`` measure from each request's SCHEDULED arrival to
+    completion — the anti-coordinated-omission definition: a request
+    delayed by the queue (or by the generator catching up after a
+    stall) is charged that delay, so ``p99`` reflects what an
+    independent caller would have seen at this offered rate."""
+
+    duration_s: float
+    offered_rps: float
+    sent: int = 0
+    shed: int = 0
+    shed_hinted: int = 0
+    ok: int = 0
+    expired: int = 0
+    failed: int = 0
+    points_ok: int = 0
+    latencies_s: list = field(default_factory=list)
+    by_class: dict = field(default_factory=dict)
+
+    def _count(self, priority: str, outcome: str) -> None:
+        cls = self.by_class.setdefault(
+            priority, {"ok": 0, "shed": 0, "expired": 0, "failed": 0})
+        cls[outcome] += 1
+
+    @property
+    def attempts(self) -> int:
+        """Every scheduled arrival (exact after the drain: each lands
+        in exactly one of shed/ok/expired/failed)."""
+        return self.shed + self.ok + self.expired + self.failed
+
+    @property
+    def throughput(self) -> float:
+        """Completed evals/s: points of OK requests per second."""
+        return self.points_ok / self.duration_s if self.duration_s \
+            else 0.0
+
+    def latency_quantiles(self) -> dict:
+        return _quantiles(self.latencies_s, "")
+
+
+def _open_collector(out_q, res: OpenLoopResult, lock: threading.Lock,
+                    clock) -> None:
+    from dcf_tpu.errors import DeadlineExceededError, QueueFullError
+
+    while True:
+        item = out_q.get()
+        if item is None:
+            return
+        fut, t_sched, m, pr = item
+        try:
+            fut.result()
+        except QueueFullError as e:
+            # Refusals delivered through the future are sheds.  Two
+            # flavors: the WIRE path's submit-time refusal (the server
+            # shed BEFORE acceptance, after the local submit already
+            # succeeded) retracts the ``sent`` — "sent" must mean "the
+            # SERVICE accepted it" on both paths or the
+            # serve_requests_total reconciliation breaks — while an
+            # EVICTION (``e.evicted``) was accepted and counted before
+            # losing its room, so its ``sent`` stands.
+            with lock:
+                if not getattr(e, "evicted", False):
+                    res.sent -= 1
+                res.shed += 1
+                if getattr(e, "retry_after_s", None) is not None:
+                    res.shed_hinted += 1
+                res._count(pr, "shed")
+            continue
+        except DeadlineExceededError:
+            with lock:
+                res.expired += 1
+                res._count(pr, "expired")
+            continue
+        except Exception:  # fallback-ok: a collector must survive ANY
+            # delivered failure (typed DcfErrors and the raw backend
+            # exception a retries-exhausted batch passes through) —
+            # a dead collector would wedge the drain.
+            with lock:
+                res.failed += 1
+                res._count(pr, "failed")
+            continue
+        dt = clock() - t_sched
+        with lock:
+            res.ok += 1
+            res.points_ok += m
+            res.latencies_s.append(max(dt, 0.0))
+            res._count(pr, "ok")
+
+
+def open_loop(service, key_ids, *, rate_rps: float, duration_s: float,
+              min_points: int, max_points: int, seed: int = 2026,
+              party: int = 0, clock=monotonic,
+              priority_mix: dict | None = None, skew: float = 0.0,
+              deadline_ms: float | None = None,
+              collectors: int = 4) -> OpenLoopResult:
+    """Offer ``rate_rps`` requests/s of Poisson arrivals to ``service``
+    (a ``DcfService`` or an ``EdgeClient`` — anything with ``submit``)
+    for ``duration_s`` seconds, independent of completions, and return
+    the ``OpenLoopResult``.  The service must be started.
+
+    Arrivals are a seeded renewal process: inter-arrival gaps are
+    exponential draws from ONE rng, so the whole arrival schedule (and
+    every per-request key/size/priority draw) replays exactly per
+    seed.  One scheduler thread submits on schedule; ``collectors``
+    threads drain the futures so a slow completion never back-pressures
+    the arrival process (that back-pressure is exactly the closed-loop
+    artifact this mode exists to remove).  The run always DRAINS: every
+    accepted future is collected before returning, however late.
+
+    ``deadline_ms`` is attached to every request — under overload the
+    service converts queue delay into typed ``DeadlineExceededError``
+    expiries, which the result counts separately from failures."""
+    import math
+    import queue as _queue
+
+    if not rate_rps > 0 or not math.isfinite(rate_rps):
+        # api-edge: loadgen config contract at the harness edge
+        raise ValueError(
+            f"rate_rps must be finite and > 0, got {rate_rps}")
+    if min_points < 1 or min_points > max_points:
+        # api-edge: loadgen config contract at the harness edge
+        raise ValueError(
+            f"bad request-size range [{min_points}, {max_points}]")
+    if not math.isfinite(skew) or skew < 0:
+        # api-edge: same contract as closed_loop
+        raise ValueError(f"skew must be finite and >= 0, got {skew}")
+    from dcf_tpu.errors import QueueFullError
+
+    key_ids = list(key_ids)
+    key_probs = None
+    if skew > 0:
+        ranks = np.arange(1, len(key_ids) + 1, dtype=np.float64)
+        w = ranks ** -float(skew)
+        key_probs = w / w.sum()
+    if priority_mix:
+        priorities = sorted(priority_mix)
+        for p in priorities:
+            parse_priority(p)  # typos die here, not per-arrival
+        total = float(sum(priority_mix.values()))
+        if total <= 0 or min(priority_mix.values()) < 0:
+            # api-edge: same contract as closed_loop
+            raise ValueError(
+                f"priority_mix weights must be >= 0 and sum > 0, "
+                f"got {priority_mix}")
+        weights = [priority_mix[p] / total for p in priorities]
+    else:
+        priorities, weights = ["normal"], [1.0]
+
+    nb = _n_bytes_of(service)
+    rng = np.random.default_rng(seed)
+    res = OpenLoopResult(duration_s=0.0, offered_rps=float(rate_rps))
+    lock = threading.Lock()
+    out_q: _queue.Queue = _queue.Queue()
+    pool = [threading.Thread(target=_open_collector,
+                             args=(out_q, res, lock, clock),
+                             name=f"openloop-collect-{i}", daemon=True)
+            for i in range(max(collectors, 1))]
+    for t in pool:
+        t.start()
+    # Purely a wait primitive (never set): the run is NOT cancellable
+    # — the arrival schedule is the load definition and only the
+    # schedule check ends the loop.
+    sleeper = threading.Event()
+    t0 = clock()
+    t_next = t0
+    # The scheduler loops on the clock by design: the arrival SCHEDULE
+    # is the load definition, and latency is measured from it.
+    while True:
+        t_next += float(rng.exponential(1.0 / rate_rps))
+        if t_next - t0 >= duration_s:
+            break
+        wait = t_next - clock()
+        if wait > 0:
+            sleeper.wait(wait)
+        m = int(rng.integers(min_points, max_points + 1))
+        if key_probs is None:
+            key_id = key_ids[int(rng.integers(0, len(key_ids)))]
+        else:
+            key_id = key_ids[int(rng.choice(len(key_ids), p=key_probs))]
+        pr = priorities[int(rng.choice(len(priorities), p=weights))]
+        xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
+        try:
+            fut = service.submit(key_id, xs, b=party,
+                                 deadline_ms=deadline_ms, priority=pr)
+        except QueueFullError as e:
+            with lock:
+                res.shed += 1
+                if getattr(e, "retry_after_s", None) is not None:
+                    res.shed_hinted += 1
+                res._count(pr, "shed")
+            continue
+        except Exception:  # fallback-ok: the scheduler must survive
+            # ANY submit-time failure (e.g. a hot-swapped key) — a
+            # dead scheduler silently truncates the offered load.
+            with lock:
+                res.failed += 1
+                res._count(pr, "failed")
+            continue
+        with lock:
+            res.sent += 1
+        out_q.put((fut, t_next, m, pr))
+    # Drain: every accepted future completes (the service's contract),
+    # so the collectors empty the queue and exit on their sentinels.
+    for _ in pool:
+        out_q.put(None)
+    for t in pool:
+        t.join()
+    res.duration_s = clock() - t0
+    return res
+
+
 def closed_loop(service, key_ids, *, duration_s: float, concurrency: int,
                 min_points: int, max_points: int, seed: int = 2026,
                 party: int = 0, clock=monotonic,
                 priority_mix: dict | None = None,
-                skew: float = 0.0) -> LoadgenResult:
+                skew: float = 0.0, clients=None) -> LoadgenResult:
     """Drive ``service`` with ``concurrency`` closed-loop clients for
     ``duration_s`` seconds of wall time; returns the aggregated result.
     The service must be started (worker thread running).
@@ -251,7 +499,12 @@ def closed_loop(service, key_ids, *, duration_s: float, concurrency: int,
     s > 0 weights rank r (the r-th entry of ``key_ids``) by 1/r^s,
     normalized.  Must be finite and >= 0 (the CLI benches validate the
     ``--skew`` flag before spending warmup time; this is the API-edge
-    backstop)."""
+    backstop).
+
+    ``clients`` (ISSUE 12): one submit target PER THREAD — the wire
+    mode.  ``edge_bench`` passes a list of ``concurrency`` connected
+    ``EdgeClient``s so each closed-loop client drives its own TCP
+    connection (the in-process default shares the one ``service``)."""
     import math
 
     if not math.isfinite(skew) or skew < 0:
@@ -283,13 +536,20 @@ def closed_loop(service, key_ids, *, duration_s: float, concurrency: int,
         weights = [priority_mix[p] / total for p in priorities]
     else:
         priorities, weights = ["normal"], [1.0]
+    if clients is not None and len(clients) != concurrency:
+        # api-edge: loadgen config contract at the harness edge — a
+        # short list would silently drop offered load
+        raise ValueError(
+            f"clients must hold one target per thread "
+            f"({concurrency}), got {len(clients)}")
     res = LoadgenResult(duration_s=0.0)
     lock = threading.Lock()
     stop = threading.Event()
     threads = [
         threading.Thread(
             target=_client,
-            args=(service, list(key_ids), stop, res, lock,
+            args=(clients[i] if clients is not None else service,
+                  list(key_ids), stop, res, lock,
                   np.random.default_rng(seed + 7 * i), min_points,
                   max_points, party, clock, priorities, weights,
                   key_probs),

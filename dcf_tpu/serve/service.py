@@ -81,6 +81,14 @@ Load-bearing knobs (``ServeConfig``):
   Declare pools with ``add_pool(PoolSpec(...))`` and mint fresh
   session keys with ``register_key(key_id, pool=...)`` — registration
   then costs a pool pop, not an n-level GGM keygen walk.
+* ``tenants`` — the network edge's tenant table (ISSUE 12,
+  ``serve.edge``): a tuple of ``admission.TenantSpec`` mapping each
+  tenant onto the EXISTING priority classes (a frame may self-demote
+  below its tenant class, never promote above it) and arming a
+  per-tenant points-per-second token bucket on the injectable clock.
+  Only the ``EdgeServer`` consults it; in-process submits are
+  unaffected.  Empty (the default) = the open edge: every tenant
+  serves as NORMAL, unlimited.
 
 Pipelining: within a batch run, host->device staging of batch N+1
 overlaps the (async) device eval of batch N — the worker dispatches
@@ -124,6 +132,7 @@ from dcf_tpu.serve.admission import (
     Priority,
     Request,
     ServeFuture,
+    TenantSpec,
     expire,
     parse_priority,
 )
@@ -131,6 +140,7 @@ from dcf_tpu.serve.breaker import BreakerBoard
 from dcf_tpu.serve.batcher import (
     BatchPlan,
     gather_batch,
+    ingest_points,
     plan_batches,
     scatter_batch,
 )
@@ -164,8 +174,22 @@ class ServeConfig:
     store_dir: str = ""
     batch_timeout_s: float = 0.0
     keyfactory_refill_interval_s: float = 0.05
+    tenants: tuple = ()
 
     def __post_init__(self):
+        for t in self.tenants:
+            if not isinstance(t, TenantSpec):
+                # api-edge: config contract — the tenant table is the
+                # edge's admission policy; a loose dict would let a
+                # typo'd field silently disable a tenant's rate limit
+                raise ValueError(
+                    f"tenants entries must be serve.TenantSpec, got "
+                    f"{type(t).__name__}")
+        names = [t.name for t in self.tenants]
+        if len(set(names)) != len(names):
+            # api-edge: config contract — two specs for one tenant
+            # would make the effective class/rate order-dependent
+            raise ValueError(f"duplicate tenant names in {names}")
         if self.max_batch < 1 or self.max_batch & (self.max_batch - 1):
             raise ShapeError(
                 f"max_batch must be a power of two >= 1, "
@@ -267,8 +291,16 @@ class DcfService:
             device_bytes_budget=self.config.device_bytes_budget,
             metrics=self.metrics, breakers=self.breakers,
             frontier_cache=self.frontier_cache)
-        self.queue = AdmissionQueue(self.config.max_queued_points,
-                                    metrics=self.metrics)
+        # Retry-after hints (ISSUE 12): overload sheds advise ~two
+        # coalescing windows (the soonest a drained batch could have
+        # made room — a heuristic, disclosed as such); brownout
+        # refusals advise brownout_clear_s (the calm the hysteresis
+        # controller needs before BATCH re-admits — the principled
+        # lower bound on "when could this possibly succeed").
+        self.queue = AdmissionQueue(
+            self.config.max_queued_points, metrics=self.metrics,
+            shed_retry_after_s=2 * self.config.max_delay_ms / 1e3,
+            brownout_retry_after_s=self.config.brownout_clear_s)
         # Durable key store (ISSUE 8): the write-through target of
         # register_key(durable=True) and the source restore_keys()
         # re-registers from after a crash.
@@ -500,17 +532,41 @@ class DcfService:
         with ``DeadlineExceededError``.  ``priority`` — CRITICAL /
         NORMAL (default) / BATCH — decides who is shed under overload
         and brownout, never dispatch order (``serve.admission``).
-        Raises ``QueueFullError`` when shed.  Thread-safe."""
-        if b not in (0, 1):
-            # api-edge: party index contract at the serve edge
-            raise ValueError(f"party b must be 0 or 1, got {b}")
-        priority = parse_priority(priority)
+        Raises ``QueueFullError`` when shed.  Thread-safe.
+
+        Normalizes ``xs`` and routes through :meth:`submit_bytes` —
+        the batcher has exactly ONE feed (``batcher.ingest_points``),
+        shared with the network edge's wire path (ISSUE 12)."""
         xs = np.ascontiguousarray(np.asarray(xs, dtype=np.uint8))
         if xs.ndim != 2 or xs.shape[1] != self._dcf.n_bytes:
             raise ShapeError(
                 f"xs must be [M, {self._dcf.n_bytes}], got {xs.shape}")
         if xs.shape[0] < 1:
             raise ShapeError("cannot submit an empty request")
+        return self.submit_bytes(key_id, xs.data, b=b,
+                                 deadline_ms=deadline_ms,
+                                 priority=priority)
+
+    def submit_bytes(self, key_id: str, data, b: int = 0,
+                     deadline_ms: float | None = None,
+                     priority: Priority | str = Priority.NORMAL
+                     ) -> ServeFuture:
+        """Submit packed point BYTES for one registered key (ISSUE 12).
+
+        ``data``: any buffer-protocol object holding M >= 1 points of
+        ``n_bytes`` each, back to back — the network edge hands the
+        received frame's payload ``memoryview`` straight here, and
+        ``submit`` hands its normalized ndarray's buffer, so EVERY
+        request reaches the batcher through ``batcher.ingest_points``
+        (zero copies, zero per-point Python objects; the first copy of
+        wire bytes is the span gather into the padded device batch).
+        The caller must not mutate ``data`` until the future completes.
+        Same admission/deadline/priority semantics as ``submit``."""
+        if b not in (0, 1):
+            # api-edge: party index contract at the serve edge
+            raise ValueError(f"party b must be 0 or 1, got {b}")
+        priority = parse_priority(priority)
+        xs = ingest_points(data, self._dcf.n_bytes)
         self.registry.bundle(key_id)  # unknown key_id fails at submit
         now = self._clock()
         self._update_brownout(now)  # the gate reflects current pressure
@@ -700,7 +756,8 @@ class DcfService:
             raise CircuitOpenError(
                 f"circuit breaker open for key {key_id!r} on backend "
                 f"family {fam!r}: failing fast until the cooldown's "
-                "half-open probe succeeds")
+                "half-open probe succeeds",
+                retry_after_s=self.breakers.retry_after(key_id, fam))
         try:
             return self._serve_group_batches(group, key_id, b)
         except BaseException:  # fallback-ok: re-raised below — this

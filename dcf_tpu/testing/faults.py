@@ -125,6 +125,23 @@ POINTS = (
     #                     factory's per-pool breaker, and claims serve
     #                     from the remaining pool / the counted
     #                     synchronous-mint fallback)
+    "edge.accept",      # network-edge accept loop (serve/edge.py —
+    #                     fires before each accept(); no handler args.
+    #                     A raising handler models a transient accept
+    #                     failure (EMFILE, a dying NIC): the loop must
+    #                     count it (edge_accept_errors_total) and keep
+    #                     accepting — live connections are untouched)
+    "edge.read",        # network-edge connection read (serve/edge.py —
+    #                     fires before each socket recv on a
+    #                     connection; handler args: peer tag, bytes
+    #                     wanted.  A raising handler models a dead/
+    #                     malicious peer: the CONNECTION dies typed,
+    #                     the accept loop and every other connection
+    #                     survive.  ``latency(clock, s)`` here is the
+    #                     slow-client seam: each blocking read advances
+    #                     the injectable clock, so a stalled sender
+    #                     demonstrably trips the existing deadline/
+    #                     watchdog path instead of wedging the worker)
 )
 
 _ACTIVE: dict[str, Callable] = {}

@@ -311,6 +311,48 @@ def test_cli_chaos_bench_rejects_non_facade_backend():
 
 
 @pytest.mark.slow
+@pytest.mark.edge
+def test_cli_edge_bench_smoke(capsys):
+    """ISSUE 12: edge_bench end to end — wire parity vs the C++ core,
+    the single-feed ingest probe, interleaved wire/in-process legs
+    with the >= 0.8 ratio gate, the 8-connection soak under the
+    deterministic edge.read fault (reconnects observed, zero
+    mismatches), a fully-hinted refusal leg, and the open-loop latency
+    leg with its metric reconciliation (the harness raises SystemExit
+    if any gate fails — the CI-soak contract)."""
+    recs = run_cli(
+        capsys,
+        ["edge_bench", "--duration=6", "--max-batch=2048"],
+    )
+    assert recs[0]["bench"] == "edge_bench"
+    assert recs[0]["wire_vs_inprocess"] >= 0.8
+    assert recs[0]["ingest_single_feed"] is True
+    assert recs[0]["soak_mismatches"] == 0
+    assert recs[0]["soak_reconnects"] >= 1
+    assert recs[0]["refusals"] >= 1
+    assert recs[0]["refusals_hinted"] == recs[0]["refusals"]
+    assert recs[0]["open_loop_reconciled"] is True
+    assert "interpret" in recs[0]["unit"] or \
+        recs[0]["platform"] == "tpu"
+
+
+@pytest.mark.edge
+def test_cli_edge_bench_validates_flags_fast():
+    """edge_bench applies the fail-fast flag discipline: a bad backend,
+    connection count or request-size range dies loudly before the
+    bundle gen / warmup ladder spend real time."""
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="edge_bench"):
+        cli.main(["edge_bench", "--backend=sharded"])
+    with pytest.raises(SystemExit, match="connections"):
+        cli.main(["edge_bench", "--connections=0"])
+    with pytest.raises(SystemExit, match="request-size range"):
+        cli.main(["edge_bench", "--max-batch=64",
+                  "--min-req-points=200"])
+
+
+@pytest.mark.slow
 @pytest.mark.durability
 def test_cli_chaos_bench_crash_restart_smoke(capsys, tmp_path):
     """ISSUE 8: chaos_bench --crash-restart end to end — durable keys

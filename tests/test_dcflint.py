@@ -319,6 +319,35 @@ def test_store_layer_lint_clean():
     assert run_path(REPO / "dcf_tpu" / "serve" / "store.py") == []
 
 
+def test_edge_layer_lint_clean():
+    """The ISSUE-12 CI satellite: the network edge —
+    ``serve/edge.py`` (wire codecs, EdgeServer/EdgeClient, the tenant
+    token buckets) — sweeps clean under ALL six passes.
+    Secret-hygiene and determinism are the load-bearing ones: wire
+    buffers hold evaluated SHARE bytes on their way to a party (the
+    name set knows ``share*`` for exactly this layer), and every piece
+    of admission math (buckets, deadlines) runs on the injectable
+    clock, never ``time.*``."""
+    assert run_path(REPO / "dcf_tpu" / "serve" / "edge.py") == []
+
+
+def test_secret_hygiene_covers_share_buffers(tmp_path):
+    """ISSUE 12: ``share*`` joined the key-material name set — a
+    logged share next to the other party's reconstructs the function
+    value, so edge-shaped code printing or metric-labelling a share
+    buffer is flagged like a seed leak."""
+    write(tmp_path, "serve/edgey.py", (
+        "def respond(req_id, share_bytes, shares, m, shared):\n"
+        "    log(f'sending {share_bytes}')\n"          # name leak
+        "    counter.inc(len(shares))\n"               # metric sink
+        "    counter.inc(m)\n"                         # scalar: fine
+        "    log(f'state {shared}')\n"))  # 'shared' state: NOT a secret
+    got = [v for v in run_path(tmp_path, ["secret-hygiene"])
+           if v.path.endswith("edgey.py")]
+    assert [v.line for v in got] == [2, 3]
+    assert "share_bytes" in got[0].message
+
+
 def test_determinism_detects_and_exempts(tmp_path):
     bad = ("import time, random\n"
            "import numpy as np\n"

@@ -1,0 +1,877 @@
+"""dcf_tpu.serve.edge: the zero-copy DCFK wire path (ISSUE 12).
+
+Covers the acceptance contract — wire-path two-party reconstruction
+bit-exact vs the numpy oracle, the bytes-ingest entry as the ONLY
+batcher feed (zero per-point Python objects on ingest), tenant->class
+mapping with the per-tenant token bucket, typed retry-after hints on
+every refusal class — plus the wire-frame fuzz (seeded byte flips,
+truncations, oversized length prefixes, mid-frame disconnects all die
+as typed PER-CONNECTION errors that never kill the accept loop or
+another tenant's connection), the ``edge.accept``/``edge.read`` fault
+seams, the slow-client walk on the fake clock (a stalled sender trips
+the existing deadline path instead of wedging the worker), and the
+open-loop (Poisson) loadgen mode with its metric reconciliation.  The
+8-connection soak under injected read faults rides the serial slow
+leg.
+"""
+
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from dcf_tpu import Dcf
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.errors import (
+    CircuitOpenError,
+    QueueFullError,
+    ShapeError,
+)
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.serve import DcfService, ServeConfig, TenantSpec
+from dcf_tpu.serve.batcher import ingest_points
+from dcf_tpu.serve.edge import (
+    E_DEADLINE,
+    E_RATE_LIMITED,
+    E_WIRE,
+    EdgeClient,
+    EdgeServer,
+    T_ERROR,
+    T_SHARE,
+    TokenBucket,
+    decode_response,
+    encode_request,
+)
+from dcf_tpu.testing import faults
+from dcf_tpu.testing.faults import FakeClock
+
+pytestmark = pytest.mark.edge
+
+NB, LAM = 2, 16
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0xED6E)
+
+
+@pytest.fixture(scope="module")
+def ck(rng):
+    return [rng.bytes(32), rng.bytes(32)]
+
+
+@pytest.fixture(scope="module")
+def dcf(ck):
+    return Dcf(NB, LAM, ck, backend="bitsliced")
+
+
+@pytest.fixture(scope="module")
+def prg(ck):
+    return HirosePrgNp(LAM, ck)
+
+
+@pytest.fixture(scope="module")
+def bundles(dcf, rng):
+    out = {}
+    for name, k in (("edge-a", 1), ("edge-b", 1)):
+        alphas = rng.integers(0, 256, (k, NB), dtype=np.uint8)
+        betas = rng.integers(0, 256, (k, LAM), dtype=np.uint8)
+        out[name] = dcf.gen(alphas, betas, rng=rng)
+    return out
+
+
+def oracle(prg, bundle, b, xs):
+    return eval_batch_np(prg, b, bundle.for_party(b), xs)
+
+
+def recon_oracle(prg, bundle, xs):
+    return oracle(prg, bundle, 0, xs) ^ oracle(prg, bundle, 1, xs)
+
+
+def make_service(dcf, bundles, **knobs):
+    knobs.setdefault("max_batch", 32)
+    knobs.setdefault("max_delay_ms", 1.0)
+    svc = dcf.serve(**knobs)
+    for name, bundle in bundles.items():
+        svc.register_key(name, bundle)
+    return svc
+
+
+def started_edge(dcf, bundles, **knobs):
+    svc = make_service(dcf, bundles, **knobs)
+    svc.start()
+    server = EdgeServer(svc).start()
+    return svc, server
+
+
+def _read_frames(sock) -> list:
+    """Drain one raw socket to EOF and strictly decode every response
+    frame on it.  A reset counts as EOF: the server hanging up on a
+    mangled frame (with our unread bytes still in its receive buffer)
+    surfaces as RST — the typed-containment outcome, not a failure."""
+    data = b""
+    while True:
+        try:
+            chunk = sock.recv(1 << 16)
+        except ConnectionResetError:
+            break
+        if not chunk:
+            break
+        data += chunk
+    frames = []
+    off = 0
+    while off < len(data):
+        (body_len,) = struct.unpack_from("<I", data, off)
+        body = data[off + 4:off + 4 + body_len]
+        frames.append(decode_response(body))
+        off += 4 + body_len
+    return frames
+
+
+# --------------------------------------------------------- acceptance
+
+
+def test_wire_roundtrip_parity_vs_oracle(dcf, bundles, prg, rng):
+    """Ragged requests, both parties, through a real TCP connection:
+    every reconstruction bit-exact vs the numpy oracle."""
+    svc, server = started_edge(dcf, bundles)
+    try:
+        with EdgeClient(*server.address, n_bytes=NB) as c:
+            for i in range(6):
+                name = sorted(bundles)[i % 2]
+                m = int(rng.integers(1, 40)) if i != 3 else 1
+                xs = rng.integers(0, 256, (m, NB), dtype=np.uint8)
+                y0 = c.evaluate(name, xs, b=0, timeout=60)
+                y1 = c.evaluate(name, xs, b=1, timeout=60)
+                assert np.array_equal(
+                    y0 ^ y1, recon_oracle(prg, bundles[name], xs)), name
+    finally:
+        server.close()
+        svc.close()
+
+
+def test_ingest_points_zero_copy_contract(rng):
+    """The bytes-ingest entry aliases the caller's buffer — no copy,
+    no per-point objects — and enforces the geometry strictly."""
+    buf = bytearray(rng.integers(0, 256, 12, dtype=np.uint8).tobytes())
+    arr = ingest_points(buf, 3)  # m derived: 12 / 3
+    assert arr.shape == (4, 3) and arr.dtype == np.uint8
+    assert np.shares_memory(arr, np.frombuffer(buf, dtype=np.uint8))
+    buf[0] ^= 0xFF  # a view sees the mutation; a copy would not
+    assert arr[0, 0] == buf[0]
+    assert ingest_points(memoryview(buf), 3, m=4).shape == (4, 3)
+    with pytest.raises(ShapeError):
+        ingest_points(buf, 5)  # 12 % 5 != 0
+    with pytest.raises(ShapeError):
+        ingest_points(buf, 3, m=5)  # wrong m
+    with pytest.raises(ShapeError):
+        ingest_points(b"", 3)  # empty
+    with pytest.raises(ShapeError):
+        ingest_points(buf, 0)
+
+
+def test_ingest_entry_is_the_only_batcher_feed(dcf, bundles, prg, rng,
+                                               monkeypatch):
+    """Both ingest paths — in-process ``submit`` and the wire path —
+    route every request through ``batcher.ingest_points`` exactly
+    once, and the array each request evaluates is a VIEW of the
+    ingested buffer (the zero-per-point-object claim, asserted at the
+    single feed)."""
+    import dcf_tpu.serve.service as service_mod
+
+    calls = []
+    real = service_mod.ingest_points
+
+    def counting(data, n_bytes, m=None):
+        out = real(data, n_bytes, m)
+        assert out.base is not None  # a view, never a fresh copy
+        calls.append(out.shape[0])
+        return out
+
+    monkeypatch.setattr(service_mod, "ingest_points", counting)
+    svc, server = started_edge(dcf, bundles)
+    try:
+        xs = rng.integers(0, 256, (9, NB), dtype=np.uint8)
+        y_in = svc.evaluate("edge-a", xs, timeout=60)
+        with EdgeClient(*server.address, n_bytes=NB) as c:
+            y_wire = c.evaluate("edge-a", xs, timeout=60)
+        assert calls == [9, 9]  # one ingest per request, either path
+        assert np.array_equal(y_in, y_wire)
+        assert np.array_equal(y_in, oracle(prg, bundles["edge-a"], 0,
+                                           xs))
+    finally:
+        server.close()
+        svc.close()
+
+
+# ------------------------------------------------- tenants + buckets
+
+
+def test_token_bucket_exact_refill_schedule():
+    clk = FakeClock(100.0)
+    tb = TokenBucket(10.0, 20, clk())
+    assert tb.admit(20, clk()) == 0.0  # the burst drains
+    retry = tb.admit(5, clk())
+    assert retry == pytest.approx(0.5)  # 5 tokens at 10/s
+    clk.advance(0.5)
+    assert tb.admit(5, clk()) == 0.0  # the hint was exact
+    # a request above capacity can never pass — the hint is the
+    # (unreachable) time-to-points, always positive
+    retry = tb.admit(100, clk())
+    assert retry == pytest.approx(10.0)
+    # ... INCLUDING against a FULL bucket: clamping the hint at
+    # capacity would return 0.0 here, which the edge reads as
+    # "admitted" — a zero-token rate-limit bypass for any oversized
+    # request (and the tokens must stay untouched by the refusal)
+    full = TokenBucket(10.0, 20, clk())
+    assert full.admit(1000, clk()) == pytest.approx(98.0)
+    assert full.admit(20, clk()) == 0.0  # nothing was drained
+    assert TokenBucket(0.0, 0, clk()).admit(10 ** 9, clk()) == 0.0
+
+
+def test_tenant_classes_and_rate_limit_hints(dcf, bundles, rng):
+    """The tenant table maps onto the EXISTING classes: a bronze
+    (BATCH) tenant is brownout-refused where silver (NORMAL) serves;
+    a request can self-demote but never self-promote above its tenant
+    class; bucket refusals carry the exact time-to-refill."""
+    svc, server = started_edge(
+        dcf, bundles,
+        tenants=(TenantSpec("gold", "critical"),
+                 TenantSpec("silver", "normal"),
+                 TenantSpec("bronze", "batch"),
+                 TenantSpec("capped", "normal", points_per_sec=50.0,
+                            burst_points=8)))
+    try:
+        xs = rng.integers(0, 256, (8, NB), dtype=np.uint8)
+        host, port = server.address
+        with EdgeClient(host, port, n_bytes=NB, tenant="capped") as c:
+            assert c.evaluate("edge-a", xs, timeout=60).shape == \
+                (1, 8, LAM)
+            with pytest.raises(QueueFullError) as ei:  # bucket empty
+                c.evaluate("edge-a", xs, timeout=60)
+            assert ei.value.retry_after_s == pytest.approx(8 / 50.0,
+                                                           rel=0.5)
+        # Brownout: BATCH refused at the door; the tenant class — not
+        # the frame's claimed priority — decides.
+        svc.queue.set_brownout(True)
+        with EdgeClient(host, port, n_bytes=NB, tenant="gold") as gold, \
+                EdgeClient(host, port, n_bytes=NB,
+                           tenant="silver") as silver, \
+                EdgeClient(host, port, n_bytes=NB,
+                           tenant="bronze") as bronze:
+            assert silver.evaluate("edge-a", xs,
+                                   timeout=60).shape == (1, 8, LAM)
+            with pytest.raises(QueueFullError) as ei:
+                # self-promotion is capped at the tenant class: the
+                # frame claims CRITICAL, the bronze table row says
+                # BATCH, brownout refuses BATCH
+                bronze.evaluate("edge-a", xs, timeout=60,
+                                priority="critical")
+            assert ei.value.retry_after_s == pytest.approx(
+                svc.config.brownout_clear_s)
+            with pytest.raises(QueueFullError):
+                # self-DEMOTION works: gold may mark its own traffic
+                # BATCH and eat the brownout refusal
+                gold.evaluate("edge-a", xs, timeout=60,
+                              priority="batch")
+            assert gold.evaluate("edge-a", xs,
+                                 timeout=60).shape == (1, 8, LAM)
+        svc.queue.set_brownout(False)
+        snap = svc.metrics_snapshot()
+        assert snap["edge_tenant_refusals_total{tenant=capped}"] == 1
+        assert snap["edge_tenant_refusals_total{tenant=bronze}"] == 0
+        assert snap["edge_tenant_requests_total{tenant=silver}"] == 1
+    finally:
+        server.close()
+        svc.close()
+
+
+def test_unknown_tenant_refused_typed(dcf, bundles, rng):
+    svc, server = started_edge(
+        dcf, bundles, tenants=(TenantSpec("gold", "critical"),))
+    try:
+        xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+        host, port = server.address
+        with EdgeClient(host, port, n_bytes=NB, tenant="nobody") as c:
+            with pytest.raises(ValueError, match="unknown tenant"):
+                c.evaluate("edge-a", xs, timeout=60)
+        # the refusal was request-level: the accept loop still serves
+        with EdgeClient(host, port, n_bytes=NB, tenant="gold") as c:
+            assert c.evaluate("edge-a", xs, timeout=60).shape == \
+                (1, 4, LAM)
+    finally:
+        server.close()
+        svc.close()
+
+
+# --------------------------------------------------- retry-after (in-process)
+
+
+def test_circuit_open_carries_cooldown_retry_after(dcf, bundles, rng):
+    """An open breaker's CircuitOpenError carries the REMAINING
+    cooldown, ticking down on the injectable clock."""
+    clk = FakeClock(50.0)
+    svc = DcfService(dcf, ServeConfig(
+        max_batch=32, retries=0, breaker_failures=1,
+        breaker_cooldown_s=4.0), clock=clk)
+    for name, bundle in bundles.items():
+        svc.register_key(name, bundle)
+    xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+    with faults.inject("serve.eval"):
+        fut = svc.submit("edge-a", xs)
+        svc.pump()
+    with pytest.raises(faults.InjectedFault):
+        fut.result(1)
+    fut = svc.submit("edge-a", xs)
+    svc.pump()
+    with pytest.raises(CircuitOpenError) as ei:
+        fut.result(1)
+    assert ei.value.retry_after_s == pytest.approx(4.0)
+    clk.advance(1.5)
+    fut = svc.submit("edge-a", xs)
+    svc.pump()
+    with pytest.raises(CircuitOpenError) as ei:
+        fut.result(1)
+    assert ei.value.retry_after_s == pytest.approx(2.5)
+    assert svc.breakers.retry_after("edge-a",
+                                    dcf.backend_name) == \
+        pytest.approx(2.5)
+    assert svc.breakers.retry_after("edge-b", dcf.backend_name) is None
+    svc.close(drain=False)
+
+
+def test_overload_and_brownout_retry_after(dcf, bundles, rng):
+    """Queue-full sheds advise ~two coalescing windows; brownout
+    refusals advise brownout_clear_s; draining advises nothing."""
+    svc = make_service(dcf, bundles, max_queued_points=8,
+                       max_delay_ms=3.0, brownout_clear_s=2.5)
+    xs = rng.integers(0, 256, (6, NB), dtype=np.uint8)
+    svc.submit("edge-a", xs)
+    with pytest.raises(QueueFullError) as ei:  # 6 + 6 > 8
+        svc.submit("edge-a", xs)
+    assert ei.value.retry_after_s == pytest.approx(2 * 3.0 / 1e3)
+    svc.queue.set_brownout(True)
+    with pytest.raises(QueueFullError) as ei:
+        svc.submit("edge-a", xs, priority="batch")
+    assert ei.value.retry_after_s == pytest.approx(2.5)
+    svc.queue.set_brownout(False)
+    svc.close()
+    with pytest.raises(QueueFullError) as ei:
+        svc.submit("edge-a", xs)
+    assert ei.value.retry_after_s is None
+
+
+def test_eviction_carries_evicted_flag_across_the_wire(dcf, bundles,
+                                                       rng):
+    """Post-acceptance evictions are marked ``evicted`` (the request
+    WAS counted in serve_requests_total) and the marker survives the
+    wire as its own code — load accounting must not retract a 'sent'
+    for them."""
+    from dcf_tpu.serve.edge import (
+        E_EVICTED,
+        E_QUEUE_FULL,
+        _code_for,
+        _raise_wire,
+    )
+
+    svc = make_service(dcf, bundles, max_queued_points=8)
+    xs = rng.integers(0, 256, (6, NB), dtype=np.uint8)
+    f_batch = svc.submit("edge-a", xs, priority="batch")
+    svc.submit("edge-a", xs, priority="critical")  # evicts the batch
+    with pytest.raises(QueueFullError) as ei:
+        f_batch.result(1)
+    assert ei.value.evicted is True
+    assert ei.value.retry_after_s is not None
+    # submit-time sheds stay unmarked
+    with pytest.raises(QueueFullError) as ei:
+        svc.submit("edge-a", xs, priority="batch")
+    assert ei.value.evicted is False
+    svc.close(drain=False)
+    # the wire mapping round-trips the marker
+    e = QueueFullError("x", retry_after_s=1.0, evicted=True)
+    assert _code_for(e) == E_EVICTED
+    back = _raise_wire(E_EVICTED, 1.0, "x")
+    assert isinstance(back, QueueFullError)
+    assert back.evicted is True and back.retry_after_s == 1.0
+    assert _raise_wire(E_QUEUE_FULL, None, "y").evicted is False
+
+
+# --------------------------------------------------------- wire fuzz
+
+
+def _valid_request_frame(key_id: str, xs) -> bytes:
+    return encode_request(7, "", key_id, 0, 255, None, xs.data,
+                          xs.shape[1], xs.shape[0])
+
+
+def test_request_frame_byte_flips_die_typed(dcf, bundles, rng):
+    """Seeded byte flips of a valid request frame: every mutation dies
+    as a typed PER-CONNECTION outcome (an ERROR frame and/or a closed
+    connection) — never a SHARE of corrupt provenance, never a dead
+    accept loop.  A healthy connection keeps serving throughout."""
+    svc, server = started_edge(dcf, bundles)
+    try:
+        host, port = server.address
+        xs = rng.integers(0, 256, (5, NB), dtype=np.uint8)
+        frame = _valid_request_frame("edge-a", xs)
+        healthy = EdgeClient(host, port, n_bytes=NB)
+        offsets = rng.integers(0, len(frame), 40)
+        xors = rng.integers(1, 256, 40)
+        for i, (off, xor) in enumerate(zip(offsets, xors)):
+            mutated = faults.corrupt(frame, int(off), int(xor))
+            s = socket.create_connection((host, port), timeout=10)
+            s.sendall(mutated)
+            s.shutdown(socket.SHUT_WR)  # a short frame = disconnect
+            s.settimeout(10)
+            try:
+                frames = _read_frames(s)
+            finally:
+                s.close()
+            for f in frames:
+                assert f[0] == "error", \
+                    f"flip #{i} (offset {off}, xor {xor:#04x}) " \
+                    f"produced a SHARE from a corrupt frame"
+            # the accept loop and the other connection survive
+            assert healthy.evaluate(
+                "edge-a", xs, timeout=60).shape == (1, 5, LAM)
+        healthy.close()
+    finally:
+        server.close()
+        svc.close()
+
+
+def test_truncations_and_oversized_prefix_die_typed(dcf, bundles, rng):
+    """Truncated frames are mid-frame disconnects (contained, counted);
+    an oversized length prefix is refused typed without allocating or
+    reading the claimed body."""
+    svc, server = started_edge(dcf, bundles)
+    server.max_frame_bytes = 1 << 16
+    try:
+        host, port = server.address
+        xs = rng.integers(0, 256, (5, NB), dtype=np.uint8)
+        frame = _valid_request_frame("edge-a", xs)
+        for cut in sorted({int(c)
+                           for c in rng.integers(1, len(frame), 10)}):
+            s = socket.create_connection((host, port), timeout=10)
+            s.sendall(frame[:cut])
+            s.shutdown(socket.SHUT_WR)
+            s.settimeout(10)
+            frames = _read_frames(s)
+            s.close()
+            assert all(f[0] == "error" for f in frames)
+        errors_before = svc.metrics_snapshot()[
+            "edge_wire_errors_total"]
+        s = socket.create_connection((host, port), timeout=10)
+        s.sendall(struct.pack("<I", (1 << 20)))  # over the 64 KB bound
+        s.settimeout(10)
+        frames = _read_frames(s)
+        s.close()
+        assert len(frames) == 1
+        kind, req_id, code, retry, msg = frames[0]
+        assert (kind, code) == ("error", E_WIRE)
+        assert "length prefix" in msg
+        deadline = 200
+        while svc.metrics_snapshot()[
+                "edge_wire_errors_total"] <= errors_before:
+            deadline -= 1
+            assert deadline > 0, "wire error never counted"
+        # still serving
+        with EdgeClient(host, port, n_bytes=NB) as c:
+            assert c.evaluate("edge-a", xs, timeout=60).shape == \
+                (1, 5, LAM)
+    finally:
+        server.close()
+        svc.close()
+
+
+def test_edge_read_fault_kills_one_connection_only(dcf, bundles, rng):
+    """An armed edge.read fault ends exactly the connection whose read
+    fired — typed at the client, with every other connection and the
+    accept loop untouched."""
+    svc, server = started_edge(dcf, bundles)
+    try:
+        host, port = server.address
+        xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+        a = EdgeClient(host, port, n_bytes=NB)
+        b = EdgeClient(host, port, n_bytes=NB)
+        assert a.evaluate("edge-a", xs, timeout=60).shape == (1, 4, LAM)
+        assert b.evaluate("edge-a", xs, timeout=60).shape == (1, 4, LAM)
+        from dcf_tpu.errors import DcfError
+
+        with faults.inject_schedule("edge.read", window_evals=1):
+            with pytest.raises(DcfError):
+                # the next read on A's connection dies; the pending
+                # future fails typed — the connection-level wire error
+                # (DcfError carrying the injected cause) or, if EOF
+                # wins the race, BackendUnavailableError (a subclass)
+                a.evaluate("edge-a", xs, timeout=60)
+        # B never noticed; a reconnect of A serves again
+        assert b.evaluate("edge-a", xs, timeout=60).shape == (1, 4, LAM)
+        a.close()
+        with EdgeClient(host, port, n_bytes=NB) as a2:
+            assert a2.evaluate("edge-a", xs,
+                               timeout=60).shape == (1, 4, LAM)
+        b.close()
+    finally:
+        server.close()
+        svc.close()
+
+
+def test_edge_accept_fault_loop_survives(dcf, bundles, rng):
+    """A raising edge.accept fault is counted and the loop keeps
+    accepting — the next connection serves."""
+    svc, server = started_edge(dcf, bundles)
+    try:
+        host, port = server.address
+        xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+        with EdgeClient(host, port, n_bytes=NB) as c1:
+            assert c1.evaluate("edge-a", xs,
+                               timeout=60).shape == (1, 4, LAM)
+            with faults.inject_schedule("edge.accept",
+                                        window_evals=1) as sched:
+                # c2 may be accepted by the loop iteration already
+                # parked in accept(); the armed fire kills a LATER
+                # iteration — c3 proves the loop outlived it.
+                with EdgeClient(host, port, n_bytes=NB) as c2:
+                    assert c2.evaluate(
+                        "edge-a", xs, timeout=60).shape == (1, 4, LAM)
+                with EdgeClient(host, port, n_bytes=NB) as c3:
+                    assert c3.evaluate(
+                        "edge-a", xs, timeout=60).shape == (1, 4, LAM)
+                assert sched.failed == 1  # the window was consumed
+        assert svc.metrics_snapshot()["edge_accept_errors_total"] >= 1
+    finally:
+        server.close()
+        svc.close()
+
+
+def test_read_timeout_bounds_slow_loris(dcf, bundles, rng):
+    """``read_timeout_s``: a peer stalling mid-frame costs at most the
+    bound before its connection dies typed and counted — a healthy
+    connection is untouched."""
+    svc = make_service(dcf, bundles)
+    svc.start()
+    server = EdgeServer(svc, read_timeout_s=0.2).start()
+    try:
+        host, port = server.address
+        xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+        loris = socket.create_connection((host, port), timeout=10)
+        loris.sendall(_valid_request_frame("edge-a", xs)[:9])  # stall
+        spins = 200
+        while svc.metrics_snapshot()[
+                "edge_connection_errors_total"] < 1:
+            spins -= 1
+            assert spins > 0, "stalled reader never timed out"
+            threading.Event().wait(0.02)
+        loris.close()
+        with EdgeClient(host, port, n_bytes=NB) as c:
+            assert c.evaluate("edge-a", xs, timeout=60).shape == \
+                (1, 4, LAM)
+    finally:
+        server.close()
+        svc.close()
+    with pytest.raises(ValueError, match="read_timeout_s"):
+        EdgeServer(svc, read_timeout_s=-1)
+
+
+# ------------------------------------------------- slow-client walk
+
+
+def test_slow_client_trips_deadline_not_worker(dcf, bundles, prg, rng):
+    """The slow-client seam: ``latency`` armed at edge.read advances
+    the injectable clock on every server recv, so a sender stalling
+    mid-frame expires its own QUEUED request through the existing
+    deadline path (typed DEADLINE error frame) while another
+    connection keeps serving — the worker never wedges on the stalled
+    socket."""
+    clk = FakeClock(1000.0)
+    svc = DcfService(dcf, ServeConfig(max_batch=32, max_delay_ms=0.0),
+                     clock=clk)
+    for name, bundle in bundles.items():
+        svc.register_key(name, bundle)
+    server = EdgeServer(svc).start()
+    try:
+        host, port = server.address
+        xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+        req1 = encode_request(1, "", "edge-a", 0, 255, 50.0, xs.data,
+                              NB, 4)  # 50 ms deadline on the fake clock
+        req2 = encode_request(2, "", "edge-a", 0, 255, None, xs.data,
+                              NB, 4)
+        with faults.inject("edge.read",
+                           handler=faults.latency(clk, 0.2)):
+            slow = socket.create_connection((host, port), timeout=30)
+            slow.sendall(req1)
+            slow.sendall(req2[:7])  # ... and stall mid-frame
+            # wait until the server's reads have advanced the clock
+            # well past req1's deadline (each recv fire adds 0.2 s;
+            # reaching +0.95 needs the post-submit req2 fires, so
+            # req1 is guaranteed both SUBMITTED and expired)
+            spins = 2000
+            while clk.t < 1000.0 + 0.95:
+                spins -= 1
+                assert spins > 0, "edge.read latency never advanced " \
+                    "the clock"
+                threading.Event().wait(0.005)
+            # the worker is NOT wedged: another connection round-trips
+            # while the slow one stalls (pump() drives the service and
+            # expires req1 on the way)
+            with EdgeClient(host, port, n_bytes=NB) as healthy:
+                fut = healthy.submit("edge-b", xs)
+                spins = 2000  # pump until the server thread has queued
+                while not fut.done():  # the request (no worker thread
+                    svc.pump()         # in this fake-clock setup)
+                    spins -= 1
+                    assert spins > 0, "healthy request never served"
+                    threading.Event().wait(0.005)
+                assert np.array_equal(
+                    fut.result(60),
+                    oracle(prg, bundles["edge-b"], 0, xs))
+            # req1 expired typed through the queue's deadline sweep
+            slow.sendall(req2[7:])  # un-stall: req2 completes normally
+            # Pump-and-poll: the service has no worker thread here, so
+            # a pump may be needed AFTER the server thread queues req2
+            # — never block in recv without pumping again.
+            slow.settimeout(0.2)
+            got = {}
+            buf = b""
+            deadline = 300
+            while len(got) < 2:
+                deadline -= 1
+                assert deadline > 0, f"responses never arrived ({got})"
+                svc.pump()
+                try:
+                    chunk = slow.recv(1 << 16)
+                except TimeoutError:
+                    continue
+                assert chunk, "server hung up before both responses"
+                buf += chunk
+                while len(buf) >= 4:
+                    (body_len,) = struct.unpack_from("<I", buf, 0)
+                    if len(buf) < 4 + body_len:
+                        break
+                    frame = decode_response(buf[4:4 + body_len])
+                    got[frame[1]] = frame
+                    buf = buf[4 + body_len:]
+            slow.close()
+        kind1, _, code1, _, _ = got[1]
+        assert (kind1, code1) == ("error", E_DEADLINE)
+        kind2, _, y2 = got[2]
+        assert kind2 == "share"
+        assert np.array_equal(y2, oracle(prg, bundles["edge-a"], 0, xs))
+        assert svc.metrics_snapshot()[
+            "serve_deadline_expired_total"] >= 1
+    finally:
+        server.close()
+        svc.close(drain=False)
+
+
+# ------------------------------------------------- open-loop loadgen
+
+
+def test_open_loop_reconciles_and_drains(dcf, bundles, prg, rng):
+    from dcf_tpu.serve.loadgen import open_loop
+
+    svc = make_service(dcf, bundles, max_delay_ms=0.5)
+    svc.start()
+    base = svc.metrics_snapshot()
+    res = open_loop(svc, sorted(bundles), rate_rps=250.0,
+                    duration_s=0.6, min_points=1, max_points=8,
+                    seed=11)
+    snap = svc.metrics_snapshot()
+    svc.close()
+    assert res.attempts == res.shed + res.ok + res.expired + res.failed
+    assert res.ok > 0 and res.failed == 0
+    assert res.sent == snap["serve_requests_total"] \
+        - base["serve_requests_total"]
+    assert res.shed == snap["serve_shed_total"] - base["serve_shed_total"]
+    assert res.expired == snap["serve_deadline_expired_total"] \
+        - base["serve_deadline_expired_total"]
+    q = res.latency_quantiles()
+    assert set(q) == {"p50_s", "p90_s", "p99_s"}
+    assert "normal" in res.by_class
+
+
+def test_open_loop_counts_expiries_and_hinted_sheds(dcf, bundles, rng):
+    """Against a stopped service every accepted request expires
+    through the deadline path, and overload sheds carry their hints —
+    both visible in the open-loop result."""
+    from dcf_tpu.serve.loadgen import open_loop
+
+    svc = make_service(dcf, bundles, max_queued_points=64)
+    done = {}
+
+    def run():
+        done["res"] = open_loop(
+            svc, sorted(bundles), rate_rps=400.0, duration_s=0.4,
+            min_points=4, max_points=8, seed=13, deadline_ms=1.0)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()  # the service is NOT pumping: the queue fills, sheds,
+    t.join(0.5)  # and queued requests outlive their 1 ms deadlines
+    while t.is_alive():
+        svc.pump()  # expire + drain so open_loop's collectors finish
+        t.join(0.05)
+    res = done["res"]
+    svc.close()
+    assert res.expired > 0
+    assert res.shed > 0
+    assert res.shed_hinted == res.shed  # every shed carried its hint
+    assert res.attempts == res.shed + res.ok + res.expired + res.failed
+
+
+def test_open_loop_validates_flags():
+    from dcf_tpu.serve.loadgen import open_loop
+
+    with pytest.raises(ValueError, match="rate_rps"):
+        open_loop(None, ["k"], rate_rps=0, duration_s=1,
+                  min_points=1, max_points=2)
+    with pytest.raises(ValueError, match="request-size"):
+        open_loop(None, ["k"], rate_rps=10, duration_s=1,
+                  min_points=3, max_points=2)
+    with pytest.raises(ValueError, match="skew"):
+        open_loop(None, ["k"], rate_rps=10, duration_s=1,
+                  min_points=1, max_points=2, skew=-1)
+
+
+# --------------------------------------------------------- the soak
+
+
+@pytest.mark.slow
+def test_edge_soak_8_connections_bit_exact(dcf, bundles, prg, rng):
+    """The serial-leg soak: 8 concurrent connections under an
+    every-12th-recv edge.read fault — connections die typed and
+    reconnect, every delivered two-party reconstruction is bit-exact
+    vs the numpy oracle, every refusal carries a hint, and the accept
+    loop outlives all of it."""
+    svc, server = started_edge(dcf, bundles, max_batch=64,
+                               max_delay_ms=1.0)
+    host, port = server.address
+    names = sorted(bundles)
+    # Warm every padded shape for BOTH parties — the soak measures the
+    # failure/recovery loop, not first-compile latency.
+    xs_warm = rng.integers(0, 256, (64, NB), dtype=np.uint8)
+    m_warm = 1
+    while m_warm <= 64:
+        for b in (0, 1):
+            svc.evaluate(names[0], xs_warm[:m_warm], b=b, timeout=120)
+        m_warm *= 2
+    stats = {"ok": 0, "bad": 0, "reconnects": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+    fires = {"n": 0}
+
+    def every_nth(*_a):
+        fires["n"] += 1
+        if fires["n"] % 12 == 0:
+            raise faults.InjectedFault("edge.read soak fault")
+
+    def client(i):
+        crng = np.random.default_rng(0x50AC + i)
+        conn = None
+        while not stop.is_set():
+            if conn is None:
+                try:
+                    conn = EdgeClient(host, port, n_bytes=NB)
+                except OSError:
+                    continue
+            name = names[int(crng.integers(0, len(names)))]
+            m = int(crng.integers(1, 33))
+            xs = crng.integers(0, 256, (m, NB), dtype=np.uint8)
+            try:
+                f0 = conn.submit(name, xs, b=0)
+                f1 = conn.submit(name, xs, b=1)
+                got = f0.result(120) ^ f1.result(120)
+            except Exception:  # noqa: BLE001 — the injected kill path
+                with lock:
+                    stats["reconnects"] += 1
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001 — best-effort close
+                    pass
+                conn = None
+                continue
+            ok = np.array_equal(got, recon_oracle(prg, bundles[name],
+                                                  xs))
+            with lock:
+                stats["ok" if ok else "bad"] += 1
+        if conn is not None:
+            conn.close()
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(8)]
+    try:
+        with faults.inject("edge.read", handler=every_nth):
+            for t in threads:
+                t.start()
+            stop.wait(4.0)
+            stop.set()
+            for t in threads:
+                t.join(120)
+    finally:
+        server.close()
+        svc.close()
+    assert stats["bad"] == 0
+    assert stats["ok"] >= 16
+    assert stats["reconnects"] >= 1  # the fault path was exercised
+    assert not any(t.is_alive() for t in threads)
+
+
+# ----------------------------------------------------------- config
+
+
+def test_serveconfig_tenant_table_validation():
+    with pytest.raises(ValueError, match="TenantSpec"):
+        ServeConfig(tenants=({"name": "x"},))
+    with pytest.raises(ValueError, match="duplicate"):
+        ServeConfig(tenants=(TenantSpec("a"), TenantSpec("a")))
+    with pytest.raises(ValueError, match="non-empty"):
+        TenantSpec("")
+    with pytest.raises(ValueError, match="priority"):
+        TenantSpec("a", "platinum")
+    with pytest.raises(ValueError, match="points_per_sec"):
+        TenantSpec("a", points_per_sec=-1)
+    cfg = ServeConfig(tenants=(TenantSpec("a", "batch"),))
+    from dcf_tpu.serve import Priority
+
+    assert cfg.tenants[0].priority is Priority.BATCH
+
+
+def test_wire_error_frame_decodes_typed(dcf, bundles, rng):
+    """A raw look at the ERROR frame: the rate-limit refusal carries
+    its code and hint on the wire itself, not just in the client's
+    re-raise."""
+    svc, server = started_edge(
+        dcf, bundles,
+        tenants=(TenantSpec("t", "normal", points_per_sec=10.0,
+                            burst_points=4),))
+    try:
+        host, port = server.address
+        xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+        s = socket.create_connection((host, port), timeout=30)
+        s.sendall(encode_request(5, "t", "edge-a", 0, 255, None,
+                                 xs.data, NB, 4))
+        s.sendall(encode_request(6, "t", "edge-a", 0, 255, None,
+                                 xs.data, NB, 4))
+        s.shutdown(socket.SHUT_WR)
+        s.settimeout(30)
+        deadline = 400
+        frames = []
+        while len(frames) < 2 and deadline:
+            deadline -= 1
+            svc.pump()
+            try:
+                frames = _read_frames(s)
+            except OSError:
+                break
+        s.close()
+        by_id = {f[1]: f for f in frames}
+        assert by_id[5][0] == "share"
+        kind, _, code, retry, _ = by_id[6]
+        assert (kind, code) == ("error", E_RATE_LIMITED)
+        assert retry == pytest.approx(4 / 10.0, rel=0.5)
+        assert {T_SHARE, T_ERROR} == {2, 3}  # layout pins
+    finally:
+        server.close()
+        svc.close()

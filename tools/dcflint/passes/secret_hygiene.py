@@ -44,10 +44,17 @@ from tools.dcflint import FileContext, LintPass, register
 
 SECRET_NAME_RE = re.compile(
     r"^(seed\w*|s0s?|cw(_\w+)?|cws|key_bundle|bundle|kb|key_material"
-    r"|cipher_keys?|combine_masks?|frames?|key_frame)$")
+    r"|cipher_keys?|combine_masks?|frames?|key_frame|shares?(_\w+)?)$")
 # ``frame`` (ISSUE 8, dcf_tpu/serve/store.py): a serialized DCFK frame
 # is the seeds and correction words it encodes — logging one is
 # logging the key.
+# ``share``/``shares``/``share_*``/``shares_*`` (ISSUE 12,
+# dcf_tpu/serve/edge.py): the network edge holds evaluated SHARE bytes
+# in wire buffers on their way to a party — one logged share next to
+# the other party's is the reconstructed function value, so
+# share-named buffers are held to the same sink rule as key material.
+# Deliberately NOT ``share\w*``: ``shared``/``shared_image``/
+# ``shared_lock`` are ordinary state names, not secrets.
 # ``combine_masks`` (PR 5, dcf_tpu/protocols): a protocol bundle's
 # per-interval combine mask is ``pub * beta`` — beta in the clear for
 # wraparound intervals, i.e. the secret function value itself.
