@@ -746,7 +746,21 @@ class Dcf:
         arms a per-tenant token bucket on the injectable clock;
         refusals cross the wire as typed error frames carrying
         ``retry_after_s`` (breaker cooldown / brownout hysteresis /
-        exact bucket refill).
+        exact bucket refill).  ``tls_cert``/``tls_key`` arm stdlib-ssl
+        TLS on the edge socket and ``tls_client_ca`` pins clients
+        (ISSUE 13; README "Network edge").
+
+        Pod scale (ISSUE 13, README "Pod serving"): one service +
+        edge is a SHARD.  Run N of them (the ``serve_host`` CLI
+        subcommand: warm-restore from the durable store, serve DCFE,
+        publish address + metrics snapshots) behind a
+        ``serve.DcfRouter`` over a ``serve.ShardMap`` rendezvous ring
+        — keys are owned by ``owner(key_id)``, durably replicated to
+        the replica (``KeyStore.replicate_to``, generations
+        preserved), and the router forwards frames zero-copy,
+        failing CRITICAL traffic over to the replica when a shard
+        goes suspect and refusing everything else typed with
+        ``retry_after_s``.
         """
         from dcf_tpu.serve import DcfService, ServeConfig
 

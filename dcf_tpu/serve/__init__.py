@@ -40,8 +40,19 @@ keys":
   with per-tenant token buckets (``TenantSpec`` in
   ``ServeConfig.tenants``), and typed wire error frames carrying
   retry-after hints; ``EdgeClient`` is the pipelining counterpart;
+- ``serve.shardmap``  the pod shard ring (ISSUE 13): rendezvous
+  placement of keys onto host shards — deterministic keyed-digest
+  scores, minimal disruption under membership change, the replica
+  ranking failover and frame replication both read;
+- ``serve.router``    the pod routing tier (ISSUE 13): a DCFE-on-
+  both-sides router forwarding frames header-decode-only (payload
+  relayed as a memoryview through pooled ``EdgeClient``s) with
+  typed-taxonomy failover — suspect shards fail CRITICAL traffic
+  over to the key's replica, everything else refused typed with
+  ``retry_after_s``;
 - ``serve.metrics``   dependency-free counters/gauges/histograms with a
   deterministic snapshot (embedded in RESULTS_serve JSONL lines);
+  ``rollup_snapshots`` sums per-host snapshots into the pod view;
 - ``serve.service``   ``DcfService``: the worker loop tying it together,
   with a stage-ahead double-buffered dispatch pipeline and the
   ``serve.stage``/``serve.eval`` fault seams;
@@ -59,15 +70,23 @@ from dcf_tpu.serve.admission import (  # noqa: F401
     TenantSpec,
 )
 from dcf_tpu.serve.breaker import BreakerBoard  # noqa: F401
-from dcf_tpu.serve.edge import EdgeClient, EdgeServer  # noqa: F401
+from dcf_tpu.serve.edge import (  # noqa: F401
+    EdgeClient,
+    EdgeClientPool,
+    EdgeServer,
+)
 from dcf_tpu.serve.frontier_cache import FrontierCache  # noqa: F401
 from dcf_tpu.serve.keyfactory import KeyFactory, PoolSpec  # noqa: F401
-from dcf_tpu.serve.metrics import Metrics  # noqa: F401
+from dcf_tpu.serve.metrics import Metrics, rollup_snapshots  # noqa: F401
 from dcf_tpu.serve.registry import KeyRegistry  # noqa: F401
+from dcf_tpu.serve.router import DcfRouter  # noqa: F401
 from dcf_tpu.serve.service import DcfService, ServeConfig  # noqa: F401
+from dcf_tpu.serve.shardmap import ShardMap, ShardSpec  # noqa: F401
 from dcf_tpu.serve.store import KeyStore, RestoreReport  # noqa: F401
 
 __all__ = ["DcfService", "ServeConfig", "ServeFuture", "Priority",
-           "TenantSpec", "EdgeServer", "EdgeClient",
-           "BreakerBoard", "FrontierCache", "KeyFactory", "Metrics",
-           "KeyRegistry", "KeyStore", "PoolSpec", "RestoreReport"]
+           "TenantSpec", "EdgeServer", "EdgeClient", "EdgeClientPool",
+           "BreakerBoard", "DcfRouter", "FrontierCache", "KeyFactory",
+           "Metrics", "KeyRegistry", "KeyStore", "PoolSpec",
+           "RestoreReport", "ShardMap", "ShardSpec",
+           "rollup_snapshots"]

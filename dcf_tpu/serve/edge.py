@@ -74,8 +74,12 @@ promotable above it.  Per-tenant admission is a points-per-second
 token bucket on the injectable clock, applied BEFORE the shared queue:
 a refusal costs the shared service nothing and carries the exact
 time-to-refill as its ``retry_after_s``.  An empty table (the
-default) admits every tenant as NORMAL, unlimited — the open edge the
-benches drive; a configured table refuses unknown tenants typed.
+default) admits every tenant unlimited, defaulting to NORMAL but
+honoring a frame's EXPLICIT class verbatim — the open edge is "no
+policy", which is what lets a pod router forward its already-admitted
+effective class to a shard without the shard re-clamping it (ISSUE
+13); a configured table refuses unknown tenants typed and enforces
+the never-promote cap.
 
 Refusals and failures cross the wire as typed ERROR frames: the code
 maps back to the ``dcf_tpu.errors`` class on the client
@@ -93,6 +97,17 @@ each blocking read advances the injectable clock, so a stalled sender
 trips the existing deadline/watchdog path instead of wedging the
 worker.
 
+TLS (ISSUE 13 satellite): ``ServeConfig.tls_cert``/``tls_key`` (or
+the same ``EdgeServer`` kwargs) wrap every accepted connection in
+stdlib ``ssl``; ``tls_client_ca`` PINS clients — only peers
+presenting a cert signed by that CA complete the handshake (the
+router<->shard link hardening).  The handshake is deferred to the
+reader thread, so a plaintext or unpinned peer is a counted
+per-connection failure, never a wedged accept loop.  ``EdgeClient``
+takes ``tls=/tls_ca=/tls_cert=/tls_key=``; ``EdgeClientPool`` (the
+reusable reconnect-with-backoff transport the pod router forwards
+through — ISSUE 13) passes them along.
+
 Clocking: admission math (buckets, deadlines) uses the service's
 injectable clock, never ``time.*`` (dcflint determinism).  Server-side
 socket reads BLOCK by default — the right behavior for trusted/idle
@@ -109,6 +124,7 @@ from __future__ import annotations
 
 import queue
 import socket
+import ssl
 import struct
 import threading
 import zlib
@@ -134,10 +150,11 @@ from dcf_tpu.serve.admission import (
 )
 from dcf_tpu.serve.metrics import Metrics, labeled
 from dcf_tpu.testing.faults import fire
+from dcf_tpu.utils.benchtime import monotonic
 
-__all__ = ["EdgeServer", "EdgeClient", "TokenBucket", "WIRE_CODES",
-           "MAGIC", "VERSION", "T_REQUEST", "T_SHARE", "T_ERROR",
-           "encode_request", "encode_error"]
+__all__ = ["EdgeServer", "EdgeClient", "EdgeClientPool", "TokenBucket",
+           "WIRE_CODES", "MAGIC", "VERSION", "T_REQUEST", "T_SHARE",
+           "T_ERROR", "encode_request", "encode_error"]
 
 MAGIC = b"DCFE"
 VERSION = 1
@@ -175,6 +192,11 @@ E_EVICTED = 12  # QueueFullError's post-ACCEPTANCE spelling: the
 #                 request was admitted (and counted) before a
 #                 higher-priority submit took its room — load
 #                 accounting must not retract a "sent" for it
+E_STALE = 13  # StaleStateError's own code (ISSUE 13): a hot-swap
+#               racing a forwarded eval is a KEY-level race the caller
+#               resolves by retrying the same target — the router must
+#               be able to tell it from E_UNAVAILABLE, which is a
+#               backend-down signal it treats as failover pressure
 
 #: code -> exception class the client raises (see ``_raise_wire``).
 WIRE_CODES = {
@@ -190,6 +212,7 @@ WIRE_CODES = {
     E_UNKNOWN_TENANT: ValueError,
     E_TIMEOUT: BatchTimeoutError,
     E_EVICTED: QueueFullError,
+    E_STALE: StaleStateError,
 }
 
 _EXC_CODES = (
@@ -200,7 +223,7 @@ _EXC_CODES = (
     (BatchTimeoutError, E_TIMEOUT),
     (KeyFormatError, E_WIRE),
     (ShapeError, E_SHAPE),
-    (StaleStateError, E_UNAVAILABLE),
+    (StaleStateError, E_STALE),
     (BackendUnavailableError, E_UNAVAILABLE),
     (DcfError, E_INTERNAL),
     (ValueError, E_BAD_REQUEST),
@@ -231,6 +254,15 @@ def _sendmsg_all(sock: socket.socket, parts: list) -> None:
     unless the kernel short-writes)."""
     views = [memoryview(p).cast("B") if not isinstance(p, memoryview)
              else p.cast("B") for p in parts]
+    if isinstance(sock, ssl.SSLSocket):
+        # SSLSocket has no scatter-gather send (sendmsg raises
+        # NotImplementedError): join once and sendall.  The copy is
+        # inherent to TLS anyway — every byte is re-encrypted into the
+        # record layer — so the zero-copy claim is scoped to the
+        # plaintext transport, and the TLS knob trades that copy for
+        # the wire staying confidential.
+        sock.sendall(b"".join(views))
+        return
     total = sum(v.nbytes for v in views)
     sent = sock.sendmsg(views)
     while sent < total:
@@ -262,20 +294,36 @@ def _frame(body_parts) -> bytes:
                      _CRC.pack(crc)])
 
 
+def _request_parts(req_id: int, tenant: str, key_id: str, party: int,
+                   priority: int, deadline_ms: float | None,
+                   payload, n_bytes: int, m: int) -> list:
+    """The ONE REQUEST-body encoding (validation included), as byte
+    pieces with the payload referenced by buffer: ``encode_request``
+    joins them into a frame; ``EdgeClient.submit_bytes`` hands them to
+    the scatter-gather send.  Two encoders would drift."""
+    tb = tenant.encode("utf-8")
+    kb_name = key_id.encode("utf-8")
+    if len(tb) > 255 or len(kb_name) > 255:
+        raise ShapeError("tenant/key_id must encode to <= 255 bytes")
+    if not 0 <= int(party) <= 255:
+        # Validated here, not by struct.pack: submit_bytes relies on
+        # encoding failures being raised BEFORE a future registers
+        raise ShapeError(f"party byte must fit u8, got {party}")
+    head = MAGIC + _FRAME_HEAD.pack(VERSION, T_REQUEST) + _REQ_HEAD.pack(
+        req_id, int(party), priority,
+        -1.0 if deadline_ms is None else float(deadline_ms),
+        m, n_bytes, len(tb), len(kb_name))
+    return [head, tb, kb_name, memoryview(payload)]
+
+
 def encode_request(req_id: int, tenant: str, key_id: str, party: int,
                    priority: int, deadline_ms: float | None,
                    payload, n_bytes: int, m: int) -> bytes:
     """One REQUEST frame (envelope included).  ``payload`` is any
     buffer-protocol object of ``m * n_bytes`` packed point bytes."""
-    tb = tenant.encode("utf-8")
-    kb_name = key_id.encode("utf-8")
-    if len(tb) > 255 or len(kb_name) > 255:
-        raise ShapeError("tenant/key_id must encode to <= 255 bytes")
-    head = MAGIC + _FRAME_HEAD.pack(VERSION, T_REQUEST) + _REQ_HEAD.pack(
-        req_id, party, priority,
-        -1.0 if deadline_ms is None else float(deadline_ms),
-        m, n_bytes, len(tb), len(kb_name))
-    return _frame([head, tb, kb_name, memoryview(payload)])
+    return _frame(_request_parts(req_id, tenant, key_id, party,
+                                 priority, deadline_ms, payload,
+                                 n_bytes, m))
 
 
 def encode_share(req_id: int, y: np.ndarray) -> list[bytes]:
@@ -589,6 +637,14 @@ class _Conn:
     def _read_loop(self) -> None:
         srv = self._srv
         try:
+            if isinstance(self._sock, ssl.SSLSocket):
+                # Deferred TLS handshake (see the accept loop): a
+                # peer speaking plaintext, or one without the pinned
+                # client cert, fails HERE — an SSLError is an OSError,
+                # so the containment below counts it and ends only
+                # this connection.  read_timeout_s bounds the
+                # handshake like any other read.
+                self._sock.do_handshake()
             while not self._closing:
                 body = self._read_frame()
                 if body is None:
@@ -651,9 +707,19 @@ class _Conn:
         if pri == _PRI_DEFAULT:
             eff = tenant.spec.priority
         elif pri in (0, 1, 2):
-            # A request may demote below its tenant class, never
-            # promote above it (larger enum value = lower class).
-            eff = Priority(max(pri, tenant.spec.priority.value))
+            if tenant is srv._default_tenant:
+                # The OPEN edge (no tenant table) honors the frame's
+                # class verbatim: an empty table is "no policy", and
+                # the router->shard link depends on the forwarded
+                # class surviving the hop — the tenant cap is a
+                # CONFIGURED-table rule, not a default clamp (ISSUE
+                # 13: a clamp here silently demoted every routed
+                # CRITICAL request to NORMAL on its shard).
+                eff = Priority(pri)
+            else:
+                # A request may demote below its tenant class, never
+                # promote above it (larger enum value = lower class).
+                eff = Priority(max(pri, tenant.spec.priority.value))
         else:
             refuse(E_BAD_REQUEST,
                    f"priority byte must be 0/1/2 or 255, got {pri}")
@@ -742,7 +808,9 @@ class EdgeServer:
 
     def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
                  *, max_frame_bytes: int = 64 << 20, backlog: int = 64,
-                 read_timeout_s: float = 0.0):
+                 read_timeout_s: float = 0.0,
+                 tls_cert: str | None = None, tls_key: str | None = None,
+                 tls_client_ca: str | None = None):
         if max_frame_bytes < _BODY_MIN + _CRC.size:
             # api-edge: config contract — a bound below one empty
             # frame refuses everything
@@ -762,9 +830,45 @@ class EdgeServer:
         self._port = port
         self.max_frame_bytes = int(max_frame_bytes)
         self._backlog = int(backlog)
-        self.n_bytes = service._dcf.n_bytes
+        # The point width comes from the service-like target: a
+        # DcfService exposes it as a property; the pod router
+        # (serve.router) carries its own — anything with n_bytes,
+        # _clock, metrics, config.tenants and submit_bytes can sit
+        # behind this server (ISSUE 13: the router speaks DCFE on both
+        # sides by fronting itself with this exact class).
+        self.n_bytes = int(service.n_bytes)
         self._clock = service._clock
         self.metrics = service.metrics
+        # TLS (ISSUE 13 satellite): explicit kwargs override the
+        # service config's tls_* knobs (None = inherit).  cert+key arm
+        # the server context; tls_client_ca additionally PINS clients —
+        # only peers presenting a cert signed by that CA complete the
+        # handshake (the router<->shard link hardening).
+        cfg = getattr(service, "config", None)
+        cert = tls_cert if tls_cert is not None \
+            else getattr(cfg, "tls_cert", "")
+        key = tls_key if tls_key is not None \
+            else getattr(cfg, "tls_key", "")
+        client_ca = tls_client_ca if tls_client_ca is not None \
+            else getattr(cfg, "tls_client_ca", "")
+        if bool(cert) != bool(key):
+            # api-edge: TLS config contract — half a keypair serves
+            # nothing; failing loudly beats a plaintext surprise
+            raise ValueError(
+                "TLS needs BOTH tls_cert and tls_key (got only one)")
+        if client_ca and not cert:
+            # api-edge: TLS config contract — client pinning without a
+            # server identity is not a mode ssl offers
+            raise ValueError(
+                "tls_client_ca requires tls_cert/tls_key")
+        self._tls_ctx: ssl.SSLContext | None = None
+        if cert:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert, key)
+            if client_ca:
+                ctx.load_verify_locations(client_ca)
+                ctx.verify_mode = ssl.CERT_REQUIRED
+            self._tls_ctx = ctx
         self._lock = threading.Lock()
         self._conns: set[_Conn] = set()
         self._listener: socket.socket | None = None
@@ -869,6 +973,15 @@ class EdgeServer:
             try:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
                                 1)
+                if self._tls_ctx is not None:
+                    # Wrap WITHOUT handshaking: the handshake blocks on
+                    # peer bytes, and it must cost a reader thread, not
+                    # the accept loop (the reader performs it as its
+                    # first read — a plaintext or unpinned peer dies
+                    # there as a counted per-connection failure).
+                    sock = self._tls_ctx.wrap_socket(
+                        sock, server_side=True,
+                        do_handshake_on_connect=False)
                 if self.read_timeout_s:
                     # The slow-loris bound: a recv blocking past this
                     # dies as a per-connection OSError (counted,
@@ -907,16 +1020,23 @@ class EdgeServer:
 def _raise_wire(code: int, retry_after_s: float | None, msg: str):
     cls = WIRE_CODES.get(code, DcfError)
     if cls is QueueFullError:
-        return cls(msg, retry_after_s=retry_after_s,
-                   evicted=code == E_EVICTED)
-    if cls is CircuitOpenError:
-        return cls(msg, retry_after_s=retry_after_s)
-    if cls is ValueError:
+        err = cls(msg, retry_after_s=retry_after_s,
+                  evicted=code == E_EVICTED)
+    elif cls is CircuitOpenError:
+        err = cls(msg, retry_after_s=retry_after_s)
+    elif cls is ValueError:
         # api-edge: the server flagged a request-contract violation
         # (unknown key/tenant, bad party) — builtin semantics, exactly
         # what the in-process call site would have raised.
-        return ValueError(msg)
-    return cls(msg)
+        err = ValueError(msg)
+    else:
+        err = cls(msg)
+    # The raw wire code rides along (ISSUE 13): two codes can map to
+    # one class (E_QUEUE_FULL vs E_RATE_LIMITED, E_UNAVAILABLE vs a
+    # local transport death, which carries NO wire_code), and the
+    # router's suspicion policy is keyed on the code, not the class.
+    err.wire_code = code
+    return err
 
 
 class EdgeClient:
@@ -934,7 +1054,9 @@ class EdgeClient:
 
     def __init__(self, host: str, port: int, *, n_bytes: int,
                  tenant: str = "", connect_timeout: float = 30.0,
-                 max_frame_bytes: int = 256 << 20):
+                 max_frame_bytes: int = 256 << 20, tls: bool = False,
+                 tls_ca: str = "", tls_cert: str = "",
+                 tls_key: str = ""):
         self.n_bytes = int(n_bytes)
         self.tenant = tenant
         # Response-frame sanity bound (mirrors the server's request
@@ -943,8 +1065,35 @@ class EdgeClient:
         # per response, or an oversized VALID share would tear the
         # connection down as a framing error.
         self.max_frame_bytes = int(max_frame_bytes)
+        ctx: ssl.SSLContext | None = None
+        if tls or tls_ca or tls_cert:
+            # TLS (ISSUE 13 satellite): ``tls_ca`` pins the server —
+            # the handshake verifies its cert chains to that CA (the
+            # cert's SAN must cover ``host``, IP or name).  Without a
+            # CA the link is encrypted but UNAUTHENTICATED — lab-only,
+            # stated here so nobody mistakes it for pinning.
+            # ``tls_cert``/``tls_key`` present a client cert for
+            # servers that pin clients (``tls_client_ca``).  Context
+            # construction precedes the dial: a bad TLS config must
+            # fail loudly, not after a connect timeout.
+            if bool(tls_cert) != bool(tls_key):
+                # api-edge: TLS config contract (half a keypair)
+                raise ValueError(
+                    "client TLS needs BOTH tls_cert and tls_key")
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            if tls_ca:
+                ctx.load_verify_locations(tls_ca)
+                ctx.check_hostname = True
+            else:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            if tls_cert:
+                ctx.load_cert_chain(tls_cert, tls_key)
         self._sock = socket.create_connection(
             (host, port), timeout=connect_timeout)
+        if ctx is not None:
+            self._sock = ctx.wrap_socket(self._sock,
+                                         server_hostname=host)
         # Blocking from here on: the reader parks in recv between
         # responses (close() unblocks it); waiting bounds belong to
         # ``ServeFuture.result(timeout)``, not the transport.
@@ -973,22 +1122,52 @@ class EdgeClient:
                 f"xs must be [M, {self.n_bytes}], got {xs.shape}")
         if xs.shape[0] < 1:
             raise ShapeError("cannot submit an empty request")
+        return self.submit_bytes(key_id, xs.data, m=xs.shape[0], b=b,
+                                 deadline_ms=deadline_ms,
+                                 priority=priority)
+
+    def submit_bytes(self, key_id: str, data, m: int | None = None,
+                     b: int = 0, deadline_ms: float | None = None,
+                     priority=None) -> ServeFuture:
+        """Wire twin of ``DcfService.submit_bytes`` — and the pod
+        router's relay path (ISSUE 13): ``data`` (any buffer-protocol
+        object of ``m`` packed ``n_bytes``-wide points; ``m`` derived
+        when omitted) is sent BY REFERENCE via the scatter-gather
+        write, so a forwarded request's payload crosses this hop as a
+        ``memoryview`` of the upstream frame buffer — no join, no
+        re-materialization.  The caller must keep ``data`` alive until
+        this call returns (the send completes synchronously)."""
+        view = memoryview(data).cast("B")
+        if m is None:
+            if view.nbytes == 0 or view.nbytes % self.n_bytes:
+                raise ShapeError(
+                    f"payload of {view.nbytes} bytes is not a positive "
+                    f"multiple of n_bytes={self.n_bytes}")
+            m = view.nbytes // self.n_bytes
+        if m < 1 or m * self.n_bytes != view.nbytes:
+            raise ShapeError(
+                f"payload holds {view.nbytes} bytes, not m={m} points "
+                f"of {self.n_bytes}")
         pri = _PRI_DEFAULT if priority is None \
             else parse_priority(priority).value
-        fut = ServeFuture()
         with self._lock:
             if self._closed:
                 raise BackendUnavailableError(
                     "edge connection is closed")
             req_id = self._next_id
             self._next_id += 1
-        # Encode BEFORE registering: an encoding failure (e.g. a
-        # key_id over the 255-byte field) must not leave an orphaned
-        # never-completed future in _pending for the connection's
-        # lifetime.  The burned req_id is harmless.
-        frame = encode_request(req_id, self.tenant, key_id, b, pri,
-                               deadline_ms, xs.data, self.n_bytes,
-                               xs.shape[0])
+        # Encode BEFORE registering: an encoding failure (a key_id
+        # over the 255-byte field, a bad party byte) must not leave an
+        # orphaned never-completed future in _pending for the
+        # connection's lifetime.  The burned req_id is harmless.
+        views = [memoryview(p).cast("B") for p in _request_parts(
+            req_id, self.tenant, key_id, b, pri, deadline_ms, view,
+            self.n_bytes, m)]
+        crc = 0
+        for v in views:
+            crc = zlib.crc32(v, crc)
+        body_len = sum(v.nbytes for v in views) + _CRC.size
+        fut = ServeFuture()
         with self._lock:
             if self._closed:
                 raise BackendUnavailableError(
@@ -996,7 +1175,9 @@ class EdgeClient:
             self._pending[req_id] = fut
         try:
             with self._send_lock:
-                self._sock.sendall(frame)
+                _sendmsg_all(self._sock,
+                             [_PREFIX.pack(body_len), *views,
+                              _CRC.pack(crc)])
         except OSError as e:
             # A failed send means the TRANSPORT is gone, not just this
             # request: mark the connection closed and fail every
@@ -1096,6 +1277,156 @@ class EdgeClient:
         self._reader.join(5.0)
 
     def __enter__(self) -> "EdgeClient":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+class EdgeClientPool:
+    """A bounded, reconnecting pool of ``EdgeClient`` connections to
+    ONE target (ISSUE 13): PR 12's benches hand-rolled ``closed``-check
+    + reconnect loops; this is that logic promoted into the reusable
+    transport the pod router forwards through (and ``edge_bench``/
+    ``loadgen`` drive).
+
+    Semantics:
+
+    * at most ``size`` live connections, leased round-robin — a lease
+      prefers a live slot and only DIALS when the slot it lands on is
+      empty or its client reports ``closed`` (the PR 12 reconnect
+      signal: transport death fails every pending future typed and
+      latches ``closed``; request-level typed failures leave the
+      connection open and the pool alone);
+    * dial failures back off exponentially on the INJECTABLE clock
+      (``reconnect_backoff_s`` doubling up to ``max_backoff_s``) —
+      while the target stays dark every lease fails typed
+      ``BackendUnavailableError`` immediately, without burning a
+      connect timeout per request; the first successful dial resets
+      the backoff;
+    * no internal request retry: a submit that fails is the CALLER's
+      typed outcome (the router's failover policy decides what happens
+      next — the transport must not make that call for it).
+
+    ``reconnects``/``dials`` are plain counters the benches read
+    (``reconnects`` counts dials that REPLACED a dead client, i.e. the
+    PR 12 soak's reconnect stat).  Thread-safe.
+    """
+
+    def __init__(self, host: str, port: int, *, n_bytes: int,
+                 tenant: str = "", size: int = 2, clock=monotonic,
+                 connect_timeout: float = 5.0,
+                 reconnect_backoff_s: float = 0.05,
+                 max_backoff_s: float = 2.0,
+                 max_frame_bytes: int = 256 << 20, tls: bool = False,
+                 tls_ca: str = "", tls_cert: str = "",
+                 tls_key: str = ""):
+        if size < 1:
+            # api-edge: pool config contract
+            raise ValueError(f"pool size must be >= 1, got {size}")
+        if reconnect_backoff_s <= 0 or max_backoff_s < reconnect_backoff_s:
+            # api-edge: pool config contract — a zero base would make
+            # "dark" unrepresentable and hammer a dead target
+            raise ValueError(
+                f"need 0 < reconnect_backoff_s <= max_backoff_s, got "
+                f"{reconnect_backoff_s}/{max_backoff_s}")
+        self.host, self.port = host, int(port)
+        self.n_bytes = int(n_bytes)
+        self.tenant = tenant
+        self.size = int(size)
+        self._clock = clock
+        self._connect_timeout = float(connect_timeout)
+        self._base_backoff = float(reconnect_backoff_s)
+        self._max_backoff = float(max_backoff_s)
+        self._client_kwargs = dict(
+            n_bytes=self.n_bytes, tenant=tenant,
+            connect_timeout=self._connect_timeout,
+            max_frame_bytes=max_frame_bytes, tls=tls, tls_ca=tls_ca,
+            tls_cert=tls_cert, tls_key=tls_key)
+        self._lock = threading.Lock()
+        self._slots: list[EdgeClient | None] = [None] * self.size
+        self._rr = 0
+        self._backoff = 0.0
+        self._dark_until: float | None = None
+        self._closed = False
+        self.reconnects = 0  # dials that replaced a dead client
+        self.dials = 0       # every successful connect
+
+    def _lease(self) -> EdgeClient:
+        with self._lock:
+            if self._closed:
+                raise BackendUnavailableError(
+                    f"pool to {self.host}:{self.port} is closed")
+            # One full round-robin scan for a LIVE slot first: a dead
+            # slot must not force a dial while healthy connections sit
+            # idle beside it.
+            for _ in range(self.size):
+                i = self._rr
+                self._rr = (self._rr + 1) % self.size
+                c = self._slots[i]
+                if c is not None and not c.closed:
+                    return c
+            # Every slot is empty or dead: dial into the current slot,
+            # honoring the dark-target backoff on the injectable clock.
+            now = self._clock()
+            if self._dark_until is not None and now < self._dark_until:
+                raise BackendUnavailableError(
+                    f"target {self.host}:{self.port} is dark; next "
+                    f"dial in {self._dark_until - now:.3f}s "
+                    "(reconnect backoff)")
+            i = self._rr
+            self._rr = (self._rr + 1) % self.size
+            replacing = self._slots[i] is not None
+            try:
+                fresh = EdgeClient(self.host, self.port,
+                                   **self._client_kwargs)
+            except OSError as e:
+                self._backoff = min(
+                    max(2 * self._backoff, self._base_backoff),
+                    self._max_backoff)
+                self._dark_until = now + self._backoff
+                raise BackendUnavailableError(
+                    f"cannot connect to {self.host}:{self.port} "
+                    f"(backing off {self._backoff:.3f}s): {e}") from e
+            self._backoff = 0.0
+            self._dark_until = None
+            self._slots[i] = fresh
+            self.dials += 1
+            if replacing:
+                self.reconnects += 1
+            return fresh
+
+    def submit(self, key_id: str, xs, b: int = 0,
+               deadline_ms: float | None = None,
+               priority=None) -> ServeFuture:
+        return self._lease().submit(key_id, xs, b=b,
+                                    deadline_ms=deadline_ms,
+                                    priority=priority)
+
+    def submit_bytes(self, key_id: str, data, m: int | None = None,
+                     b: int = 0, deadline_ms: float | None = None,
+                     priority=None) -> ServeFuture:
+        return self._lease().submit_bytes(key_id, data, m=m, b=b,
+                                          deadline_ms=deadline_ms,
+                                          priority=priority)
+
+    def evaluate(self, key_id: str, xs, b: int = 0,
+                 deadline_ms: float | None = None,
+                 timeout: float | None = None,
+                 priority=None) -> np.ndarray:
+        return self.submit(key_id, xs, b, deadline_ms,
+                           priority).result(timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            slots, self._slots = self._slots, [None] * self.size
+        for c in slots:
+            if c is not None:
+                c.close()
+
+    def __enter__(self) -> "EdgeClientPool":
         return self
 
     def __exit__(self, *exc) -> bool:

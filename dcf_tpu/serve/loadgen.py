@@ -50,7 +50,8 @@ from dcf_tpu.serve.admission import parse_priority
 from dcf_tpu.utils.benchtime import monotonic
 
 __all__ = ["LoadgenResult", "closed_loop", "ChurnResult",
-           "session_churn", "OpenLoopResult", "open_loop"]
+           "session_churn", "OpenLoopResult", "open_loop",
+           "reconcile_against_rollup"]
 
 
 @dataclass
@@ -85,11 +86,63 @@ class LoadgenResult:
 
 
 def _n_bytes_of(target) -> int:
-    """The point width of any submit target: a ``DcfService`` (via its
-    facade) or an ``EdgeClient`` (which carries ``n_bytes`` itself —
-    the wire client cannot reach through the socket)."""
+    """The point width of any submit target: a ``DcfService``, an
+    ``EdgeClient``/``EdgeClientPool``, or a pod ``DcfRouter`` — every
+    target carries ``n_bytes`` (the wire-side ones cannot reach
+    through the socket; the router carries the pod's)."""
     nb = getattr(target, "n_bytes", None)
     return int(nb) if nb is not None else int(target._dcf.n_bytes)
+
+
+def reconcile_against_rollup(res, rollup_before: dict,
+                             rollup_after: dict) -> dict:
+    """Reconcile one loadgen result against a POD metrics rollup
+    (ISSUE 13 small fix): the PR 6/12 reconciliation compared client
+    counts to ONE service's metrics snapshot, which silently assumed
+    one process — behind a router, each class's sheds (and an
+    open-loop run's accepted/expired counts) land on WHICHEVER shard
+    owned each key, so the server side of the ledger is the SUM over
+    hosts (``serve.metrics.rollup_snapshots`` of the shards'
+    snapshots), never a single service's.
+
+    ``rollup_before``/``rollup_after``: pod rollups bracketing the
+    run (the delta scopes the comparison to this run's traffic; the
+    caller must quiesce other load across the bracket).  Returns a
+    detail dict with per-class ``{"client": n, "pod": n}`` pairs and
+    the overall verdict under ``"reconciled"``.
+
+    What is compared: per-class shed counts (submit-time sheds AND
+    evictions both land in ``serve_shed_by_class_total`` — admission
+    counts evictions as sheds delivered late) for both result types;
+    open-loop results additionally reconcile ``sent`` against
+    ``serve_requests_total`` and ``expired`` against
+    ``serve_deadline_expired_total``.  Edge-tier refusals that never
+    reach a shard queue (tenant token buckets, the router's suspect
+    refusals — which clients see as ``CircuitOpenError`` failures,
+    not sheds) are deliberately OUTSIDE this ledger: they are counted
+    by the tier that refused (``edge_tenant_refusals_total``,
+    ``router_suspect_refusals_total``)."""
+
+    def delta(name: str) -> int:
+        return (rollup_after.get(name, 0) - rollup_before.get(name, 0))
+
+    out: dict = {}
+    ok = True
+    by = getattr(res, "by_class", {}) or {}
+    for pr in ("critical", "normal", "batch"):
+        client = by.get(pr, {}).get("shed", 0)
+        pod = delta(f"serve_shed_by_class_total{{priority={pr}}}")
+        out[f"shed_{pr}"] = {"client": client, "pod": pod}
+        ok = ok and client == pod
+    if isinstance(res, OpenLoopResult):
+        out["sent"] = {"client": res.sent,
+                       "pod": delta("serve_requests_total")}
+        out["expired"] = {"client": res.expired,
+                          "pod": delta("serve_deadline_expired_total")}
+        ok = (ok and out["sent"]["client"] == out["sent"]["pod"]
+              and out["expired"]["client"] == out["expired"]["pod"])
+    out["reconciled"] = ok
+    return out
 
 
 def _client(service, key_ids, stop: threading.Event, res: LoadgenResult,

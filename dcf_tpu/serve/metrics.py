@@ -72,7 +72,8 @@ from bisect import bisect_left
 from typing import Sequence
 
 __all__ = ["Counter", "Gauge", "Histogram", "Metrics", "labeled",
-           "DEFAULT_LATENCY_BOUNDS", "OCCUPANCY_BOUNDS"]
+           "rollup_snapshots", "DEFAULT_LATENCY_BOUNDS",
+           "OCCUPANCY_BOUNDS"]
 
 #: Seconds buckets spanning sub-ms batching decisions to multi-second
 #: CPU-mode large-batch evals.
@@ -82,6 +83,38 @@ DEFAULT_LATENCY_BOUNDS = (
 
 #: Occupancy is a fraction in (0, 1]; padded batches land below 1.
 OCCUPANCY_BOUNDS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+def rollup_snapshots(snapshots) -> dict:
+    """Sum per-host ``Metrics.snapshot()`` dicts into ONE pod view
+    (ISSUE 13): counters and gauges add across hosts (a pod's resident
+    bytes / queue depth / shed totals are the sums), histogram
+    ``*_sum``/``*_count`` add, ``*_buckets`` add elementwise, and
+    ``*_bounds`` must AGREE (same instrument definition on every host
+    — a mismatch raises rather than summing apples onto oranges).
+    Series only some hosts carry (per-tenant/per-key labels) sum over
+    the hosts that have them.  Key order stays sorted — the rollup is
+    itself a valid deterministic snapshot, so the pod benches embed it
+    exactly like a single host's."""
+    out: dict = {}
+    for snap in snapshots:
+        for name, value in snap.items():
+            if name not in out:
+                out[name] = (list(value) if isinstance(value, list)
+                             else value)
+            elif name.endswith("_bounds"):
+                if list(value) != list(out[name]):
+                    # api-edge: rollup contract — two hosts disagreeing
+                    # on an instrument's bucket bounds is a deploy bug,
+                    # not something to average away
+                    raise ValueError(
+                        f"histogram bounds differ across hosts for "
+                        f"{name!r}")
+            elif name.endswith("_buckets"):
+                out[name] = [a + b for a, b in zip(out[name], value)]
+            else:
+                out[name] = out[name] + value
+    return dict(sorted(out.items()))
 
 
 def labeled(name: str, **labels: str) -> str:

@@ -1,0 +1,449 @@
+"""Pod-scale routing tier (ISSUE 13): a zero-copy DCFE router over a
+rendezvous-hashed shard ring.
+
+``DcfRouter`` is the distributed half of the serving tier.  A pod is N
+independent shard processes — each the existing crash-safe,
+breaker-guarded, pool-fed single-host unit (``DcfService`` +
+``EdgeServer``, ISSUE 8/6/11/12) — and the router is the one process
+clients talk to.  It speaks DCFE on BOTH sides:
+
+* **downstream** it IS an ``EdgeServer`` target: the router exposes
+  the service-like surface (``n_bytes``, ``_clock``, ``metrics``,
+  ``config.tenants``, ``submit_bytes``) so the PR 12 edge front — its
+  frame codecs, tenant table, token buckets, per-connection
+  containment and wire fuzz discipline — fronts the router UNCHANGED.
+  Tenancy therefore lives at the pod door: the router's tenant table
+  admits and class-caps requests once, and the shard links ride the
+  open edge (or a TLS-pinned one — see below);
+* **upstream** it forwards through ``EdgeClientPool`` connections, one
+  pool per shard.  Forwarding is HEADER-DECODE ONLY: the edge front
+  decodes the request header and hands this router the payload as a
+  ``memoryview`` of the received frame buffer, and
+  ``EdgeClient.submit_bytes`` relays exactly that view through the
+  scatter-gather send — the packed points are never re-materialized,
+  so PR 12's zero-copy ingest contract holds end to end across two
+  hops (the shard's batcher gathers straight from bytes that were
+  DMA'd off the router's socket).  Responses relay the same way:
+  the share planes decoded off the shard connection are a view of its
+  receive buffer, and ``encode_share`` hands that buffer to the
+  downstream ``sendmsg``.
+
+Placement is the ``serve.shardmap`` rendezvous ring: ``owner(key_id)``
+serves the key, ``ranked(key_id)[1]`` is its replica.  Provisioning
+mirrors the same ranking (``ShardMap.placement``): a durable key's
+DCFK frame is written — ``KeyStore`` discipline, atomic publish,
+generation preserved — into the owner's AND the replica's stores, so
+the host failover lands on has already restored the key at warm-start
+(``restore_keys()``) with the generation the owner registered it
+under.
+
+Failover consumes the EXISTING typed taxonomy as its signal — the
+router invents no second health protocol:
+
+* a TRANSPORT death (connect refused, dark target, a send/read that
+  failed — ``BackendUnavailableError`` with no ``wire_code``) and a
+  shard-side **breaker-open** (``E_CIRCUIT_OPEN``) or **overload/
+  brownout** (``E_QUEUE_FULL``, non-evicted) error frame all mark the
+  shard SUSPECT until the hint's ``retry_after_s`` (or
+  ``suspect_cooldown_s``) elapses on the injectable clock;
+* while the owner is suspect, **CRITICAL** traffic fails over to the
+  key's replica shard — which serves the durably replicated frame,
+  generation preserved — and a CRITICAL request that watched its
+  forward die fails over inline, once, before reporting anything;
+* **everything else is refused typed** with ``CircuitOpenError``
+  carrying the remaining suspect time as ``retry_after_s`` — the same
+  fail-fast contract the per-host breaker board gives a single shard,
+  lifted to the ring;
+* every OTHER typed outcome (unknown key, ``ShapeError``, deadline,
+  ``StaleStateError`` from a hot-swap racing a forwarded eval —
+  ``E_STALE`` keeps it distinguishable on the wire) passes through
+  untouched: key-level outcomes are the caller's, not routing signals.
+
+Cross-host hot-swap needs no new machinery: re-registering a key on
+its shard bumps the registry generation there, and a forwarded eval
+whose group snapshot predates the swap fails ``StaleStateError``
+exactly as an in-process one would (PR 5's guard) — the router relays
+the typed error and never pairs stale images.
+
+TLS (ISSUE 13 satellite): give the router ``tls_*`` client knobs and
+each shard's ``EdgeServer`` a cert (plus ``tls_client_ca`` to PIN the
+router's client cert) and the router<->shard links are encrypted and
+mutually authenticated; the pod door takes the same server knobs
+through the router's ``config``.
+
+Clocking: suspicion math runs on the injectable clock (dcflint
+determinism).  All state is per-router-process; two routers over the
+same ring agree on placement by construction (the ring is a pure
+function) and converge on health independently — suspicion is local
+observation, not consensus.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from dcf_tpu.errors import (
+    BackendUnavailableError,
+    CircuitOpenError,
+    ShapeError,
+)
+from dcf_tpu.serve.admission import Priority, parse_priority
+from dcf_tpu.serve.edge import (
+    E_CIRCUIT_OPEN,
+    E_QUEUE_FULL,
+    EdgeClientPool,
+    EdgeServer,
+)
+from dcf_tpu.serve.metrics import Metrics, labeled
+from dcf_tpu.serve.service import ServeConfig
+from dcf_tpu.serve.shardmap import ShardMap, ShardSpec
+from dcf_tpu.utils.benchtime import monotonic
+
+__all__ = ["DcfRouter"]
+
+
+def _suspect_signal(exc: BaseException) -> bool:
+    """Does ``exc`` indict the SHARD (vs the request)?  Transport
+    death carries no ``wire_code``; breaker-open and overload/brownout
+    arrive as coded error frames.  An EVICTED QueueFullError is a
+    priority-pressure outcome for one request, not host sickness, and
+    ``E_RATE_LIMITED`` (a tenant bucket on the shard link) would be
+    router misconfiguration — neither marks a shard suspect."""
+    code = getattr(exc, "wire_code", None)
+    if code is None:
+        return isinstance(exc, BackendUnavailableError)
+    if code == E_CIRCUIT_OPEN:
+        return True
+    return code == E_QUEUE_FULL and not getattr(exc, "evicted", False)
+
+
+class _RelayFuture:
+    """The future a routed submit returns: waits on the forwarded
+    request and owns the response-time half of the failover policy.
+    The work runs on the WAITER's thread (the edge writer streaming
+    this future, or an in-process caller) — the router spawns no
+    per-request threads."""
+
+    __slots__ = ("_router", "_inner", "_target", "_args")
+
+    def __init__(self, router: "DcfRouter", inner, target: ShardSpec,
+                 args: tuple | None):
+        self._router = router
+        self._inner = inner
+        self._target = target
+        self._args = args  # (key_id, data, m, b, deadline_ms, pri),
+        # or None once failover is spent; holding ``data`` here is safe
+        # because the edge front keeps the frame buffer alive until
+        # this future completes
+
+    def done(self) -> bool:
+        return self._inner.done()
+
+    def result(self, timeout: float | None = None) -> np.ndarray:
+        # One deadline across failovers: a caller's result(5) must
+        # return or raise within ~5s even if the wait is split across
+        # the owner and the replica — the failover wait gets the
+        # REMAINING time, not a fresh budget.
+        deadline = None if timeout is None \
+            else self._router._clock() + timeout
+        while True:
+            remaining = None if deadline is None else max(
+                deadline - self._router._clock(), 0.0)
+            try:
+                return self._inner.result(remaining)
+            except TimeoutError:
+                raise
+            except Exception as e:  # fallback-ok: classified by the
+                # router — a shard-indicting failure marks it suspect
+                # (and may fail over); everything else re-raises as
+                # the caller's typed outcome.  The loop runs the
+                # FAILOVER target's outcome through the same
+                # classification (args spent, so at most one inline
+                # re-route): a transport death on the replica must
+                # also mark it suspect and surface hinted, never
+                # escape bare.
+                retry = self._router._on_forward_failure(
+                    self._target, e, self._args)
+                if retry is None:
+                    raise
+                self._inner, self._target = retry
+                self._args = None  # one inline failover per request
+
+
+class DcfRouter:
+    """DCFE router over a shard ring (see the module docstring).
+
+    ``shards``: a ``ShardMap`` or an iterable of ``ShardSpec``.
+    ``n_bytes``: the pod's packed point width (every shard serves the
+    same geometry; the router cannot discover it over the wire).
+    ``tenants``: the pod-door tenant table (``admission.TenantSpec``)
+    — consumed by the fronting ``EdgeServer`` exactly as a single
+    shard's would be.  ``replicas``: how many ranking successors hold
+    a key's replicated frame (the failover walk goes exactly that
+    deep).  ``pool_size``: connections per shard link.  ``tls_*``:
+    client-side TLS for the shard links (``tls_cert``/``tls_key`` =
+    the router's client cert for pinned shards).
+
+    ``start(host, port)`` fronts the router with its own
+    ``EdgeServer`` (DCFE downstream); in-process callers can skip it
+    and drive ``submit``/``submit_bytes``/``evaluate`` directly (the
+    loadgen's router-target mode)."""
+
+    def __init__(self, shards, *, n_bytes: int, tenants: tuple = (),
+                 clock=monotonic, metrics: Metrics | None = None,
+                 replicas: int = 1, suspect_cooldown_s: float = 1.0,
+                 pool_size: int = 2, connect_timeout: float = 5.0,
+                 reconnect_backoff_s: float = 0.05,
+                 max_frame_bytes: int = 256 << 20, tls: bool = False,
+                 tls_ca: str = "", tls_cert: str = "",
+                 tls_key: str = ""):
+        self.map = shards if isinstance(shards, ShardMap) \
+            else ShardMap(shards)
+        if replicas < 0:
+            # api-edge: router config contract
+            raise ValueError(f"replicas must be >= 0, got {replicas}")
+        if suspect_cooldown_s <= 0:
+            # api-edge: router config contract — a zero cooldown would
+            # mark-and-forget in the same instant, disabling failover
+            raise ValueError(
+                f"suspect_cooldown_s must be > 0, "
+                f"got {suspect_cooldown_s}")
+        self.n_bytes = int(n_bytes)
+        self.replicas = int(replicas)
+        self.suspect_cooldown_s = float(suspect_cooldown_s)
+        self._clock = clock
+        self.metrics = metrics if metrics is not None else Metrics()
+        # The service-like config the fronting EdgeServer reads: the
+        # tenant table is the POD door's admission policy.
+        self.config = ServeConfig(tenants=tuple(tenants))
+        self._lock = threading.Lock()
+        self._suspect_until: dict[str, float] = {}
+        self._pools = {
+            s.host_id: EdgeClientPool(
+                s.host, s.port, n_bytes=self.n_bytes, size=pool_size,
+                clock=clock, connect_timeout=connect_timeout,
+                reconnect_backoff_s=reconnect_backoff_s,
+                max_frame_bytes=max_frame_bytes, tls=tls,
+                tls_ca=tls_ca, tls_cert=tls_cert, tls_key=tls_key)
+            for s in self.map.hosts()}
+        self.edge: EdgeServer | None = None
+        m = self.metrics
+        self._c_forwards = {
+            s.host_id: m.counter(labeled("router_forwards_total",
+                                         shard=s.host_id))
+            for s in self.map.hosts()}
+        self._c_suspected = {
+            s.host_id: m.counter(labeled("router_suspected_total",
+                                         shard=s.host_id))
+            for s in self.map.hosts()}
+        self._c_failovers = m.counter("router_failovers_total")
+        self._c_refused = m.counter("router_suspect_refusals_total")
+        self._g_suspects = m.gauge("router_suspect_shards")
+
+    # -- health -------------------------------------------------------
+
+    def suspect_remaining(self, host_id: str) -> float:
+        """Seconds of suspicion left for ``host_id`` (0 = trusted)."""
+        now = self._clock()
+        with self._lock:
+            return max(self._suspect_until.get(host_id, 0.0) - now, 0.0)
+
+    def mark_suspect(self, host_id: str,
+                     for_s: float | None = None) -> None:
+        """Mark a shard suspect for ``for_s`` seconds (default: the
+        router's cooldown).  Extends, never shortens — two signals
+        racing must not let the later, shorter hint re-admit early."""
+        until = self._clock() + (self.suspect_cooldown_s
+                                 if for_s is None else max(for_s, 0.0))
+        with self._lock:
+            if until > self._suspect_until.get(host_id, 0.0):
+                self._suspect_until[host_id] = until
+            now = self._clock()
+            self._g_suspects.set(sum(
+                1 for t in self._suspect_until.values() if t > now))
+        c = self._c_suspected.get(host_id)
+        if c is not None:
+            c.inc()
+
+    def _on_forward_failure(self, target: ShardSpec,
+                            exc: BaseException, args):
+        """Classify one forwarded request's failure (from the relay
+        future's wait, on the waiter's thread).  Returns ``None`` to
+        re-raise ``exc`` (possibly converted), or ``(inner, target)``
+        for an inline CRITICAL failover that was successfully
+        re-submitted."""
+        if not _suspect_signal(exc):
+            return None  # a key-level outcome: the caller's, verbatim
+        hint = getattr(exc, "retry_after_s", None)
+        self.mark_suspect(target.host_id, hint)
+        if args is not None:
+            key_id, data, m, b, deadline_ms, pri = args
+            if pri is Priority.CRITICAL:
+                ranked = self.map.placement(key_id, self.replicas)
+                for nxt in ranked:
+                    if nxt.host_id == target.host_id \
+                            or self.suspect_remaining(nxt.host_id) > 0:
+                        continue
+                    try:
+                        inner = self._pools[nxt.host_id].submit_bytes(
+                            key_id, data, m=m, b=b,
+                            deadline_ms=deadline_ms, priority=pri)
+                    except BackendUnavailableError:
+                        self.mark_suspect(nxt.host_id)
+                        continue
+                    self._c_failovers.inc()
+                    self._c_forwards[nxt.host_id].inc()
+                    return inner, nxt
+        if hint is None:
+            # Account every refusal: a bare transport death becomes
+            # the ring's typed fail-fast refusal, hint attached (and
+            # counted — this is a router-minted refusal, unlike the
+            # pass-throughs above, which the shard already counted),
+            # so a caller never sees an unhinted routing-tier failure.
+            self._c_refused.inc()
+            raise CircuitOpenError(
+                f"shard {target.host_id!r} is suspect (transport "
+                f"failure: {type(exc).__name__}: {exc}); failing fast "
+                "until the cooldown elapses",
+                retry_after_s=self.suspect_cooldown_s) from exc
+        return None
+
+    # -- submission ---------------------------------------------------
+
+    def submit_bytes(self, key_id: str, data, b: int = 0,
+                     deadline_ms: float | None = None,
+                     priority=Priority.NORMAL):
+        """Route one packed-bytes request (the edge front's entry;
+        mirrors ``DcfService.submit_bytes``).  Returns a future whose
+        failure modes are the shard's own typed taxonomy plus the
+        routing tier's suspect refusal (``CircuitOpenError`` with
+        ``retry_after_s``)."""
+        pri = parse_priority(priority)
+        view = memoryview(data).cast("B")
+        if view.nbytes == 0 or view.nbytes % self.n_bytes:
+            raise ShapeError(
+                f"payload of {view.nbytes} bytes is not a positive "
+                f"multiple of n_bytes={self.n_bytes}")
+        m = view.nbytes // self.n_bytes
+        ranked = self.map.placement(key_id, self.replicas)
+        args = (key_id, view, m, b, deadline_ms, pri)
+        # Walk the placement: the first trusted holder gets the
+        # forward.  Non-CRITICAL traffic only ever sees the owner —
+        # replicas exist for CRITICAL continuity, not load spreading
+        # (spreading would double-serve a key and hide owner sickness).
+        candidates = ranked if pri is Priority.CRITICAL else ranked[:1]
+        first_err: BaseException | None = None
+        for i, target in enumerate(candidates):
+            remaining = self.suspect_remaining(target.host_id)
+            if remaining > 0:
+                if first_err is None:
+                    first_err = CircuitOpenError(
+                        f"shard {target.host_id!r} (owner of "
+                        f"{key_id!r}) is suspect; failing fast",
+                        retry_after_s=remaining)
+                continue
+            try:
+                inner = self._pools[target.host_id].submit_bytes(
+                    key_id, view, m=m, b=b, deadline_ms=deadline_ms,
+                    priority=pri)
+            except BackendUnavailableError as e:
+                # Submit-time transport death: mark and keep walking
+                # (CRITICAL) or refuse typed (everyone else).
+                self.mark_suspect(target.host_id)
+                if first_err is None:
+                    first_err = CircuitOpenError(
+                        f"shard {target.host_id!r} is unreachable "
+                        f"({e}); failing fast until the cooldown "
+                        "elapses",
+                        retry_after_s=self.suspect_cooldown_s)
+                first_err.__cause__ = e
+                continue
+            if i > 0:
+                self._c_failovers.inc()
+            self._c_forwards[target.host_id].inc()
+            # Failover spending rule: the relay future may fail over
+            # inline only if this forward went to the OWNER (a forward
+            # already on a replica has walked the ring once; the
+            # relay's own policy further restricts inline failover to
+            # CRITICAL traffic).
+            relay_args = args if i == 0 else None
+            return _RelayFuture(self, inner, target, relay_args)
+        self._c_refused.inc()
+        raise first_err if first_err is not None else \
+            CircuitOpenError(
+                f"no shard available for {key_id!r}",
+                retry_after_s=self.suspect_cooldown_s)
+
+    def submit(self, key_id: str, xs, b: int = 0,
+               deadline_ms: float | None = None,
+               priority=Priority.NORMAL):
+        """In-process convenience twin of ``DcfService.submit`` — the
+        loadgen's router-target mode (ISSUE 13 satellite: ``open_loop``
+        / ``closed_loop`` drive a router exactly like a service)."""
+        xs = np.ascontiguousarray(np.asarray(xs, dtype=np.uint8))
+        if xs.ndim != 2 or xs.shape[1] != self.n_bytes:
+            raise ShapeError(
+                f"xs must be [M, {self.n_bytes}], got {xs.shape}")
+        if xs.shape[0] < 1:
+            raise ShapeError("cannot submit an empty request")
+        return self.submit_bytes(key_id, xs.data, b=b,
+                                 deadline_ms=deadline_ms,
+                                 priority=priority)
+
+    def evaluate(self, key_id: str, xs, b: int = 0,
+                 deadline_ms: float | None = None,
+                 timeout: float | None = None,
+                 priority=Priority.NORMAL) -> np.ndarray:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(key_id, xs, b, deadline_ms,
+                           priority).result(timeout)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self, host: str = "127.0.0.1", port: int = 0,
+              **edge_kwargs) -> "DcfRouter":
+        """Front the router with its own DCFE ``EdgeServer`` (the pod
+        door).  ``edge_kwargs`` pass through (``tls_cert``/``tls_key``
+        terminate client TLS at the router; ``read_timeout_s`` etc.)."""
+        if self.edge is None:
+            self.edge = EdgeServer(self, host, port,
+                                   **edge_kwargs).start()
+        return self
+
+    @property
+    def address(self) -> tuple[str, int]:
+        if self.edge is None:
+            # api-edge: lifecycle contract, same spelling as EdgeServer
+            raise ValueError("router edge not started (call start())")
+        return self.edge.address
+
+    def close(self) -> None:
+        if self.edge is not None:
+            self.edge.close()
+            self.edge = None
+        for pool in self._pools.values():
+            pool.close()
+
+    def __enter__(self) -> "DcfRouter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # -- observability ------------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        """The ROUTER's own deterministic metrics (forwards per shard,
+        failovers, suspect refusals, plus the fronting edge's series).
+        The pod view — per-shard serve metrics summed — is
+        ``serve.metrics.rollup_snapshots`` over the shards' own
+        snapshots; the router cannot see inside its shards and does
+        not pretend to."""
+        return self.metrics.snapshot()
+
+    def __repr__(self) -> str:
+        return (f"DcfRouter(shards={self.map.host_ids()}, "
+                f"replicas={self.replicas})")

@@ -175,8 +175,24 @@ class ServeConfig:
     batch_timeout_s: float = 0.0
     keyfactory_refill_interval_s: float = 0.05
     tenants: tuple = ()
+    tls_cert: str = ""
+    tls_key: str = ""
+    tls_client_ca: str = ""
 
     def __post_init__(self):
+        # TLS on the edge socket (ISSUE 13 satellite): cert+key arm
+        # the EdgeServer's ssl context; tls_client_ca pins clients
+        # (router<->shard links).  Validated here so a half-configured
+        # keypair dies at config time, not when the first EdgeServer
+        # is constructed.
+        if bool(self.tls_cert) != bool(self.tls_key):
+            # api-edge: config contract — half a keypair serves nothing
+            raise ValueError(
+                "TLS needs BOTH tls_cert and tls_key (got only one)")
+        if self.tls_client_ca and not self.tls_cert:
+            # api-edge: config contract — client pinning needs a
+            # server identity
+            raise ValueError("tls_client_ca requires tls_cert/tls_key")
         for t in self.tenants:
             if not isinstance(t, TenantSpec):
                 # api-edge: config contract — the tenant table is the
@@ -521,6 +537,14 @@ class DcfService:
         return self.registry.key_ids()
 
     # -- submission ---------------------------------------------------------
+
+    @property
+    def n_bytes(self) -> int:
+        """The service's packed point width in bytes — the one shape
+        fact every submit target shares (``EdgeClient`` carries it,
+        the pod router carries its own), so the edge, the loadgen and
+        the router read it without reaching into the facade."""
+        return self._dcf.n_bytes
 
     def submit(self, key_id: str, xs: np.ndarray, b: int = 0,
                deadline_ms: float | None = None,

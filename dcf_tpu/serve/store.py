@@ -531,6 +531,25 @@ class KeyStore:
                 f"and was quarantined ({e})") from e
         return kb, pb, ent["generation"]
 
+    def replicate_to(self, other: "KeyStore", key_id: str) -> int:
+        """Replicate ``key_id``'s durable frame into ``other``
+        PRESERVING its generation (ISSUE 13): the pod provisioning
+        primitive — a key placed by the shard ring is written to its
+        owner's store and replicated to its replica's, so the host
+        CRITICAL traffic fails over to has already restored the key,
+        same bytes, same generation, at its next warm start.
+
+        Validation first (``load`` — a frame this store would
+        quarantine must not propagate its damage), then ``other``'s
+        own atomic-publish + monotonic-generation discipline applies:
+        a replica already holding a NEWER generation keeps it.
+        Returns the generation replicated."""
+        repl_frame = self.load(key_id)  # (bundle, protocol, generation)
+        bundle, protocol, generation = repl_frame
+        other.put(key_id, bundle, protocol=protocol,
+                  generation=generation)
+        return generation
+
     def quarantine(self, key_id: str) -> None:
         """Set ``key_id``'s stored frame aside explicitly — for callers
         that reject a frame on grounds the codec cannot see (e.g. the

@@ -448,3 +448,56 @@ def test_cli_parse_priority_mix_validation():
             _parse_priority_mix(bad)
     with pytest.raises(SystemExit, match="sum to zero"):
         _parse_priority_mix("critical=0,normal=0")
+
+
+@pytest.mark.pod
+def test_cli_pod_bench_validates_flags_fast():
+    """pod_bench/serve_host apply the fail-fast flag discipline: a bad
+    shard count, backend, or request-size range (and a serve_host with
+    nowhere to restore keys from) dies loudly before any subprocess is
+    spawned or a warmup ladder runs."""
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="shards"):
+        cli.main(["pod_bench", "--shards=1"])
+    with pytest.raises(SystemExit, match="facade backends"):
+        cli.main(["pod_bench", "--backend=sharded"])
+    with pytest.raises(SystemExit, match="request-size range"):
+        cli.main(["pod_bench", "--max-batch=64",
+                  "--min-req-points=200"])
+    with pytest.raises(SystemExit, match="store-dir"):
+        cli.main(["serve_host"])
+
+
+@pytest.mark.slow
+@pytest.mark.pod
+def test_cli_pod_bench_smoke(capsys):
+    """ISSUE 13: pod_bench end to end — 3 serve_host shard PROCESSES
+    (+ the solo leg's) warm-restored from ring-placed replicated
+    stores behind the DCFE router, interleaved solo/pod closed-loop
+    legs, the open-loop pod-rollup reconciliation, and the
+    kill-a-shard failover soak with every request accounted (the
+    harness raises SystemExit if any gate fails).  The >= 2.2x
+    throughput gate applies only where the host offers the pod
+    parallelism; on smaller hosts the emitted line records it
+    environment-gated — asserted either way."""
+    recs = run_cli(
+        capsys,
+        ["pod_bench", "--shards=3", "--duration=6", "--bundles=6",
+         "--max-batch=256", "--concurrency=3"],
+    )
+    assert recs[0]["bench"] == "pod_bench"
+    assert recs[0]["shards"] == 3
+    assert recs[0]["soak_mismatches"] == 0
+    assert recs[0]["soak_unaccounted"] == 0
+    assert recs[0]["soak_refused_unhinted"] == 0
+    assert recs[0]["failover_parity"] is True
+    assert recs[0]["generations_held"] is True
+    assert recs[0]["pod_quarantined"] == 0
+    assert recs[0]["open_loop_pod_reconciled"] is True
+    assert recs[0]["router_failovers"] >= 1
+    gate = recs[0]["throughput_gate"]
+    assert gate.startswith("applies") or \
+        gate.startswith("environment-gated")
+    if gate.startswith("applies"):
+        assert recs[0]["pod_vs_single"] >= 2.2

@@ -436,3 +436,35 @@ def test_exception_hygiene_shim_removed():
     exception-hygiene``.  This pins the removal so the shim does not
     quietly resurrect."""
     assert not (REPO / "tools" / "check_exception_hygiene.py").exists()
+
+
+def test_pod_layer_lint_clean():
+    """The ISSUE-13 CI satellite: the pod tier — ``serve/router.py``
+    (the DCFE forwarding/failover core) and ``serve/shardmap.py`` (the
+    rendezvous ring) — sweeps clean under ALL six passes.  Determinism
+    is the load-bearing one: suspicion cooldowns run on the injectable
+    clock and placement on a keyed blake2b digest, never a process-
+    salted hash or ``time.*``; secret-hygiene matters because the
+    router relays SHARE bytes and replication moves whole DCFK
+    frames."""
+    assert run_path(REPO / "dcf_tpu" / "serve" / "router.py") == []
+    assert run_path(REPO / "dcf_tpu" / "serve" / "shardmap.py") == []
+
+
+def test_secret_hygiene_covers_replication_frames(tmp_path):
+    """ISSUE 13: ``repl_frame``/``replica_frame`` joined the
+    key-material name set — a replication buffer is the same DCFK
+    frame on its way to another host's store, so pod-tier code
+    printing or metric-labelling one is flagged like logging the key
+    itself."""
+    write(tmp_path, "serve/podding.py", (
+        "def replicate(key_id, repl_frame, replica_frames, n,"
+        " replicated):\n"
+        "    log(f'shipping {repl_frame}')\n"       # name leak
+        "    counter.inc(len(replica_frames))\n"    # metric sink
+        "    counter.inc(n)\n"                      # scalar: fine
+        "    log(f'state {replicated}')\n"))  # ordinary state name
+    got = [v for v in run_path(tmp_path, ["secret-hygiene"])
+           if v.path.endswith("podding.py")]
+    assert [v.line for v in got] == [2, 3]
+    assert "repl_frame" in got[0].message

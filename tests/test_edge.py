@@ -15,6 +15,7 @@ open-loop (Poisson) loadgen mode with its metric reconciliation.  The
 leg.
 """
 
+import pathlib
 import socket
 import struct
 import threading
@@ -872,6 +873,130 @@ def test_wire_error_frame_decodes_typed(dcf, bundles, rng):
         assert (kind, code) == ("error", E_RATE_LIMITED)
         assert retry == pytest.approx(4 / 10.0, rel=0.5)
         assert {T_SHARE, T_ERROR} == {2, 3}  # layout pins
+    finally:
+        server.close()
+        svc.close()
+
+
+# --------------------------------------------------------------- tls
+
+
+TLS_DIR = pathlib.Path(__file__).parent / "data" / "tls"
+
+
+def test_tls_loopback_parity_and_plaintext_refused(dcf, bundles, prg,
+                                                   rng):
+    """ISSUE 13 TLS satellite: the edge socket behind stdlib ``ssl``
+    — a CA-pinned TLS client round-trips bit-exact vs the numpy
+    oracle, a PLAINTEXT client against the same port dies typed as a
+    per-connection failure, and the accept loop survives to serve the
+    next TLS client."""
+    svc, server = started_edge(
+        dcf, bundles, tls_cert=str(TLS_DIR / "server.pem"),
+        tls_key=str(TLS_DIR / "server.key"))
+    try:
+        xs = rng.integers(0, 256, (6, NB), dtype=np.uint8)
+        with EdgeClient(*server.address, n_bytes=NB, tls=True,
+                        tls_ca=str(TLS_DIR / "ca.pem")) as c:
+            got = c.evaluate("edge-a", xs, b=0, timeout=60) ^ \
+                c.evaluate("edge-a", xs, b=1, timeout=60)
+        assert np.array_equal(got,
+                              recon_oracle(prg, bundles["edge-a"], xs))
+        # Plaintext against the TLS port: the deferred handshake fails
+        # on the reader thread — this connection dies typed, counted.
+        from dcf_tpu.errors import BackendUnavailableError
+
+        before = svc.metrics_snapshot().get(
+            "edge_connection_errors_total", 0)
+        with pytest.raises((BackendUnavailableError, OSError)):
+            plain = EdgeClient(*server.address, n_bytes=NB)
+            try:
+                plain.evaluate("edge-a", xs, b=0, timeout=10)
+            finally:
+                plain.close()
+        # ...and the accept loop is alive for the next TLS peer.
+        with EdgeClient(*server.address, n_bytes=NB, tls=True,
+                        tls_ca=str(TLS_DIR / "ca.pem")) as c:
+            c.evaluate("edge-a", xs, b=0, timeout=60)
+        assert svc.metrics_snapshot().get(
+            "edge_connection_errors_total", 0) > before
+    finally:
+        server.close()
+        svc.close()
+
+
+def test_tls_client_cert_pinning_for_router_links(dcf, bundles, rng):
+    """``tls_client_ca`` pins the router<->shard link: a TLS client
+    WITHOUT the pinned cert fails the handshake typed; one presenting
+    the CA-signed client cert serves."""
+    from dcf_tpu.errors import BackendUnavailableError
+
+    svc, server = started_edge(
+        dcf, bundles, tls_cert=str(TLS_DIR / "server.pem"),
+        tls_key=str(TLS_DIR / "server.key"),
+        tls_client_ca=str(TLS_DIR / "ca.pem"))
+    try:
+        xs = rng.integers(0, 256, (3, NB), dtype=np.uint8)
+        with pytest.raises((BackendUnavailableError, OSError)):
+            c = EdgeClient(*server.address, n_bytes=NB, tls=True,
+                           tls_ca=str(TLS_DIR / "ca.pem"))
+            try:
+                c.evaluate("edge-a", xs, b=0, timeout=10)
+            finally:
+                c.close()
+        with EdgeClient(*server.address, n_bytes=NB, tls=True,
+                        tls_ca=str(TLS_DIR / "ca.pem"),
+                        tls_cert=str(TLS_DIR / "client.pem"),
+                        tls_key=str(TLS_DIR / "client.key")) as c:
+            y = c.evaluate("edge-a", xs, b=0, timeout=60)
+            assert y.shape == (1, 3, LAM)
+    finally:
+        server.close()
+        svc.close()
+
+
+def test_tls_config_validation():
+    with pytest.raises(ValueError, match="BOTH"):
+        ServeConfig(tls_cert="cert.pem")
+    with pytest.raises(ValueError, match="BOTH"):
+        ServeConfig(tls_key="key.pem")
+    with pytest.raises(ValueError, match="tls_client_ca"):
+        ServeConfig(tls_client_ca="ca.pem")
+    # The client validates its keypair BEFORE dialing anything.
+    with pytest.raises(ValueError, match="BOTH"):
+        EdgeClient("127.0.0.1", 1, n_bytes=2, tls=True,
+                   tls_cert="c.pem")
+
+
+def test_open_edge_honors_explicit_class_verbatim(dcf, bundles, rng,
+                                                  monkeypatch):
+    """ISSUE 13 review fix: the OPEN edge (no tenant table) must not
+    clamp an explicit priority byte to the default tenant's NORMAL —
+    that clamp silently demoted every router-forwarded CRITICAL
+    request at its shard.  No table = no policy: the frame's class
+    reaches the service verbatim (a CONFIGURED table still enforces
+    the never-promote cap — pinned elsewhere)."""
+    from dcf_tpu.serve import Priority
+
+    svc, server = started_edge(dcf, bundles)
+    seen = []
+    real = svc.submit_bytes
+
+    def spying(key_id, data, b=0, deadline_ms=None,
+               priority=Priority.NORMAL):
+        seen.append(priority)
+        return real(key_id, data, b=b, deadline_ms=deadline_ms,
+                    priority=priority)
+
+    monkeypatch.setattr(svc, "submit_bytes", spying)
+    try:
+        xs = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+        with EdgeClient(*server.address, n_bytes=NB) as c:
+            c.evaluate("edge-a", xs, priority="critical", timeout=60)
+            c.evaluate("edge-a", xs, timeout=60)  # no byte: NORMAL
+            c.evaluate("edge-a", xs, priority="batch", timeout=60)
+        assert seen == [Priority.CRITICAL, Priority.NORMAL,
+                        Priority.BATCH]
     finally:
         server.close()
         svc.close()

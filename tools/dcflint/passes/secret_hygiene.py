@@ -44,10 +44,15 @@ from tools.dcflint import FileContext, LintPass, register
 
 SECRET_NAME_RE = re.compile(
     r"^(seed\w*|s0s?|cw(_\w+)?|cws|key_bundle|bundle|kb|key_material"
-    r"|cipher_keys?|combine_masks?|frames?|key_frame|shares?(_\w+)?)$")
+    r"|cipher_keys?|combine_masks?|frames?|key_frame"
+    r"|repl(ica)?_frames?|shares?(_\w+)?)$")
 # ``frame`` (ISSUE 8, dcf_tpu/serve/store.py): a serialized DCFK frame
 # is the seeds and correction words it encodes — logging one is
 # logging the key.
+# ``repl_frame``/``replica_frame`` (ISSUE 13, dcf_tpu/serve/store.py
+# ``replicate_to`` + the pod provisioning path): a replication buffer
+# is the SAME DCFK frame on its way to another host's store — the
+# pod tier must not get a logging loophole by renaming the buffer.
 # ``share``/``shares``/``share_*``/``shares_*`` (ISSUE 12,
 # dcf_tpu/serve/edge.py): the network edge holds evaluated SHARE bytes
 # in wire buffers on their way to a party — one logged share next to
