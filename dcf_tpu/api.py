@@ -761,6 +761,24 @@ class Dcf:
         failing CRITICAL traffic over to the replica when a shard
         goes suspect and refusing everything else typed with
         ``retry_after_s``.
+
+        Self-healing (ISSUE 14, README "Pod serving" / Self-healing):
+        the router's ``probe_interval_s`` / ``probe_timeout_s`` /
+        ``probe_fail_n`` / ``probe_recover_m`` knobs arm an active
+        health prober (DCFE PING per shard; ``start_health()`` runs
+        it, ``health.pump()`` drives tests) whose DOWN verdict
+        PROMOTES each victim key's replica to acting owner for every
+        priority class, and whose DOWN -> UP re-admission is gated
+        behind an anti-entropy digest exchange.  LIVE (non-durable)
+        registrations replicate through
+        ``router.register_key``/``register_frame`` — the owner mints
+        the generation, replicas apply it preserved, and the
+        monotonic-generation fence (``StaleStateError`` /
+        ``E_STALE``, ``serve_replica_fenced_total``) makes an old
+        partition side structurally unable to roll a key back.  The
+        shard-side surface is ``register_frame`` /
+        ``apply_replica_frame`` / ``replication_digest`` /
+        ``sync_frames`` on this service.
         """
         from dcf_tpu.serve import DcfService, ServeConfig
 

@@ -3112,6 +3112,476 @@ def _pod_soak(router, bundles, prg, nb, *, duration_s: float,
     return stats
 
 
+def _pod_live_register(router, dcf, rng, lam, nb, count: int,
+                       prefix: str = "live-key") -> tuple:
+    """Register ``count`` LIVE (non-durable) keys through the router's
+    REGISTER fan-out (ISSUE 14): the owner mints each generation, the
+    replicas apply it preserved — the path whose survival the kill and
+    partition soaks gate on.  Returns ``(bundles, generations)``."""
+    live, live_gens = {}, {}
+    for i in range(count):
+        name = f"{prefix}-{i}"
+        alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
+        betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
+        kb = dcf.gen(alphas, betas, rng=rng)
+        live_gens[name] = router.register_key(name, kb)
+        live[name] = kb
+    return live, live_gens
+
+
+def _pod_wire_digest(addr: tuple, nb: int) -> dict:
+    """A shard's live ``{key_id: generation}`` digest over the wire
+    (the DIGEST verb — generations only, no key material moves)."""
+    from dcf_tpu.serve import EdgeClient
+
+    with EdgeClient(addr[0], addr[1], n_bytes=nb) as c:
+        return c.pull_digest(timeout=60)
+
+
+def bench_pod_selfheal(args) -> None:
+    """``pod_bench --partition`` / ``--flap`` (ISSUE 14): the
+    partition-tolerance acceptance scenario.  N shard processes behind
+    the self-healing router; durable keys provisioned through the
+    stores (owner + replica, ``replicate_to``), live keys through the
+    REGISTER fan-out; then a ``net.partition`` window (``--flap``:
+    three windows) cuts the router<->victim link under 3-thread mixed
+    CRITICAL/NORMAL load while the health prober runs.
+
+    Emitted-then-asserted gates:
+
+    * LEDGER: every request reconstructs bit-exact vs the numpy
+      oracle or is refused typed WITH ``retry_after_s`` — zero
+      mismatches, zero untyped, zero unhinted;
+    * PROMOTION: the prober walks the victim to DOWN inside every cut
+      window, and a NORMAL request for a victim-owned key then serves
+      bit-exact from the promoted replica within about one probe
+      interval of the DOWN transition;
+    * HEALING: after every window the victim is re-admitted UP
+      through the anti-entropy gate, its wire digest converges to the
+      owners' generations (including a re-registration minted MID-cut
+      on the reachable side), and generations never regress across
+      cycles;
+    * THE FENCE: a doctored old-generation REGISTER frame sent
+      straight to the victim dies typed ``E_STALE`` and the key keeps
+      serving the newer bits."""
+    import os
+    import shutil
+    import tempfile
+
+    from dcf_tpu.backends.numpy_backend import eval_batch_np
+    from dcf_tpu.errors import StaleStateError
+    from dcf_tpu.ops.prg import HirosePrgNp
+    from dcf_tpu.serve import (
+        DcfRouter,
+        EdgeClient,
+        KeyStore,
+        ShardMap,
+        ShardSpec,
+    )
+    from dcf_tpu.serve.health import DOWN, UP
+    from dcf_tpu.testing import faults
+
+    n_shards = args.shards
+    if n_shards < 2:
+        raise SystemExit(
+            f"--shards must be >= 2 for the partition scenario, "
+            f"got {n_shards}")
+    if args.probe_interval <= 0:
+        raise SystemExit(
+            f"--probe-interval must be > 0, got {args.probe_interval}")
+    if args.live_bundles < 0:
+        raise SystemExit(
+            f"--live-bundles must be >= 0, got {args.live_bundles}")
+    dcf, lam, nb, backend, rng = _serve_host_facade(args)
+    prg = HirosePrgNp(lam, dcf.cipher_keys)
+    n_bundles = args.bundles or 4
+    cycles = 3 if args.flap else 1
+    mode = "flap" if args.flap else "partition"
+
+    keep_dirs = bool(args.store_dir)
+    root = args.store_dir or tempfile.mkdtemp(prefix="dcf-pod-")
+    os.makedirs(root, exist_ok=True)
+    shard_ids = [f"shard-{i}" for i in range(n_shards)]
+    ring = ShardMap([ShardSpec(s) for s in shard_ids])
+    stores = {s: KeyStore(os.path.join(root, s)) for s in shard_ids}
+    bundles, gens = {}, {}
+    for i in range(n_bundles):
+        name = f"key-{i}"
+        alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
+        betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
+        kb = dcf.gen(alphas, betas, rng=rng)
+        bundles[name], gens[name] = kb, i + 1
+        placed = ring.placement(name, replicas=1)
+        stores[placed[0].host_id].put(name, kb, generation=gens[name])
+        for rep in placed[1:]:
+            stores[placed[0].host_id].replicate_to(
+                stores[rep.host_id], name)
+    procs: dict = {}
+    router = None
+    try:
+        for tag in shard_ids:
+            procs[tag] = _pod_spawn(tag, os.path.join(root, tag),
+                                    root, args)
+        ready = _pod_wait_ready(procs)
+        pod_specs = [ShardSpec(s, ready[s]["host"], ready[s]["port"])
+                     for s in shard_ids]
+        addr_of = {s: (ready[s]["host"], ready[s]["port"])
+                   for s in shard_ids}
+        router = DcfRouter(
+            pod_specs, n_bytes=nb,
+            probe_interval_s=args.probe_interval,
+            probe_timeout_s=5.0, probe_fail_n=3,
+            probe_recover_m=2, reconnect_backoff_s=0.02,
+            max_backoff_s=max(min(args.probe_interval, 0.5), 0.02))
+        live, live_gens = _pod_live_register(
+            router, dcf, rng, lam, nb, args.live_bundles)
+        bundles.update(live)
+        gens.update(live_gens)
+        log(f"provisioned {n_bundles} durable + {len(live)} live keys "
+            f"over {n_shards} shards")
+
+        xs_gate = rng.integers(0, 256, (64, nb), dtype=np.uint8)
+        for name, kb in bundles.items():
+            got = router.evaluate(name, xs_gate, b=0, timeout=300) ^ \
+                router.evaluate(name, xs_gate, b=1, timeout=300)
+            want = eval_batch_np(prg, 0, kb.for_party(0), xs_gate) ^ \
+                eval_batch_np(prg, 1, kb.for_party(1), xs_gate)
+            if not np.array_equal(got, want):
+                raise SystemExit(
+                    f"pod_bench parity mismatch vs numpy oracle on "
+                    f"{name}")
+        log(f"routed parity vs numpy oracle: OK ({len(bundles)} keys)")
+
+        owners = {n: ring.owner(n).host_id for n in bundles}
+        by_owner: dict = {}
+        for name, owner in owners.items():
+            by_owner.setdefault(owner, []).append(name)
+        # Warm every padded pow-2 batch shape on every shard (both
+        # parties) — without this the soak pays the XLA compile storm
+        # mid-cut and the ledger measures compilation, not healing.
+        max_batch = args.max_batch or (1 << 10)
+        xs_warm = rng.integers(0, 256, (max_batch, nb), dtype=np.uint8)
+        m = 1
+        while m <= max_batch:
+            for keys in by_owner.values():
+                router.evaluate(keys[0], xs_warm[:m], b=0, timeout=300)
+                router.evaluate(keys[0], xs_warm[:m], b=1, timeout=300)
+            m *= 2
+        log("warmup ladder done (all shards, both parties)")
+        victim = max(by_owner, key=lambda s: len(by_owner[s]))
+        # A key to register MID-cut: its owner stays reachable, its
+        # replica is the cut victim — the heal must converge it.  The
+        # name is always a FRESH one mined from the ring (placement
+        # is a pure function, so the search is deterministic): the
+        # soak clients snapshot their key list before it exists, so
+        # no client ever oracles a key whose bundle this thread is
+        # swapping mid-cut (that would race the bench's bookkeeping,
+        # not the product).
+        midcut_key = next(
+            f"midcut-key-{i}" for i in range(100000)
+            if ring.placement(f"midcut-key-{i}", replicas=1)[0]
+            .host_id != victim
+            and victim in {s.host_id for s in ring.placement(
+                f"midcut-key-{i}", replicas=1)})
+        victim_key = sorted(by_owner[victim])[0]
+
+        router.start_health()
+        deadline = time.monotonic() + 60
+        while any(st != UP for st in router.health.states().values()):
+            if time.monotonic() > deadline:
+                raise SystemExit(
+                    f"pod_bench: prober never saw the pod UP "
+                    f"({router.health.states()})")
+            time.sleep(0.05)
+
+        # The soak clients (ledger accumulates across all cycles).
+        import threading
+
+        stats = {"sessions_ok": 0, "critical_ok": 0, "mismatches": 0,
+                 "refused_hinted": 0, "refused_unhinted": 0,
+                 "unaccounted": 0}
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def client(i: int) -> None:
+            from dcf_tpu.errors import DcfError
+
+            crng = np.random.default_rng(args.seed + 311 * i)
+            names = sorted(bundles)
+            while not stop.is_set():
+                name = names[int(crng.integers(0, len(names)))]
+                pr = "critical" if crng.random() < 0.4 else "normal"
+                m = int(crng.integers(1, 33))
+                xs = crng.integers(0, 256, (m, nb), dtype=np.uint8)
+                try:
+                    f0 = router.submit(name, xs, b=0, priority=pr)
+                    f1 = router.submit(name, xs, b=1, priority=pr)
+                    got = f0.result(120) ^ f1.result(120)
+                except DcfError as e:
+                    hinted = getattr(e, "retry_after_s",
+                                     None) is not None
+                    with lock:
+                        stats["refused_hinted" if hinted else
+                              "refused_unhinted"] += 1
+                    continue
+                except Exception:  # fallback-ok: the gate's failure
+                    # arm — anything untyped is what the soak hunts
+                    with lock:
+                        stats["unaccounted"] += 1
+                    continue
+                kb = bundles[name]
+                want = eval_batch_np(prg, 0, kb.for_party(0), xs) ^ \
+                    eval_batch_np(prg, 1, kb.for_party(1), xs)
+                with lock:
+                    if np.array_equal(got, want):
+                        stats["sessions_ok"] += 1
+                        if pr == "critical":
+                            stats["critical_ok"] += 1
+                    else:
+                        stats["mismatches"] += 1
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True) for i in range(3)]
+        t_soak0 = time.monotonic()
+        for t in threads:
+            t.start()
+        # The cut window must fit several probe ROUNDS even on a
+        # loaded 1-CPU host where a healthy shard's ping can take
+        # seconds — a window shorter than fail_n rounds cannot
+        # demonstrate the DOWN walk, it just measures CPU contention.
+        cut_s = max(float(args.duration) / (3 * cycles),
+                    6 * args.probe_interval, 10.0)
+        down_seen = up_recovered = 0
+        promoted_within: list = []
+        digest_regressions = 0
+        seen_gens: dict = {}
+        try:
+            for cycle in range(cycles):
+                t0 = time.monotonic()
+                handler = faults.partition(
+                    {(router.local_tag, victim)}, clock=time.monotonic,
+                    window=(t0, t0 + cut_s))
+                with faults.inject("net.partition", handler=handler):
+                    # Wait for the prober to mark the victim DOWN.
+                    while time.monotonic() < t0 + cut_s:
+                        if router.health.state(victim) == DOWN:
+                            break
+                        time.sleep(0.02)
+                    if router.health.state(victim) == DOWN:
+                        down_seen += 1
+                        t_down = time.monotonic()
+                        # Promotion: NORMAL traffic for a victim-owned
+                        # key ROUTES to the replica (the submit
+                        # returning un-refused IS the promotion — the
+                        # timed claim is routing availability, not
+                        # this loaded host's eval speed) and serves
+                        # bit-exact.
+                        xs = rng.integers(0, 256, (4, nb),
+                                          dtype=np.uint8)
+                        kb = bundles[victim_key]
+                        try:
+                            f0 = router.submit(victim_key, xs, b=0)
+                            routed_s = time.monotonic() - t_down
+                            f1 = router.submit(victim_key, xs, b=1)
+                            got = f0.result(120) ^ f1.result(120)
+                        except Exception:  # fallback-ok: a missing
+                            # promotion fails the promoted_within
+                            # gate below — counted, not fatal here
+                            got = None
+                        want = eval_batch_np(
+                            prg, 0, kb.for_party(0), xs) ^ \
+                            eval_batch_np(prg, 1, kb.for_party(1), xs)
+                        if got is not None \
+                                and np.array_equal(got, want):
+                            promoted_within.append(routed_s)
+                    if midcut_key is not None:
+                        # Mint a NEWER generation on the reachable
+                        # side mid-cut: the heal must converge it.
+                        alphas = rng.integers(0, 256, (1, nb),
+                                              dtype=np.uint8)
+                        betas = rng.integers(0, 256, (1, lam),
+                                             dtype=np.uint8)
+                        bundles[midcut_key] = dcf.gen(alphas, betas,
+                                                      rng=rng)
+                        gens[midcut_key] = router.register_key(
+                            midcut_key, bundles[midcut_key])
+                    while time.monotonic() < t0 + cut_s:
+                        time.sleep(0.05)
+                # Healed: the prober must re-admit through the
+                # anti-entropy gate.
+                deadline = time.monotonic() + 60
+                while router.health.state(victim) != UP:
+                    if time.monotonic() > deadline:
+                        break
+                    time.sleep(0.05)
+                if router.health.state(victim) == UP:
+                    up_recovered += 1
+                digest = _pod_wire_digest(addr_of[victim], nb)
+                for k, g in digest.items():
+                    if g < seen_gens.get(k, 0):
+                        digest_regressions += 1
+                    seen_gens[k] = max(g, seen_gens.get(k, 0))
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(60)
+        soak_wall_s = time.monotonic() - t_soak0
+
+        # Convergence: the victim holds the owners' generations for
+        # every key the ring places on it.
+        digest = _pod_wire_digest(addr_of[victim], nb)
+        converged = all(
+            digest.get(n) == gens[n] for n in sorted(bundles)
+            if victim in {s.host_id
+                          for s in ring.placement(n, replicas=1)})
+        # The fence: a doctored OLD-generation frame at the victim.
+        alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
+        betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
+        doctored = dcf.gen(alphas, betas, rng=rng)
+        fence_held = False
+        with EdgeClient(*addr_of[victim], n_bytes=nb) as c:
+            try:
+                c.register_frame(victim_key, doctored.to_bytes(),
+                                 generation=gens[victim_key])
+            except StaleStateError:
+                fence_held = True
+        xs_post = rng.integers(0, 256, (16, nb), dtype=np.uint8)
+        kb = bundles[victim_key]
+        got = router.evaluate(victim_key, xs_post, b=0, timeout=300) ^ \
+            router.evaluate(victim_key, xs_post, b=1, timeout=300)
+        want = eval_batch_np(prg, 0, kb.for_party(0), xs_post) ^ \
+            eval_batch_np(prg, 1, kb.for_party(1), xs_post)
+        post_parity = bool(np.array_equal(got, want))
+        log(f"soak: {stats}; down_seen={down_seen}/{cycles} "
+            f"up_recovered={up_recovered}/{cycles} "
+            f"converged={converged} fence_held={fence_held}")
+
+        import jax
+
+        platform = jax.devices()[0].platform
+        rsnap = router.metrics_snapshot()
+        # The denominator is the MEASURED soak wall time (cut windows
+        # + heal waits), not --duration: the cut floor and the gated
+        # re-admission stretch the run, and sessions/s must not be
+        # inflated by a denominator the soak outlived.
+        rate = stats["sessions_ok"] / max(soak_wall_s, 1e-9)
+        extra = {
+            "mode": mode,
+            "shards": n_shards,
+            "bundles": n_bundles,
+            "live_bundles": len(live),
+            "cycles": cycles,
+            "cut_s": round(cut_s, 3),
+            "soak_wall_s": round(soak_wall_s, 3),
+            "probe_interval_s": args.probe_interval,
+            "soak_sessions_ok": stats["sessions_ok"],
+            "soak_critical_ok": stats["critical_ok"],
+            "soak_mismatches": stats["mismatches"],
+            "soak_refused_hinted": stats["refused_hinted"],
+            "soak_refused_unhinted": stats["refused_unhinted"],
+            "soak_unaccounted": stats["unaccounted"],
+            "down_seen": down_seen,
+            "up_recovered": up_recovered,
+            "promoted_serve_s": [round(s, 3)
+                                 for s in promoted_within],
+            "digest_converged": converged,
+            "digest_regressions": digest_regressions,
+            "fence_held": fence_held,
+            "post_heal_parity": post_parity,
+            "anti_entropy_runs": rsnap.get(
+                "router_anti_entropy_runs_total", 0),
+            "anti_entropy_frames": rsnap.get(
+                "router_anti_entropy_frames_total", 0),
+            "promoted_forwards": rsnap.get(
+                "router_promoted_forwards_total", 0),
+            "platform": platform,
+            "repro": (f"python -m dcf_tpu.cli pod_bench --{mode} "
+                      f"--shards {n_shards} "
+                      f"--duration {float(args.duration):g} "
+                      f"--bundles {n_bundles} "
+                      f"--live-bundles {args.live_bundles} "
+                      f"--seed {args.seed}"),
+        }
+        unit = f"sessions/s ({mode} soak, two-party, mixed priority)"
+        if platform != "tpu":
+            unit += (" [no TPU this session: XLA-CPU interpret mode, "
+                     "disclosed]")
+        _emit("pod_bench", backend, "sessions_per_sec", rate, unit,
+              extra_fields=extra)
+
+        failures = []
+        if stats["mismatches"] or stats["unaccounted"] \
+                or stats["refused_unhinted"]:
+            failures.append(
+                f"ledger not clean: {stats['mismatches']} mismatches, "
+                f"{stats['unaccounted']} untyped, "
+                f"{stats['refused_unhinted']} unhinted refusals")
+        if stats["sessions_ok"] < 3 or stats["critical_ok"] < 1:
+            failures.append(
+                f"soak delivered only {stats['sessions_ok']} sessions "
+                f"({stats['critical_ok']} CRITICAL)")
+        if down_seen < cycles:
+            failures.append(
+                f"prober marked the victim DOWN in only {down_seen} "
+                f"of {cycles} cut windows")
+        if up_recovered < cycles:
+            failures.append(
+                f"victim re-admitted UP after only {up_recovered} of "
+                f"{cycles} heals")
+        if len(promoted_within) < down_seen:
+            failures.append(
+                "a victim-owned key did not serve NORMAL traffic from "
+                "its promoted replica during a cut window")
+        elif promoted_within and max(promoted_within) > max(
+                args.probe_interval, 1.0) + 2.0:
+            failures.append(
+                f"promoted replica took {max(promoted_within):.2f}s "
+                "after DOWN (> one probe interval + slack)")
+        if not converged:
+            failures.append(
+                "the victim's digest did not converge to the owners' "
+                "generations after the heal")
+        if digest_regressions:
+            failures.append(
+                f"{digest_regressions} generation regressions across "
+                "cycles")
+        if not fence_held:
+            failures.append(
+                "a doctored old-generation frame was NOT fenced")
+        if not post_parity:
+            failures.append(
+                "the fenced key stopped serving the newer bits")
+        if extra["anti_entropy_runs"] < cycles:
+            failures.append(
+                f"anti-entropy ran only {extra['anti_entropy_runs']} "
+                f"times for {cycles} heals")
+        if extra["anti_entropy_frames"] < cycles:
+            failures.append(
+                f"anti-entropy pulled only "
+                f"{extra['anti_entropy_frames']} frames — the mid-cut "
+                f"registration ({midcut_key}) did not converge "
+                "through the digest exchange")
+        if failures:
+            raise SystemExit("pod_bench: " + "; ".join(failures))
+    finally:
+        if router is not None:
+            try:
+                router.close()
+            except Exception:  # fallback-ok: best-effort teardown
+                pass
+        for tag, (proc, _r, _m) in procs.items():
+            if proc.poll() is None:
+                proc.terminate()
+        for tag, (proc, _r, _m) in procs.items():
+            try:
+                proc.wait(15)
+            except Exception:  # fallback-ok: a shard that ignores
+                # SIGTERM gets the hard kill below
+                proc.kill()
+        if not keep_dirs:
+            shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_pod(args) -> None:
     """The pod-scale serving acceptance bench (ISSUE 13): N localhost
     shard PROCESSES behind the zero-copy DCFE router, vs the same
@@ -3151,8 +3621,21 @@ def bench_pod(args) -> None:
        replica store holds the provisioned generations, and the pod
        rollup shows ZERO quarantines.
 
+    ISSUE 14 upgrades: ``--live-bundles`` NON-durable keys are
+    registered through the router's REGISTER fan-out on top of the
+    durable ones, the health prober runs through every leg, and the
+    kill soak additionally gates that the victim's live keys serve
+    CRITICAL bit-exact from the promoted replica within about one
+    probe interval of the SIGKILL — zero re-keygen, generations
+    preserved on the replica's live registry (checked over the wire
+    via the DIGEST verb).  ``--partition`` / ``--flap`` run the
+    partition-tolerance scenario instead (``bench_pod_selfheal``).
+
     Emits one ``RESULTS_pod`` JSONL line (platform disclosed in-line),
     then applies the exit gates."""
+    if args.partition or args.flap:
+        return bench_pod_selfheal(args)
+
     import os
     import shutil
     import signal
@@ -3172,6 +3655,12 @@ def bench_pod(args) -> None:
         raise SystemExit(
             f"--shards must be >= 2 (a pod of one is the solo leg), "
             f"got {n_shards}")
+    if args.probe_interval <= 0:
+        raise SystemExit(
+            f"--probe-interval must be > 0, got {args.probe_interval}")
+    if args.live_bundles < 0:
+        raise SystemExit(
+            f"--live-bundles must be >= 0, got {args.live_bundles}")
     dcf, lam, nb, backend, rng = _serve_host_facade(args)
     prg = HirosePrgNp(lam, dcf.cipher_keys)
     max_batch = args.max_batch or (1 << 10)
@@ -3237,11 +3726,33 @@ def bench_pod(args) -> None:
                     f"({doc['quarantined']} quarantined)")
         pod_specs = [ShardSpec(s, ready[s]["host"], ready[s]["port"])
                      for s in shard_ids]
-        router = DcfRouter(pod_specs, n_bytes=nb)
+        addr_of = {s: (ready[s]["host"], ready[s]["port"])
+                   for s in shard_ids}
+        router = DcfRouter(pod_specs, n_bytes=nb,
+                           probe_interval_s=args.probe_interval,
+                           probe_timeout_s=5.0,
+                           probe_fail_n=3, probe_recover_m=2,
+                           max_backoff_s=max(
+                               min(args.probe_interval, 0.5), 0.05))
         solo = DcfRouter(
             [ShardSpec("solo", ready["solo"]["host"],
                        ready["solo"]["port"])], n_bytes=nb)
         routers = [router, solo]
+
+        # ISSUE 14: live (NON-durable) keys through the REGISTER
+        # fan-out — owner mints, replica applies, generations
+        # preserved; registered on the solo ring too so both
+        # throughput legs serve the identical key set.
+        live, live_gens = _pod_live_register(
+            router, dcf, rng, lam, nb, args.live_bundles)
+        for name, kb in live.items():
+            solo.register_key(name, kb)
+        bundles.update(live)
+        for name in live:
+            owners[name] = ring.owner(name).host_id
+            by_owner.setdefault(owners[name], []).append(name)
+        log(f"registered {len(live)} live (non-durable) keys through "
+            "the router fan-out")
 
         # Leg 3: routed parity gate (both parties, numpy oracle).
         xs_gate = rng.integers(0, 256, (128, nb), dtype=np.uint8)
@@ -3275,6 +3786,7 @@ def bench_pod(args) -> None:
                                     timeout=300)
             m *= 2
         log("warmup ladder done (all shards + solo, both parties)")
+        router.start_health()  # the control plane runs from here on
 
         # Leg 4: interleaved solo vs pod closed-loop segments.
         segs = 3
@@ -3319,44 +3831,102 @@ def bench_pod(args) -> None:
             f"shed={res_open.shed} expired={res_open.expired} "
             f"pod-reconciled={recon['reconciled']}")
 
-        # Leg 6: kill-a-shard failover soak.  The victim owns keys;
-        # its replicas must pick CRITICAL traffic up.
-        victim = max(by_owner, key=lambda s: len(by_owner[s]))
+        # Leg 6: kill-a-shard failover soak.  The victim owns keys —
+        # preferring a shard that owns LIVE (non-durable) ones, whose
+        # survival on the replica is the ISSUE 14 acceptance — and its
+        # replicas must pick CRITICAL traffic up.
+        victim = max(by_owner, key=lambda s: (
+            len([n for n in by_owner[s] if n in live]),
+            len(by_owner[s])))
         victim_keys = sorted(by_owner[victim])
+        victim_live_keys = sorted(n for n in victim_keys if n in live)
+        kill_stats: dict = {"critical_within_s": None}
+        xs_kill = rng.integers(0, 256, (8, nb), dtype=np.uint8)
 
         def kill_victim() -> None:
-            log(f"soak: SIGKILL {victim} "
-                f"(owner of {len(victim_keys)} keys)")
+            log(f"soak: SIGKILL {victim} (owner of "
+                f"{len(victim_keys)} keys, {len(victim_live_keys)} "
+                "live)")
             procs[victim][0].send_signal(signal.SIGKILL)
+            if not victim_live_keys:
+                return
+            # ISSUE 14 acceptance: CRITICAL traffic for a victim-owned
+            # NON-durable key serves bit-exact from the replica within
+            # about one probe interval of the kill (per-request
+            # failover does not even wait for the prober's DOWN).
+            name = victim_live_keys[0]
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 30:
+                try:
+                    got = router.evaluate(name, xs_kill, b=0,
+                                          timeout=60,
+                                          priority="critical") ^ \
+                        router.evaluate(name, xs_kill, b=1,
+                                        timeout=60,
+                                        priority="critical")
+                except Exception:  # fallback-ok: the window between
+                    # SIGKILL landing and the replica serving IS the
+                    # measurement — keep trying until the deadline
+                    time.sleep(0.02)
+                    continue
+                kb = live[name]
+                want = eval_batch_np(prg, 0, kb.for_party(0),
+                                     xs_kill) ^ \
+                    eval_batch_np(prg, 1, kb.for_party(1), xs_kill)
+                if np.array_equal(got, want):
+                    kill_stats["critical_within_s"] = \
+                        time.monotonic() - t0
+                return
 
         soak_s = max(float(args.duration) / 4, 4.0)
         soak = _pod_soak(router, bundles, prg, nb,
                          duration_s=soak_s, conns=max(conns, 4),
                          seed=args.seed, kill_after_s=soak_s / 3,
                          kill_fn=kill_victim)
-        log(f"soak: {soak}")
+        log(f"soak: {soak} critical_within_s="
+            f"{kill_stats['critical_within_s']}")
 
         # Post-soak: every victim-owned key still serves CRITICAL
-        # bit-exact from its replica, whose store holds the
-        # provisioned generation.
+        # bit-exact from its replica; durable keys' replica STORES
+        # hold the provisioned generation, live keys' replica LIVE
+        # registries hold the owner-minted one (checked over the wire
+        # — zero re-keygen either way: the parity proves the replica
+        # serves the same pre-minted bits).
         failover_parity = True
         generations_held = True
+        # By now the prober has marked the victim DOWN, so NORMAL
+        # traffic is served via promotion too — exercised below.
+        deadline = time.monotonic() + 60
+        while router.health.state(victim) != "down" \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        down_observed = router.health.state(victim) == "down"
         xs_post = rng.integers(0, 256, (16, nb), dtype=np.uint8)
+        rep_digests: dict = {}
         for name in victim_keys:
             kb = bundles[name]
+            pr = "critical" if name not in live else "normal"
             got = router.evaluate(name, xs_post, b=0, timeout=300,
-                                  priority="critical") \
+                                  priority=pr) \
                 ^ router.evaluate(name, xs_post, b=1, timeout=300,
-                                  priority="critical")
+                                  priority=pr)
             want = eval_batch_np(prg, 0, kb.for_party(0), xs_post) ^ \
                 eval_batch_np(prg, 1, kb.for_party(1), xs_post)
             failover_parity &= bool(np.array_equal(got, want))
             rep = next(s.host_id
                        for s in ring.placement(name, replicas=1)[1:])
-            generations_held &= (
-                stores[rep].generation_of(name) == gens[name])
+            if name in live:
+                if rep not in rep_digests:
+                    rep_digests[rep] = _pod_wire_digest(addr_of[rep],
+                                                        nb)
+                generations_held &= (
+                    rep_digests[rep].get(name) == live_gens[name])
+            else:
+                generations_held &= (
+                    stores[rep].generation_of(name) == gens[name])
         log(f"post-kill: replica parity={failover_parity}, "
-            f"generations_held={generations_held}")
+            f"generations_held={generations_held}, "
+            f"down_observed={down_observed}")
         time.sleep(1.2)
         roll_final = _pod_rollup(metric_files)
         quarantined = roll_final.get("serve_store_quarantined_total", 0)
@@ -3395,6 +3965,15 @@ def bench_pod(args) -> None:
             "soak_unaccounted": soak["unaccounted"],
             "failover_parity": failover_parity,
             "generations_held": generations_held,
+            "live_bundles": len(live),
+            "victim_live_keys": len(victim_live_keys),
+            "critical_within_s": (
+                None if kill_stats["critical_within_s"] is None
+                else round(kill_stats["critical_within_s"], 3)),
+            "probe_interval_s": args.probe_interval,
+            "down_observed": down_observed,
+            "promoted_forwards": rsnap.get(
+                "router_promoted_forwards_total", 0),
             "pod_quarantined": quarantined,
             "router_failovers": rsnap.get("router_failovers_total", 0),
             "router_suspect_refusals": rsnap.get(
@@ -3442,7 +4021,22 @@ def bench_pod(args) -> None:
                 "replica after the kill")
         if not generations_held:
             failures.append(
-                "a replica store lost its provisioned generation")
+                "a replica lost its provisioned generation (store or "
+                "live registry)")
+        if victim_live_keys:
+            within = kill_stats["critical_within_s"]
+            if within is None:
+                failures.append(
+                    "CRITICAL traffic for a victim-owned LIVE key "
+                    "never served from the replica after the kill")
+            elif within > max(2 * args.probe_interval, 3.0):
+                failures.append(
+                    f"CRITICAL live-key failover took {within:.2f}s "
+                    "(> ~one probe interval with scheduling slack)")
+            if not down_observed:
+                failures.append(
+                    "the prober never marked the SIGKILLed victim "
+                    "DOWN")
         if quarantined:
             failures.append(
                 f"{quarantined} frames quarantined across the pod")
@@ -3640,6 +4234,32 @@ def main(argv=None) -> None:
                    help="pod_bench: localhost shard processes in the "
                         "pod ring (>= 2; the solo comparison leg is "
                         "spawned on top)")
+    p.add_argument("--live-bundles", type=int, default=4,
+                   help="pod_bench: LIVE (non-durable) keys registered "
+                        "through the router's REGISTER fan-out on top "
+                        "of the --bundles durable ones (ISSUE 14: the "
+                        "kill/partition soaks prove they survive their "
+                        "owner's death on the replica, generations "
+                        "preserved, zero re-keygen)")
+    p.add_argument("--partition", action="store_true",
+                   help="pod_bench: run the partition-tolerance "
+                        "scenario instead — a net.partition window "
+                        "isolates one shard under load; every request "
+                        "completes bit-exact or is refused typed with "
+                        "retry_after_s, the prober walks the victim "
+                        "UP->SUSPECT->DOWN with NORMAL traffic served "
+                        "from promoted replicas, and on heal the "
+                        "anti-entropy gate converges the digest with "
+                        "zero generation regressions (a doctored "
+                        "old-generation frame is fenced typed)")
+    p.add_argument("--flap", action="store_true",
+                   help="pod_bench: the partition scenario with three "
+                        "cut/heal cycles — generations must be "
+                        "monotone across every flap")
+    p.add_argument("--probe-interval", type=float, default=0.25,
+                   help="pod_bench: health-prober probe interval in "
+                        "seconds (fail-3/recover-2 hysteresis rides "
+                        "on it)")
     p.add_argument("--bind", default="127.0.0.1",
                    help="serve_host: address to bind the DCFE edge on")
     p.add_argument("--port", type=int, default=0,

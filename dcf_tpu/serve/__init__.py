@@ -76,9 +76,14 @@ from dcf_tpu.serve.edge import (  # noqa: F401
     EdgeServer,
 )
 from dcf_tpu.serve.frontier_cache import FrontierCache  # noqa: F401
+from dcf_tpu.serve.health import (  # noqa: F401
+    HealthEvent,
+    HealthProber,
+)
 from dcf_tpu.serve.keyfactory import KeyFactory, PoolSpec  # noqa: F401
 from dcf_tpu.serve.metrics import Metrics, rollup_snapshots  # noqa: F401
 from dcf_tpu.serve.registry import KeyRegistry  # noqa: F401
+from dcf_tpu.serve.replicate import Replicator  # noqa: F401
 from dcf_tpu.serve.router import DcfRouter  # noqa: F401
 from dcf_tpu.serve.service import DcfService, ServeConfig  # noqa: F401
 from dcf_tpu.serve.shardmap import ShardMap, ShardSpec  # noqa: F401
@@ -86,7 +91,7 @@ from dcf_tpu.serve.store import KeyStore, RestoreReport  # noqa: F401
 
 __all__ = ["DcfService", "ServeConfig", "ServeFuture", "Priority",
            "TenantSpec", "EdgeServer", "EdgeClient", "EdgeClientPool",
-           "BreakerBoard", "DcfRouter", "FrontierCache", "KeyFactory",
-           "Metrics", "KeyRegistry", "KeyStore", "PoolSpec",
-           "RestoreReport", "ShardMap", "ShardSpec",
-           "rollup_snapshots"]
+           "BreakerBoard", "DcfRouter", "FrontierCache", "HealthEvent",
+           "HealthProber", "KeyFactory", "Metrics", "KeyRegistry",
+           "KeyStore", "PoolSpec", "Replicator", "RestoreReport",
+           "ShardMap", "ShardSpec", "rollup_snapshots"]

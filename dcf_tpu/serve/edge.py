@@ -108,6 +108,40 @@ takes ``tls=/tls_ca=/tls_cert=/tls_key=``; ``EdgeClientPool`` (the
 reusable reconnect-with-backoff transport the pod router forwards
 through — ISSUE 13) passes them along.
 
+Control frames (ISSUE 14, the pod self-healing tier): beside REQUEST/
+SHARE/ERROR the protocol carries four lightweight control verbs —
+
+* **PING** (type 4) / **PONG** (type 5): the health prober's liveness
+  round trip.  Answered straight off the reader thread, admission-free
+  by design: a shard in brownout is ALIVE (it is shedding load on
+  purpose), and refusing pings there would make the router mark it
+  DOWN and promote replicas against a host that is serving CRITICAL
+  traffic fine.  PONG doubles as the generic ack (its ``value`` field
+  carries the registration generation for REGISTER).
+* **REGISTER** (type 6): a DCFK frame forwarded by reference —
+  ``(key_id, generation, proto flag, frame bytes)``.  ``generation=0``
+  asks the receiver to MINT one (the owner-side registration);
+  ``generation>0`` is the replica/anti-entropy spelling: apply with
+  the owner's generation preserved, fenced by the monotonic-generation
+  guard (a frame at or below the local generation dies typed
+  ``StaleStateError`` / ``E_STALE`` — an old partition side is
+  structurally unable to roll a key back).  Not tenant-gated:
+  registration is an operator/router action authenticated by the TLS
+  client-pinning story, not the evaluation admission table.
+* **DIGEST** (type 7) / **SYNC** (type 8): the anti-entropy exchange.
+  Mode 1 asks for the peer's ``{key_id: generation}`` digest (SYNC
+  entries with zero-length frames); mode 0 carries the caller's digest
+  and the SYNC response returns only frames whose generation is
+  STRICTLY newer — the pull half of partition healing
+  (``serve.replicate``).
+
+Partition seam (ISSUE 14): a client constructed with ``tags=(local,
+peer)`` fires ``net.partition`` before each dial and each frame send
+(``testing.faults.partition`` is the canonical handler — it raises
+``OSError`` for cut pairs, which the client contains as transport
+death), so the pod soaks can cut and heal router<->shard links
+deterministically.  Untagged clients never fire it.
+
 Clocking: admission math (buckets, deadlines) uses the service's
 injectable clock, never ``time.*`` (dcflint determinism).  Server-side
 socket reads BLOCK by default — the right behavior for trusted/idle
@@ -154,7 +188,10 @@ from dcf_tpu.utils.benchtime import monotonic
 
 __all__ = ["EdgeServer", "EdgeClient", "EdgeClientPool", "TokenBucket",
            "WIRE_CODES", "MAGIC", "VERSION", "T_REQUEST", "T_SHARE",
-           "T_ERROR", "encode_request", "encode_error"]
+           "T_ERROR", "T_PING", "T_PONG", "T_REGISTER", "T_DIGEST",
+           "T_SYNC", "encode_request", "encode_error", "encode_ping",
+           "encode_pong", "encode_register", "encode_digest",
+           "encode_sync"]
 
 MAGIC = b"DCFE"
 VERSION = 1
@@ -162,6 +199,11 @@ VERSION = 1
 T_REQUEST = 1
 T_SHARE = 2
 T_ERROR = 3
+T_PING = 4      # liveness probe (ISSUE 14: the health prober's frame)
+T_PONG = 5      # ping/register ack; ``value`` carries the generation
+T_REGISTER = 6  # DCFK frame forwarding (mint / fenced replica apply)
+T_DIGEST = 7    # anti-entropy digest exchange request
+T_SYNC = 8      # anti-entropy response: strictly-newer frames
 
 _PREFIX = struct.Struct("<I")        # the length envelope
 _FRAME_HEAD = struct.Struct("<HB")   # version, type (after the magic)
@@ -169,6 +211,13 @@ _BODY_MIN = 4 + _FRAME_HEAD.size     # magic + version + type
 _REQ_HEAD = struct.Struct("<QBBdIHBB")
 _RES_HEAD = struct.Struct("<QHIH")
 _ERR_HEAD = struct.Struct("<QHdH")
+_PING_HEAD = struct.Struct("<Q")     # req_id
+_PONG_HEAD = struct.Struct("<QQ")    # req_id, value
+_REG_HEAD = struct.Struct("<QQBB")   # req_id, generation, proto, key_len
+_DIG_HEAD = struct.Struct("<QBI")    # req_id, mode, entry count
+_DIG_ENTRY = struct.Struct("<QB")    # generation, key_len
+_SYNC_HEAD = struct.Struct("<QI")    # req_id, entry count
+_SYNC_ENTRY = struct.Struct("<QBBI")  # generation, proto, key_len, frame_len
 _CRC = struct.Struct("<I")
 _PRI_DEFAULT = 255  # "the tenant's class" priority byte
 
@@ -351,6 +400,77 @@ def encode_error(req_id: int, code: int, message: str,
     return _frame([head, mb])
 
 
+def encode_ping(req_id: int) -> bytes:
+    """One PING frame (ISSUE 14: the health prober's liveness probe)."""
+    head = MAGIC + _FRAME_HEAD.pack(VERSION, T_PING) + _PING_HEAD.pack(
+        req_id)
+    return _frame([head])
+
+
+def encode_pong(req_id: int, value: int = 0) -> bytes:
+    """PING/REGISTER ack; ``value`` echoes the registration generation
+    (0 for a plain pong)."""
+    head = MAGIC + _FRAME_HEAD.pack(VERSION, T_PONG) + _PONG_HEAD.pack(
+        req_id, value)
+    return _frame([head])
+
+
+def encode_register(req_id: int, key_id: str, frame, generation: int = 0,
+                    proto: bool = False) -> bytes:
+    """One REGISTER frame: a DCFK v2/v3 frame forwarded by reference
+    (``frame`` is any buffer-protocol object — the bundle bytes are
+    never re-materialized here).  ``generation=0`` = mint at the
+    receiver (the owner-side registration); ``generation>0`` = the
+    fenced replica/anti-entropy apply, owner's generation preserved."""
+    kb_name = key_id.encode("utf-8")
+    if len(kb_name) > 255:
+        raise ShapeError("key_id must encode to <= 255 bytes")
+    if generation < 0:
+        raise ShapeError(f"generation must be >= 0, got {generation}")
+    head = MAGIC + _FRAME_HEAD.pack(VERSION, T_REGISTER) + _REG_HEAD.pack(
+        req_id, int(generation), int(bool(proto)), len(kb_name))
+    return _frame([head, kb_name, memoryview(frame)])
+
+
+def encode_digest(req_id: int, digest: dict, mode: int = 0) -> bytes:
+    """One DIGEST frame carrying ``{key_id: generation}``.  ``mode=0``:
+    "here is my digest, send me strictly-newer frames"; ``mode=1``:
+    "report your digest" (the response's SYNC entries then carry
+    zero-length frames).  Entries are emitted in sorted key order —
+    deterministic bytes for a given digest."""
+    if mode not in (0, 1):
+        raise ShapeError(f"digest mode must be 0 or 1, got {mode}")
+    parts = [MAGIC + _FRAME_HEAD.pack(VERSION, T_DIGEST)
+             + _DIG_HEAD.pack(req_id, mode, len(digest))]
+    for key_id in sorted(digest):
+        kb_name = key_id.encode("utf-8")
+        if len(kb_name) > 255:
+            raise ShapeError("key_id must encode to <= 255 bytes")
+        parts.append(_DIG_ENTRY.pack(int(digest[key_id]), len(kb_name)))
+        parts.append(kb_name)
+    return _frame(parts)
+
+
+def encode_sync(req_id: int, entries) -> bytes:
+    """One SYNC frame: ``entries`` is a list of ``(key_id, generation,
+    proto, frame)`` tuples (``frame`` = DCFK bytes, or ``b""`` for a
+    digest-only reply)."""
+    entries = list(entries)
+    parts = [MAGIC + _FRAME_HEAD.pack(VERSION, T_SYNC)
+             + _SYNC_HEAD.pack(req_id, len(entries))]
+    for key_id, generation, proto, frame in entries:
+        kb_name = key_id.encode("utf-8")
+        view = memoryview(frame).cast("B") if frame else memoryview(b"")
+        if len(kb_name) > 255:
+            raise ShapeError("key_id must encode to <= 255 bytes")
+        parts.append(_SYNC_ENTRY.pack(int(generation), int(bool(proto)),
+                                      len(kb_name), view.nbytes))
+        parts.append(kb_name)
+        if view.nbytes:
+            parts.append(view)
+    return _frame(parts)
+
+
 def _check_body(body, claims: str) -> memoryview:
     """Shared strict-decode head: magic, version, CRC over the whole
     body — ``KeyFormatError`` naming the field, DCFK discipline."""
@@ -423,9 +543,130 @@ def decode_request(body) -> dict:
     }
 
 
+def decode_ping(body) -> int:
+    """Strict PING decode -> ``req_id``."""
+    view = _check_body(body, "a ping")
+    _, ftype = _FRAME_HEAD.unpack_from(view, 4)
+    if ftype != T_PING:
+        raise KeyFormatError(f"frame type {ftype} is not a ping")
+    if view.nbytes != _BODY_MIN + _PING_HEAD.size + _CRC.size:
+        raise KeyFormatError(
+            f"ping frame must be exactly "
+            f"{_BODY_MIN + _PING_HEAD.size + _CRC.size} bytes, "
+            f"got {view.nbytes}")
+    (req_id,) = _PING_HEAD.unpack_from(view, _BODY_MIN)
+    return req_id
+
+
+def decode_register(body) -> dict:
+    """Strict REGISTER decode.  ``frame`` is a zero-copy ``memoryview``
+    of the DCFK bytes inside ``body`` (the caller owns the buffer)."""
+    view = _check_body(body, "a register")
+    _, ftype = _FRAME_HEAD.unpack_from(view, 4)
+    if ftype != T_REGISTER:
+        raise KeyFormatError(f"frame type {ftype} is not a register")
+    if view.nbytes < _BODY_MIN + _REG_HEAD.size + _CRC.size:
+        raise KeyFormatError(
+            f"truncated frame: {view.nbytes} bytes cannot hold a "
+            "register header")
+    req_id, generation, proto, key_len = _REG_HEAD.unpack_from(
+        view, _BODY_MIN)
+    if proto not in (0, 1):
+        raise KeyFormatError(
+            f"register proto flag must be 0 or 1, got {proto}")
+    off = _BODY_MIN + _REG_HEAD.size
+    end = view.nbytes - _CRC.size
+    if off + key_len > end:
+        raise KeyFormatError(
+            f"truncated frame: section 'key_id' needs bytes "
+            f"[{off}, {off + key_len}) but the payload ends at {end}")
+    key_id = bytes(view[off:off + key_len]).decode("utf-8", "replace")
+    off += key_len
+    if off >= end:
+        raise KeyFormatError(
+            "register frame carries no DCFK payload (a zero-byte "
+            "frame cannot be a key)")
+    return {"req_id": req_id, "key_id": key_id,
+            "generation": generation, "proto": bool(proto),
+            "frame": view[off:end]}
+
+
+def decode_digest(body) -> tuple:
+    """Strict DIGEST decode -> ``(req_id, mode, {key_id: generation})``."""
+    view = _check_body(body, "a digest")
+    _, ftype = _FRAME_HEAD.unpack_from(view, 4)
+    if ftype != T_DIGEST:
+        raise KeyFormatError(f"frame type {ftype} is not a digest")
+    if view.nbytes < _BODY_MIN + _DIG_HEAD.size + _CRC.size:
+        raise KeyFormatError(
+            f"truncated frame: {view.nbytes} bytes cannot hold a "
+            "digest header")
+    req_id, mode, count = _DIG_HEAD.unpack_from(view, _BODY_MIN)
+    if mode not in (0, 1):
+        raise KeyFormatError(
+            f"digest mode must be 0 or 1, got {mode}")
+    off = _BODY_MIN + _DIG_HEAD.size
+    end = view.nbytes - _CRC.size
+    digest: dict = {}
+    for i in range(count):
+        if off + _DIG_ENTRY.size > end:
+            raise KeyFormatError(
+                f"truncated frame: digest entry {i} needs bytes "
+                f"[{off}, {off + _DIG_ENTRY.size}) but the payload "
+                f"ends at {end} (header claims {count} entries)")
+        generation, key_len = _DIG_ENTRY.unpack_from(view, off)
+        off += _DIG_ENTRY.size
+        if off + key_len > end:
+            raise KeyFormatError(
+                f"truncated frame: digest entry {i}'s key_id "
+                f"overruns the payload (header claims {count} entries)")
+        key_id = bytes(view[off:off + key_len]).decode("utf-8",
+                                                       "replace")
+        off += key_len
+        digest[key_id] = generation
+    if off != end:
+        raise KeyFormatError(
+            f"oversized frame: {end - off} trailing bytes after "
+            f"{count} digest entries")
+    return req_id, mode, digest
+
+
+def _decode_sync_entries(view: memoryview, off: int, end: int,
+                         count: int) -> list:
+    entries = []
+    for i in range(count):
+        if off + _SYNC_ENTRY.size > end:
+            raise KeyFormatError(
+                f"truncated frame: sync entry {i} needs bytes "
+                f"[{off}, {off + _SYNC_ENTRY.size}) but the payload "
+                f"ends at {end} (header claims {count} entries)")
+        generation, proto, key_len, frame_len = _SYNC_ENTRY.unpack_from(
+            view, off)
+        if proto not in (0, 1):
+            raise KeyFormatError(
+                f"sync entry {i} proto flag must be 0 or 1, got {proto}")
+        off += _SYNC_ENTRY.size
+        if off + key_len + frame_len > end:
+            raise KeyFormatError(
+                f"truncated frame: sync entry {i}'s sections overrun "
+                f"the payload (header claims {count} entries)")
+        key_id = bytes(view[off:off + key_len]).decode("utf-8",
+                                                       "replace")
+        off += key_len
+        frame = bytes(view[off:off + frame_len])
+        off += frame_len
+        entries.append((key_id, generation, bool(proto), frame))
+    if off != end:
+        raise KeyFormatError(
+            f"oversized frame: {end - off} trailing bytes after "
+            f"{count} sync entries")
+    return entries
+
+
 def decode_response(body) -> tuple:
-    """Client-side strict decode: ``("share", req_id, y)`` or
-    ``("error", req_id, code, retry_after_s, message)``."""
+    """Client-side strict decode: ``("share", req_id, y)``,
+    ``("error", req_id, code, retry_after_s, message)``,
+    ``("pong", req_id, value)`` or ``("sync", req_id, entries)``."""
     view = _check_body(body, "a response")
     _, ftype = _FRAME_HEAD.unpack_from(view, 4)
     end = view.nbytes - _CRC.size
@@ -453,9 +694,24 @@ def decode_response(body) -> tuple:
         msg = bytes(view[off:end]).decode("utf-8", "replace")
         return ("error", req_id, code,
                 retry if retry >= 0 else None, msg)
+    if ftype == T_PONG:
+        if view.nbytes != _BODY_MIN + _PONG_HEAD.size + _CRC.size:
+            raise KeyFormatError(
+                f"pong frame must be exactly "
+                f"{_BODY_MIN + _PONG_HEAD.size + _CRC.size} bytes, "
+                f"got {view.nbytes}")
+        req_id, value = _PONG_HEAD.unpack_from(view, _BODY_MIN)
+        return ("pong", req_id, value)
+    if ftype == T_SYNC:
+        if view.nbytes < _BODY_MIN + _SYNC_HEAD.size + _CRC.size:
+            raise KeyFormatError("truncated frame: no sync header")
+        req_id, count = _SYNC_HEAD.unpack_from(view, _BODY_MIN)
+        entries = _decode_sync_entries(
+            view, _BODY_MIN + _SYNC_HEAD.size, end, count)
+        return ("sync", req_id, entries)
     raise KeyFormatError(
         f"frame type {ftype} is not a response (client side accepts "
-        "types 2 and 3)")
+        "types 2, 3, 5 and 8)")
 
 
 # ------------------------------------------------------ admission
@@ -650,7 +906,7 @@ class _Conn:
                 if body is None:
                     break
                 srv._c_frames.inc()
-                self._handle_request(body)
+                self._handle_frame(body)
         except KeyFormatError as e:
             # Framing violation (bad magic/length/CRC, from the
             # envelope read or the frame decode): answer typed, then
@@ -676,6 +932,104 @@ class _Conn:
         finally:
             self._enqueue(None)  # writer drains what is queued, then
             srv._forget(self)   # the connection is gone
+
+    def _handle_frame(self, body: bytearray) -> None:
+        """Dispatch one decoded-length frame by type.  ``_read_frame``
+        already bounds ``body`` at >= the header size, so the type
+        peek cannot overrun; a corrupt type byte lands in a decoder
+        whose CRC/type check dies ``KeyFormatError`` — the framing
+        kill, exactly like any other mangled frame."""
+        ftype = body[6]  # after magic (4) + version (2)
+        if ftype == T_REQUEST:
+            self._handle_request(body)
+        elif ftype == T_PING:
+            req_id = decode_ping(body)
+            self._srv._c_control.inc()
+            # Admission-free by design: liveness, not serving capacity
+            # (a shard in brownout is alive and must answer probes —
+            # see the module docstring's control-frame section).
+            self._enqueue(("ctl", encode_pong(req_id, 0)))
+        elif ftype == T_REGISTER:
+            self._handle_register(body)
+        elif ftype == T_DIGEST:
+            self._handle_digest(body)
+        else:
+            raise KeyFormatError(
+                f"frame type {ftype} is not a server-side frame "
+                "(server side accepts types 1, 4, 6 and 7)")
+
+    def _handle_register(self, body: bytearray) -> None:
+        req = decode_register(body)
+        srv = self._srv
+        req_id = req["req_id"]
+        srv._c_control.inc()
+        try:
+            if req["generation"]:
+                apply_fn = getattr(srv._service, "apply_replica_frame",
+                                   None)
+                if apply_fn is None:
+                    # api-edge: surface contract — this endpoint (e.g.
+                    # a pod router's own door when the frame carries a
+                    # forced generation it should never see) does not
+                    # accept replica applies
+                    raise ValueError(
+                        "this endpoint does not accept replica "
+                        "REGISTER frames (no apply_replica_frame "
+                        "surface)")
+                gen = apply_fn(req["key_id"], req["frame"],
+                               req["generation"], proto=req["proto"])
+            else:
+                mint_fn = getattr(srv._service, "register_frame", None)
+                if mint_fn is None:
+                    # api-edge: surface contract
+                    raise ValueError(
+                        "this endpoint does not accept REGISTER "
+                        "frames (no register_frame surface)")
+                gen = mint_fn(req["key_id"], req["frame"],
+                              proto=req["proto"])
+        except Exception as e:  # fallback-ok: a refused registration
+            # (fenced generation -> E_STALE, geometry mismatch, corrupt
+            # DCFK payload) is a REQUEST-level outcome — answer typed,
+            # keep the connection (framing was intact).
+            srv._c_refused.inc()
+            self._enqueue(encode_error(
+                req_id, _code_for(e), str(e),
+                getattr(e, "retry_after_s", None)))
+            return
+        self._enqueue(("ctl", encode_pong(req_id, int(gen))))
+
+    def _handle_digest(self, body: bytearray) -> None:
+        req_id, mode, digest = decode_digest(body)
+        srv = self._srv
+        srv._c_control.inc()
+        try:
+            if mode == 1:
+                dig_fn = getattr(srv._service, "replication_digest",
+                                 None)
+                if dig_fn is None:
+                    # api-edge: surface contract (a router holds no
+                    # registrations to digest)
+                    raise ValueError(
+                        "this endpoint holds no registrations to "
+                        "digest (no replication_digest surface)")
+                entries = [(k, g, False, b"")
+                           for k, g in sorted(dig_fn().items())]
+            else:
+                sync_fn = getattr(srv._service, "sync_frames", None)
+                if sync_fn is None:
+                    # api-edge: surface contract
+                    raise ValueError(
+                        "this endpoint cannot serve an anti-entropy "
+                        "pull (no sync_frames surface)")
+                entries = sync_fn(digest)
+        except Exception as e:  # fallback-ok: request-level outcome,
+            # answered typed; the connection survives
+            srv._c_refused.inc()
+            self._enqueue(encode_error(
+                req_id, _code_for(e), str(e),
+                getattr(e, "retry_after_s", None)))
+            return
+        self._enqueue(("ctl", encode_sync(req_id, entries)))
 
     def _handle_request(self, body: bytearray) -> None:
         req = decode_request(body)
@@ -764,6 +1118,10 @@ class _Conn:
                 if isinstance(item, (bytes, bytearray)):
                     self._sock.sendall(item)
                     srv._c_errors_sent.inc()
+                    continue
+                if item[0] == "ctl":  # PONG/SYNC control responses
+                    self._sock.sendall(item[1])
+                    srv._c_responses.inc()
                     continue
                 req_id, fut, _body = item
                 try:
@@ -892,6 +1250,7 @@ class EdgeServer:
         self._c_refused = m.counter("edge_refused_total")
         self._c_responses = m.counter("edge_responses_total")
         self._c_errors_sent = m.counter("edge_errors_sent_total")
+        self._c_control = m.counter("edge_control_frames_total")
 
     # -- lifecycle ----------------------------------------------------
 
@@ -1056,9 +1415,16 @@ class EdgeClient:
                  tenant: str = "", connect_timeout: float = 30.0,
                  max_frame_bytes: int = 256 << 20, tls: bool = False,
                  tls_ca: str = "", tls_cert: str = "",
-                 tls_key: str = ""):
+                 tls_key: str = "", tags: tuple | None = None):
         self.n_bytes = int(n_bytes)
         self.tenant = tenant
+        # Partition seam identity (ISSUE 14): ``(local, peer)`` tags —
+        # when set, every dial and every frame send fires
+        # ``net.partition`` so the chaos harness can cut this link
+        # (the handler raises OSError, contained as transport death).
+        self._tags = tuple(tags) if tags is not None else None
+        if self._tags is not None:
+            fire("net.partition", *self._tags)
         # Response-frame sanity bound (mirrors the server's request
         # knob): a SHARE payload is k*m*lam — raise this when a
         # large-lambda service legitimately returns more than 256 MiB
@@ -1175,6 +1541,8 @@ class EdgeClient:
             self._pending[req_id] = fut
         try:
             with self._send_lock:
+                if self._tags is not None:
+                    fire("net.partition", *self._tags)
                 _sendmsg_all(self._sock,
                              [_PREFIX.pack(body_len), *views,
                               _CRC.pack(crc)])
@@ -1189,6 +1557,84 @@ class EdgeClient:
             self._fail_pending(err)
             raise err from e
         return fut
+
+    # -- control frames (ISSUE 14) ------------------------------------
+
+    def _roundtrip(self, encode, timeout: float | None):
+        """Register a future, send one control frame (``encode(req_id)
+        -> frame bytes``), wait for its response.  Send failures take
+        the submit path's transport-death containment; a TIMEOUT prunes
+        the pending entry (a prober timing out every interval must not
+        grow ``_pending`` without bound — a late response to a pruned
+        id is dropped by the reader, harmless)."""
+        with self._lock:
+            if self._closed:
+                raise BackendUnavailableError(
+                    "edge connection is closed")
+            req_id = self._next_id
+            self._next_id += 1
+        # Encode BEFORE registering: same orphaned-future rule as
+        # submit_bytes.
+        wire = encode(req_id)
+        fut = ServeFuture()
+        with self._lock:
+            if self._closed:
+                raise BackendUnavailableError(
+                    "edge connection is closed")
+            self._pending[req_id] = fut
+        try:
+            with self._send_lock:
+                if self._tags is not None:
+                    fire("net.partition", *self._tags)
+                self._sock.sendall(wire)
+        except OSError as e:
+            err = BackendUnavailableError(
+                f"edge connection lost on send: {e}")
+            self._fail_pending(err)
+            raise err from e
+        try:
+            return fut.result(timeout)
+        except TimeoutError:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise
+
+    def ping(self, timeout: float | None = None) -> bool:
+        """One PING round trip (ISSUE 14: the health prober's liveness
+        probe).  Returns True, or raises — transport death typed
+        ``BackendUnavailableError``, an unanswered probe the builtin
+        ``TimeoutError``."""
+        self._roundtrip(encode_ping, timeout)
+        return True
+
+    def register_frame(self, key_id: str, frame, generation: int = 0,
+                       proto: bool = False,
+                       timeout: float | None = None) -> int:
+        """Forward one DCFK frame for registration (ISSUE 14).
+        ``generation=0`` mints at the receiver (owner registration);
+        ``generation>0`` is the fenced replica apply — a receiver
+        already at or past that generation raises the real
+        ``StaleStateError`` here (``E_STALE``).  Returns the
+        generation the key is registered under."""
+        return int(self._roundtrip(
+            lambda rid: encode_register(rid, key_id, frame,
+                                        generation, proto), timeout))
+
+    def pull_digest(self, timeout: float | None = None) -> dict:
+        """The peer's live ``{key_id: generation}`` registration
+        digest (anti-entropy, mode 1 — no frame bytes move)."""
+        entries = self._roundtrip(
+            lambda rid: encode_digest(rid, {}, mode=1), timeout)
+        return {k: g for k, g, _p, _f in entries}
+
+    def sync_newer(self, digest: dict,
+                   timeout: float | None = None) -> list:
+        """Anti-entropy pull (mode 0): send ``digest`` and receive
+        ``(key_id, generation, proto, frame)`` entries for every key
+        the peer holds at a STRICTLY newer generation."""
+        return self._roundtrip(
+            lambda rid: encode_digest(rid, dict(digest), mode=0),
+            timeout)
 
     def evaluate(self, key_id: str, xs, b: int = 0,
                  deadline_ms: float | None = None,
@@ -1226,7 +1672,7 @@ class EdgeClient:
                     break  # mid-frame EOF: fail pending below
                 kind, req_id, *rest = decode_response(body)
                 fut = self._pending.pop(req_id, None)
-                if kind == "share":
+                if kind in ("share", "pong", "sync"):
                     if fut is not None:
                         fut.set_result(rest[0])
                 elif fut is not None:
@@ -1321,7 +1767,7 @@ class EdgeClientPool:
                  max_backoff_s: float = 2.0,
                  max_frame_bytes: int = 256 << 20, tls: bool = False,
                  tls_ca: str = "", tls_cert: str = "",
-                 tls_key: str = ""):
+                 tls_key: str = "", tags: tuple | None = None):
         if size < 1:
             # api-edge: pool config contract
             raise ValueError(f"pool size must be >= 1, got {size}")
@@ -1343,7 +1789,7 @@ class EdgeClientPool:
             n_bytes=self.n_bytes, tenant=tenant,
             connect_timeout=self._connect_timeout,
             max_frame_bytes=max_frame_bytes, tls=tls, tls_ca=tls_ca,
-            tls_cert=tls_cert, tls_key=tls_key)
+            tls_cert=tls_cert, tls_key=tls_key, tags=tags)
         self._lock = threading.Lock()
         self._slots: list[EdgeClient | None] = [None] * self.size
         self._rr = 0
@@ -1417,6 +1863,41 @@ class EdgeClientPool:
                  priority=None) -> np.ndarray:
         return self.submit(key_id, xs, b, deadline_ms,
                            priority).result(timeout)
+
+    # -- control frames (ISSUE 14: the health/replication surface) ----
+
+    def ping(self, timeout: float | None = None) -> bool:
+        """One PING round trip through a leased connection — the
+        health prober's probe.  While the target is dark the lease
+        fails typed inside the backoff without dialing, so probe
+        frequency against a dead host is bounded by ``max_backoff_s``
+        (recovery detection is therefore at most one backoff late —
+        and the UP transition clamps the backoff so REQUESTS never
+        wait it out; see ``reset_backoff``)."""
+        return self._lease().ping(timeout)
+
+    def register_frame(self, key_id: str, frame, generation: int = 0,
+                       proto: bool = False,
+                       timeout: float | None = None) -> int:
+        return self._lease().register_frame(key_id, frame, generation,
+                                            proto, timeout)
+
+    def pull_digest(self, timeout: float | None = None) -> dict:
+        return self._lease().pull_digest(timeout)
+
+    def sync_newer(self, digest: dict,
+                   timeout: float | None = None) -> list:
+        return self._lease().sync_newer(digest, timeout)
+
+    def reset_backoff(self) -> None:
+        """Clamp the dial backoff to zero (ISSUE 14 satellite): the
+        health prober just CONFIRMED the target is up, so a pool that
+        accumulated the full exponential backoff during a long outage
+        must not keep failing leases fast until it drains — the next
+        lease dials immediately."""
+        with self._lock:
+            self._backoff = 0.0
+            self._dark_until = None
 
     def close(self) -> None:
         with self._lock:

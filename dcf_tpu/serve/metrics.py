@@ -55,6 +55,27 @@ escaped per-pool containment, e.g. a dying store's reclaim flip —
 counted, never silently swallowed).  Pool-hit rate =
 hits / (hits + misses); ``keyfactory_bench`` reports it per run.
 
+Self-healing series (ISSUE 14, recorded by ``serve.health``,
+``serve.replicate`` and the router): ``router_health_state{shard=}``
+(0 up / 1 suspect / 2 down), ``router_probes_total{shard=}`` /
+``router_probe_failures_total{shard=}``,
+``router_health_transitions_total`` (+ ``{to=...}``),
+``router_down_shards``, ``router_recover_gate_failures_total``,
+``router_promoted_forwards_total`` (forwards served by a replica
+promoted past a DOWN owner — the health plane's counterpart of
+``router_failovers_total``, which stays the request-suspicion walk),
+``router_down_refusals_total`` (every placed holder DOWN);
+replication: ``router_registered_total`` /
+``router_replicated_total`` / ``router_replicate_failures_total`` /
+``router_replica_fenced_total``, ``router_anti_entropy_runs_total`` /
+``router_anti_entropy_frames_total`` /
+``router_anti_entropy_fenced_total``, and the shard-side
+``serve_replica_applied_total`` / ``serve_replica_fenced_total``
+(the monotonic-generation fence firing).  Host-churn hygiene: the
+prober's and router's per-shard series are removed with the host
+(``HealthProber.remove_target`` / ``DcfRouter.set_ring``), the
+``BreakerBoard.forget`` discipline.
+
 Secret hygiene: metric NAMES are static strings and metric values are
 scalars; key ids chosen by callers become label values via ``labeled``
 and must never be derived from key material (the dcflint secret-hygiene

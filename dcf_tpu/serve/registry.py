@@ -226,6 +226,56 @@ class KeyRegistry:
             self._g_registered.set(len(self._entries))
             return self._generation
 
+    def register_at(self, key_id: str, bundle: KeyBundle,
+                    generation: int, protocol=None) -> int:
+        """Register ``key_id`` under a FORCED generation (ISSUE 14:
+        the replica-apply / anti-entropy path — the generation was
+        minted by the key's OWNER and must be preserved so the ring
+        agrees on one total order per key).  The monotonic-generation
+        fence: an entry already at or past ``generation`` raises
+        ``StaleStateError`` — an old partition side is structurally
+        unable to roll a key back, because the only way to supersede a
+        registration is a strictly newer generation.  The registry's
+        own counter advances past the applied generation, so a later
+        LOCAL hot-swap of any key mints strictly above everything this
+        registry has ever seen (the restart-ordering guarantee: a
+        recovered owner anti-entropies FIRST, flooring its counter on
+        the replica's generations, and only then re-admits traffic —
+        its next mint can never alias a pre-crash generation)."""
+        if generation < 1:
+            # api-edge: replication contract — generation 0 is the
+            # wire's "mint here" sentinel, never a forced apply
+            raise ValueError(
+                f"register_at({key_id!r}) needs a generation >= 1, "
+                f"got {generation}")
+        if bundle.s0s.shape[1] != 2:
+            raise ShapeError(
+                f"register_at({key_id!r}) wants the full two-party "
+                "bundle (shape [K, 2, lam] s0s)")
+        with self._lock:
+            prev = self._entries.get(key_id)
+            if prev is not None and prev.generation >= generation:
+                raise StaleStateError(
+                    f"replica frame for {key_id!r} carries generation "
+                    f"{generation} but this registry already holds "
+                    f"generation {prev.generation}; the monotonic "
+                    "fence refuses the rollback")
+            if prev is not None:
+                self._evict_entry(key_id, prev)
+            self._entries[key_id] = _Entry(bundle, int(generation),
+                                           protocol)
+            self._generation = max(self._generation, int(generation))
+            self._g_registered.set(len(self._entries))
+            return int(generation)
+
+    def digest(self) -> dict:
+        """The live ``{key_id: generation}`` map (ISSUE 14: the
+        anti-entropy exchange unit — generations only, no key
+        material)."""
+        with self._lock:
+            return {key_id: entry.generation
+                    for key_id, entry in self._entries.items()}
+
     def mint_generations(self, count: int) -> range:
         """Reserve ``count`` fresh generations from the shared counter
         (ISSUE 11: the key factory publishes pool frames under real

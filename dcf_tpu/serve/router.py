@@ -59,6 +59,36 @@ router invents no second health protocol:
   ``E_STALE`` keeps it distinguishable on the wire) passes through
   untouched: key-level outcomes are the caller's, not routing signals.
 
+Self-healing (ISSUE 14): the per-request suspicion above is the FAST
+signal; the ``serve.health.HealthProber`` is the control plane layered
+on top — a periodic DCFE PING per shard through the same pools, with
+UP -> SUSPECT -> DOWN -> UP hysteresis (``probe_fail_n`` /
+``probe_recover_m``):
+
+* a prober-SUSPECT shard routes exactly like a request-suspect one
+  (merged in ``_routable_remaining``; the metrics keep the two
+  distinguishable — ``router_suspected_total`` vs
+  ``router_health_state``/``router_probe_failures_total``);
+* a DOWN shard is dropped from the placement walk for EVERY class:
+  each victim key's replica is PROMOTED to acting owner
+  (``router_promoted_forwards_total`` — no keys move, rendezvous
+  already pinned the successor), so NORMAL/BATCH traffic keeps
+  serving instead of waiting out refusal cooldowns;
+* recovery is GATED: the DOWN -> UP transition runs the anti-entropy
+  pass (``serve.replicate.Replicator.anti_entropy`` — digest
+  exchange, strictly-newer pulls, monotonic-generation fence) before
+  the shard is re-admitted, and the UP transition clamps the pool's
+  dial backoff and clears stale request suspicion.
+
+Live registrations (ISSUE 14): ``register_frame``/``register_key``
+fan a DCFK frame out across the ring — the owner MINTS the
+generation, replicas apply it preserved, and the fence
+(``StaleStateError``/``E_STALE``) makes an old partition side
+structurally unable to roll a key back.  ``KeyStore.replicate_to``
+remains the durable twin.  ``set_ring`` swaps membership atomically
+and FORGETS removed hosts' state and metric series (bounded
+cardinality under host churn).
+
 Cross-host hot-swap needs no new machinery: re-registering a key on
 its shard bumps the registry generation there, and a forwarded eval
 whose group snapshot predates the swap fails ``StaleStateError``
@@ -96,7 +126,9 @@ from dcf_tpu.serve.edge import (
     EdgeClientPool,
     EdgeServer,
 )
+from dcf_tpu.serve.health import DOWN, SUSPECT, HealthProber
 from dcf_tpu.serve.metrics import Metrics, labeled
+from dcf_tpu.serve.replicate import Replicator
 from dcf_tpu.serve.service import ServeConfig
 from dcf_tpu.serve.shardmap import ShardMap, ShardSpec
 from dcf_tpu.utils.benchtime import monotonic
@@ -186,19 +218,31 @@ class DcfRouter:
     client-side TLS for the shard links (``tls_cert``/``tls_key`` =
     the router's client cert for pinned shards).
 
+    ``probe_interval_s`` / ``probe_timeout_s`` / ``probe_fail_n`` /
+    ``probe_recover_m`` (ISSUE 14): the health prober's cadence and
+    hysteresis — ``start_health()`` runs it as a thread, tests drive
+    ``health.pump()`` deterministically.  ``local_tag`` names this
+    router on the ``net.partition`` fault seam.
+
     ``start(host, port)`` fronts the router with its own
     ``EdgeServer`` (DCFE downstream); in-process callers can skip it
     and drive ``submit``/``submit_bytes``/``evaluate`` directly (the
-    loadgen's router-target mode)."""
+    loadgen's router-target mode).  ``register_key``/``register_frame``
+    fan a live registration across the ring; ``set_ring`` swaps
+    membership and forgets removed hosts' state."""
 
     def __init__(self, shards, *, n_bytes: int, tenants: tuple = (),
                  clock=monotonic, metrics: Metrics | None = None,
                  replicas: int = 1, suspect_cooldown_s: float = 1.0,
                  pool_size: int = 2, connect_timeout: float = 5.0,
                  reconnect_backoff_s: float = 0.05,
+                 max_backoff_s: float = 2.0,
                  max_frame_bytes: int = 256 << 20, tls: bool = False,
                  tls_ca: str = "", tls_cert: str = "",
-                 tls_key: str = ""):
+                 tls_key: str = "", probe_interval_s: float = 0.25,
+                 probe_timeout_s: float | None = None,
+                 probe_fail_n: int = 3, probe_recover_m: int = 2,
+                 local_tag: str = "router"):
         self.map = shards if isinstance(shards, ShardMap) \
             else ShardMap(shards)
         if replicas < 0:
@@ -220,14 +264,18 @@ class DcfRouter:
         self.config = ServeConfig(tenants=tuple(tenants))
         self._lock = threading.Lock()
         self._suspect_until: dict[str, float] = {}
-        self._pools = {
-            s.host_id: EdgeClientPool(
-                s.host, s.port, n_bytes=self.n_bytes, size=pool_size,
-                clock=clock, connect_timeout=connect_timeout,
-                reconnect_backoff_s=reconnect_backoff_s,
-                max_frame_bytes=max_frame_bytes, tls=tls,
-                tls_ca=tls_ca, tls_cert=tls_cert, tls_key=tls_key)
-            for s in self.map.hosts()}
+        # One kwargs dict so set_ring-created pools match construction-
+        # time ones exactly (two pool builders would drift).
+        self.local_tag = str(local_tag)
+        self._pool_kwargs = dict(
+            n_bytes=self.n_bytes, size=pool_size, clock=clock,
+            connect_timeout=connect_timeout,
+            reconnect_backoff_s=reconnect_backoff_s,
+            max_backoff_s=max_backoff_s,
+            max_frame_bytes=max_frame_bytes, tls=tls, tls_ca=tls_ca,
+            tls_cert=tls_cert, tls_key=tls_key)
+        self._pools = {s.host_id: self._make_pool(s)
+                       for s in self.map.hosts()}
         self.edge: EdgeServer | None = None
         m = self.metrics
         self._c_forwards = {
@@ -240,15 +288,93 @@ class DcfRouter:
             for s in self.map.hosts()}
         self._c_failovers = m.counter("router_failovers_total")
         self._c_refused = m.counter("router_suspect_refusals_total")
+        self._c_promoted = m.counter("router_promoted_forwards_total")
+        self._c_down_refused = m.counter("router_down_refusals_total")
         self._g_suspects = m.gauge("router_suspect_shards")
+        # The self-healing control plane (ISSUE 14): live-registration
+        # fan-out + anti-entropy over the SAME pools the forwards use,
+        # and the active health prober whose DOWN/UP transitions drive
+        # promotion and gated re-admission (see the module docstring).
+        self.replicator = Replicator(
+            self._pools, lambda: self.map, replicas=self.replicas,
+            metrics=self.metrics)
+        self.health = HealthProber(
+            self._pools, interval_s=probe_interval_s,
+            timeout_s=probe_timeout_s, fail_n=probe_fail_n,
+            recover_m=probe_recover_m, clock=clock,
+            metrics=self.metrics, recover_gate=self._recover_gate,
+            on_transition=self._on_health_transition)
+
+    def _make_pool(self, spec: ShardSpec) -> EdgeClientPool:
+        return EdgeClientPool(spec.host, spec.port,
+                              tags=(self.local_tag, spec.host_id),
+                              **self._pool_kwargs)
 
     # -- health -------------------------------------------------------
 
+    def _on_health_transition(self, ev) -> None:
+        """React to a prober transition (ISSUE 14).  On UP: clamp the
+        pool's dial backoff (satellite: a pool whose target was DOWN
+        for a long time must not wait out its accumulated exponential
+        backoff after health CONFIRMED recovery) and clear the
+        request-signal suspicion — a probe-confirmed recovery outranks
+        a stale per-request cooldown.  (Request suspicion raised while
+        the prober still says UP is deliberately untouched: no
+        transition fires, so the cooldown holds — the two signals
+        disagree in the conservative direction.)"""
+        if ev.to != "up":
+            return
+        pool = self._pools.get(ev.host_id)
+        if pool is not None:
+            pool.reset_backoff()
+        with self._lock:
+            self._suspect_until.pop(ev.host_id, None)
+            now = self._clock()
+            self._g_suspects.set(sum(
+                1 for t in self._suspect_until.values() if t > now))
+
+    def _recover_gate(self, host_id: str) -> bool:
+        """The prober's DOWN -> UP gate: the anti-entropy pass
+        (``serve.replicate``).  A shard is re-admitted only after it
+        converged with every peer the prober does not itself hold
+        DOWN — re-admitting earlier could serve stale generations,
+        the silent-wrong-answer partition bug."""
+        try:
+            self.replicator.anti_entropy(
+                host_id,
+                peer_ok=lambda h: self.health.state(h) != DOWN)
+        except Exception:  # fallback-ok: the prober counts the gate
+            # failure and keeps the shard DOWN; the next recover_m
+            # window retries
+            return False
+        return True
+
+    def start_health(self) -> "DcfRouter":
+        """Start the active prober thread (production mode; tests
+        drive ``self.health.pump()`` deterministically instead)."""
+        self.health.start()
+        return self
+
     def suspect_remaining(self, host_id: str) -> float:
-        """Seconds of suspicion left for ``host_id`` (0 = trusted)."""
+        """Seconds of suspicion left for ``host_id`` (0 = trusted).
+        The REQUEST-signal cooldown only; the prober's states are read
+        via ``self.health`` (the two are merged by the routing walk in
+        ``_routable_remaining``, and distinguishable in the metrics:
+        ``router_suspected_total`` counts request signals,
+        ``router_health_state``/``router_probe_failures_total`` the
+        probe plane)."""
         now = self._clock()
         with self._lock:
             return max(self._suspect_until.get(host_id, 0.0) - now, 0.0)
+
+    def _routable_remaining(self, host_id: str) -> float:
+        """The merged do-not-route window: the request-signal cooldown
+        OR the prober's SUSPECT state (hinted at one probe interval —
+        the next round resolves it either way)."""
+        remaining = self.suspect_remaining(host_id)
+        if self.health.state(host_id) == SUSPECT:
+            remaining = max(remaining, self.health.interval_s)
+        return remaining
 
     def mark_suspect(self, host_id: str,
                      for_s: float | None = None) -> None:
@@ -284,17 +410,21 @@ class DcfRouter:
                 ranked = self.map.placement(key_id, self.replicas)
                 for nxt in ranked:
                     if nxt.host_id == target.host_id \
-                            or self.suspect_remaining(nxt.host_id) > 0:
+                            or self._routable_remaining(nxt.host_id) > 0 \
+                            or self.health.state(nxt.host_id) == DOWN:
                         continue
+                    pool = self._pools.get(nxt.host_id)
+                    if pool is None:
+                        continue  # left the ring mid-flight
                     try:
-                        inner = self._pools[nxt.host_id].submit_bytes(
+                        inner = pool.submit_bytes(
                             key_id, data, m=m, b=b,
                             deadline_ms=deadline_ms, priority=pri)
                     except BackendUnavailableError:
                         self.mark_suspect(nxt.host_id)
                         continue
                     self._c_failovers.inc()
-                    self._c_forwards[nxt.host_id].inc()
+                    self._count_forward(nxt.host_id)
                     return inner, nxt
         if hint is None:
             # Account every refusal: a bare transport death becomes
@@ -312,6 +442,14 @@ class DcfRouter:
 
     # -- submission ---------------------------------------------------
 
+    def _count_forward(self, host_id: str) -> None:
+        c = self._c_forwards.get(host_id)
+        if c is None:  # a host added by set_ring after construction
+            c = self.metrics.counter(labeled("router_forwards_total",
+                                             shard=host_id))
+            self._c_forwards[host_id] = c
+        c.inc()
+
     def submit_bytes(self, key_id: str, data, b: int = 0,
                      deadline_ms: float | None = None,
                      priority=Priority.NORMAL):
@@ -328,24 +466,43 @@ class DcfRouter:
                 f"multiple of n_bytes={self.n_bytes}")
         m = view.nbytes // self.n_bytes
         ranked = self.map.placement(key_id, self.replicas)
+        # PROMOTION (ISSUE 14): a host the prober holds DOWN leaves the
+        # walk for EVERY class — its replica serves as acting owner (no
+        # keys move; rendezvous already pinned the successor).  SUSPECT
+        # keeps the PR 13 semantics below: CRITICAL fails over,
+        # everyone else is refused typed until the state resolves.
+        alive = [t for t in ranked
+                 if self.health.state(t.host_id) != DOWN]
+        if not alive:
+            self._c_refused.inc()
+            self._c_down_refused.inc()
+            raise CircuitOpenError(
+                f"every placed shard for {key_id!r} is DOWN "
+                f"({[t.host_id for t in ranked]}); failing fast until "
+                "a probe recovers one",
+                retry_after_s=self.health.interval_s)
+        alive_ids = {t.host_id for t in alive}
         args = (key_id, view, m, b, deadline_ms, pri)
         # Walk the placement: the first trusted holder gets the
-        # forward.  Non-CRITICAL traffic only ever sees the owner —
-        # replicas exist for CRITICAL continuity, not load spreading
+        # forward.  Non-CRITICAL traffic only ever sees the acting
+        # owner — replicas exist for continuity, not load spreading
         # (spreading would double-serve a key and hide owner sickness).
-        candidates = ranked if pri is Priority.CRITICAL else ranked[:1]
+        candidates = alive if pri is Priority.CRITICAL else alive[:1]
         first_err: BaseException | None = None
         for i, target in enumerate(candidates):
-            remaining = self.suspect_remaining(target.host_id)
+            remaining = self._routable_remaining(target.host_id)
             if remaining > 0:
                 if first_err is None:
                     first_err = CircuitOpenError(
-                        f"shard {target.host_id!r} (owner of "
+                        f"shard {target.host_id!r} (acting owner of "
                         f"{key_id!r}) is suspect; failing fast",
                         retry_after_s=remaining)
                 continue
+            pool = self._pools.get(target.host_id)
+            if pool is None:
+                continue  # left the ring between placement and here
             try:
-                inner = self._pools[target.host_id].submit_bytes(
+                inner = pool.submit_bytes(
                     key_id, view, m=m, b=b, deadline_ms=deadline_ms,
                     priority=pri)
             except BackendUnavailableError as e:
@@ -360,14 +517,19 @@ class DcfRouter:
                         retry_after_s=self.suspect_cooldown_s)
                 first_err.__cause__ = e
                 continue
-            if i > 0:
-                self._c_failovers.inc()
-            self._c_forwards[target.host_id].inc()
+            if target.host_id != ranked[0].host_id:
+                if ranked[0].host_id not in alive_ids:
+                    self._c_promoted.inc()  # owner DOWN: the replica
+                    # is the acting owner (health-plane signal) ...
+                else:
+                    self._c_failovers.inc()  # ... vs the request-
+                    # plane suspect walk — the metrics distinguish them
+            self._count_forward(target.host_id)
             # Failover spending rule: the relay future may fail over
-            # inline only if this forward went to the OWNER (a forward
-            # already on a replica has walked the ring once; the
-            # relay's own policy further restricts inline failover to
-            # CRITICAL traffic).
+            # inline only if this forward went to the first acting
+            # choice (a forward already down the walk has used the
+            # ring once; the relay's own policy further restricts
+            # inline failover to CRITICAL traffic).
             relay_args = args if i == 0 else None
             return _RelayFuture(self, inner, target, relay_args)
         self._c_refused.inc()
@@ -400,6 +562,92 @@ class DcfRouter:
         return self.submit(key_id, xs, b, deadline_ms,
                            priority).result(timeout)
 
+    # -- registration (ISSUE 14: live-key replication) ----------------
+
+    def register_frame(self, key_id: str, frame,
+                       proto: bool = False) -> int:
+        """Register one DCFK frame across the ring (the pod-door
+        REGISTER verb — the fronting ``EdgeServer`` routes type-6
+        frames here): the OWNER mints the generation, each replica
+        applies it preserved (``serve.replicate.Replicator``).
+        Returns the generation.  Live (non-durable): the durable twin
+        is store provisioning via ``KeyStore.replicate_to``."""
+        return self.replicator.register(key_id, frame,
+                                        proto=bool(proto))
+
+    def register_key(self, key_id: str, bundle) -> int:
+        """In-process convenience twin of ``register_frame``: accepts
+        a ``KeyBundle`` or ``protocols.ProtocolBundle`` and fans its
+        frame out across the ring."""
+        from dcf_tpu.protocols import ProtocolBundle
+
+        proto = isinstance(bundle, ProtocolBundle)
+        return self.register_frame(key_id, bundle.to_bytes(),
+                                   proto=proto)
+
+    # -- ring membership (ISSUE 14 satellite: bounded state) ----------
+
+    def set_ring(self, shards) -> None:
+        """Swap the shard ring atomically (``ShardMap`` or an iterable
+        of ``ShardSpec``).  Removed hosts are FORGOTTEN — pool closed,
+        suspect/backoff/health state dropped, labeled metric series
+        removed (the ``BreakerBoard.forget`` cardinality discipline:
+        host churn must not grow router state or its snapshot without
+        limit).  Added hosts get fresh pools and health targets; a
+        host whose ADDRESS changed (same id) is re-dialed.  In-flight
+        requests keep the ranking they started with (the old map
+        reference stays valid — ``ShardMap`` is immutable)."""
+        new = shards if isinstance(shards, ShardMap) \
+            else ShardMap(shards)
+        old = self.map
+        old_ids = {s.host_id: s for s in old.hosts()}
+        new_ids = {s.host_id: s for s in new.hosts()}
+        # Added hosts get their pools BEFORE the map swaps: a submit
+        # or registration placing onto the new ring must find the
+        # link already dialed-able (the reverse order would open a
+        # window where placement names a host with no pool).
+        for host_id, spec in new_ids.items():
+            if host_id not in old_ids:
+                self._pools[host_id] = self._make_pool(spec)
+                self._c_forwards[host_id] = self.metrics.counter(
+                    labeled("router_forwards_total", shard=host_id))
+                self._c_suspected[host_id] = self.metrics.counter(
+                    labeled("router_suspected_total", shard=host_id))
+                self.health.add_target(host_id,
+                                       self._pools[host_id])
+            elif old_ids[host_id].address != spec.address:
+                # Same identity, new address: re-dial (placement is
+                # keyed on host_id, so no keys move).
+                stale = self._pools.pop(host_id, None)
+                if stale is not None:
+                    stale.close()
+                self._pools[host_id] = self._make_pool(spec)
+                self.health.add_target(host_id,
+                                       self._pools[host_id])
+        self.map = new  # atomic reference swap
+        for host_id in old_ids:
+            if host_id not in new_ids:
+                self._forget_host(host_id)
+
+    def _forget_host(self, host_id: str) -> None:
+        """Drop EVERY piece of per-host router state for a host that
+        left the ring (pinned by the cardinality test: churning hosts
+        in and out leaves the suspect map, the pool table and the
+        metrics snapshot exactly where they started)."""
+        pool = self._pools.pop(host_id, None)
+        if pool is not None:
+            pool.close()
+        self.health.remove_target(host_id)
+        with self._lock:
+            self._suspect_until.pop(host_id, None)
+            now = self._clock()
+            self._g_suspects.set(sum(
+                1 for t in self._suspect_until.values() if t > now))
+        self._c_forwards.pop(host_id, None)
+        self._c_suspected.pop(host_id, None)
+        for name in ("router_forwards_total", "router_suspected_total"):
+            self.metrics.remove(labeled(name, shard=host_id))
+
     # -- lifecycle ----------------------------------------------------
 
     def start(self, host: str = "127.0.0.1", port: int = 0,
@@ -420,10 +668,11 @@ class DcfRouter:
         return self.edge.address
 
     def close(self) -> None:
+        self.health.close()
         if self.edge is not None:
             self.edge.close()
             self.edge = None
-        for pool in self._pools.values():
+        for pool in list(self._pools.values()):
             pool.close()
 
     def __enter__(self) -> "DcfRouter":

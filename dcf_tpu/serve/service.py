@@ -148,6 +148,7 @@ from dcf_tpu.serve.frontier_cache import FrontierCache
 from dcf_tpu.serve.keyfactory import KeyFactory, PoolSpec
 from dcf_tpu.serve.metrics import Metrics, OCCUPANCY_BOUNDS
 from dcf_tpu.serve.registry import KeyRegistry
+from dcf_tpu.serve import replicate
 from dcf_tpu.serve.store import KeyStore
 from dcf_tpu.testing.faults import fire
 from dcf_tpu.utils.benchtime import monotonic
@@ -535,6 +536,42 @@ class DcfService:
 
     def key_ids(self) -> list[str]:
         return self.registry.key_ids()
+
+    # -- replication surface (ISSUE 14, ``serve.replicate``) ----------------
+
+    def register_frame(self, key_id: str, frame,
+                       proto: bool = False) -> int:
+        """Register one DCFK frame off the wire (the OWNER half of the
+        DCFE REGISTER verb): decode through the existing codec, mint a
+        fresh generation, return it — the router forwards it to the
+        replicas with this generation preserved.  Live (non-durable)
+        by design: ``KeyStore.replicate_to`` is the durable twin."""
+        obj = replicate.decode_key_frame(frame, proto)
+        self.register_key(key_id, obj)
+        return self.registry.snapshot(key_id)[2]
+
+    def apply_replica_frame(self, key_id: str, frame, generation: int,
+                            proto: bool = False) -> int:
+        """Apply one forwarded frame under the owner's generation (the
+        REPLICA half of REGISTER, and the anti-entropy apply).  The
+        monotonic-generation fence refuses a frame at or below the
+        local generation typed ``StaleStateError``
+        (``serve_replica_fenced_total``) — an old partition side can
+        never roll this key back."""
+        return replicate.apply_frame(
+            self.registry, key_id, frame, int(generation),
+            bool(proto), lam=self._dcf.lam,
+            n_bytes=self._dcf.n_bytes, metrics=self.metrics)
+
+    def replication_digest(self) -> dict:
+        """The live ``{key_id: generation}`` map (anti-entropy digest
+        exchange — generations only, no key material)."""
+        return self.registry.digest()
+
+    def sync_frames(self, digest: dict) -> list:
+        """Frames STRICTLY newer than ``digest`` records, for the
+        anti-entropy pull (``serve.replicate.sync_frames``)."""
+        return replicate.sync_frames(self.registry, digest)
 
     # -- submission ---------------------------------------------------------
 

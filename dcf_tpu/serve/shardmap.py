@@ -129,6 +129,17 @@ class ShardMap:
 
     # -- placement ----------------------------------------------------
 
+    def peers(self, host_id: str) -> tuple[ShardSpec, ...]:
+        """Every OTHER member of the ring (ISSUE 14: the anti-entropy
+        exchange set for ``host_id`` — a healed shard converges with
+        its peers, never with itself).  Raises for an unknown id, same
+        contract as ``without_host``."""
+        if host_id not in self._by_id:
+            # api-edge: ring membership contract
+            raise ValueError(f"host {host_id!r} is not in the ring "
+                             f"({self.host_ids()})")
+        return tuple(s for s in self._shards if s.host_id != host_id)
+
     def ranked(self, key_id: str) -> list[ShardSpec]:
         """Every host, descending rendezvous score for ``key_id``:
         ``[owner, replica, ...]``.  Ties (astronomically unlikely with

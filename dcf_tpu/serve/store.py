@@ -564,6 +564,16 @@ class KeyStore:
             if ent is not None:
                 self._quarantine_locked(key_id, ent, entries)
 
+    def digest(self) -> dict:
+        """The durable ``{key_id: generation}`` map (ISSUE 14: the
+        durable twin of ``KeyRegistry.digest`` — the partition soaks
+        assert zero generation regressions against it, and an operator
+        can diff a replica store against its owner's without moving a
+        byte of key material)."""
+        with self._lock:
+            return {key_id: ent["generation"]
+                    for key_id, ent in self._read_manifest().items()}
+
     def max_generation(self) -> int:
         """The highest generation any stored frame carries (0 for an
         empty or unreadable store).  A store-backed registry floors its

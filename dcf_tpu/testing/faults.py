@@ -73,6 +73,7 @@ __all__ = [
     "inject_schedule",
     "flaky",
     "latency",
+    "partition",
     "torn_write",
 ]
 
@@ -142,6 +143,19 @@ POINTS = (
     #                     the injectable clock, so a stalled sender
     #                     demonstrably trips the existing deadline/
     #                     watchdog path instead of wedging the worker)
+    "net.partition",    # pod network partition (serve/edge.py — fires
+    #                     before each EdgeClient dial and each frame
+    #                     send on a TAGGED client (the pod router tags
+    #                     its shard links); handler args: local tag,
+    #                     peer tag.  ``partition({...})`` is the
+    #                     canonical handler: it raises OSError for the
+    #                     named host pairs, which the edge client
+    #                     contains as transport death — exactly what a
+    #                     dropped/denied frame looks like to the
+    #                     routing tier, so suspicion, health probing,
+    #                     promotion and anti-entropy recovery are all
+    #                     deterministically drivable without touching
+    #                     a real network)
 )
 
 _ACTIVE: dict[str, Callable] = {}
@@ -306,6 +320,42 @@ def torn_write(nbytes: int) -> Callable:
     def handler(_key_id, path, *_args):
         with open(path, "r+b") as fh:
             fh.truncate(nbytes)
+
+    return handler
+
+
+def partition(pairs, *, clock: Callable[[], float] | None = None,
+              window: tuple[float, float] | None = None) -> Callable:
+    """Handler factory for the ``net.partition`` seam (ISSUE 14): deny
+    every frame between the named host pairs.  ``pairs`` is an iterable
+    of ``(a, b)`` tag pairs, symmetric — ``("router", "shard-0")`` cuts
+    both directions of that link.  The handler raises ``OSError`` (what
+    a dropped frame looks like to a socket client), which the edge
+    client contains as transport death: pending futures fail typed,
+    the routing tier marks the peer suspect, health probes start
+    failing — the partition is observable only through the same typed
+    taxonomy a real one would produce.
+
+    ``clock`` + ``window=(start, end)``: deny only while
+    ``start <= clock() < end`` — the healable-partition window the
+    partition/flap soaks drive (heal = the clock leaving the window;
+    no un-arming race with in-flight requests)."""
+    cut = {frozenset(p) for p in pairs}
+    if any(len(p) != 2 for p in cut):
+        raise ValueError(f"partition pairs must name two hosts: {pairs}")
+    if (clock is None) != (window is None):
+        raise ValueError("clock and window arm the healable window "
+                         "together (pass both or neither)")
+
+    def handler(src: str, dst: str, *_args) -> None:
+        if frozenset((src, dst)) not in cut:
+            return
+        if window is not None:
+            now = clock()
+            if not window[0] <= now < window[1]:
+                return
+        raise OSError(
+            f"injected network partition: {src!r} <-> {dst!r} is cut")
 
     return handler
 

@@ -469,6 +469,25 @@ def test_cli_pod_bench_validates_flags_fast():
         cli.main(["serve_host"])
 
 
+@pytest.mark.selfheal
+def test_cli_pod_bench_selfheal_validates_flags_fast():
+    """ISSUE 14: the partition/flap scenario applies the same
+    fail-fast flag discipline — bad probe cadence, live-key count or
+    shard count die loudly before any subprocess is spawned."""
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="probe-interval"):
+        cli.main(["pod_bench", "--partition", "--probe-interval=0"])
+    with pytest.raises(SystemExit, match="live-bundles"):
+        cli.main(["pod_bench", "--partition", "--live-bundles=-1"])
+    with pytest.raises(SystemExit, match="shards"):
+        cli.main(["pod_bench", "--flap", "--shards=1"])
+    with pytest.raises(SystemExit, match="probe-interval"):
+        cli.main(["pod_bench", "--probe-interval=-1"])
+    with pytest.raises(SystemExit, match="live-bundles"):
+        cli.main(["pod_bench", "--live-bundles=-1"])
+
+
 @pytest.mark.slow
 @pytest.mark.pod
 def test_cli_pod_bench_smoke(capsys):
@@ -501,3 +520,41 @@ def test_cli_pod_bench_smoke(capsys):
         gate.startswith("environment-gated")
     if gate.startswith("applies"):
         assert recs[0]["pod_vs_single"] >= 2.2
+    # ISSUE 14: the kill soak's live (non-durable) keys served from
+    # the promoted replica — generations preserved, zero re-keygen.
+    assert recs[0]["live_bundles"] >= 1
+    if recs[0]["victim_live_keys"]:
+        assert recs[0]["critical_within_s"] is not None
+        assert recs[0]["down_observed"] is True
+
+
+@pytest.mark.slow
+@pytest.mark.selfheal
+def test_cli_pod_bench_partition_smoke(capsys):
+    """ISSUE 14: ``pod_bench --partition`` end to end — a
+    ``net.partition`` window cuts the router<->victim link under
+    mixed load while the health prober runs; the harness raises
+    SystemExit unless the ledger is clean, the victim walks DOWN and
+    back UP through the anti-entropy gate, the mid-cut registration
+    converges with zero generation regressions, promotion serves
+    NORMAL traffic from the replica, and the doctored old-generation
+    frame is fenced typed."""
+    recs = run_cli(
+        capsys,
+        ["pod_bench", "--partition", "--shards=3", "--duration=8",
+         "--bundles=4", "--live-bundles=3", "--max-batch=256"],
+    )
+    assert recs[0]["bench"] == "pod_bench"
+    assert recs[0]["mode"] == "partition"
+    assert recs[0]["soak_mismatches"] == 0
+    assert recs[0]["soak_unaccounted"] == 0
+    assert recs[0]["soak_refused_unhinted"] == 0
+    assert recs[0]["down_seen"] == 1
+    assert recs[0]["up_recovered"] == 1
+    assert recs[0]["digest_converged"] is True
+    assert recs[0]["digest_regressions"] == 0
+    assert recs[0]["fence_held"] is True
+    assert recs[0]["post_heal_parity"] is True
+    assert recs[0]["anti_entropy_runs"] >= 1
+    assert recs[0]["anti_entropy_frames"] >= 1
+    assert len(recs[0]["promoted_serve_s"]) == 1

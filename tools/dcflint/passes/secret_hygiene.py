@@ -44,7 +44,7 @@ from tools.dcflint import FileContext, LintPass, register
 
 SECRET_NAME_RE = re.compile(
     r"^(seed\w*|s0s?|cw(_\w+)?|cws|key_bundle|bundle|kb|key_material"
-    r"|cipher_keys?|combine_masks?|frames?|key_frame"
+    r"|cipher_keys?|combine_masks?|frames?|frame_bytes|key_frame"
     r"|repl(ica)?_frames?|shares?(_\w+)?)$")
 # ``frame`` (ISSUE 8, dcf_tpu/serve/store.py): a serialized DCFK frame
 # is the seeds and correction words it encodes — logging one is
@@ -53,6 +53,10 @@ SECRET_NAME_RE = re.compile(
 # ``replicate_to`` + the pod provisioning path): a replication buffer
 # is the SAME DCFK frame on its way to another host's store — the
 # pod tier must not get a logging loophole by renaming the buffer.
+# ``frame_bytes`` (ISSUE 14, dcf_tpu/serve/replicate.py + the DCFE
+# REGISTER/SYNC wire path): the live-replication and anti-entropy
+# buffers hold serialized DCFK frames — bundle bytes in flight between
+# registries are key material under a third name, same rule.
 # ``share``/``shares``/``share_*``/``shares_*`` (ISSUE 12,
 # dcf_tpu/serve/edge.py): the network edge holds evaluated SHARE bytes
 # in wire buffers on their way to a party — one logged share next to
