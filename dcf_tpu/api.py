@@ -779,6 +779,24 @@ class Dcf:
         shard-side surface is ``register_frame`` /
         ``apply_replica_frame`` / ``replication_digest`` /
         ``sync_frames`` on this service.
+
+        Membership (ISSUE 15, README "Ring operations"): a
+        ``serve.MembershipController`` over the router closes the
+        loop from health to the ring — a shard DOWN past
+        ``eject_grace_s`` is AUTO-EJECTED with every frame it held
+        re-replicated to its new placement before the swap commits
+        (durable via ``KeyStore.replicate_to``, live via the
+        anti-entropy pull); ``join(spec)`` warms a new host through
+        the SYNC path before admitting it (no cold-miss storm);
+        ``drain(host_id)`` migrates, swaps, and holds the link
+        through an in-flight grace before the forget (``serve_host``
+        then drains on SIGTERM and exits 0).  Every commit mints a
+        monotonic ring EPOCH carried on forwarded frames; this
+        service tracks the observed maximum (``ring_epoch`` /
+        ``check_ring_epoch``) and refuses older ones typed
+        (``RingEpochError`` / ``E_EPOCH``,
+        ``serve_epoch_fenced_total``) — a router on a stale ring is
+        structurally unable to serve a conflicting placement.
         """
         from dcf_tpu.serve import DcfService, ServeConfig
 

@@ -2901,7 +2901,16 @@ def bench_serve_host(args) -> None:
     SIGTERM/SIGINT (or until its parent exits — a shard orphaned by a
     dead launcher must not linger).  ``--tls-cert``/``--tls-key``
     (+ ``--tls-client-ca`` to pin the router) arm TLS on the edge
-    socket."""
+    socket.
+
+    Graceful shutdown (ISSUE 15 satellite): SIGTERM/SIGINT stop the
+    edge (no new frames), DRAIN the service (``close(drain=True)`` —
+    queued requests are served, never failed), write one final
+    metrics snapshot, and remove the ready file before exiting 0 — a
+    PLANNED restart (a drain, a rolling deploy) loses no accepted
+    work and un-advertises itself, so a launcher polling the ready
+    file sees the shard gone rather than stale.  SIGKILL remains the
+    crash test: the failover/restore machinery owns that path."""
     import json as _json
     import os
     import signal
@@ -2952,14 +2961,33 @@ def bench_serve_host(args) -> None:
                 log("serve_host: parent exited; shutting down")
                 break
     finally:
+        # Ordered graceful teardown: listener first (no NEW
+        # connections — live ones stay open so drained responses can
+        # still reach their clients), then drain the service (queued
+        # requests complete; frames arriving mid-drain are refused
+        # typed over the still-open links), then the edge flushes each
+        # writer's backlog before the hard close, and only THEN the
+        # final metrics snapshot — it must include the drained work's
+        # counters.
+        edge.stop_accepting()
+        try:
+            svc.close(drain=True)
+        except Exception:  # fallback-ok: a failing drain (dying
+            # store reclaim at shutdown) must not skip the snapshot
+            # or the ready-file removal below
+            log("serve_host: drain raised; exiting anyway")
+        edge.close(drain_s=5.0)
         if args.metrics_file:
             try:
                 _flush(args.metrics_file, svc.metrics_snapshot())
             except OSError:
                 pass  # fallback-ok: dying disk at shutdown — the
                 # periodic flush above already published a snapshot
-        edge.close()
-        svc.close(drain=False)
+        if args.ready_file:
+            try:
+                os.unlink(args.ready_file)
+            except OSError:
+                pass  # fallback-ok: never written, or already gone
     log("serve_host: stopped")
 
 
@@ -3582,6 +3610,542 @@ def bench_pod_selfheal(args) -> None:
             shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_pod_churn(args) -> None:
+    """``pod_bench --churn`` (ISSUE 15): the autonomous-membership
+    acceptance scenario — kill -> auto-eject -> re-replication
+    verified -> heal -> graceful re-join, plus a drain leg, under
+    3-thread mixed load with the ledger running throughout.
+
+    Phases:
+
+    1. **provision + spawn** — durable keys ring-placed into owner +
+       replica stores (``KeyStore.replicate_to``), live keys through
+       the REGISTER fan-out, N ``serve_host`` subprocesses behind the
+       self-healing router with a ``MembershipController`` owning the
+       ring (``stores`` handed over for the durable migration half);
+    2. **kill -> auto-eject** — one shard SIGKILLed; the prober walks
+       it DOWN, the controller waits out ``--eject-grace`` and ejects:
+       the ring shrinks, the epoch bumps, and every key the victim
+       held is re-replicated to its new placement BEFORE the commit —
+       verified over the wire DIGEST verb (live registries) and the
+       stores (durable frames), generations preserved;
+    3. **heal -> graceful re-join** — the victim process is respawned
+       on its own store (warm restore) and re-admitted via
+       ``controller.join``: warmed through the anti-entropy SYNC path
+       against the prospective ring first, the epoch bumps again, and
+       its digest converges before the first routed request lands;
+    4. **drain** — a second shard is gracefully decommissioned:
+       frames migrated, ring swapped (epoch bump), in-flight grace
+       held, then the process SIGTERMed — which now DRAINS and exits
+       0 with its ready file removed (the ISSUE 15 satellite);
+    5. **the epoch fence** — a doctored STALE-epoch REQUEST frame
+       sent straight to a shard dies typed ``E_EPOCH`` with a retry
+       hint, and the key keeps serving the CURRENT ring's bits.
+
+    Emitted-then-asserted gates: ledger clean (every request bit-exact
+    vs the numpy oracle or refused typed WITH ``retry_after_s``), zero
+    generation regressions across every observed digest, zero lost
+    keys (every key still serves bit-exact after all three changes),
+    eject/join/drain all committed with strictly-increasing epochs,
+    the stale-epoch frame fenced, zero quarantines, and the drained
+    shard exited 0."""
+    import os
+    import shutil
+    import signal
+    import socket as socket_mod
+    import struct as struct_mod
+    import tempfile
+    import threading
+
+    from dcf_tpu.backends.numpy_backend import eval_batch_np
+    from dcf_tpu.errors import DcfError
+    from dcf_tpu.ops.prg import HirosePrgNp
+    from dcf_tpu.serve import (
+        DcfRouter,
+        EdgeClient,
+        KeyStore,
+        MembershipController,
+        ShardMap,
+        ShardSpec,
+    )
+    from dcf_tpu.serve.edge import (
+        E_EPOCH,
+        decode_response,
+        encode_request,
+    )
+    from dcf_tpu.serve.health import UP
+
+    n_shards = args.shards
+    if n_shards < 3:
+        raise SystemExit(
+            f"--churn needs --shards >= 3 (the auto-eject must leave "
+            f"a replicated ring), got {n_shards}")
+    if args.probe_interval <= 0:
+        raise SystemExit(
+            f"--probe-interval must be > 0, got {args.probe_interval}")
+    if args.eject_grace <= 0:
+        raise SystemExit(
+            f"--eject-grace must be > 0, got {args.eject_grace}")
+    if args.live_bundles < 0:
+        raise SystemExit(
+            f"--live-bundles must be >= 0, got {args.live_bundles}")
+    dcf, lam, nb, backend, rng = _serve_host_facade(args)
+    prg = HirosePrgNp(lam, dcf.cipher_keys)
+    n_bundles = args.bundles or 4
+
+    keep_dirs = bool(args.store_dir)
+    root = args.store_dir or tempfile.mkdtemp(prefix="dcf-pod-")
+    os.makedirs(root, exist_ok=True)
+    shard_ids = [f"shard-{i}" for i in range(n_shards)]
+    ring = ShardMap([ShardSpec(s) for s in shard_ids])
+    stores = {s: KeyStore(os.path.join(root, s)) for s in shard_ids}
+    bundles, gens = {}, {}
+    for i in range(n_bundles):
+        name = f"key-{i}"
+        alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
+        betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
+        kb = dcf.gen(alphas, betas, rng=rng)
+        bundles[name], gens[name] = kb, i + 1
+        placed = ring.placement(name, replicas=1)
+        stores[placed[0].host_id].put(name, kb, generation=gens[name])
+        for rep in placed[1:]:
+            stores[placed[0].host_id].replicate_to(
+                stores[rep.host_id], name)
+    procs: dict = {}
+    router = None
+    controller = None
+    try:
+        for tag in shard_ids:
+            procs[tag] = _pod_spawn(tag, os.path.join(root, tag),
+                                    root, args)
+        ready = _pod_wait_ready(procs)
+        pod_specs = [ShardSpec(s, ready[s]["host"], ready[s]["port"])
+                     for s in shard_ids]
+        addr_of = {s: (ready[s]["host"], ready[s]["port"])
+                   for s in shard_ids}
+        router = DcfRouter(
+            pod_specs, n_bytes=nb,
+            probe_interval_s=args.probe_interval,
+            probe_timeout_s=5.0, probe_fail_n=3, probe_recover_m=2,
+            reconnect_backoff_s=0.02,
+            max_backoff_s=max(min(args.probe_interval, 0.5), 0.02))
+        controller = MembershipController(
+            router, stores=stores,
+            eject_grace_s=float(args.eject_grace),
+            drain_grace_s=1.0, min_hosts=2,
+            poll_interval_s=min(args.probe_interval, 0.25))
+        live, live_gens = _pod_live_register(
+            router, dcf, rng, lam, nb, args.live_bundles)
+        bundles.update(live)
+        gens.update(live_gens)
+        log(f"provisioned {n_bundles} durable + {len(live)} live keys "
+            f"over {n_shards} shards")
+
+        # Parity gate + warmup ladder (the soak must measure churn,
+        # not the XLA compile storm).
+        xs_gate = rng.integers(0, 256, (64, nb), dtype=np.uint8)
+        for name, kb in bundles.items():
+            got = router.evaluate(name, xs_gate, b=0, timeout=300) ^ \
+                router.evaluate(name, xs_gate, b=1, timeout=300)
+            want = eval_batch_np(prg, 0, kb.for_party(0), xs_gate) ^ \
+                eval_batch_np(prg, 1, kb.for_party(1), xs_gate)
+            if not np.array_equal(got, want):
+                raise SystemExit(
+                    f"pod_bench parity mismatch vs numpy oracle on "
+                    f"{name}")
+        owners = {n: ring.owner(n).host_id for n in bundles}
+        by_owner: dict = {}
+        for name, owner in owners.items():
+            by_owner.setdefault(owner, []).append(name)
+        max_batch = args.max_batch or (1 << 10)
+        xs_warm = rng.integers(0, 256, (max_batch, nb), dtype=np.uint8)
+        m = 1
+        while m <= max_batch:
+            for keys in by_owner.values():
+                router.evaluate(keys[0], xs_warm[:m], b=0, timeout=300)
+                router.evaluate(keys[0], xs_warm[:m], b=1, timeout=300)
+            m *= 2
+        log("routed parity + warmup ladder done")
+
+        router.start_health()
+        deadline = time.monotonic() + 60
+        while any(st != UP for st in router.health.states().values()):
+            if time.monotonic() > deadline:
+                raise SystemExit(
+                    f"pod_bench: prober never saw the pod UP "
+                    f"({router.health.states()})")
+            time.sleep(0.05)
+        controller.start()
+
+        # The ledger (accumulates across every phase).
+        stats = {"ok": 0, "critical_ok": 0, "mismatches": 0,
+                 "refused_hinted": 0, "refused_unhinted": 0,
+                 "unaccounted": 0}
+        lock = threading.Lock()
+        stop = threading.Event()
+        names_snapshot = sorted(bundles)
+
+        def client(i: int) -> None:
+            crng = np.random.default_rng(args.seed + 401 * i)
+            while not stop.is_set():
+                name = names_snapshot[
+                    int(crng.integers(0, len(names_snapshot)))]
+                pr = "critical" if crng.random() < 0.4 else "normal"
+                m = int(crng.integers(1, 33))
+                xs = crng.integers(0, 256, (m, nb), dtype=np.uint8)
+                try:
+                    f0 = router.submit(name, xs, b=0, priority=pr)
+                    f1 = router.submit(name, xs, b=1, priority=pr)
+                    got = f0.result(120) ^ f1.result(120)
+                except DcfError as e:
+                    hinted = getattr(e, "retry_after_s",
+                                     None) is not None
+                    with lock:
+                        stats["refused_hinted" if hinted else
+                              "refused_unhinted"] += 1
+                    continue
+                except Exception:  # fallback-ok: the gate's failure
+                    # arm — anything untyped is what the soak hunts
+                    with lock:
+                        stats["unaccounted"] += 1
+                    continue
+                kb = bundles[name]
+                want = eval_batch_np(prg, 0, kb.for_party(0), xs) ^ \
+                    eval_batch_np(prg, 1, kb.for_party(1), xs)
+                with lock:
+                    if np.array_equal(got, want):
+                        stats["ok"] += 1
+                        if pr == "critical":
+                            stats["critical_ok"] += 1
+                    else:
+                        stats["mismatches"] += 1
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True) for i in range(3)]
+        t_soak0 = time.monotonic()
+        for t in threads:
+            t.start()
+
+        seen_gens: dict = {}
+        digest_regressions = 0
+
+        def absorb_digest(digest: dict) -> None:
+            nonlocal digest_regressions
+            for k, g in digest.items():
+                if g < seen_gens.get(k, 0):
+                    digest_regressions += 1
+                seen_gens[k] = max(g, seen_gens.get(k, 0))
+
+        failures: list = []
+        # ---- Phase 2: kill -> auto-eject ----------------------------
+        victim = max(by_owner, key=lambda s: (
+            len([n for n in by_owner[s] if n in live]),
+            len(by_owner[s])))
+        victim_keys = sorted(
+            n for n in bundles
+            if victim in ring.placement_ids(n, replicas=1))
+        log(f"SIGKILL {victim} (holds {len(victim_keys)} keys); "
+            f"auto-eject after {args.eject_grace:g}s of DOWN")
+        procs[victim][0].send_signal(signal.SIGKILL)
+        procs[victim][0].wait(30)  # reap before the respawn reuses
+        # the procs slot (a SIGKILLed child exits immediately)
+        deadline = time.monotonic() + 120
+        while victim in router.map:
+            if time.monotonic() > deadline:
+                raise SystemExit(
+                    "pod_bench: the controller never auto-ejected the "
+                    f"killed shard (health={router.health.states()}, "
+                    f"ring={router.map.host_ids()})")
+            time.sleep(0.05)
+        epoch_after_eject = router.ring_epoch
+        eject_ring = router.map
+        if epoch_after_eject < 1:
+            failures.append("eject committed without an epoch bump")
+        # Re-replication verified: every key the victim held is now
+        # placed wholly on survivors — live registries over the wire
+        # DIGEST verb, durable frames in the stores.
+        survivor_digests = {s: _pod_wire_digest(addr_of[s], nb)
+                            for s in eject_ring.host_ids()}
+        for d in survivor_digests.values():
+            absorb_digest(d)
+        for name in victim_keys:
+            placed = eject_ring.placement_ids(name, replicas=1)
+            for holder in placed:
+                if survivor_digests[holder].get(name) != gens[name]:
+                    failures.append(
+                        f"post-eject holder {holder} serves "
+                        f"{name!r} at generation "
+                        f"{survivor_digests[holder].get(name)} "
+                        f"!= provisioned {gens[name]}")
+                if name not in live \
+                        and stores[holder].digest().get(name) \
+                        != gens[name]:
+                    failures.append(
+                        f"post-eject store {holder} lacks durable "
+                        f"{name!r} at generation {gens[name]}")
+        lost = controller.lost_keys(exclude={victim})
+        if lost:
+            failures.append(f"keys lost after eject: {lost}")
+        log(f"auto-eject OK: ring={eject_ring.host_ids()} "
+            f"epoch={epoch_after_eject}")
+
+        # ---- Phase 3: heal -> graceful re-join ----------------------
+        try:
+            os.unlink(procs[victim][1])  # the SIGKILL left the stale
+            # ready file behind; the respawn must publish a fresh one
+        except OSError:
+            pass
+        procs[victim] = _pod_spawn(victim, os.path.join(root, victim),
+                                   root, args)
+        rejoin_ready = _pod_wait_ready({victim: procs[victim]})
+        spec = ShardSpec(victim, rejoin_ready[victim]["host"],
+                         rejoin_ready[victim]["port"])
+        addr_of[victim] = spec.address
+        controller.join(spec)
+        epoch_after_join = router.ring_epoch
+        if epoch_after_join <= epoch_after_eject:
+            failures.append("join committed without an epoch bump")
+        if victim not in router.map:
+            failures.append("join did not admit the healed shard")
+        # Warmed-before-admitted: the rejoined shard's digest holds
+        # every key the ring places on it, generations preserved.
+        victim_digest = _pod_wire_digest(addr_of[victim], nb)
+        absorb_digest(victim_digest)
+        for name in sorted(bundles):
+            placed = router.map.placement_ids(name, replicas=1)
+            if victim in placed \
+                    and victim_digest.get(name) != gens[name]:
+                failures.append(
+                    f"rejoined shard serves {name!r} at generation "
+                    f"{victim_digest.get(name)} != {gens[name]}")
+        log(f"graceful re-join OK: epoch={epoch_after_join}")
+
+        # ---- Phase 4: drain ----------------------------------------
+        drain_host = next(s for s in router.map.host_ids()
+                          if s != victim)
+        controller.drain(drain_host)
+        epoch_after_drain = router.ring_epoch
+        if epoch_after_drain <= epoch_after_join:
+            failures.append("drain committed without an epoch bump")
+        if drain_host in router.map:
+            failures.append("drain left the host in the ring")
+        deadline = time.monotonic() + 60
+        while drain_host in controller.draining():
+            if time.monotonic() > deadline:
+                failures.append(
+                    "the drain grace never completed (forget pending)")
+                break
+            time.sleep(0.05)
+        drain_digests = {s: _pod_wire_digest(addr_of[s], nb)
+                         for s in router.map.host_ids()}
+        for d in drain_digests.values():
+            absorb_digest(d)
+        for name in sorted(bundles):
+            placed = router.map.placement_ids(name, replicas=1)
+            for holder in placed:
+                if drain_digests[holder].get(name) != gens[name]:
+                    failures.append(
+                        f"post-drain holder {holder} serves {name!r} "
+                        f"at {drain_digests[holder].get(name)} != "
+                        f"{gens[name]}")
+        lost = controller.lost_keys(exclude={drain_host})
+        if lost:
+            failures.append(f"keys lost after drain: {lost}")
+        # The drained process: SIGTERM now DRAINS and exits 0 with the
+        # ready file removed (the graceful-shutdown satellite).
+        procs[drain_host][0].send_signal(signal.SIGTERM)
+        try:
+            rc = procs[drain_host][0].wait(60)
+        except Exception:  # fallback-ok: counted via the gate below
+            rc = None
+        if rc != 0:
+            failures.append(
+                f"drained shard exited rc={rc} on SIGTERM (graceful "
+                "shutdown must exit 0)")
+        if os.path.exists(procs[drain_host][1]):
+            failures.append(
+                "drained shard left its ready file behind")
+        log(f"drain OK: ring={router.map.host_ids()} "
+            f"epoch={epoch_after_drain} drained-exit rc={rc}")
+
+        # ---- Phase 5: the epoch fence ------------------------------
+        fence_target = router.map.host_ids()[0]
+        # Make sure the target has adopted the CURRENT epoch (probes
+        # disseminate it; one fenced ping is deterministic).
+        with EdgeClient(*addr_of[fence_target], n_bytes=nb) as c:
+            shard_epoch = c.ping_epoch(timeout=60,
+                                       epoch=router.ring_epoch)
+            stale = max(router.ring_epoch - 1, 1)
+            fence_key = next(n for n in sorted(bundles)
+                             if router.map.owner(n).host_id
+                             == fence_target)
+            xs_f = rng.integers(0, 256, (4, nb), dtype=np.uint8)
+            doctored = encode_request(
+                991, "", fence_key, 0, 255, None, xs_f.data, nb,
+                4, epoch=stale)
+            s = socket_mod.create_connection(addr_of[fence_target],
+                                             timeout=60)
+            try:
+                s.sendall(doctored)
+                s.shutdown(socket_mod.SHUT_WR)
+                s.settimeout(60)
+                data = b""
+                while True:
+                    try:
+                        chunk = s.recv(1 << 16)
+                    except OSError:
+                        break
+                    if not chunk:
+                        break
+                    data += chunk
+            finally:
+                s.close()
+            fence_held = False
+            off = 0
+            while off < len(data):
+                (blen,) = struct_mod.unpack_from("<I", data, off)
+                decoded = decode_response(data[off + 4:off + 4 + blen])
+                if decoded[0] == "error" and decoded[2] == E_EPOCH \
+                        and decoded[3] is not None:
+                    fence_held = True
+                off += 4 + blen
+        if shard_epoch != router.ring_epoch:
+            failures.append(
+                f"shard epoch {shard_epoch} never converged to the "
+                f"ring epoch {router.ring_epoch}")
+        if not fence_held:
+            failures.append(
+                "a doctored stale-epoch frame was NOT refused E_EPOCH "
+                "with a retry hint")
+        # ...and the key keeps serving the CURRENT ring's bits.
+        kb = bundles[fence_key]
+        got = router.evaluate(fence_key, xs_f, b=0, timeout=300) ^ \
+            router.evaluate(fence_key, xs_f, b=1, timeout=300)
+        want = eval_batch_np(prg, 0, kb.for_party(0), xs_f) ^ \
+            eval_batch_np(prg, 1, kb.for_party(1), xs_f)
+        post_parity = bool(np.array_equal(got, want))
+        if not post_parity:
+            failures.append(
+                "the fenced key stopped serving the current ring's "
+                "bits")
+
+        stop.set()
+        for t in threads:
+            t.join(60)
+        soak_wall_s = time.monotonic() - t_soak0
+
+        # Zero lost keys, globally: every key still serves bit-exact
+        # on the final two-host ring.
+        xs_post = rng.integers(0, 256, (8, nb), dtype=np.uint8)
+        for name, kb in sorted(bundles.items()):
+            got = router.evaluate(name, xs_post, b=0, timeout=300) ^ \
+                router.evaluate(name, xs_post, b=1, timeout=300)
+            want = eval_batch_np(prg, 0, kb.for_party(0), xs_post) ^ \
+                eval_batch_np(prg, 1, kb.for_party(1), xs_post)
+            if not np.array_equal(got, want):
+                failures.append(
+                    f"{name!r} no longer serves bit-exact after the "
+                    "churn (lost or rolled back)")
+        metric_files = [procs[s][2] for s in shard_ids]
+        time.sleep(1.2)
+        roll = _pod_rollup(metric_files)
+        quarantined = roll.get("serve_store_quarantined_total", 0)
+        kinds = [e.kind for e in controller.events()]
+
+        import jax
+
+        platform = jax.devices()[0].platform
+        rsnap = router.metrics_snapshot()
+        rate = stats["ok"] / max(soak_wall_s, 1e-9)
+        extra = {
+            "mode": "churn",
+            "shards": n_shards,
+            "bundles": n_bundles,
+            "live_bundles": len(live),
+            "eject_grace_s": float(args.eject_grace),
+            "probe_interval_s": args.probe_interval,
+            "soak_wall_s": round(soak_wall_s, 3),
+            "soak_sessions_ok": stats["ok"],
+            "soak_critical_ok": stats["critical_ok"],
+            "soak_mismatches": stats["mismatches"],
+            "soak_refused_hinted": stats["refused_hinted"],
+            "soak_refused_unhinted": stats["refused_unhinted"],
+            "soak_unaccounted": stats["unaccounted"],
+            "epochs": [epoch_after_eject, epoch_after_join,
+                       epoch_after_drain],
+            "membership_events": kinds,
+            "digest_regressions": digest_regressions,
+            "fence_held": fence_held,
+            "post_fence_parity": post_parity,
+            "drained_exit_rc": rc,
+            "migrated_frames": rsnap.get(
+                "membership_migrated_frames_total", 0),
+            "durable_replications": rsnap.get(
+                "membership_durable_replications_total", 0),
+            "lost_keys": rsnap.get("membership_lost_keys_total", 0),
+            "pod_quarantined": quarantined,
+            "platform": platform,
+            "repro": (f"python -m dcf_tpu.cli pod_bench --churn "
+                      f"--shards {n_shards} "
+                      f"--bundles {n_bundles} "
+                      f"--live-bundles {args.live_bundles} "
+                      f"--eject-grace {float(args.eject_grace):g} "
+                      f"--seed {args.seed}"),
+        }
+        unit = "sessions/s (churn soak, two-party, mixed priority)"
+        if platform != "tpu":
+            unit += (" [no TPU this session: XLA-CPU interpret mode, "
+                     "disclosed]")
+        _emit("pod_bench", backend, "sessions_per_sec", rate, unit,
+              extra_fields=extra)
+
+        if stats["mismatches"] or stats["unaccounted"] \
+                or stats["refused_unhinted"]:
+            failures.append(
+                f"ledger not clean: {stats['mismatches']} mismatches, "
+                f"{stats['unaccounted']} untyped, "
+                f"{stats['refused_unhinted']} unhinted refusals")
+        if stats["ok"] < 3 or stats["critical_ok"] < 1:
+            failures.append(
+                f"soak delivered only {stats['ok']} sessions "
+                f"({stats['critical_ok']} CRITICAL)")
+        if digest_regressions:
+            failures.append(
+                f"{digest_regressions} generation regressions across "
+                "the churn")
+        for want_kind in ("eject", "join", "drain", "drain-complete"):
+            if want_kind not in kinds:
+                failures.append(
+                    f"no {want_kind!r} membership event was committed")
+        if quarantined:
+            failures.append(
+                f"{quarantined} frames quarantined across the pod")
+        if failures:
+            raise SystemExit("pod_bench: " + "; ".join(failures))
+    finally:
+        if controller is not None:
+            try:
+                controller.close()
+            except Exception:  # fallback-ok: best-effort teardown
+                pass
+        if router is not None:
+            try:
+                router.close()
+            except Exception:  # fallback-ok: best-effort teardown
+                pass
+        for tag, (proc, _r, _m) in procs.items():
+            if proc.poll() is None:
+                proc.terminate()
+        for tag, (proc, _r, _m) in procs.items():
+            try:
+                proc.wait(15)
+            except Exception:  # fallback-ok: a shard that ignores
+                # SIGTERM gets the hard kill below
+                proc.kill()
+        if not keep_dirs:
+            shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_pod(args) -> None:
     """The pod-scale serving acceptance bench (ISSUE 13): N localhost
     shard PROCESSES behind the zero-copy DCFE router, vs the same
@@ -3632,7 +4196,18 @@ def bench_pod(args) -> None:
     partition-tolerance scenario instead (``bench_pod_selfheal``).
 
     Emits one ``RESULTS_pod`` JSONL line (platform disclosed in-line),
-    then applies the exit gates."""
+    then applies the exit gates.
+
+    ISSUE 15: ``--churn`` runs the autonomous-membership scenario
+    instead (``bench_pod_churn``) — kill -> auto-eject ->
+    re-replication verified -> heal -> graceful re-join, plus a drain
+    leg and the stale-epoch fence."""
+    if args.churn:
+        if args.partition or args.flap:
+            raise SystemExit(
+                "--churn and --partition/--flap are separate "
+                "scenarios; pick one")
+        return bench_pod_churn(args)
     if args.partition or args.flap:
         return bench_pod_selfheal(args)
 
@@ -4256,6 +4831,23 @@ def main(argv=None) -> None:
                    help="pod_bench: the partition scenario with three "
                         "cut/heal cycles — generations must be "
                         "monotone across every flap")
+    p.add_argument("--churn", action="store_true",
+                   help="pod_bench: the autonomous-membership "
+                        "scenario (ISSUE 15) — SIGKILL one shard, the "
+                        "controller auto-ejects it after the grace "
+                        "with every frame re-replicated to the new "
+                        "placement (verified over the DIGEST verb + "
+                        "the stores), the healed shard re-joins only "
+                        "after the anti-entropy warm-up, a second "
+                        "shard is gracefully drained (SIGTERM exits "
+                        "0), and a doctored stale-epoch frame is "
+                        "refused E_EPOCH — gates: ledger clean, zero "
+                        "generation regressions, zero lost keys")
+    p.add_argument("--eject-grace", type=float, default=3.0,
+                   help="pod_bench --churn: seconds a shard must stay "
+                        "DOWN before the membership controller "
+                        "auto-ejects it (the flap filter; promotion "
+                        "already serves its keys meanwhile)")
     p.add_argument("--probe-interval", type=float, default=0.25,
                    help="pod_bench: health-prober probe interval in "
                         "seconds (fail-3/recover-2 hysteresis rides "

@@ -24,6 +24,7 @@ grouping it with geometry staleness is what lets callers write one
       +-- CircuitOpenError       (RuntimeError) breaker open: failing fast
       +-- KeyQuarantinedError    (RuntimeError) durable frame corrupt: set aside
       +-- BatchTimeoutError      (TimeoutError) batch overran its wall deadline
+      +-- RingEpochError         (RuntimeError) frame fenced: sender's ring is stale
 
 The serve-layer classes belong to the online serving layer
 (``dcf_tpu.serve``):
@@ -64,6 +65,7 @@ __all__ = [
     "CircuitOpenError",
     "KeyQuarantinedError",
     "BatchTimeoutError",
+    "RingEpochError",
     "BackendFallbackWarning",
 ]
 
@@ -183,6 +185,25 @@ class BatchTimeoutError(DcfError, TimeoutError):
     so a backend that hangs instead of crashing still demotes, still
     opens its breaker, and still stops stalling the worker while the
     queue sheds behind it (``serve.service``)."""
+
+
+class RingEpochError(DcfError, RuntimeError):
+    """A forwarded pod frame carried a ring epoch OLDER than one this
+    shard has already observed: the sender routed on a stale membership
+    view (ISSUE 15, ``serve.membership``).  Serving the request anyway
+    could double-serve a key across two conflicting placements — the
+    membership analog of the generation-fence rollback — so the shard
+    refuses it structurally instead.  The sender must refresh its ring
+    (``DcfRouter.set_ring`` with the current epoch) before retrying.
+
+    ``retry_after_s``: a short constant hint — membership convergence
+    is one control-plane round, not a load condition.  Crosses the wire
+    as its own code (``E_EPOCH``), so a router can tell "my ring is
+    stale" from every backend-health signal."""
+
+    def __init__(self, *args, retry_after_s: float | None = None):
+        super().__init__(*args)
+        self.retry_after_s = retry_after_s
 
 
 class BackendFallbackWarning(UserWarning):

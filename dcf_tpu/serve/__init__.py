@@ -44,6 +44,11 @@ keys":
   placement of keys onto host shards — deterministic keyed-digest
   scores, minimal disruption under membership change, the replica
   ranking failover and frame replication both read;
+- ``serve.membership`` autonomous ring membership (ISSUE 15):
+  health-driven auto-eject with pre-commit re-replication, graceful
+  warm-before-admit join, three-phase drain for planned decommission,
+  and the monotonic ring-epoch fence (``RingEpochError``/``E_EPOCH``)
+  that structurally refuses routers on a stale membership view;
 - ``serve.router``    the pod routing tier (ISSUE 13): a DCFE-on-
   both-sides router forwarding frames header-decode-only (payload
   relayed as a memoryview through pooled ``EdgeClient``s) with
@@ -81,6 +86,10 @@ from dcf_tpu.serve.health import (  # noqa: F401
     HealthProber,
 )
 from dcf_tpu.serve.keyfactory import KeyFactory, PoolSpec  # noqa: F401
+from dcf_tpu.serve.membership import (  # noqa: F401
+    MembershipController,
+    MembershipEvent,
+)
 from dcf_tpu.serve.metrics import Metrics, rollup_snapshots  # noqa: F401
 from dcf_tpu.serve.registry import KeyRegistry  # noqa: F401
 from dcf_tpu.serve.replicate import Replicator  # noqa: F401
@@ -93,5 +102,6 @@ __all__ = ["DcfService", "ServeConfig", "ServeFuture", "Priority",
            "TenantSpec", "EdgeServer", "EdgeClient", "EdgeClientPool",
            "BreakerBoard", "DcfRouter", "FrontierCache", "HealthEvent",
            "HealthProber", "KeyFactory", "Metrics", "KeyRegistry",
-           "KeyStore", "PoolSpec", "Replicator", "RestoreReport",
+           "KeyStore", "MembershipController", "MembershipEvent",
+           "PoolSpec", "Replicator", "RestoreReport",
            "ShardMap", "ShardSpec", "rollup_snapshots"]

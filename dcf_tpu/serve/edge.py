@@ -25,7 +25,9 @@ Wire format (all integers little-endian; every frame is a ``u32``
 body-length envelope followed by the body)::
 
     0   4   magic  b"DCFE"
-    4   2   version (u16, currently 1)
+    4   2   version (u16, currently 2 — v2 added the ring-epoch
+            field to REQUEST/PING/REGISTER, ISSUE 15; both ends of
+            every link in this repo speak v2, v1 is refused typed)
     6   1   type    (u8: 1=REQUEST  2=SHARE  3=ERROR)
 
     REQUEST body (type 1):
@@ -38,7 +40,9 @@ body-length envelope followed by the body)::
     29  2   n_bytes     u16  bytes per point (must match the service)
     31  1   tenant_len  u8
     32  1   key_len     u8
-    33      tenant      utf-8 [tenant_len]
+    33  4   epoch       u32  ring epoch the sender routed on (0 = un-
+                             fenced: direct clients, solo services)
+    37      tenant      utf-8 [tenant_len]
     ..      key_id      utf-8 [key_len]
     ..      xs payload  raw packed points, m * n_bytes
     end-4   crc32       u32 of ALL prior body bytes (zlib.crc32)
@@ -135,6 +139,22 @@ SHARE/ERROR the protocol carries four lightweight control verbs —
   STRICTLY newer — the pull half of partition healing
   (``serve.replicate``).
 
+Epoch fencing (ISSUE 15, ``serve.membership``): every ring-membership
+change is committed under a monotonic **ring epoch** minted by the
+membership controller.  Forwarded REQUEST and REGISTER frames (and the
+health prober's PINGs) carry the sender's epoch; a shard tracks the
+highest epoch it has seen (``DcfService.check_ring_epoch`` — adoption
+is monotonic-max, the same first-writer discipline the generation
+fence uses) and REFUSES any fenced frame carrying an older one, typed
+``RingEpochError`` / ``E_EPOCH`` with a retry hint.  A router still
+routing on a pre-change ring is therefore *structurally* unable to
+double-serve a key against a conflicting placement — the PR 14
+generation-fence discipline lifted from keys to membership.  Epoch 0
+means unfenced (direct clients, solo deployments): the check is
+skipped, exactly as generation 0 means "mint here" on REGISTER.
+Epoch adoption, like REGISTER itself, is an operator/router action
+authenticated by the TLS client-pinning story, not the tenant table.
+
 Partition seam (ISSUE 14): a client constructed with ``tags=(local,
 peer)`` fires ``net.partition`` before each dial and each frame send
 (``testing.faults.partition`` is the canonical handler — it raises
@@ -173,6 +193,7 @@ from dcf_tpu.errors import (
     DeadlineExceededError,
     KeyFormatError,
     QueueFullError,
+    RingEpochError,
     ShapeError,
     StaleStateError,
 )
@@ -194,7 +215,7 @@ __all__ = ["EdgeServer", "EdgeClient", "EdgeClientPool", "TokenBucket",
            "encode_sync"]
 
 MAGIC = b"DCFE"
-VERSION = 1
+VERSION = 2  # v2 (ISSUE 15): REQUEST/PING/REGISTER carry a ring epoch
 
 T_REQUEST = 1
 T_SHARE = 2
@@ -208,12 +229,13 @@ T_SYNC = 8      # anti-entropy response: strictly-newer frames
 _PREFIX = struct.Struct("<I")        # the length envelope
 _FRAME_HEAD = struct.Struct("<HB")   # version, type (after the magic)
 _BODY_MIN = 4 + _FRAME_HEAD.size     # magic + version + type
-_REQ_HEAD = struct.Struct("<QBBdIHBB")
+_REQ_HEAD = struct.Struct("<QBBdIHBBI")  # ..., tenant_len, key_len, epoch
 _RES_HEAD = struct.Struct("<QHIH")
 _ERR_HEAD = struct.Struct("<QHdH")
-_PING_HEAD = struct.Struct("<Q")     # req_id
+_PING_HEAD = struct.Struct("<QI")    # req_id, ring epoch (0 = unfenced)
 _PONG_HEAD = struct.Struct("<QQ")    # req_id, value
-_REG_HEAD = struct.Struct("<QQBB")   # req_id, generation, proto, key_len
+_REG_HEAD = struct.Struct("<QQIBB")  # req_id, generation, epoch, proto,
+#                                      key_len
 _DIG_HEAD = struct.Struct("<QBI")    # req_id, mode, entry count
 _DIG_ENTRY = struct.Struct("<QB")    # generation, key_len
 _SYNC_HEAD = struct.Struct("<QI")    # req_id, entry count
@@ -246,6 +268,11 @@ E_STALE = 13  # StaleStateError's own code (ISSUE 13): a hot-swap
 #               resolves by retrying the same target — the router must
 #               be able to tell it from E_UNAVAILABLE, which is a
 #               backend-down signal it treats as failover pressure
+E_EPOCH = 14  # RingEpochError (ISSUE 15): the SENDER's ring is stale —
+#               a membership change committed a newer epoch than the
+#               one this frame carries.  Neither a shard-health signal
+#               (the shard is fine) nor a key-level outcome: the
+#               sender must refresh its ring before retrying
 
 #: code -> exception class the client raises (see ``_raise_wire``).
 WIRE_CODES = {
@@ -262,6 +289,7 @@ WIRE_CODES = {
     E_TIMEOUT: BatchTimeoutError,
     E_EVICTED: QueueFullError,
     E_STALE: StaleStateError,
+    E_EPOCH: RingEpochError,
 }
 
 _EXC_CODES = (
@@ -272,6 +300,7 @@ _EXC_CODES = (
     (BatchTimeoutError, E_TIMEOUT),
     (KeyFormatError, E_WIRE),
     (ShapeError, E_SHAPE),
+    (RingEpochError, E_EPOCH),
     (StaleStateError, E_STALE),
     (BackendUnavailableError, E_UNAVAILABLE),
     (DcfError, E_INTERNAL),
@@ -345,11 +374,13 @@ def _frame(body_parts) -> bytes:
 
 def _request_parts(req_id: int, tenant: str, key_id: str, party: int,
                    priority: int, deadline_ms: float | None,
-                   payload, n_bytes: int, m: int) -> list:
+                   payload, n_bytes: int, m: int,
+                   epoch: int = 0) -> list:
     """The ONE REQUEST-body encoding (validation included), as byte
     pieces with the payload referenced by buffer: ``encode_request``
     joins them into a frame; ``EdgeClient.submit_bytes`` hands them to
-    the scatter-gather send.  Two encoders would drift."""
+    the scatter-gather send.  Two encoders would drift.  ``epoch``
+    (ISSUE 15): the ring epoch the sender routed on; 0 = unfenced."""
     tb = tenant.encode("utf-8")
     kb_name = key_id.encode("utf-8")
     if len(tb) > 255 or len(kb_name) > 255:
@@ -358,21 +389,24 @@ def _request_parts(req_id: int, tenant: str, key_id: str, party: int,
         # Validated here, not by struct.pack: submit_bytes relies on
         # encoding failures being raised BEFORE a future registers
         raise ShapeError(f"party byte must fit u8, got {party}")
+    if epoch < 0:
+        raise ShapeError(f"ring epoch must be >= 0, got {epoch}")
     head = MAGIC + _FRAME_HEAD.pack(VERSION, T_REQUEST) + _REQ_HEAD.pack(
         req_id, int(party), priority,
         -1.0 if deadline_ms is None else float(deadline_ms),
-        m, n_bytes, len(tb), len(kb_name))
+        m, n_bytes, len(tb), len(kb_name), int(epoch))
     return [head, tb, kb_name, memoryview(payload)]
 
 
 def encode_request(req_id: int, tenant: str, key_id: str, party: int,
                    priority: int, deadline_ms: float | None,
-                   payload, n_bytes: int, m: int) -> bytes:
+                   payload, n_bytes: int, m: int,
+                   epoch: int = 0) -> bytes:
     """One REQUEST frame (envelope included).  ``payload`` is any
     buffer-protocol object of ``m * n_bytes`` packed point bytes."""
     return _frame(_request_parts(req_id, tenant, key_id, party,
                                  priority, deadline_ms, payload,
-                                 n_bytes, m))
+                                 n_bytes, m, epoch))
 
 
 def encode_share(req_id: int, y: np.ndarray) -> list[bytes]:
@@ -400,35 +434,46 @@ def encode_error(req_id: int, code: int, message: str,
     return _frame([head, mb])
 
 
-def encode_ping(req_id: int) -> bytes:
-    """One PING frame (ISSUE 14: the health prober's liveness probe)."""
+def encode_ping(req_id: int, epoch: int = 0) -> bytes:
+    """One PING frame (ISSUE 14: the health prober's liveness probe).
+    ``epoch`` (ISSUE 15): the prober's ring epoch — probes DISSEMINATE
+    membership epochs, so shards converge on a committed epoch within
+    about one probe interval; 0 = unfenced liveness only."""
+    if epoch < 0:
+        raise ShapeError(f"ring epoch must be >= 0, got {epoch}")
     head = MAGIC + _FRAME_HEAD.pack(VERSION, T_PING) + _PING_HEAD.pack(
-        req_id)
+        req_id, int(epoch))
     return _frame([head])
 
 
 def encode_pong(req_id: int, value: int = 0) -> bytes:
     """PING/REGISTER ack; ``value`` echoes the registration generation
-    (0 for a plain pong)."""
+    (for REGISTER) or the receiver's current ring epoch (for PING —
+    how the membership benches verify epoch convergence over the
+    wire)."""
     head = MAGIC + _FRAME_HEAD.pack(VERSION, T_PONG) + _PONG_HEAD.pack(
         req_id, value)
     return _frame([head])
 
 
 def encode_register(req_id: int, key_id: str, frame, generation: int = 0,
-                    proto: bool = False) -> bytes:
+                    proto: bool = False, epoch: int = 0) -> bytes:
     """One REGISTER frame: a DCFK v2/v3 frame forwarded by reference
     (``frame`` is any buffer-protocol object — the bundle bytes are
     never re-materialized here).  ``generation=0`` = mint at the
     receiver (the owner-side registration); ``generation>0`` = the
-    fenced replica/anti-entropy apply, owner's generation preserved."""
+    fenced replica/anti-entropy apply, owner's generation preserved.
+    ``epoch`` (ISSUE 15): the sender's ring epoch; 0 = unfenced."""
     kb_name = key_id.encode("utf-8")
     if len(kb_name) > 255:
         raise ShapeError("key_id must encode to <= 255 bytes")
     if generation < 0:
         raise ShapeError(f"generation must be >= 0, got {generation}")
+    if epoch < 0:
+        raise ShapeError(f"ring epoch must be >= 0, got {epoch}")
     head = MAGIC + _FRAME_HEAD.pack(VERSION, T_REGISTER) + _REG_HEAD.pack(
-        req_id, int(generation), int(bool(proto)), len(kb_name))
+        req_id, int(generation), int(epoch), int(bool(proto)),
+        len(kb_name))
     return _frame([head, kb_name, memoryview(frame)])
 
 
@@ -512,7 +557,7 @@ def decode_request(body) -> dict:
             f"truncated frame: {view.nbytes} bytes cannot hold a "
             "request header")
     (req_id, party, priority, deadline_ms, m, n_bytes, tenant_len,
-     key_len) = _REQ_HEAD.unpack_from(view, _BODY_MIN)
+     key_len, epoch) = _REQ_HEAD.unpack_from(view, _BODY_MIN)
     off = _BODY_MIN + _REQ_HEAD.size
     end = view.nbytes - _CRC.size
     claims = f"m={m}, n_bytes={n_bytes}"
@@ -538,13 +583,14 @@ def decode_request(body) -> dict:
         "req_id": req_id, "tenant": tenant, "key_id": key_id,
         "party": party, "priority": priority,
         "deadline_ms": deadline_ms if deadline_ms > 0 else None,
-        "m": m, "n_bytes": n_bytes,
+        "m": m, "n_bytes": n_bytes, "epoch": epoch,
         "payload": view[off:end],
     }
 
 
-def decode_ping(body) -> int:
-    """Strict PING decode -> ``req_id``."""
+def decode_ping(body) -> tuple:
+    """Strict PING decode -> ``(req_id, epoch)`` (epoch 0 = unfenced
+    liveness only)."""
     view = _check_body(body, "a ping")
     _, ftype = _FRAME_HEAD.unpack_from(view, 4)
     if ftype != T_PING:
@@ -554,8 +600,8 @@ def decode_ping(body) -> int:
             f"ping frame must be exactly "
             f"{_BODY_MIN + _PING_HEAD.size + _CRC.size} bytes, "
             f"got {view.nbytes}")
-    (req_id,) = _PING_HEAD.unpack_from(view, _BODY_MIN)
-    return req_id
+    req_id, epoch = _PING_HEAD.unpack_from(view, _BODY_MIN)
+    return req_id, epoch
 
 
 def decode_register(body) -> dict:
@@ -569,7 +615,7 @@ def decode_register(body) -> dict:
         raise KeyFormatError(
             f"truncated frame: {view.nbytes} bytes cannot hold a "
             "register header")
-    req_id, generation, proto, key_len = _REG_HEAD.unpack_from(
+    req_id, generation, epoch, proto, key_len = _REG_HEAD.unpack_from(
         view, _BODY_MIN)
     if proto not in (0, 1):
         raise KeyFormatError(
@@ -588,7 +634,7 @@ def decode_register(body) -> dict:
             "frame cannot be a key)")
     return {"req_id": req_id, "key_id": key_id,
             "generation": generation, "proto": bool(proto),
-            "frame": view[off:end]}
+            "epoch": epoch, "frame": view[off:end]}
 
 
 def decode_digest(body) -> tuple:
@@ -834,6 +880,21 @@ class _Conn:
         except queue.Full:
             pass  # the writer is mid-backlog; the closed socket ends it
 
+    def nudge(self) -> None:
+        """Graceful-shutdown half (ISSUE 15): queue the writer's
+        end-sentinel so it delivers the queued responses — their
+        futures are already complete because ``serve_host`` drains the
+        service first — then exits.  ``EdgeServer.close(drain_s=)``
+        nudges EVERY connection before joining any writer, so the
+        flush wall time is one shared deadline, not per-connection."""
+        try:
+            self._out.put_nowait(None)
+        except queue.Full:
+            pass  # a full backlog still drains; the join bounds it
+
+    def join_writer(self, timeout: float) -> None:
+        self._writer.join(timeout)
+
     def _enqueue(self, item) -> None:
         """Reader-side put honouring the backlog bound: blocks in
         slices so a server/connection close can always free the reader
@@ -943,12 +1004,27 @@ class _Conn:
         if ftype == T_REQUEST:
             self._handle_request(body)
         elif ftype == T_PING:
-            req_id = decode_ping(body)
-            self._srv._c_control.inc()
+            req_id, epoch = decode_ping(body)
+            srv = self._srv
+            srv._c_control.inc()
             # Admission-free by design: liveness, not serving capacity
             # (a shard in brownout is alive and must answer probes —
-            # see the module docstring's control-frame section).
-            self._enqueue(("ctl", encode_pong(req_id, 0)))
+            # see the module docstring's control-frame section).  A
+            # fenced ping (epoch > 0) adopts-or-refuses like any other
+            # fenced frame: probes are how epochs disseminate, and a
+            # STALE prober must learn its ring is old, not keep
+            # confirming a membership view the pod has moved past.
+            try:
+                current = self._check_epoch(epoch)
+            except Exception as e:  # fallback-ok: the typed E_EPOCH
+                # refusal is a request-level outcome; the connection
+                # survives (framing was intact)
+                srv._c_refused.inc()
+                self._enqueue(encode_error(
+                    req_id, _code_for(e), str(e),
+                    getattr(e, "retry_after_s", None)))
+                return
+            self._enqueue(("ctl", encode_pong(req_id, current)))
         elif ftype == T_REGISTER:
             self._handle_register(body)
         elif ftype == T_DIGEST:
@@ -958,12 +1034,34 @@ class _Conn:
                 f"frame type {ftype} is not a server-side frame "
                 "(server side accepts types 1, 4, 6 and 7)")
 
+    def _check_epoch(self, epoch: int, adopt: bool = True) -> int:
+        """The ring-epoch fence (ISSUE 15): adopt-or-refuse ``epoch``
+        against the service's observed maximum.  Returns the service's
+        current epoch (0 when the target has no epoch surface — a
+        router door, or a pre-membership service); raises the typed
+        ``RingEpochError`` for a stale sender.  Epoch 0 frames are
+        unfenced and skip the check entirely.  ``adopt=False`` =
+        refuse-only (the REQUEST path's pre-admission check — see
+        ``DcfService.check_ring_epoch``)."""
+        check = getattr(self._srv._service, "check_ring_epoch", None)
+        if check is None:
+            return 0
+        if not epoch:
+            return int(getattr(self._srv._service, "ring_epoch", 0))
+        return int(check(epoch, adopt=adopt))
+
     def _handle_register(self, body: bytearray) -> None:
         req = decode_register(body)
         srv = self._srv
         req_id = req["req_id"]
         srv._c_control.inc()
         try:
+            # The membership fence runs FIRST: a registration routed on
+            # a stale ring must not mint/apply against a placement the
+            # pod has moved past (it would be healed by anti-entropy,
+            # but structurally refusing it is what makes a stale
+            # router's writes impossible rather than merely repaired).
+            self._check_epoch(req["epoch"])
             if req["generation"]:
                 apply_fn = getattr(srv._service, "apply_replica_frame",
                                    None)
@@ -1051,6 +1149,19 @@ class _Conn:
             refuse(E_BAD_REQUEST,
                    f"party must be 0 or 1, got {req['party']}")
             return
+        try:
+            # Epoch fence BEFORE tenant admission, refuse-only: a
+            # stale router's forward must not consume a tenant's token
+            # budget on a request this shard will structurally refuse
+            # — but an UNADMITTED sender must not be able to ADOPT
+            # either (one forged frame with a huge epoch would fence
+            # out the real router); adoption runs post-admission.
+            self._check_epoch(req["epoch"], adopt=False)
+        except Exception as e:  # fallback-ok: typed E_EPOCH refusal —
+            # request-level, the connection survives
+            refuse(_code_for(e), str(e),
+                   getattr(e, "retry_after_s", None))
+            return
         tenant = srv._resolve_tenant(req["tenant"])
         if tenant is None:
             refuse(E_UNKNOWN_TENANT,
@@ -1087,6 +1198,16 @@ class _Conn:
                    f"tenant {tenant.spec.name!r} over its "
                    f"{tenant.bucket.rate:g} points/s admission rate",
                    retry_after_s=retry)
+            return
+        try:
+            # Admitted: NOW a newer epoch is adopted (the refuse-only
+            # half already ran pre-admission).  A membership commit
+            # landing BETWEEN the two checks can make the sender stale
+            # here — still a typed request-level refusal.
+            self._check_epoch(req["epoch"])
+        except Exception as e:  # fallback-ok: typed E_EPOCH refusal
+            refuse(_code_for(e), str(e),
+                   getattr(e, "retry_after_s", None))
             return
         try:
             fut = srv._service.submit_bytes(
@@ -1232,6 +1353,8 @@ class EdgeServer:
         self._listener: socket.socket | None = None
         self._acceptor: threading.Thread | None = None
         self._closing = False
+        self._draining = False  # stop_accepting() ran (listener down,
+        #                         live connections still serving)
         now = self._clock()
         self._tenants = {
             spec.name: _Tenant(spec, self.metrics, now)
@@ -1273,8 +1396,14 @@ class EdgeServer:
             raise StaleStateError("edge server not started")
         return self._listener.getsockname()[:2]
 
-    def close(self) -> None:
-        self._closing = True
+    def stop_accepting(self) -> None:
+        """Shut the listener down but leave live connections OPEN —
+        the first half of a graceful shutdown (ISSUE 15): ``serve_host``
+        stops new connections, drains the service so queued requests
+        complete, and the writer threads deliver those responses over
+        the still-open links before ``close()`` tears them down.
+        Idempotent; ``close()`` calls it."""
+        self._draining = True
         listener = self._listener
         if listener is not None:
             try:
@@ -1284,8 +1413,27 @@ class EdgeServer:
             listener.close()
         if self._acceptor is not None:
             self._acceptor.join(5.0)
+
+    def close(self, drain_s: float = 0.0) -> None:
+        """Tear the edge down.  ``drain_s`` > 0 is the graceful
+        spelling: after the listener stops, each connection's writer
+        gets up to that long to flush queued responses (the futures
+        behind them must already be complete — ``serve_host`` drains
+        the service first) before the hard close."""
+        self._closing = True
+        self.stop_accepting()
         with self._lock:
             conns = list(self._conns)
+        if drain_s > 0:
+            # Sentinel every writer FIRST, then join against ONE
+            # shared deadline: K peers that stopped reading cost at
+            # most drain_s total, not K * drain_s (a supervisor's
+            # TERM-to-KILL window must bound the whole flush).
+            for c in conns:
+                c.nudge()
+            deadline = monotonic() + drain_s
+            for c in conns:
+                c.join_writer(max(0.0, deadline - monotonic()))
         for c in conns:
             c.close()
         for c in conns:
@@ -1316,10 +1464,10 @@ class EdgeServer:
                 fire("edge.accept")
                 sock, addr = self._listener.accept()
             except OSError:
-                # fallback-ok: close() shut the listener down, or a
-                # transient accept failure — the loop survives the
-                # latter and exits on the former.
-                if self._closing:
+                # fallback-ok: close()/stop_accepting() shut the
+                # listener down, or a transient accept failure — the
+                # loop survives the latter and exits on the former.
+                if self._closing or self._draining:
                     return
                 self._c_accept_errors.inc()
                 continue
@@ -1381,7 +1529,7 @@ def _raise_wire(code: int, retry_after_s: float | None, msg: str):
     if cls is QueueFullError:
         err = cls(msg, retry_after_s=retry_after_s,
                   evicted=code == E_EVICTED)
-    elif cls is CircuitOpenError:
+    elif cls in (CircuitOpenError, RingEpochError):
         err = cls(msg, retry_after_s=retry_after_s)
     elif cls is ValueError:
         # api-edge: the server flagged a request-contract violation
@@ -1494,7 +1642,7 @@ class EdgeClient:
 
     def submit_bytes(self, key_id: str, data, m: int | None = None,
                      b: int = 0, deadline_ms: float | None = None,
-                     priority=None) -> ServeFuture:
+                     priority=None, epoch: int = 0) -> ServeFuture:
         """Wire twin of ``DcfService.submit_bytes`` — and the pod
         router's relay path (ISSUE 13): ``data`` (any buffer-protocol
         object of ``m`` packed ``n_bytes``-wide points; ``m`` derived
@@ -1502,7 +1650,10 @@ class EdgeClient:
         write, so a forwarded request's payload crosses this hop as a
         ``memoryview`` of the upstream frame buffer — no join, no
         re-materialization.  The caller must keep ``data`` alive until
-        this call returns (the send completes synchronously)."""
+        this call returns (the send completes synchronously).
+        ``epoch`` (ISSUE 15): the ring epoch the sender routed on —
+        the router passes its current one; direct callers leave 0
+        (unfenced)."""
         view = memoryview(data).cast("B")
         if m is None:
             if view.nbytes == 0 or view.nbytes % self.n_bytes:
@@ -1528,7 +1679,7 @@ class EdgeClient:
         # connection's lifetime.  The burned req_id is harmless.
         views = [memoryview(p).cast("B") for p in _request_parts(
             req_id, self.tenant, key_id, b, pri, deadline_ms, view,
-            self.n_bytes, m)]
+            self.n_bytes, m, epoch)]
         crc = 0
         for v in views:
             crc = zlib.crc32(v, crc)
@@ -1599,26 +1750,41 @@ class EdgeClient:
                 self._pending.pop(req_id, None)
             raise
 
-    def ping(self, timeout: float | None = None) -> bool:
+    def ping(self, timeout: float | None = None,
+             epoch: int = 0) -> bool:
         """One PING round trip (ISSUE 14: the health prober's liveness
         probe).  Returns True, or raises — transport death typed
         ``BackendUnavailableError``, an unanswered probe the builtin
-        ``TimeoutError``."""
-        self._roundtrip(encode_ping, timeout)
+        ``TimeoutError``, a stale fenced probe the typed
+        ``RingEpochError`` (ISSUE 15 — ``epoch`` is the prober's ring
+        epoch; 0 = unfenced liveness only)."""
+        self._roundtrip(lambda rid: encode_ping(rid, epoch), timeout)
         return True
+
+    def ping_epoch(self, timeout: float | None = None,
+                   epoch: int = 0) -> int:
+        """PING returning the PEER's current ring epoch (the PONG
+        value — ISSUE 15: how the membership benches verify epoch
+        convergence over the wire).  Same failure modes as ``ping``."""
+        return int(self._roundtrip(
+            lambda rid: encode_ping(rid, epoch), timeout))
 
     def register_frame(self, key_id: str, frame, generation: int = 0,
                        proto: bool = False,
-                       timeout: float | None = None) -> int:
+                       timeout: float | None = None,
+                       epoch: int = 0) -> int:
         """Forward one DCFK frame for registration (ISSUE 14).
         ``generation=0`` mints at the receiver (owner registration);
         ``generation>0`` is the fenced replica apply — a receiver
         already at or past that generation raises the real
-        ``StaleStateError`` here (``E_STALE``).  Returns the
-        generation the key is registered under."""
+        ``StaleStateError`` here (``E_STALE``).  ``epoch`` fences the
+        registration against membership staleness (``E_EPOCH``,
+        ISSUE 15; 0 = unfenced).  Returns the generation the key is
+        registered under."""
         return int(self._roundtrip(
             lambda rid: encode_register(rid, key_id, frame,
-                                        generation, proto), timeout))
+                                        generation, proto, epoch),
+            timeout))
 
     def pull_digest(self, timeout: float | None = None) -> dict:
         """The peer's live ``{key_id: generation}`` registration
@@ -1852,10 +2018,11 @@ class EdgeClientPool:
 
     def submit_bytes(self, key_id: str, data, m: int | None = None,
                      b: int = 0, deadline_ms: float | None = None,
-                     priority=None) -> ServeFuture:
+                     priority=None, epoch: int = 0) -> ServeFuture:
         return self._lease().submit_bytes(key_id, data, m=m, b=b,
                                           deadline_ms=deadline_ms,
-                                          priority=priority)
+                                          priority=priority,
+                                          epoch=epoch)
 
     def evaluate(self, key_id: str, xs, b: int = 0,
                  deadline_ms: float | None = None,
@@ -1866,7 +2033,8 @@ class EdgeClientPool:
 
     # -- control frames (ISSUE 14: the health/replication surface) ----
 
-    def ping(self, timeout: float | None = None) -> bool:
+    def ping(self, timeout: float | None = None,
+             epoch: int = 0) -> bool:
         """One PING round trip through a leased connection — the
         health prober's probe.  While the target is dark the lease
         fails typed inside the backoff without dialing, so probe
@@ -1874,13 +2042,19 @@ class EdgeClientPool:
         (recovery detection is therefore at most one backoff late —
         and the UP transition clamps the backoff so REQUESTS never
         wait it out; see ``reset_backoff``)."""
-        return self._lease().ping(timeout)
+        return self._lease().ping(timeout, epoch=epoch)
+
+    def ping_epoch(self, timeout: float | None = None,
+                   epoch: int = 0) -> int:
+        return self._lease().ping_epoch(timeout, epoch=epoch)
 
     def register_frame(self, key_id: str, frame, generation: int = 0,
                        proto: bool = False,
-                       timeout: float | None = None) -> int:
+                       timeout: float | None = None,
+                       epoch: int = 0) -> int:
         return self._lease().register_frame(key_id, frame, generation,
-                                            proto, timeout)
+                                            proto, timeout,
+                                            epoch=epoch)
 
     def pull_digest(self, timeout: float | None = None) -> dict:
         return self._lease().pull_digest(timeout)

@@ -109,7 +109,8 @@ class HealthProber:
                  timeout_s: float | None = None, fail_n: int = 3,
                  recover_m: int = 2, clock=monotonic,
                  metrics: Metrics | None = None, recover_gate=None,
-                 on_transition=None, max_events: int = 256):
+                 on_transition=None, max_events: int = 256,
+                 epoch_source=None):
         if interval_s <= 0:
             # api-edge: prober config contract
             raise ValueError(
@@ -134,6 +135,16 @@ class HealthProber:
         self._metrics = metrics if metrics is not None else Metrics()
         self._recover_gate = recover_gate
         self._on_transition = on_transition
+        # Epoch dissemination (ISSUE 15): when set (a zero-arg callable
+        # returning the router's current ring epoch), every probe
+        # carries it — shards adopt a committed membership epoch within
+        # about one probe interval, and a STALE prober's pings are
+        # refused E_EPOCH (one more probe failure: the hysteresis walks
+        # the stale router's view DOWN, which is exactly the structural
+        # refusal the fence promises).  None = unfenced pings, and the
+        # target's ``ping`` is called WITHOUT the epoch kwarg (scripted
+        # test targets keep their one-argument signature).
+        self._epoch_source = epoch_source
         self._max_events = int(max_events)
         self._lock = threading.Lock()
         self._pump_lock = threading.Lock()  # one probe round at a time
@@ -213,7 +224,12 @@ class HealthProber:
                 self._metrics.counter(labeled(
                     "router_probes_total", shard=host_id)).inc()
                 try:
-                    ok = bool(target.ping(timeout=self.timeout_s))
+                    if self._epoch_source is not None:
+                        ok = bool(target.ping(
+                            timeout=self.timeout_s,
+                            epoch=int(self._epoch_source())))
+                    else:
+                        ok = bool(target.ping(timeout=self.timeout_s))
                 except Exception:  # fallback-ok: ANY probe failure
                     # (transport death, dark-target backoff, timeout)
                     # is one observation for the hysteresis — the

@@ -76,6 +76,27 @@ prober's and router's per-shard series are removed with the host
 (``HealthProber.remove_target`` / ``DcfRouter.set_ring``), the
 ``BreakerBoard.forget`` discipline.
 
+Membership series (ISSUE 15, recorded by ``serve.membership`` and the
+epoch fence): ``membership_ejections_total`` /
+``membership_joins_total`` / ``membership_drains_total`` (committed
+ring changes), ``membership_migrated_frames_total`` (live frames the
+convergence passes moved) /
+``membership_durable_replications_total`` (``KeyStore.replicate_to``
+copies), ``membership_change_failures_total`` (aborted changes —
+retried on a later pump), ``membership_eject_skipped_total``
+(min-hosts / multi-failure safety rails),
+``membership_store_unreachable_total`` (stores skipped in a durable
+pass because their digest read failed — a dead disk must not wedge
+membership), ``membership_lost_keys_total``
+(the zero-loss audit), ``membership_ring_size`` /
+``membership_draining_hosts`` gauges; epoch planes:
+``router_ring_epoch`` (the router's committed epoch) /
+``router_stale_epoch_total`` (forwards refused because THIS router's
+ring is stale), shard-side ``serve_ring_epoch`` (observed maximum) /
+``serve_epoch_fenced_total`` (stale frames refused ``E_EPOCH``); the
+store's ``serve_store_replicate_retries_total`` counts
+``replicate_to``'s transient-``OSError`` retries.
+
 Secret hygiene: metric NAMES are static strings and metric values are
 scalars; key ids chosen by callers become label values via ``labeled``
 and must never be derived from key material (the dcflint secret-hygiene

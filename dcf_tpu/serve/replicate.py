@@ -172,11 +172,17 @@ class Replicator:
 
     def __init__(self, pools: dict, ring, *, replicas: int = 1,
                  metrics: Metrics | None = None,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, epoch_source=None):
         self._pools = pools
         self._ring = ring
         self._replicas = int(replicas)
         self._timeout_s = float(timeout_s)
+        # ISSUE 15: zero-arg callable returning the router's current
+        # ring epoch — forwarded REGISTER frames then carry it, so a
+        # stale router's registrations are fenced E_EPOCH at the shard
+        # instead of landing on a placement the pod has moved past.
+        # None = unfenced (epoch 0 on the wire).
+        self._epoch_source = epoch_source
         m = metrics if metrics is not None else Metrics()
         self._c_registered = m.counter("router_registered_total")
         self._c_replicated = m.counter("router_replicated_total")
@@ -198,6 +204,8 @@ class Replicator:
         (transport, fence) is counted and skipped — anti-entropy
         converges it on the replica's next recovery."""
         timeout = self._timeout_s if timeout is None else timeout
+        epoch = (int(self._epoch_source())
+                 if self._epoch_source is not None else 0)
         placed = self._ring().placement(key_id, self._replicas)
         owner = placed[0]
         # .get, never [] — a registration racing a ``set_ring``
@@ -210,7 +218,8 @@ class Replicator:
                 f"owner shard {owner.host_id!r} for {key_id!r} has no "
                 "link (ring membership changed mid-registration)")
         gen = owner_pool.register_frame(
-            key_id, frame, generation=0, proto=proto, timeout=timeout)
+            key_id, frame, generation=0, proto=proto, timeout=timeout,
+            epoch=epoch)
         self._c_registered.inc()
         for rep in placed[1:]:
             pool = self._pools.get(rep.host_id)
@@ -221,7 +230,7 @@ class Replicator:
             try:
                 pool.register_frame(
                     key_id, frame, generation=gen, proto=proto,
-                    timeout=timeout)
+                    timeout=timeout, epoch=epoch)
                 self._c_replicated.inc()
             except StaleStateError:
                 # The replica already holds a NEWER generation — the
@@ -235,7 +244,8 @@ class Replicator:
         return int(gen)
 
     def anti_entropy(self, target_host_id: str, *, peer_ok=None,
-                     timeout: float | None = None) -> int:
+                     timeout: float | None = None, ring=None,
+                     peers=None) -> int:
         """Converge ``target_host_id`` with its ring peers: pull the
         target's digest, ask each reachable peer for strictly-newer
         frames, and forward to the target exactly those the ring
@@ -247,9 +257,19 @@ class Replicator:
         a stale generation would be the silent-wrong-answer partition
         bug this pass exists to close.  ``peer_ok(host_id)`` excludes
         peers the caller already knows are down (their absence is
-        accounted by THEIR health state, not this pass)."""
+        accounted by THEIR health state, not this pass).
+
+        ``ring`` / ``peers`` (ISSUE 15, the membership controller's
+        reuse): ``ring`` overrides the live map — the PROSPECTIVE ring
+        for a graceful join's pre-admission warm, the POST-eject/drain
+        ring for a migration — and decides placement filtering;
+        ``peers`` overrides the consulted source host ids (a draining
+        host has left the new ring but is the primary source of its
+        own keys; a joining host is not in the old ring at all).
+        Defaults reproduce the PR 14 recovery-gate behavior exactly:
+        the live ring, every OTHER member as a peer."""
         timeout = self._timeout_s if timeout is None else timeout
-        ring = self._ring()
+        ring = self._ring() if ring is None else ring
         target_pool = self._pools.get(target_host_id)
         if target_pool is None:
             raise BackendUnavailableError(
@@ -258,10 +278,14 @@ class Replicator:
         digest = target_pool.pull_digest(timeout)
         self._c_ae_runs.inc()
         pulled = 0
-        for peer in ring.peers(target_host_id):
-            if peer_ok is not None and not peer_ok(peer.host_id):
+        peer_ids = (list(peers) if peers is not None
+                    else ring.host_ids())
+        for peer_id in peer_ids:
+            if peer_id == target_host_id:
                 continue
-            peer_pool = self._pools.get(peer.host_id)
+            if peer_ok is not None and not peer_ok(peer_id):
+                continue
+            peer_pool = self._pools.get(peer_id)
             if peer_pool is None:
                 continue  # left the ring mid-pass: its keys moved
             # Sender-side placement filtering: pull the peer's digest
@@ -272,9 +296,8 @@ class Replicator:
             peer_digest = peer_pool.pull_digest(timeout)
             want = dict(digest)
             for key_id in peer_digest:
-                if target_host_id not in {
-                        s.host_id for s in ring.placement(
-                            key_id, self._replicas)}:
+                if target_host_id not in ring.placement_ids(
+                        key_id, self._replicas):
                     want[key_id] = DIGEST_SUPPRESS
             # Iterate: each SYNC response is CAPPED (SYNC_MAX_BYTES);
             # applying a chunk advances ``want``, so the next request

@@ -122,6 +122,7 @@ from dcf_tpu.errors import (
 from dcf_tpu.serve.admission import Priority, parse_priority
 from dcf_tpu.serve.edge import (
     E_CIRCUIT_OPEN,
+    E_EPOCH,
     E_QUEUE_FULL,
     EdgeClientPool,
     EdgeServer,
@@ -295,15 +296,26 @@ class DcfRouter:
         # fan-out + anti-entropy over the SAME pools the forwards use,
         # and the active health prober whose DOWN/UP transitions drive
         # promotion and gated re-admission (see the module docstring).
+        # Ring epoch (ISSUE 15, ``serve.membership``): the monotonic
+        # membership-commit counter this router routes under.  0 =
+        # unfenced (a standalone router that never saw a membership
+        # change) — frames then carry epoch 0 and shards skip the
+        # check.  ``set_ring(..., epoch=)`` is the only writer; every
+        # forward, registration fan-out and probe carries the value.
+        self.ring_epoch = 0
+        self._g_epoch = m.gauge("router_ring_epoch")
+        self._c_stale_epoch = m.counter("router_stale_epoch_total")
         self.replicator = Replicator(
             self._pools, lambda: self.map, replicas=self.replicas,
-            metrics=self.metrics)
+            metrics=self.metrics,
+            epoch_source=lambda: self.ring_epoch)
         self.health = HealthProber(
             self._pools, interval_s=probe_interval_s,
             timeout_s=probe_timeout_s, fail_n=probe_fail_n,
             recover_m=probe_recover_m, clock=clock,
             metrics=self.metrics, recover_gate=self._recover_gate,
-            on_transition=self._on_health_transition)
+            on_transition=self._on_health_transition,
+            epoch_source=lambda: self.ring_epoch)
 
     def _make_pool(self, spec: ShardSpec) -> EdgeClientPool:
         return EdgeClientPool(spec.host, spec.port,
@@ -401,6 +413,13 @@ class DcfRouter:
         for an inline CRITICAL failover that was successfully
         re-submitted."""
         if not _suspect_signal(exc):
+            if getattr(exc, "wire_code", None) == E_EPOCH:
+                # The shard told us OUR ring is stale (a membership
+                # commit we have not applied): counted, passed through
+                # verbatim — the hinted typed refusal is the caller's
+                # signal, and refreshing the ring is the operator's
+                # (or the owning controller's) move, not a failover.
+                self._c_stale_epoch.inc()
             return None  # a key-level outcome: the caller's, verbatim
         hint = getattr(exc, "retry_after_s", None)
         self.mark_suspect(target.host_id, hint)
@@ -419,7 +438,8 @@ class DcfRouter:
                     try:
                         inner = pool.submit_bytes(
                             key_id, data, m=m, b=b,
-                            deadline_ms=deadline_ms, priority=pri)
+                            deadline_ms=deadline_ms, priority=pri,
+                            epoch=self.ring_epoch)
                     except BackendUnavailableError:
                         self.mark_suspect(nxt.host_id)
                         continue
@@ -504,7 +524,7 @@ class DcfRouter:
             try:
                 inner = pool.submit_bytes(
                     key_id, view, m=m, b=b, deadline_ms=deadline_ms,
-                    priority=pri)
+                    priority=pri, epoch=self.ring_epoch)
             except BackendUnavailableError as e:
                 # Submit-time transport death: mark and keep walking
                 # (CRITICAL) or refuse typed (everyone else).
@@ -587,18 +607,39 @@ class DcfRouter:
 
     # -- ring membership (ISSUE 14 satellite: bounded state) ----------
 
-    def set_ring(self, shards) -> None:
+    def set_ring(self, shards, *, epoch: int | None = None,
+                 retain=()) -> None:
         """Swap the shard ring atomically (``ShardMap`` or an iterable
         of ``ShardSpec``).  Removed hosts are FORGOTTEN — pool closed,
         suspect/backoff/health state dropped, labeled metric series
         removed (the ``BreakerBoard.forget`` cardinality discipline:
         host churn must not grow router state or its snapshot without
-        limit).  Added hosts get fresh pools and health targets; a
+        limit).  Added hosts get fresh pools and health targets (a
+        pool installed ahead of time by ``preconnect`` — the
+        membership controller's pre-admission warm — is reused); a
         host whose ADDRESS changed (same id) is re-dialed.  In-flight
         requests keep the ranking they started with (the old map
-        reference stays valid — ``ShardMap`` is immutable)."""
+        reference stays valid — ``ShardMap`` is immutable).
+
+        ``epoch`` (ISSUE 15): the ring epoch this membership change is
+        committed under — strictly monotonic; subsequent forwards,
+        registrations and probes carry it, so shards structurally
+        refuse any router still routing on the pre-change ring
+        (``E_EPOCH``).  None leaves the epoch untouched (the PR 14
+        operator-invoked swap semantics).  ``retain``: removed host
+        ids whose pool/health state must be KEPT for now — a graceful
+        drain's in-flight window; the controller calls
+        ``forget_host`` after the drain grace elapses."""
         new = shards if isinstance(shards, ShardMap) \
             else ShardMap(shards)
+        if epoch is not None and epoch <= self.ring_epoch:
+            # api-edge: membership contract — the epoch IS the fence;
+            # a reused or rolled-back value would let two conflicting
+            # rings coexist as peers
+            raise ValueError(
+                f"ring epoch must be strictly monotonic: got {epoch} "
+                f"at current epoch {self.ring_epoch}")
+        retain = frozenset(retain)
         old = self.map
         old_ids = {s.host_id: s for s in old.hosts()}
         new_ids = {s.host_id: s for s in new.hosts()}
@@ -608,7 +649,17 @@ class DcfRouter:
         # window where placement names a host with no pool).
         for host_id, spec in new_ids.items():
             if host_id not in old_ids:
-                self._pools[host_id] = self._make_pool(spec)
+                pool = self._pools.get(host_id)
+                if pool is not None and (pool.host, pool.port) \
+                        != spec.address:
+                    # A retained (drain-grace) or preconnected pool
+                    # wired to a DIFFERENT endpoint than the spec being
+                    # admitted — reusing it would route every forward
+                    # for this host to the old address.  Re-dial.
+                    pool.close()
+                    pool = None
+                if pool is None:  # else: preconnect reuse
+                    self._pools[host_id] = self._make_pool(spec)
                 self._c_forwards[host_id] = self.metrics.counter(
                     labeled("router_forwards_total", shard=host_id))
                 self._c_suspected[host_id] = self.metrics.counter(
@@ -624,10 +675,53 @@ class DcfRouter:
                 self._pools[host_id] = self._make_pool(spec)
                 self.health.add_target(host_id,
                                        self._pools[host_id])
+        if epoch is not None:
+            # Epoch first, map second: a forward racing the swap then
+            # carries at worst (new epoch, old map) — served fine, the
+            # placement is epoch-checked at membership commits, not
+            # per key — never (old epoch, new map), which a shard that
+            # already adopted the new epoch would refuse spuriously.
+            self.ring_epoch = int(epoch)
+            self._g_epoch.set(self.ring_epoch)
         self.map = new  # atomic reference swap
         for host_id in old_ids:
-            if host_id not in new_ids:
+            if host_id not in new_ids and host_id not in retain:
                 self._forget_host(host_id)
+
+    def preconnect(self, spec: ShardSpec) -> EdgeClientPool:
+        """Install (or return) a pool for a host NOT yet in the ring
+        (ISSUE 15: the membership controller dials a joining host to
+        warm it through the anti-entropy path BEFORE admission — no
+        cold-miss storm on the first routed request).  Routing never
+        consults pools for unmapped hosts, so the link is inert until
+        ``set_ring`` admits it (which reuses this pool); an aborted
+        join cleans up with ``forget_host``."""
+        pool = self._pools.get(spec.host_id)
+        if pool is not None and (pool.host, pool.port) != spec.address:
+            # A leftover pool (a drain's retained link, or an earlier
+            # preconnect) wired to a different endpoint: warming
+            # through it would validate the WRONG process.  Re-dial.
+            pool.close()
+            pool = None
+        if pool is None:
+            pool = self._make_pool(spec)
+            self._pools[spec.host_id] = pool
+        return pool
+
+    def forget_host(self, host_id: str) -> None:
+        """Drop a host's pool/suspicion/health state and labeled
+        series — the deferred half of a ``set_ring(..., retain=...)``
+        drain (the pool must outlive the swap while in-flight relayed
+        requests complete against it), and the cleanup for an aborted
+        ``preconnect``.  Idempotent; refuses to forget a CURRENT ring
+        member (that would leave placement naming a host with no
+        link)."""
+        if host_id in self.map:
+            # api-edge: membership contract
+            raise ValueError(
+                f"host {host_id!r} is still in the ring; swap it out "
+                "with set_ring before forgetting its state")
+        self._forget_host(host_id)
 
     def _forget_host(self, host_id: str) -> None:
         """Drop EVERY piece of per-host router state for a host that

@@ -120,6 +120,7 @@ from dcf_tpu.errors import (
     BatchTimeoutError,
     CircuitOpenError,
     DeadlineExceededError,
+    RingEpochError,
     ShapeError,
 )
 from dcf_tpu.protocols import ProtocolBundle
@@ -358,6 +359,11 @@ class DcfService:
         self._brownout_lock = threading.Lock()
         self._pressure_since: float | None = None
         self._calm_since: float | None = None
+        # Ring-epoch fence state (ISSUE 15): the highest membership
+        # epoch this shard has observed on a fenced frame; frames
+        # carrying an older one are refused typed (check_ring_epoch).
+        self._epoch_lock = threading.Lock()
+        self._ring_epoch = 0
         m = self.metrics
         self._c_batches = m.counter("serve_batches_total")
         self._c_retries = m.counter("serve_retries_total")
@@ -366,6 +372,8 @@ class DcfService:
             "serve_breaker_fast_fails_total")
         self._c_batch_timeouts = m.counter("serve_batch_timeouts_total")
         self._c_deadline = m.counter("serve_deadline_expired_total")
+        self._c_epoch_fenced = m.counter("serve_epoch_fenced_total")
+        self._g_ring_epoch = m.gauge("serve_ring_epoch")
         self._h_occupancy = m.histogram("serve_batch_occupancy",
                                         OCCUPANCY_BOUNDS)
         self._h_stage = m.histogram("serve_stage_s")
@@ -567,6 +575,56 @@ class DcfService:
         """The live ``{key_id: generation}`` map (anti-entropy digest
         exchange — generations only, no key material)."""
         return self.registry.digest()
+
+    # -- ring-epoch fence (ISSUE 15, ``serve.membership``) ------------------
+
+    @property
+    def ring_epoch(self) -> int:
+        """The highest ring epoch this shard has observed (0 = never
+        fenced — a solo service, or one no membership controller has
+        touched)."""
+        return self._ring_epoch
+
+    def check_ring_epoch(self, epoch: int, adopt: bool = True) -> int:
+        """Adopt-or-refuse one fenced frame's ring epoch (ISSUE 15).
+
+        Monotonic-max adoption, the generation fence's discipline
+        lifted to membership: a NEWER epoch is adopted (the first
+        fenced frame after a membership commit teaches this shard the
+        new epoch — probes disseminate it within about one interval),
+        an EQUAL one passes, and an OLDER one is refused typed
+        ``RingEpochError`` (``E_EPOCH`` on the wire, counted
+        ``serve_epoch_fenced_total``) — a router still routing on a
+        pre-change ring is structurally unable to serve or register
+        against a conflicting placement.  Epoch 0 (unfenced) is a
+        no-op pass.  Returns the current epoch.
+
+        ``adopt=False`` runs the refuse-if-older half WITHOUT raising
+        the observed maximum: the edge's REQUEST path checks the fence
+        before tenant admission (a stale router must not burn a token
+        on a structurally-refused forward) but must not let an
+        UNADMITTED sender teach this shard an arbitrary epoch — one
+        forged frame with a huge epoch would otherwise fence out the
+        real router (adoption happens post-admission; PING/REGISTER
+        stay adopt-on-sight — they are router/operator verbs under the
+        TLS client-pinning trust story, not the tenant table)."""
+        epoch = int(epoch)
+        if epoch <= 0:
+            return self._ring_epoch
+        with self._epoch_lock:
+            if epoch > self._ring_epoch:
+                if adopt:
+                    self._ring_epoch = epoch
+                    self._g_ring_epoch.set(epoch)
+            elif epoch < self._ring_epoch:
+                self._c_epoch_fenced.inc()
+                raise RingEpochError(
+                    f"frame carries ring epoch {epoch} but this shard "
+                    f"has observed epoch {self._ring_epoch}: the "
+                    "sender's membership view is stale — refresh the "
+                    "ring before retrying",
+                    retry_after_s=1.0)
+            return self._ring_epoch
 
     def sync_frames(self, digest: dict) -> list:
         """Frames STRICTLY newer than ``digest`` records, for the

@@ -178,5 +178,12 @@ class ShardMap:
             raise ValueError(f"replicas must be >= 0, got {replicas}")
         return self.ranked(key_id)[:1 + replicas]
 
+    def placement_ids(self, key_id: str, replicas: int = 1) -> set:
+        """``placement`` as a host-id SET (ISSUE 15): the membership
+        controller, the router's promotion walk and the pod benches
+        all ask "does host X hold this key?" — one spelling, not four
+        copies of the comprehension."""
+        return {s.host_id for s in self.placement(key_id, replicas)}
+
     def __repr__(self) -> str:
         return f"ShardMap({self.host_ids()})"

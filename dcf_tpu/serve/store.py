@@ -71,6 +71,7 @@ import zlib
 from dataclasses import dataclass, field
 
 from dcf_tpu.errors import (
+    BackendUnavailableError,
     KeyFormatError,
     KeyQuarantinedError,
     ShapeError,
@@ -531,7 +532,9 @@ class KeyStore:
                 f"and was quarantined ({e})") from e
         return kb, pb, ent["generation"]
 
-    def replicate_to(self, other: "KeyStore", key_id: str) -> int:
+    def replicate_to(self, other: "KeyStore", key_id: str, *,
+                     retries: int = 3, backoff_s: float = 0.05,
+                     sleep=None) -> int:
         """Replicate ``key_id``'s durable frame into ``other``
         PRESERVING its generation (ISSUE 13): the pod provisioning
         primitive — a key placed by the shard ring is written to its
@@ -543,12 +546,50 @@ class KeyStore:
         quarantine must not propagate its damage), then ``other``'s
         own atomic-publish + monotonic-generation discipline applies:
         a replica already holding a NEWER generation keeps it.
-        Returns the generation replicated."""
+        Returns the generation replicated.
+
+        Bounded retry (ISSUE 15 satellite): the destination publish is
+        retried up to ``retries`` times on a TRANSIENT ``OSError``
+        (replica stores live on network mounts in a real pod — a
+        one-packet blip must not abort a whole ring migration), with
+        ``backoff_s`` doubling between attempts; each retry bumps
+        ``serve_store_replicate_retries_total``, and exhaustion raises
+        typed ``BackendUnavailableError`` with the last ``OSError``
+        cause-chained.  Typed validation failures
+        (``KeyQuarantinedError``/``KeyFormatError``) are NEVER retried
+        — re-reading damage does not repair it.  ``sleep``: injectable
+        for deterministic tests (defaults to ``time.sleep``; pass a
+        no-op to retry without waiting)."""
+        if retries < 0:
+            # api-edge: retry contract (0 = single attempt)
+            raise ValueError(f"retries must be >= 0, got {retries}")
         repl_frame = self.load(key_id)  # (bundle, protocol, generation)
         bundle, protocol, generation = repl_frame
-        other.put(key_id, bundle, protocol=protocol,
-                  generation=generation)
-        return generation
+        if sleep is None:
+            import time
+
+            sleep = time.sleep
+        c_retries = self._metrics.counter(
+            "serve_store_replicate_retries_total")
+        delay = float(backoff_s)
+        last: OSError | None = None
+        for attempt in range(retries + 1):
+            if attempt:
+                c_retries.inc()
+                sleep(delay)
+                delay *= 2
+            try:
+                other.put(key_id, bundle, protocol=protocol,
+                          generation=generation)
+                return generation
+            except KeyFormatError:
+                raise  # destination-side validation: not transient
+            except OSError as e:
+                last = e
+        raise BackendUnavailableError(
+            f"replicating {key_id!r} to {other.root!r} failed after "
+            f"{retries + 1} attempts (last: {type(last).__name__}: "
+            f"{last})") from last
 
     def quarantine(self, key_id: str) -> None:
         """Set ``key_id``'s stored frame aside explicitly — for callers

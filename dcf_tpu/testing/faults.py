@@ -143,6 +143,17 @@ POINTS = (
     #                     the injectable clock, so a stalled sender
     #                     demonstrably trips the existing deadline/
     #                     watchdog path instead of wedging the worker)
+    "membership.migrate",  # ring-membership migration pass
+    #                     (serve/membership.py — fires at the start of
+    #                     each live-convergence pass of an eject/join/
+    #                     drain, before any frame moves; handler args:
+    #                     sorted target host ids, new ring size.  A
+    #                     raising handler models a migration source
+    #                     dying mid-change: the change ABORTS typed and
+    #                     counted (membership_change_failures_total),
+    #                     the ring stays on its last committed epoch,
+    #                     and the controller retries on a later pump —
+    #                     never a half-migrated commit)
     "net.partition",    # pod network partition (serve/edge.py — fires
     #                     before each EdgeClient dial and each frame
     #                     send on a TAGGED client (the pod router tags

@@ -529,6 +529,43 @@ def test_cli_pod_bench_smoke(capsys):
 
 
 @pytest.mark.slow
+@pytest.mark.membership
+def test_cli_pod_bench_churn_smoke(capsys):
+    """ISSUE 15: ``pod_bench --churn`` end to end — SIGKILL one shard,
+    the membership controller auto-ejects it after the grace with
+    every frame re-replicated to the new placement (verified over the
+    DIGEST verb and the stores), the healed shard re-joins through
+    the anti-entropy warm-up, a second shard is gracefully drained
+    (its SIGTERM drains and exits 0), and a doctored stale-epoch
+    frame is refused E_EPOCH.  The harness raises SystemExit unless
+    the ledger is clean, generations never regress, zero keys are
+    lost, all four membership events committed under strictly-
+    increasing epochs, and zero frames quarantined."""
+    recs = run_cli(
+        capsys,
+        ["pod_bench", "--churn", "--shards=3", "--bundles=3",
+         "--live-bundles=3", "--max-batch=256", "--eject-grace=1.5",
+         "--probe-interval=0.2"],
+    )
+    assert recs[0]["bench"] == "pod_bench"
+    assert recs[0]["mode"] == "churn"
+    assert recs[0]["soak_mismatches"] == 0
+    assert recs[0]["soak_unaccounted"] == 0
+    assert recs[0]["soak_refused_unhinted"] == 0
+    assert recs[0]["digest_regressions"] == 0
+    assert recs[0]["lost_keys"] == 0
+    assert recs[0]["fence_held"] is True
+    assert recs[0]["post_fence_parity"] is True
+    assert recs[0]["drained_exit_rc"] == 0
+    assert recs[0]["pod_quarantined"] == 0
+    e1, e2, e3 = recs[0]["epochs"]
+    assert 1 <= e1 < e2 < e3
+    for kind in ("eject", "join", "drain", "drain-complete"):
+        assert kind in recs[0]["membership_events"]
+    assert recs[0]["migrated_frames"] >= 1
+
+
+@pytest.mark.slow
 @pytest.mark.selfheal
 def test_cli_pod_bench_partition_smoke(capsys):
     """ISSUE 14: ``pod_bench --partition`` end to end — a

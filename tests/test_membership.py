@@ -1,0 +1,1148 @@
+"""dcf_tpu.serve.membership: autonomous ring membership (ISSUE 15).
+
+Covers the membership controller's three verbs — health-driven
+auto-eject with pre-commit re-replication (live via the anti-entropy
+pull, durable via ``KeyStore.replicate_to``), graceful
+warm-before-admit join, and the three-phase drain with its deferred
+in-flight forget — plus the ring-epoch fence end to end
+(``RingEpochError`` / ``E_EPOCH``: adopt-or-refuse at the service,
+typed hinted refusal over the wire, a stale router structurally
+refused), the membership/health interleavings (eject racing an
+in-flight forwarded eval, a join racing a mid-warm registration, a
+drain racing a hot-swap — all typed, never bit-mismatched), the
+``membership.migrate`` fault seam's abort containment, the
+``KeyStore.replicate_to`` bounded transient-retry satellite, and the
+control-verb wire-fuzz extension (all five verbs die typed
+per-connection, both directions).  The serve_host SIGTERM drain and
+the ``pod_bench --churn`` CLI smoke ride the serial slow leg (see
+tests/test_cli.py for the latter).
+"""
+
+import pathlib
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dcf_tpu import Dcf
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.errors import (
+    BackendUnavailableError,
+    KeyQuarantinedError,
+    RingEpochError,
+)
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.serve import (
+    DcfRouter,
+    EdgeClient,
+    EdgeServer,
+    KeyStore,
+    MembershipController,
+    ShardMap,
+    ShardSpec,
+)
+from dcf_tpu.serve.edge import (
+    E_EPOCH,
+    decode_response,
+    encode_digest,
+    encode_ping,
+    encode_pong,
+    encode_register,
+    encode_request,
+    encode_sync,
+)
+from dcf_tpu.serve.health import DOWN, UP
+from dcf_tpu.testing import faults
+from dcf_tpu.testing.faults import FakeClock
+
+pytestmark = pytest.mark.membership
+
+NB, LAM = 2, 16
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0x15E)
+
+
+@pytest.fixture(scope="module")
+def ck(rng):
+    return [rng.bytes(32), rng.bytes(32)]
+
+
+@pytest.fixture(scope="module")
+def dcf(ck):
+    return Dcf(NB, LAM, ck, backend="bitsliced")
+
+
+@pytest.fixture(scope="module")
+def prg(ck):
+    return HirosePrgNp(LAM, ck)
+
+
+def mk_bundle(dcf, rng):
+    alphas = rng.integers(0, 256, (1, NB), dtype=np.uint8)
+    betas = rng.integers(0, 256, (1, LAM), dtype=np.uint8)
+    return dcf.gen(alphas, betas, rng=rng)
+
+
+def recon_oracle(prg, kb, xs):
+    return eval_batch_np(prg, 0, kb.for_party(0), xs) ^ \
+        eval_batch_np(prg, 1, kb.for_party(1), xs)
+
+
+class MemberPod:
+    """N in-process shard hosts behind one router with a
+    ``MembershipController`` on a fake clock — the tier-1 stand-in
+    for pod_bench --churn's subprocesses."""
+
+    def __init__(self, dcf, n=3, ctrl_kw=None, stores=None):
+        self.dcf = dcf
+        self.svcs, self.servers, specs = [], [], []
+        for i in range(n):
+            svc, srv, spec = self._mk_shard(f"shard-{i}")
+            self.svcs.append(svc)
+            self.servers.append(srv)
+            specs.append(spec)
+        self.map = ShardMap(specs)
+        self._index = {s.host_id: i for i, s in enumerate(specs)}
+        self.router = DcfRouter(
+            self.map, n_bytes=NB, probe_fail_n=2, probe_recover_m=2,
+            reconnect_backoff_s=0.01, max_backoff_s=0.05,
+            probe_interval_s=0.05)
+        self.clk = FakeClock(100.0)
+        kw = dict(eject_grace_s=2.0, drain_grace_s=1.0, min_hosts=2)
+        kw.update(ctrl_kw or {})
+        self.ctrl = MembershipController(self.router, clock=self.clk,
+                                         stores=stores, **kw)
+
+    def _mk_shard(self, host_id):
+        svc = self.dcf.serve(max_batch=32, max_delay_ms=1.0)
+        svc.start()
+        srv = EdgeServer(svc).start()
+        return svc, srv, ShardSpec(host_id, *srv.address)
+
+    def add_shard(self, host_id):
+        """A started-but-unadmitted extra host (the join candidate)."""
+        svc, srv, spec = self._mk_shard(host_id)
+        self.svcs.append(svc)
+        self.servers.append(srv)
+        self._index[host_id] = len(self.svcs) - 1
+        return spec
+
+    def svc_of(self, host_id):
+        return self.svcs[self._index[host_id]]
+
+    def key_owned_by(self, host_id, prefix="mb-key", ring=None):
+        ring = ring if ring is not None else self.router.map
+        n = 0
+        while True:
+            name = f"{prefix}-{n}"
+            if ring.owner(name).host_id == host_id:
+                return name
+            n += 1
+
+    def kill(self, host_id):
+        i = self._index[host_id]
+        self.servers[i].close()
+        self.svcs[i].close(drain=False)
+
+    def pump_until(self, host_id, state, rounds=120, sleep=0.05):
+        for _ in range(rounds):
+            if self.router.health.pump()[host_id] == state:
+                return True
+            time.sleep(sleep)
+        return False
+
+    def close(self):
+        self.ctrl.close()
+        self.router.close()
+        for srv in self.servers:
+            srv.close()
+        for svc in self.svcs:
+            try:
+                svc.close(drain=False)
+            except Exception:  # fallback-ok: best-effort teardown of
+                # an already-killed shard
+                pass
+
+
+# ------------------------------------------------- config contracts
+
+
+def test_controller_validates_config(dcf):
+    pod = MemberPod(dcf, n=2)
+    try:
+        with pytest.raises(ValueError):
+            MembershipController(pod.router, eject_grace_s=-1)
+        with pytest.raises(ValueError):
+            MembershipController(pod.router, min_hosts=0)
+        with pytest.raises(ValueError):
+            MembershipController(pod.router, poll_interval_s=0)
+    finally:
+        pod.close()
+
+
+def test_set_ring_epoch_monotonic_contract(dcf):
+    pod = MemberPod(dcf, n=2)
+    try:
+        pod.router.set_ring(pod.map, epoch=3)
+        assert pod.router.ring_epoch == 3
+        for stale in (3, 1, 0):
+            with pytest.raises(ValueError, match="monotonic"):
+                pod.router.set_ring(pod.map, epoch=stale)
+        assert pod.router.metrics_snapshot()["router_ring_epoch"] == 3
+    finally:
+        pod.close()
+
+
+# ------------------------------------------------- auto-eject
+
+
+def test_auto_eject_after_grace_rereplicates_live_keys(dcf, prg, rng):
+    """The tentpole loop: a shard DOWN past the grace is auto-ejected
+    — the ring shrinks, the epoch bumps, and every key it held is on
+    its NEW placement (generation preserved) before the swap commits.
+    An in-flight/post-kill request for a victim key resolves typed
+    (hinted refusal) or bit-exact via failover — never mismatched —
+    and after the eject the key serves NORMAL traffic bit-exact on
+    the new ring."""
+    pod = MemberPod(dcf, n=3)
+    try:
+        victim = "shard-0"
+        name = pod.key_owned_by(victim)
+        kb = mk_bundle(dcf, rng)
+        gen = pod.router.register_key(name, kb)
+        other = pod.key_owned_by("shard-1", prefix="mb-other")
+        kb2 = mk_bundle(dcf, rng)
+        gen2 = pod.router.register_key(other, kb2)
+        pod.kill(victim)
+        # The eject-racing-a-request interleaving: before the prober
+        # has spoken, a NORMAL submit is refused typed WITH a hint
+        # (request-plane suspicion), CRITICAL fails over bit-exact.
+        xs = rng.integers(0, 256, (5, NB), dtype=np.uint8)
+        from dcf_tpu.errors import CircuitOpenError
+
+        with pytest.raises(CircuitOpenError) as ei:
+            pod.router.evaluate(name, xs, b=0, timeout=60)
+        assert ei.value.retry_after_s is not None
+        got = pod.router.evaluate(name, xs, b=0, timeout=60,
+                                  priority="critical") ^ \
+            pod.router.evaluate(name, xs, b=1, timeout=60,
+                                priority="critical")
+        assert np.array_equal(got, recon_oracle(prg, kb, xs))
+        assert pod.pump_until(victim, DOWN)
+        # Grace not elapsed: DOWN alone never ejects.
+        assert pod.ctrl.pump() == []
+        assert victim in pod.router.map
+        pod.clk.advance(1.0)
+        assert pod.ctrl.pump() == []
+        pod.clk.advance(1.5)  # past eject_grace_s=2.0
+        events = pod.ctrl.pump()
+        assert [e.kind for e in events] == ["eject"]
+        assert events[0].host_id == victim and events[0].epoch == 1
+        assert victim not in pod.router.map
+        assert pod.router.ring_epoch == 1
+        assert len(pod.router.map) == 2
+        # Re-replication: BOTH survivors (the key's full new
+        # placement) hold the victim's key at the preserved
+        # generation; the untouched key kept its own.
+        for hid in ("shard-1", "shard-2"):
+            digest = pod.svc_of(hid).replication_digest()
+            assert digest.get(name) == gen, (hid, digest)
+        placed = {s.host_id for s in
+                  pod.router.map.placement(other, replicas=1)}
+        for hid in placed:
+            assert pod.svc_of(hid).replication_digest()[other] == gen2
+        # ...and the ejected ring serves NORMAL traffic bit-exact.
+        got = pod.router.evaluate(name, xs, b=0, timeout=60) ^ \
+            pod.router.evaluate(name, xs, b=1, timeout=60)
+        assert np.array_equal(got, recon_oracle(prg, kb, xs))
+        snap = pod.router.metrics_snapshot()
+        assert snap["membership_ejections_total"] == 1
+        assert snap["membership_ring_size"] == 2
+        assert snap["router_ring_epoch"] == 1
+        # The victim's per-host state and series are gone (the
+        # set_ring forget discipline).
+        assert victim not in pod.router._pools
+        leftovers = {k for k in snap if victim in k}
+        assert leftovers == set(), leftovers
+    finally:
+        pod.close()
+
+
+def test_eject_skipped_below_min_hosts_and_during_multi_failure(
+        dcf, rng):
+    """Safety rails: auto-eject never shrinks the ring below
+    ``min_hosts`` (a 2-host ring keeps its DOWN member — promotion
+    serves, ejection would strand the keys on a lone host), and never
+    runs while a SECOND shard is DOWN (a double failure is recovery
+    territory, not reconfiguration)."""
+    pod = MemberPod(dcf, n=2)
+    try:
+        pod.kill("shard-1")
+        assert pod.pump_until("shard-1", DOWN)
+        pod.ctrl.pump()
+        pod.clk.advance(10.0)
+        assert pod.ctrl.pump() == []
+        assert "shard-1" in pod.router.map
+        snap = pod.router.metrics_snapshot()
+        assert snap["membership_eject_skipped_total"] >= 1
+        assert snap["membership_ejections_total"] == 0
+    finally:
+        pod.close()
+    pod = MemberPod(dcf, n=3)
+    try:
+        pod.kill("shard-0")
+        pod.kill("shard-1")
+        assert pod.pump_until("shard-0", DOWN)
+        assert pod.pump_until("shard-1", DOWN)
+        pod.ctrl.pump()
+        pod.clk.advance(10.0)
+        assert pod.ctrl.pump() == []
+        assert len(pod.router.map) == 3  # both skipped: multi-failure
+        assert pod.router.metrics_snapshot()[
+            "membership_eject_skipped_total"] >= 2
+    finally:
+        pod.close()
+
+
+def test_eject_replicates_durable_frames_via_stores(dcf, rng,
+                                                    tmp_path):
+    """The durable half: the victim's on-disk store survives its
+    process and is the re-replication SOURCE — after the eject, every
+    store in the key's new placement holds the frame at the
+    provisioned generation (``KeyStore.replicate_to``, monotonic
+    guard), and the zero-loss audit passes."""
+    stores = {f"shard-{i}": KeyStore(str(tmp_path / f"shard-{i}"))
+              for i in range(3)}
+    pod = MemberPod(dcf, n=3, stores=stores)
+    try:
+        victim = "shard-0"
+        name = pod.key_owned_by(victim, prefix="mb-dur")
+        kb = mk_bundle(dcf, rng)
+        gen = pod.router.register_key(name, kb)  # live everywhere the
+        # ring places it, so serving survives the eject
+        placed = [s.host_id
+                  for s in pod.router.map.placement(name, replicas=1)]
+        stores[placed[0]].put(name, kb, generation=gen)
+        stores[placed[0]].replicate_to(stores[placed[1]], name)
+        pod.kill(victim)
+        assert pod.pump_until(victim, DOWN)
+        pod.ctrl.pump()
+        pod.clk.advance(3.0)
+        assert [e.kind for e in pod.ctrl.pump()] == ["eject"]
+        new_placed = {s.host_id for s in
+                      pod.router.map.placement(name, replicas=1)}
+        assert victim not in new_placed
+        for hid in new_placed:
+            assert stores[hid].digest().get(name) == gen, hid
+        assert pod.ctrl.lost_keys(exclude={victim}) == []
+        snap = pod.router.metrics_snapshot()
+        assert snap["membership_durable_replications_total"] >= 1
+        assert snap["membership_lost_keys_total"] == 0
+    finally:
+        pod.close()
+
+
+def test_migrate_seam_aborts_change_typed_then_retries(dcf, rng):
+    """The ``membership.migrate`` fault seam: a migration source dying
+    mid-change ABORTS the eject — counted, ring and epoch untouched —
+    and a later pump (seam disarmed) completes it.  Never a
+    half-migrated commit."""
+    pod = MemberPod(dcf, n=3)
+    try:
+        victim = "shard-0"
+        name = pod.key_owned_by(victim)
+        gen = pod.router.register_key(name, mk_bundle(dcf, rng))
+        pod.kill(victim)
+        assert pod.pump_until(victim, DOWN)
+        pod.ctrl.pump()
+        pod.clk.advance(3.0)
+        with faults.inject("membership.migrate"):
+            assert pod.ctrl.pump() == []
+            assert victim in pod.router.map
+            assert pod.router.ring_epoch == 0
+        snap = pod.router.metrics_snapshot()
+        assert snap["membership_change_failures_total"] >= 1
+        assert snap["membership_ejections_total"] == 0
+        # Disarmed: the retry commits.
+        assert [e.kind for e in pod.ctrl.pump()] == ["eject"]
+        assert victim not in pod.router.map
+        for hid in ("shard-1", "shard-2"):
+            assert pod.svc_of(hid).replication_digest()[name] == gen
+    finally:
+        pod.close()
+
+
+# ------------------------------------------------- graceful join
+
+
+def test_join_warms_before_admission_and_converges_racing_reg(
+        dcf, prg, rng):
+    """Graceful join: the newcomer is warmed through the anti-entropy
+    pull BEFORE the swap (its digest holds every key the prospective
+    ring places on it, generations preserved — no cold-miss storm),
+    the epoch bumps, and a registration racing the warm is converged
+    by the post-admission sweep.  All outcomes typed; the racing key
+    serves its registered bits bit-exact."""
+    pod = MemberPod(dcf, n=2)
+    try:
+        bundles, gens = {}, {}
+        for i in range(4):
+            name = f"mb-join-{i}"
+            bundles[name] = mk_bundle(dcf, rng)
+            gens[name] = pod.router.register_key(name, bundles[name])
+        spec = pod.add_shard("shard-2")
+        prospective = pod.router.map.with_host(spec)
+        race = pod.key_owned_by("shard-2", prefix="mb-race",
+                                ring=prospective)
+        bundles[race] = mk_bundle(dcf, rng)
+        orig = pod.ctrl._converge
+        calls = {"n": 0}
+
+        def racing_converge(*a, **kw):
+            moved = orig(*a, **kw)
+            calls["n"] += 1
+            if calls["n"] == 1:
+                # Mid-warm, pre-admission: the registration lands on
+                # the OLD 2-host ring — the post-admit sweep must
+                # carry it onto the newcomer.
+                gens[race] = pod.router.register_key(race,
+                                                     bundles[race])
+            return moved
+
+        pod.ctrl._converge = racing_converge
+        ev = pod.ctrl.join(spec)
+        assert ev.kind == "join" and ev.epoch == 1
+        assert "shard-2" in pod.router.map
+        assert pod.router.ring_epoch == 1
+        assert calls["n"] == 2  # warm + post-admit sweep
+        digest = pod.svc_of("shard-2").replication_digest()
+        for name, gen in gens.items():
+            placed = {s.host_id for s in
+                      pod.router.map.placement(name, replicas=1)}
+            if "shard-2" in placed:
+                assert digest.get(name) == gen, (name, digest)
+        assert digest.get(race) == gens[race]
+        xs = rng.integers(0, 256, (6, NB), dtype=np.uint8)
+        for name in (race, sorted(gens)[0]):
+            got = pod.router.evaluate(name, xs, b=0, timeout=60) ^ \
+                pod.router.evaluate(name, xs, b=1, timeout=60)
+            assert np.array_equal(got,
+                                  recon_oracle(prg, bundles[name], xs))
+        assert pod.router.metrics_snapshot()[
+            "membership_joins_total"] == 1
+    finally:
+        pod.close()
+
+
+def test_join_aborts_typed_on_unreachable_host_and_cleans_up(dcf,
+                                                             rng):
+    pod = MemberPod(dcf, n=2)
+    try:
+        pod.router.register_key("mb-ja", mk_bundle(dcf, rng))
+        dead = ShardSpec("shard-dead", "127.0.0.1", 1)
+        with pytest.raises(BackendUnavailableError):
+            pod.ctrl.join(dead)
+        assert "shard-dead" not in pod.router.map
+        assert "shard-dead" not in pod.router._pools
+        assert pod.router.ring_epoch == 0
+        snap = pod.router.metrics_snapshot()
+        assert snap["membership_change_failures_total"] == 1
+        assert snap["membership_joins_total"] == 0
+        with pytest.raises(ValueError, match="already in the ring"):
+            pod.ctrl.join(pod.map.hosts()[0])
+    finally:
+        pod.close()
+
+
+# ------------------------------------------------- graceful drain
+
+
+def test_drain_migrates_defers_forget_and_converges_hot_swap(
+        dcf, prg, rng):
+    """The three-phase drain: frames migrate (the drainee is the
+    source), the swap commits under a fresh epoch, and the drainee's
+    pool survives until the in-flight grace elapses on the clock —
+    only then is it forgotten (pump completes it, typed event).  A
+    hot-swap racing the migration is converged by the post-swap
+    sweep: the key serves the NEW bundle's bits on the new ring,
+    never the old's, never mismatched."""
+    pod = MemberPod(dcf, n=3)
+    try:
+        drainee = "shard-0"
+        name = pod.key_owned_by(drainee, prefix="mb-drain")
+        kb_old = mk_bundle(dcf, rng)
+        pod.router.register_key(name, kb_old)
+        kb_new = mk_bundle(dcf, rng)
+        swapped = {}
+        orig = pod.ctrl._converge
+        calls = {"n": 0}
+
+        def racing_converge(*a, **kw):
+            moved = orig(*a, **kw)
+            calls["n"] += 1
+            if calls["n"] == 1:
+                # Post-migration, pre-swap: the hot-swap lands on the
+                # OLD ring (the drainee is still the owner) at a
+                # strictly newer generation.
+                swapped["gen"] = pod.router.register_key(name, kb_new)
+            return moved
+
+        pod.ctrl._converge = racing_converge
+        ev = pod.ctrl.drain(drainee)
+        assert ev.kind == "drain" and ev.epoch == 1
+        assert drainee not in pod.router.map
+        assert pod.router.ring_epoch == 1
+        # Retained through the grace: the pool is still installed for
+        # in-flight relays...
+        assert drainee in pod.router._pools
+        assert pod.ctrl.draining() == {drainee: pytest.approx(101.0)}
+        assert pod.ctrl.pump() == []  # grace not elapsed
+        assert drainee in pod.router._pools
+        # ...and the hot-swap converged onto the new owner before the
+        # drainee goes away: newest generation, newest bits.
+        placed = {s.host_id for s in
+                  pod.router.map.placement(name, replicas=1)}
+        for hid in placed:
+            assert pod.svc_of(hid).replication_digest()[name] \
+                == swapped["gen"]
+        xs = rng.integers(0, 256, (7, NB), dtype=np.uint8)
+        got = pod.router.evaluate(name, xs, b=0, timeout=60) ^ \
+            pod.router.evaluate(name, xs, b=1, timeout=60)
+        assert np.array_equal(got, recon_oracle(prg, kb_new, xs))
+        pod.clk.advance(1.5)
+        events = pod.ctrl.pump()
+        assert [e.kind for e in events] == ["drain-complete"]
+        assert drainee not in pod.router._pools
+        assert pod.ctrl.draining() == {}
+        snap = pod.router.metrics_snapshot()
+        assert snap["membership_drains_total"] == 1
+        assert snap["membership_draining_hosts"] == 0
+        leftovers = {k for k in snap if drainee in k}
+        assert leftovers == set(), leftovers
+    finally:
+        pod.close()
+
+
+def test_drain_validations(dcf):
+    pod = MemberPod(dcf, n=1, ctrl_kw=dict(min_hosts=1))
+    try:
+        with pytest.raises(ValueError, match="not in the ring"):
+            pod.ctrl.drain("shard-9")
+        with pytest.raises(ValueError, match="last host"):
+            pod.ctrl.drain("shard-0")
+    finally:
+        pod.close()
+
+
+def test_rejoin_within_drain_grace_does_not_wedge_pump(dcf, prg, rng):
+    """A drained host that re-joins BEFORE its in-flight grace elapses
+    (a rolling restart faster than ``drain_grace_s``) must not wedge
+    the control loop: the retained pool is a ring member's pool again,
+    so the deferred forget is SKIPPED — the drain window still closes
+    with its typed event, later pumps keep running (auto-eject stays
+    armed), and the host keeps serving through the surviving link."""
+    pod = MemberPod(dcf, n=3)
+    try:
+        name = pod.key_owned_by("shard-0", prefix="mb-rr")
+        kb = mk_bundle(dcf, rng)
+        pod.router.register_key(name, kb)
+        spec = next(s for s in pod.map.hosts()
+                    if s.host_id == "shard-0")
+        assert pod.ctrl.drain("shard-0").kind == "drain"
+        ev = pod.ctrl.join(spec)  # same live process, within the grace
+        assert ev.kind == "join" and "shard-0" in pod.router.map
+        pod.clk.advance(1.5)  # past drain_grace_s=1.0
+        assert [e.kind for e in pod.ctrl.pump()] == ["drain-complete"]
+        assert "shard-0" in pod.router._pools  # the member's pool
+        assert pod.ctrl.draining() == {}
+        assert pod.ctrl.pump() == []  # the control loop is alive
+        xs = rng.integers(0, 256, (5, NB), dtype=np.uint8)
+        got = pod.router.evaluate(name, xs, b=0, timeout=60) ^ \
+            pod.router.evaluate(name, xs, b=1, timeout=60)
+        assert np.array_equal(got, recon_oracle(prg, kb, xs))
+    finally:
+        pod.close()
+
+
+def test_post_commit_sweep_failure_does_not_abort_the_change(dcf,
+                                                             rng):
+    """A transient failure in the POST-swap convergence sweep lands
+    AFTER the commit: the change must still report committed (event,
+    counters, the drain-grace bookkeeping that pump's deferred forget
+    reads) with the failure counted — re-raising would leak the
+    retained pool forever and make a retry die on the ring-membership
+    validation."""
+    pod = MemberPod(dcf, n=3)
+    try:
+        pod.router.register_key(
+            pod.key_owned_by("shard-0", prefix="mb-ps"),
+            mk_bundle(dcf, rng))
+        orig = pod.ctrl._converge
+        calls = {"n": 0}
+
+        def flaky_converge(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 2:  # the post-swap sweep
+                raise BackendUnavailableError("peer died post-commit")
+            return orig(*a, **kw)
+
+        pod.ctrl._converge = flaky_converge
+        ev = pod.ctrl.drain("shard-0")
+        assert ev.kind == "drain" and ev.epoch == 1
+        assert "shard-0" not in pod.router.map
+        assert "shard-0" in pod.ctrl.draining()
+        snap = pod.router.metrics_snapshot()
+        assert snap["membership_drains_total"] == 1
+        assert snap["membership_change_failures_total"] == 1
+        pod.clk.advance(1.5)
+        assert [e.kind for e in pod.ctrl.pump()] == ["drain-complete"]
+        assert "shard-0" not in pod.router._pools
+    finally:
+        pod.close()
+
+
+def test_join_redials_when_rejoining_host_changed_address(dcf, prg,
+                                                          rng):
+    """A drained host's REPLACEMENT process on a new port re-joining
+    within the grace: the retained pool is wired to the OLD endpoint,
+    so ``preconnect``/``set_ring`` must re-dial instead of reusing it
+    — otherwise every forward for the host lands on the dying
+    process."""
+    pod = MemberPod(dcf, n=3)
+    try:
+        name = pod.key_owned_by("shard-0", prefix="mb-ra")
+        kb = mk_bundle(dcf, rng)
+        pod.router.register_key(name, kb)
+        assert pod.ctrl.drain("shard-0").kind == "drain"
+        old_port = pod.router._pools["shard-0"].port
+        spec = pod.add_shard("shard-0")  # same identity, fresh port
+        assert spec.port != old_port
+        assert pod.ctrl.join(spec).kind == "join"
+        assert pod.router._pools["shard-0"].port == spec.port
+        # The warm landed on the NEW process and the key serves.
+        assert pod.svc_of("shard-0").replication_digest().get(name)
+        xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+        got = pod.router.evaluate(name, xs, b=0, timeout=60) ^ \
+            pod.router.evaluate(name, xs, b=1, timeout=60)
+        assert np.array_equal(got, recon_oracle(prg, kb, xs))
+    finally:
+        pod.close()
+
+
+def test_lost_keys_audit_counts_each_loss_once(dcf, rng, tmp_path):
+    """``lost_keys`` is a read-only audit: polling it must not inflate
+    ``membership_lost_keys_total`` — each loss counts once, and a key
+    lost, healed, then lost again counts as a fresh loss."""
+    stores = {"shard-0": KeyStore(str(tmp_path / "s0")),
+              "shard-1": KeyStore(str(tmp_path / "s1"))}
+    pod = MemberPod(dcf, n=2, stores=stores)
+    try:
+        stores["shard-0"].put("lk", mk_bundle(dcf, rng), generation=1)
+        assert pod.ctrl.lost_keys(exclude={"shard-0"}) == ["lk"]
+        assert pod.ctrl.lost_keys(exclude={"shard-0"}) == ["lk"]
+        assert pod.router.metrics_snapshot()[
+            "membership_lost_keys_total"] == 1
+        # Healed (the key reaches another store), then lost again —
+        # the second loss is a fresh one and counts.
+        stores["shard-0"].replicate_to(stores["shard-1"], "lk")
+        assert pod.ctrl.lost_keys(exclude={"shard-0"}) == []
+        assert pod.ctrl.lost_keys(
+            exclude={"shard-0", "shard-1"}) == ["lk"]
+        assert pod.router.metrics_snapshot()[
+            "membership_lost_keys_total"] == 2
+    finally:
+        pod.close()
+
+
+def test_unreachable_store_does_not_wedge_eject(dcf, rng, tmp_path):
+    """A store whose digest read FAILS (the disk died with its
+    process) must not wedge membership: the eject proceeds without it
+    — counted ``membership_store_unreachable_total`` — instead of
+    aborting on every pump forever while the victim's keys sit on a
+    lone promoted replica."""
+    stores = {f"shard-{i}": KeyStore(str(tmp_path / f"shard-{i}"))
+              for i in range(3)}
+    pod = MemberPod(dcf, n=3, stores=stores)
+    try:
+        victim = "shard-0"
+        name = pod.key_owned_by(victim, prefix="mb-ds")
+        kb = mk_bundle(dcf, rng)
+        gen = pod.router.register_key(name, kb)
+        placed = [s.host_id
+                  for s in pod.router.map.placement(name, replicas=1)]
+        stores[placed[0]].put(name, kb, generation=gen)
+        stores[placed[0]].replicate_to(stores[placed[1]], name)
+
+        def dead_digest():
+            raise OSError("mount gone")
+
+        stores[victim].digest = dead_digest
+        pod.kill(victim)
+        assert pod.pump_until(victim, DOWN)
+        pod.ctrl.pump()
+        pod.clk.advance(3.0)
+        assert [e.kind for e in pod.ctrl.pump()] == ["eject"]
+        assert victim not in pod.router.map
+        assert pod.router.metrics_snapshot()[
+            "membership_store_unreachable_total"] >= 1
+        for hid in pod.router.map.placement_ids(name, replicas=1):
+            assert stores[hid].digest().get(name) == gen, hid
+    finally:
+        pod.close()
+
+
+def test_durable_copy_falls_back_to_another_holder(dcf, rng,
+                                                   tmp_path):
+    """One source exhausting its bounded retries must not abort the
+    change while ANOTHER replica holds the same generation: the copy
+    falls through to the next holder, and only an all-holders failure
+    aborts (the conservative direction)."""
+    stores = {f"shard-{i}": KeyStore(str(tmp_path / f"s{i}"))
+              for i in range(3)}
+    pod = MemberPod(dcf, n=3, stores=stores)
+    try:
+        kb = mk_bundle(dcf, rng)
+        name = "mb-fb"
+        ring = pod.router.map
+        dst = [s.host_id
+               for s in ring.placement(name, replicas=1)][1]
+        holders = sorted(h for h in stores if h != dst)
+        for h in holders:
+            stores[h].put(name, kb, generation=2)
+
+        def boom(*a, **kw):
+            raise BackendUnavailableError("source store down")
+
+        stores[holders[0]].replicate_to = boom
+        assert pod.ctrl._replicate_durable(ring, exclude=set()) == 1
+        assert stores[dst].digest().get(name) == 2
+        # Every holder failing IS the abort.
+        stores[holders[1]].replicate_to = boom
+        stores[dst].delete(name)
+        with pytest.raises(BackendUnavailableError):
+            pod.ctrl._replicate_durable(ring, exclude=set())
+    finally:
+        pod.close()
+
+
+def test_unadmitted_request_cannot_adopt_epoch(dcf, prg, rng):
+    """The fence must not be a single-packet DoS: a REQUEST frame
+    from an UNADMITTED sender (unknown tenant) carrying a huge epoch
+    is refused WITHOUT adoption — the observed maximum moves only on
+    admitted requests (and the trusted PING/REGISTER verbs), so a
+    forged frame cannot fence out the real router."""
+    from dcf_tpu.serve import TenantSpec
+
+    svc = dcf.serve(max_batch=32, max_delay_ms=1.0,
+                    tenants=(TenantSpec("router", "critical"),))
+    svc.start()
+    server = EdgeServer(svc).start()
+    addr = server.address
+    kb = mk_bundle(dcf, rng)
+    svc.register_key("ep-key", kb)
+    svc.check_ring_epoch(3)  # the pod's real epoch
+    xs = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+    try:
+        forged = encode_request(1, "intruder", "ep-key", 0, 255, None,
+                                xs.tobytes(), NB, 2,
+                                epoch=(1 << 32) - 1)
+        frames = _raw_exchange(addr, forged)
+        assert [f[0] for f in frames] == ["error"]
+        assert svc.ring_epoch == 3  # NOT adopted
+        with EdgeClient(*addr, n_bytes=NB, tenant="router") as c:
+            # The real router still serves at the real epoch...
+            y = c.submit_bytes("ep-key", xs.tobytes(), b=0,
+                               epoch=3).result(timeout=60)
+            assert np.array_equal(
+                y, eval_batch_np(prg, 0, kb.for_party(0), xs))
+            # ...and an ADMITTED newer epoch still adopts.
+            c.submit_bytes("ep-key", xs.tobytes(), b=0,
+                           epoch=4).result(timeout=60)
+        assert svc.ring_epoch == 4
+    finally:
+        server.close()
+        svc.close(drain=False)
+
+
+def test_edge_graceful_drain_delivers_queued_responses(dcf, prg, rng):
+    """The serve_host shutdown ordering, in process: after
+    ``stop_accepting`` (new dials refused, live links OPEN) a request
+    already accepted is DRAINED — ``close(drain=True)`` completes it
+    and ``EdgeServer.close(drain_s=)`` flushes the response over the
+    still-open connection — so a planned restart never drops acked
+    work."""
+    svc = dcf.serve(max_batch=32, max_delay_ms=50.0)
+    svc.start()
+    server = EdgeServer(svc).start()
+    addr = server.address
+    kb = mk_bundle(dcf, rng)
+    svc.register_key("gd-key", kb)
+    client = EdgeClient(*addr, n_bytes=NB)
+    try:
+        xs = rng.integers(0, 256, (3, NB), dtype=np.uint8)
+        fut = client.submit("gd-key", xs, b=0)
+        server.stop_accepting()
+        with pytest.raises(OSError):
+            socket.create_connection(addr, timeout=2)
+        svc.close(drain=True)
+        server.close(drain_s=5.0)
+        y = fut.result(timeout=30)
+        assert np.array_equal(
+            y, eval_batch_np(prg, 0, kb.for_party(0), xs))
+    finally:
+        client.close()
+        server.close()
+        svc.close(drain=False)
+
+
+# ------------------------------------------------- the epoch fence
+
+
+def test_epoch_fence_adopt_and_refuse_in_process_and_wire(dcf, prg,
+                                                          rng):
+    """The fence end to end: a service adopts a newer epoch
+    (monotonic max, gauge written), passes an equal one, refuses an
+    older one typed with a retry hint (counted) — in-process AND over
+    the wire for REQUEST, REGISTER and PING frames (``E_EPOCH``, the
+    connection surviving every refusal).  The key keeps serving the
+    current-epoch bits after each refusal, and the PONG echoes the
+    shard's epoch (the convergence probe)."""
+    svc = dcf.serve(max_batch=32, max_delay_ms=1.0)
+    svc.start()
+    server = EdgeServer(svc).start()
+    try:
+        assert svc.ring_epoch == 0
+        assert svc.check_ring_epoch(0) == 0  # unfenced: no-op
+        assert svc.check_ring_epoch(5) == 5  # adopt
+        assert svc.check_ring_epoch(5) == 5  # equal passes
+        with pytest.raises(RingEpochError) as ei:
+            svc.check_ring_epoch(4)
+        assert ei.value.retry_after_s is not None
+        snap = svc.metrics_snapshot()
+        assert snap["serve_ring_epoch"] == 5
+        assert snap["serve_epoch_fenced_total"] == 1
+        kb = mk_bundle(dcf, rng)
+        svc.register_key("fence-key", kb)
+        xs = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+        with EdgeClient(*server.address, n_bytes=NB) as c:
+            # PING: adoption + echo.
+            assert c.ping_epoch(timeout=30, epoch=7) == 7
+            assert c.ping_epoch(timeout=30) == 7  # unfenced echo
+            # REQUEST at a stale epoch: typed, hinted, E_EPOCH.
+            with pytest.raises(RingEpochError) as ei:
+                c.submit_bytes("fence-key", xs.data, b=0,
+                               epoch=6).result(30)
+            assert ei.value.wire_code == E_EPOCH
+            assert ei.value.retry_after_s is not None
+            # REGISTER at a stale epoch: same fence, key untouched.
+            with pytest.raises(RingEpochError):
+                c.register_frame("fence-key",
+                                 mk_bundle(dcf, rng).to_bytes(),
+                                 epoch=3)
+            # A stale PING is refused too (a stale prober must learn).
+            with pytest.raises(RingEpochError):
+                c.ping(timeout=30, epoch=2)
+            # The connection survived all three refusals, and the key
+            # serves the CURRENT bits at the current epoch.
+            y0 = c.submit_bytes("fence-key", xs.data, b=0,
+                                epoch=7).result(60)
+            assert np.array_equal(
+                y0, eval_batch_np(prg, 0, kb.for_party(0), xs))
+        assert svc.metrics_snapshot()[
+            "serve_epoch_fenced_total"] == 4
+    finally:
+        server.close()
+        svc.close(drain=False)
+
+
+def test_stale_router_structurally_refused(dcf, prg, rng):
+    """Two routers over one pod: the one that applied the membership
+    commit (higher epoch) keeps serving; the one still on the old
+    ring is refused typed ``RingEpochError`` WITH a hint on every
+    forward — counted on ``router_stale_epoch_total``, never marked
+    shard-suspect (the shard is fine; the ROUTER is stale)."""
+    pod = MemberPod(dcf, n=2)
+    stale_router = None
+    try:
+        name = pod.key_owned_by("shard-0")
+        kb = mk_bundle(dcf, rng)
+        pod.router.register_key(name, kb)
+        stale_router = DcfRouter(pod.map, n_bytes=NB)
+        stale_router.set_ring(pod.map, epoch=1)
+        pod.router.set_ring(pod.map, epoch=2)
+        xs = rng.integers(0, 256, (3, NB), dtype=np.uint8)
+        # The current router's forward teaches the shards epoch 2...
+        got = pod.router.evaluate(name, xs, b=0, timeout=60) ^ \
+            pod.router.evaluate(name, xs, b=1, timeout=60)
+        assert np.array_equal(got, recon_oracle(prg, kb, xs))
+        # ...after which the stale router is structurally refused.
+        with pytest.raises(RingEpochError) as ei:
+            stale_router.evaluate(name, xs, b=0, timeout=60)
+        assert ei.value.retry_after_s is not None
+        snap = stale_router.metrics_snapshot()
+        assert snap["router_stale_epoch_total"] >= 1
+        assert stale_router.suspect_remaining("shard-0") == 0.0
+        # Refreshing the stale router's ring re-admits it.
+        stale_router.set_ring(pod.map, epoch=2)
+        got = stale_router.evaluate(name, xs, b=0, timeout=60) ^ \
+            stale_router.evaluate(name, xs, b=1, timeout=60)
+        assert np.array_equal(got, recon_oracle(prg, kb, xs))
+    finally:
+        if stale_router is not None:
+            stale_router.close()
+        pod.close()
+
+
+# ------------------------------------------------- replicate_to retry
+
+
+def test_replicate_to_bounded_retry_with_backoff(dcf, rng, tmp_path):
+    """The ISSUE 15 satellite: a transient transport ``OSError`` on
+    the destination publish is retried with doubling backoff —
+    counted — and succeeds; exhaustion dies typed
+    ``BackendUnavailableError`` with the cause chained.  A one-packet
+    blip must not abort a whole migration."""
+    src = KeyStore(str(tmp_path / "src"))
+    dst = KeyStore(str(tmp_path / "dst"))
+    kb = mk_bundle(dcf, rng)
+    src.put("rk", kb, generation=5)
+    naps: list = []
+    with faults.inject_schedule("store.write", window_evals=2,
+                                exc=OSError("injected blip")):
+        gen = src.replicate_to(dst, "rk", retries=3, backoff_s=0.05,
+                               sleep=naps.append)
+    assert gen == 5
+    assert dst.digest() == {"rk": 5}
+    assert naps == [0.05, 0.1]  # doubling backoff, one per retry
+    assert src._metrics.counter(
+        "serve_store_replicate_retries_total").value == 2
+    # Exhaustion: typed, cause-chained, counted per attempt.
+    dst2 = KeyStore(str(tmp_path / "dst2"))
+    with faults.inject_schedule("store.write", window_evals=99,
+                                exc=OSError("still down")):
+        with pytest.raises(BackendUnavailableError) as ei:
+            src.replicate_to(dst2, "rk", retries=2, backoff_s=0.01,
+                             sleep=naps.append)
+    assert isinstance(ei.value.__cause__, OSError)
+    assert dst2.digest() == {}
+    with pytest.raises(ValueError):
+        src.replicate_to(dst2, "rk", retries=-1)
+    # Validation failures are NEVER retried: a corrupt source frame
+    # quarantines immediately (re-reading damage does not repair it).
+    src.put("bad", kb, generation=1)
+    ent = src._read_manifest()["bad"]
+    path = tmp_path / "src" / ent["file"]
+    raw = bytearray(path.read_bytes())
+    raw[10] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    before = len(naps)
+    with pytest.raises(KeyQuarantinedError):
+        src.replicate_to(dst2, "bad", retries=5, sleep=naps.append)
+    assert len(naps) == before  # zero retry naps
+
+
+# ------------------------------------------------- control-verb fuzz
+
+
+def _raw_exchange(addr, wire: bytes) -> list:
+    s = socket.create_connection(addr, timeout=30)
+    data = b""
+    try:
+        s.sendall(wire)
+        s.shutdown(socket.SHUT_WR)
+        s.settimeout(30)
+        while True:
+            try:
+                chunk = s.recv(1 << 16)
+            except OSError:
+                break
+            if not chunk:
+                break
+            data += chunk
+    finally:
+        s.close()
+    frames, off = [], 0
+    while off < len(data):
+        (body_len,) = struct.unpack_from("<I", data, off)
+        frames.append(decode_response(data[off + 4:off + 4 + body_len]))
+        off += 4 + body_len
+    return frames
+
+
+def test_wire_fuzz_all_control_verbs_die_typed_per_connection(
+        dcf, rng):
+    """The ISSUE 15 fuzz satellite, server door: seeded byte-flips,
+    truncations and an oversized length prefix over ALL FIVE control
+    verbs (PING/PONG/REGISTER/DIGEST/SYNC — PONG and SYNC are
+    client-side frames, so even their PRISTINE forms must die typed
+    at a server) each cost exactly one connection — never a non-error
+    response, never the reader thread, never the accept loop — with a
+    healthy pinned connection round-tripping throughout and fresh
+    dials accepted after."""
+    svc = dcf.serve(max_batch=32, max_delay_ms=1.0)
+    svc.start()
+    server = EdgeServer(svc).start()
+    addr = server.address
+    kb = mk_bundle(dcf, rng)
+    valid = {
+        "ping": encode_ping(11, 0),
+        "register": encode_register(12, "fz-key", kb.to_bytes(), 0,
+                                    False),
+        "digest": encode_digest(13, {"fz-key": 3}, mode=1),
+        "pong": encode_pong(14, 0),
+        "sync": encode_sync(15, [("fz-key", 1, False, b"notakey")]),
+    }
+    healthy = EdgeClient(*addr, n_bytes=NB)
+    try:
+        for verb, frame in sorted(valid.items()):
+            mangles = []
+            if verb in ("pong", "sync"):
+                mangles.append(frame)  # pristine, but not a server
+                # frame: the type dispatch itself must kill typed
+            for off in rng.choice(len(frame) - 4, size=4,
+                                  replace=False):
+                buf = bytearray(frame)
+                buf[4 + int(off)] ^= 0x41
+                mangles.append(bytes(buf))
+            mangles.append(frame[: max(len(frame) // 2, 5)])
+            mangles.append(struct.pack("<I", 1 << 30))
+            for i, wire in enumerate(mangles):
+                frames = _raw_exchange(addr, wire)
+                for decoded in frames:
+                    assert decoded[0] == "error", (verb, i, decoded)
+                assert healthy.ping(timeout=30)
+                assert not healthy.closed
+        # Nothing fuzzed ever registered; the accept loop still dials.
+        assert "fz-key" not in svc.replication_digest()
+        with EdgeClient(*addr, n_bytes=NB) as fresh:
+            assert fresh.ping(timeout=30)
+    finally:
+        healthy.close()
+        server.close()
+        svc.close(drain=False)
+
+
+def test_corrupt_control_response_fails_client_typed(rng):
+    """The client direction: a corrupted PONG off the wire fails the
+    pending control round trip typed (``BackendUnavailableError`` —
+    the reader cannot trust the stream) and latches ``closed``, the
+    pool's reconnect signal — never a hang, never an untyped
+    escape."""
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    host, port = lst.getsockname()[:2]
+    box: dict = {}
+
+    def fake_server():
+        conn, _ = lst.accept()
+        try:
+            conn.settimeout(30)
+            conn.recv(1 << 16)  # the client's ping frame
+            pong = bytearray(encode_pong(1, 0))
+            pong[9] ^= 0x7F  # corrupt inside the body: CRC must catch
+            conn.sendall(bytes(pong))
+        finally:
+            conn.close()
+
+    t = threading.Thread(target=fake_server, daemon=True)
+    t.start()
+    c = EdgeClient(host, port, n_bytes=NB)
+    try:
+        with pytest.raises(BackendUnavailableError):
+            c.ping(timeout=30)
+        assert c.closed
+    finally:
+        c.close()
+        lst.close()
+        t.join(10)
+
+
+# ------------------------------------------------- CI satellites
+
+
+def test_membership_layer_lint_clean():
+    """The ISSUE-15 CI satellite: ``serve/membership.py`` sweeps clean
+    under ALL six dcflint passes — determinism (grace and drain math
+    on the injectable clock only) and secret hygiene (migrations move
+    DCFK frames; the controller logs names, hosts, epochs and counts
+    only) are the load-bearing ones."""
+    from tools.dcflint import run_path
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    assert run_path(repo / "dcf_tpu" / "serve" / "membership.py") == []
+
+
+def test_cli_churn_flags_validated_fast():
+    """``pod_bench --churn`` applies the fail-fast flag discipline:
+    bad shard counts, grace, probe cadence and conflicting scenario
+    flags die loudly before any subprocess is spawned."""
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="shards >= 3"):
+        cli.main(["pod_bench", "--churn", "--shards=2"])
+    with pytest.raises(SystemExit, match="eject-grace"):
+        cli.main(["pod_bench", "--churn", "--eject-grace=0"])
+    with pytest.raises(SystemExit, match="probe-interval"):
+        cli.main(["pod_bench", "--churn", "--probe-interval=0"])
+    with pytest.raises(SystemExit, match="live-bundles"):
+        cli.main(["pod_bench", "--churn", "--live-bundles=-1"])
+    with pytest.raises(SystemExit, match="separate"):
+        cli.main(["pod_bench", "--churn", "--partition"])
+
+
+# ------------------------------------------------- the slow legs
+
+
+@pytest.mark.slow
+def test_serve_host_sigterm_drains_and_unadvertises(dcf, rng,
+                                                    tmp_path):
+    """The graceful-shutdown satellite, end to end: a serve_host
+    subprocess warm-restores its store, advertises via the ready
+    file, and on SIGTERM drains, writes a final metrics snapshot,
+    REMOVES the ready file, and exits 0.  (SIGKILL stays the crash
+    test — pod_bench's kill soak owns that path.)"""
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    store_dir = tmp_path / "host-store"
+    store = KeyStore(str(store_dir))
+    kb = mk_bundle(dcf, rng)
+    store.put("sh-key", kb, generation=3)
+    ready = tmp_path / "ready.json"
+    metrics = tmp_path / "metrics.json"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dcf_tpu.cli", "serve_host",
+         "--store-dir", str(store_dir), "--ready-file", str(ready),
+         "--metrics-file", str(metrics), "--seed", "7",
+         "--backend", "cpu", "--max-batch", "32"])
+    try:
+        deadline = time.monotonic() + 300
+        while not ready.exists():
+            assert proc.poll() is None, "serve_host died before ready"
+            assert time.monotonic() < deadline, "never became ready"
+            time.sleep(0.2)
+        doc = json.loads(ready.read_text())
+        assert doc["restored"] == 1
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(120)
+        assert rc == 0
+        assert not ready.exists()  # un-advertised on the way out
+        snap = json.loads(metrics.read_text())  # final snapshot
+        assert snap["serve_store_restored_total"] == 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(30)
+        if os.path.exists(str(ready)):
+            os.unlink(str(ready))

@@ -39,6 +39,7 @@ DCF_ERRORS = frozenset({
     "CircuitOpenError",
     "KeyQuarantinedError",
     "BatchTimeoutError",
+    "RingEpochError",
 })
 _ALWAYS_OK = DCF_ERRORS | {"NotImplementedError"}
 _MARKED_OK = frozenset({"ValueError", "TypeError"})
