@@ -797,6 +797,21 @@ class Dcf:
         (``RingEpochError`` / ``E_EPOCH``,
         ``serve_epoch_fenced_total``) — a router on a stale ring is
         structurally unable to serve a conflicting placement.
+
+        Autoscaling (ISSUE 16, README "Autoscaling"): the
+        ``max_queued_points`` knob here is the demand signal's
+        denominator — each shard reports ``queue_points`` against it
+        in the ``LoadSample`` piggybacked on health PONGs
+        (``load_report``; ``serve_host --max-queued-points`` is the
+        CLI spelling), so size it to the shard's real appetite, not
+        "large enough to never matter".  A
+        ``serve.CapacityController`` over the router + membership
+        pair turns those samples into ring changes: ``scale_out_n``
+        consecutive pressure ticks admit a host from the declared
+        standby pool (``serve_host --standby`` processes),
+        ``scale_in_m`` consecutive idle ticks drain the least-loaded
+        one back, with a hard ``cooldown_s`` after any observed
+        membership change — oscillating load produces zero churn.
         """
         from dcf_tpu.serve import DcfService, ServeConfig
 

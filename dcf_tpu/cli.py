@@ -2923,15 +2923,34 @@ def bench_serve_host(args) -> None:
             "serve_host needs --store-dir (the shard's durable key "
             "store; pod provisioning writes frames there)")
     dcf, lam, nb, backend, _rng = _serve_host_facade(args)
+    knobs = {}
+    if args.max_queued_points:
+        # The surge scenario pins a small admission bound so sustained
+        # overload becomes visible demand (sheds/brownout) within the
+        # bench window instead of an invisible mile-deep queue.
+        knobs["max_queued_points"] = args.max_queued_points
     svc = dcf.serve(max_batch=args.max_batch or (1 << 10),
                     max_delay_ms=args.max_delay_ms,
                     store_dir=args.store_dir,
                     tls_cert=args.tls_cert, tls_key=args.tls_key,
-                    tls_client_ca=args.tls_client_ca)
-    report = svc.restore_keys()
-    log(f"serve_host[{backend} lam={lam} nb={nb}]: restored "
-        f"{len(report.restored)} keys "
-        f"({len(report.quarantined)} quarantined)")
+                    tls_client_ca=args.tls_client_ca, **knobs)
+    if args.standby:
+        # A standby host (ISSUE 16) is provisioned-but-idle: it serves
+        # and probes, but restores nothing at startup — the graceful
+        # join's warm-before-admit pass ships it exactly the keys its
+        # ring placement owes it WHEN the capacity controller admits
+        # it, so a stale store left from a previous tour never races
+        # the migration.
+        from dcf_tpu.serve import RestoreReport
+
+        report = RestoreReport()
+        log(f"serve_host[{backend} lam={lam} nb={nb}]: STANDBY "
+            "(restore skipped; the join warms this host)")
+    else:
+        report = svc.restore_keys()
+        log(f"serve_host[{backend} lam={lam} nb={nb}]: restored "
+            f"{len(report.restored)} keys "
+            f"({len(report.quarantined)} quarantined)")
     svc.start()
     edge = EdgeServer(svc, host=args.bind, port=args.port).start()
     host, port = edge.address
@@ -2946,7 +2965,8 @@ def bench_serve_host(args) -> None:
         _flush(args.ready_file, {
             "host": host, "port": port, "pid": os.getpid(),
             "restored": len(report.restored),
-            "quarantined": len(report.quarantined)})
+            "quarantined": len(report.quarantined),
+            "standby": bool(args.standby)})
     log(f"serve_host listening on {host}:{port}")
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -3011,9 +3031,65 @@ def _pod_rollup(metric_files: list) -> dict:
     return rollup_snapshots(snaps)
 
 
-def _pod_spawn(tag: str, store_dir: str, run_dir: str, args) -> tuple:
+def _pod_provision(dcf, lam, nb, rng, root, shard_ids,
+                   n_bundles: int, *, solo: bool = False) -> tuple:
+    """The ONE provisioning block every pod scenario starts with
+    (ISSUE 16 small fix: ``--churn``/``--partition``/``--flap``/the
+    kill leg each carried a near-copy): build the rendezvous ring over
+    ``shard_ids``, open one ``KeyStore`` per shard under ``root``,
+    mint ``n_bundles`` two-party bundles, and write each durably to
+    its owner's store with ``replicate_to`` copies to its replicas —
+    same bytes, same generation.  ``solo`` adds the single-shard
+    comparison store holding everything (the ``bench_pod`` leg).
+    Returns ``(ring, stores, bundles, gens)``."""
+    import os
+
+    from dcf_tpu.serve import KeyStore, ShardMap, ShardSpec
+
+    ring = ShardMap([ShardSpec(s) for s in shard_ids])
+    stores = {s: KeyStore(os.path.join(root, s)) for s in shard_ids}
+    if solo:
+        stores["solo"] = KeyStore(os.path.join(root, "solo"))
+    bundles, gens = {}, {}
+    for i in range(n_bundles):
+        name = f"key-{i}"
+        alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
+        betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
+        kb = dcf.gen(alphas, betas, rng=rng)
+        bundles[name], gens[name] = kb, i + 1
+        placed = ring.placement(name, replicas=1)
+        stores[placed[0].host_id].put(name, kb, generation=gens[name])
+        for rep in placed[1:]:
+            stores[placed[0].host_id].replicate_to(
+                stores[rep.host_id], name)
+        if solo:
+            stores["solo"].put(name, kb, generation=gens[name])
+    return ring, stores, bundles, gens
+
+
+def _pod_warmup(rng, nb: int, max_batch: int, plan) -> None:
+    """The ONE warmup ladder every pod scenario runs (the other half
+    of the ISSUE 16 dedupe): warm every padded pow-2 batch shape on
+    every process, both parties — ``plan`` is ``[(target,
+    [key, ...]), ...]`` with one key per shard the ladder must reach.
+    Without this the soaks pay the XLA compile storm mid-scenario and
+    the ledger measures compilation, not the product."""
+    xs_warm = rng.integers(0, 256, (max_batch, nb), dtype=np.uint8)
+    m = 1
+    while m <= max_batch:
+        for target, keys in plan:
+            for name in keys:
+                target.evaluate(name, xs_warm[:m], b=0, timeout=300)
+                target.evaluate(name, xs_warm[:m], b=1, timeout=300)
+        m *= 2
+
+
+def _pod_spawn(tag: str, store_dir: str, run_dir: str, args,
+               standby: bool = False, extra=()) -> tuple:
     """Spawn one serve_host subprocess; returns (Popen, ready_path,
-    metrics_path)."""
+    metrics_path).  ``standby``: launch it as a provisioned-but-idle
+    standby host (``serve_host --standby``, ISSUE 16); ``extra``:
+    additional serve_host flags (the surge scenario's queue bound)."""
     import os
     import subprocess
 
@@ -3029,6 +3105,9 @@ def _pod_spawn(tag: str, store_dir: str, run_dir: str, args) -> tuple:
         cmd += ["--lam", str(args.lam)]
     if args.domain_bytes:
         cmd += ["--domain-bytes", str(args.domain_bytes)]
+    if standby:
+        cmd += ["--standby"]
+    cmd += list(extra)
     proc = subprocess.Popen(cmd)
     return proc, ready, metrics
 
@@ -3199,13 +3278,7 @@ def bench_pod_selfheal(args) -> None:
     from dcf_tpu.backends.numpy_backend import eval_batch_np
     from dcf_tpu.errors import StaleStateError
     from dcf_tpu.ops.prg import HirosePrgNp
-    from dcf_tpu.serve import (
-        DcfRouter,
-        EdgeClient,
-        KeyStore,
-        ShardMap,
-        ShardSpec,
-    )
+    from dcf_tpu.serve import DcfRouter, EdgeClient, ShardSpec
     from dcf_tpu.serve.health import DOWN, UP
     from dcf_tpu.testing import faults
 
@@ -3230,20 +3303,8 @@ def bench_pod_selfheal(args) -> None:
     root = args.store_dir or tempfile.mkdtemp(prefix="dcf-pod-")
     os.makedirs(root, exist_ok=True)
     shard_ids = [f"shard-{i}" for i in range(n_shards)]
-    ring = ShardMap([ShardSpec(s) for s in shard_ids])
-    stores = {s: KeyStore(os.path.join(root, s)) for s in shard_ids}
-    bundles, gens = {}, {}
-    for i in range(n_bundles):
-        name = f"key-{i}"
-        alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
-        betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
-        kb = dcf.gen(alphas, betas, rng=rng)
-        bundles[name], gens[name] = kb, i + 1
-        placed = ring.placement(name, replicas=1)
-        stores[placed[0].host_id].put(name, kb, generation=gens[name])
-        for rep in placed[1:]:
-            stores[placed[0].host_id].replicate_to(
-                stores[rep.host_id], name)
+    ring, stores, bundles, gens = _pod_provision(
+        dcf, lam, nb, rng, root, shard_ids, n_bundles)
     procs: dict = {}
     router = None
     try:
@@ -3284,17 +3345,9 @@ def bench_pod_selfheal(args) -> None:
         by_owner: dict = {}
         for name, owner in owners.items():
             by_owner.setdefault(owner, []).append(name)
-        # Warm every padded pow-2 batch shape on every shard (both
-        # parties) — without this the soak pays the XLA compile storm
-        # mid-cut and the ledger measures compilation, not healing.
         max_batch = args.max_batch or (1 << 10)
-        xs_warm = rng.integers(0, 256, (max_batch, nb), dtype=np.uint8)
-        m = 1
-        while m <= max_batch:
-            for keys in by_owner.values():
-                router.evaluate(keys[0], xs_warm[:m], b=0, timeout=300)
-                router.evaluate(keys[0], xs_warm[:m], b=1, timeout=300)
-            m *= 2
+        _pod_warmup(rng, nb, max_batch,
+                    [(router, [keys[0] for keys in by_owner.values()])])
         log("warmup ladder done (all shards, both parties)")
         victim = max(by_owner, key=lambda s: len(by_owner[s]))
         # A key to register MID-cut: its owner stays reachable, its
@@ -3663,9 +3716,7 @@ def bench_pod_churn(args) -> None:
     from dcf_tpu.serve import (
         DcfRouter,
         EdgeClient,
-        KeyStore,
         MembershipController,
-        ShardMap,
         ShardSpec,
     )
     from dcf_tpu.serve.edge import (
@@ -3697,20 +3748,8 @@ def bench_pod_churn(args) -> None:
     root = args.store_dir or tempfile.mkdtemp(prefix="dcf-pod-")
     os.makedirs(root, exist_ok=True)
     shard_ids = [f"shard-{i}" for i in range(n_shards)]
-    ring = ShardMap([ShardSpec(s) for s in shard_ids])
-    stores = {s: KeyStore(os.path.join(root, s)) for s in shard_ids}
-    bundles, gens = {}, {}
-    for i in range(n_bundles):
-        name = f"key-{i}"
-        alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
-        betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
-        kb = dcf.gen(alphas, betas, rng=rng)
-        bundles[name], gens[name] = kb, i + 1
-        placed = ring.placement(name, replicas=1)
-        stores[placed[0].host_id].put(name, kb, generation=gens[name])
-        for rep in placed[1:]:
-            stores[placed[0].host_id].replicate_to(
-                stores[rep.host_id], name)
+    ring, stores, bundles, gens = _pod_provision(
+        dcf, lam, nb, rng, root, shard_ids, n_bundles)
     procs: dict = {}
     router = None
     controller = None
@@ -3758,13 +3797,8 @@ def bench_pod_churn(args) -> None:
         for name, owner in owners.items():
             by_owner.setdefault(owner, []).append(name)
         max_batch = args.max_batch or (1 << 10)
-        xs_warm = rng.integers(0, 256, (max_batch, nb), dtype=np.uint8)
-        m = 1
-        while m <= max_batch:
-            for keys in by_owner.values():
-                router.evaluate(keys[0], xs_warm[:m], b=0, timeout=300)
-                router.evaluate(keys[0], xs_warm[:m], b=1, timeout=300)
-            m *= 2
+        _pod_warmup(rng, nb, max_batch,
+                    [(router, [keys[0] for keys in by_owner.values()])])
         log("routed parity + warmup ladder done")
 
         router.start_health()
@@ -4146,6 +4180,512 @@ def bench_pod_churn(args) -> None:
             shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_pod_surge(args) -> None:
+    """``pod_bench --surge`` (ISSUE 16): the demand-driven autoscaling
+    acceptance scenario — a Zipf-skewed open-loop RAMP schedule drives
+    the pod into sustained pressure, the ``CapacityController`` admits
+    a ``serve_host --standby`` process through the graceful join
+    within the reaction bound, the post-surge idle window drains the
+    least-loaded host back to standby, and a scripted oscillating-load
+    leg (the ``capacity.decide`` seam) is pinned to ZERO ring churn.
+
+    Phases:
+
+    1. **provision + spawn** — durable keys ring-placed into the
+       ``--shards`` ring stores; ``--standby-hosts`` extra
+       ``serve_host --standby`` processes come up provisioned-but-idle
+       (no restore: the join's warm-before-admit ships keys when — if
+       — demand admits them); every shard takes a SMALL admission
+       bound (``--max-queued-points``, default 4096 here) so overload
+       becomes visible demand within the bench window;
+    2. **surge** — a seeded open-loop ramp (``ramp up -> hold at
+       ~4x the calibrated closed-loop capacity -> fall quiet``) with
+       Zipf key skew and a deadline on every request, while the main
+       thread pumps the capacity controller on the injectable-clock
+       tick (the deterministic driving mode — the same controller the
+       ``start()`` worker would tick);
+    3. **scale-out** — sustained pressure (queue fraction / brownout /
+       shed deltas, aggregated via the metrics-rollup path) must admit
+       a standby host within ``--reaction-bound`` seconds of the ramp
+       start: epoch-fenced join, warm-before-admit;
+    4. **scale-in** — the post-surge idle streak must drain the
+       least-loaded host back to the standby pool (durable migration,
+       deferred forget) once the cooldown clears;
+    5. **oscillation** — a seam handler forces
+       pressure/idle/pressure/idle... verdicts inside the hysteresis
+       windows: the ring epoch must not move and zero scaling events
+       may commit (the flap-damping acceptance).
+
+    Emitted-then-asserted gates: scale-out within the reaction bound,
+    scale-in committed (ring back to ``--shards``, standby pool
+    refilled), zero lost keys, zero generation regressions across
+    every observed digest, post-shrink two-party parity vs the numpy
+    oracle on EVERY key, zero CRITICAL sheds across the pod rollup,
+    strictly-increasing epochs across the scaling events, and the
+    oscillation leg's zero-churn pin."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    from dcf_tpu.backends.numpy_backend import eval_batch_np
+    from dcf_tpu.errors import DcfError
+    from dcf_tpu.ops.prg import HirosePrgNp
+    from dcf_tpu.serve import (
+        CapacityController,
+        DcfRouter,
+        KeyStore,
+        MembershipController,
+        ShardSpec,
+    )
+    from dcf_tpu.serve.capacity import IDLE, PRESSURE, ForcedVerdict
+    from dcf_tpu.serve.health import UP
+    from dcf_tpu.serve.loadgen import closed_loop, open_loop_ramp
+    from dcf_tpu.serve.metrics import labeled
+    from dcf_tpu.testing import faults
+
+    n_shards = args.shards
+    if n_shards < 2:
+        raise SystemExit(
+            f"--surge needs --shards >= 2 (scale-in must leave a "
+            f"replicated ring), got {n_shards}")
+    if args.standby_hosts < 1:
+        raise SystemExit(
+            f"--standby-hosts must be >= 1 (a surge with nothing to "
+            f"admit gates nothing), got {args.standby_hosts}")
+    if args.probe_interval <= 0:
+        raise SystemExit(
+            f"--probe-interval must be > 0, got {args.probe_interval}")
+    if args.reaction_bound <= 0:
+        raise SystemExit(
+            f"--reaction-bound must be > 0, got {args.reaction_bound}")
+    dcf, lam, nb, backend, rng = _serve_host_facade(args)
+    prg = HirosePrgNp(lam, dcf.cipher_keys)
+    n_bundles = args.bundles or 4
+    n_standby = args.standby_hosts
+    max_batch = args.max_batch or (1 << 10)
+    min_req = args.min_req_points or (max_batch * 3 // 8)
+    max_req = args.max_req_points or (max_batch // 2)
+    if not 1 <= min_req <= max_req:
+        raise SystemExit(
+            f"bad request-size range [{min_req}, {max_req}]")
+    qbound = args.max_queued_points or 4096
+    skew = _parse_skew(args.skew) or 1.0
+
+    keep_dirs = bool(args.store_dir)
+    root = args.store_dir or tempfile.mkdtemp(prefix="dcf-pod-")
+    os.makedirs(root, exist_ok=True)
+    shard_ids = [f"shard-{i}" for i in range(n_shards)]
+    standby_ids = [f"standby-{i}" for i in range(n_standby)]
+    ring, stores, bundles, gens = _pod_provision(
+        dcf, lam, nb, rng, root, shard_ids, n_bundles)
+    for tag in standby_ids:
+        # Provisioned-but-empty: the graceful join's warm-before-admit
+        # migration fills it IF demand ever admits the host.
+        stores[tag] = KeyStore(os.path.join(root, tag))
+    procs: dict = {}
+    router = None
+    mc = None
+    cap = None
+    try:
+        qflags = ["--max-queued-points", str(qbound)]
+        for tag in shard_ids:
+            procs[tag] = _pod_spawn(tag, os.path.join(root, tag),
+                                    root, args, extra=qflags)
+        for tag in standby_ids:
+            procs[tag] = _pod_spawn(tag, os.path.join(root, tag),
+                                    root, args, standby=True,
+                                    extra=qflags)
+        ready = _pod_wait_ready(procs)
+        for tag in standby_ids:
+            if not ready[tag].get("standby") \
+                    or ready[tag].get("restored"):
+                raise SystemExit(
+                    f"pod_bench: {tag} did not come up as an empty "
+                    f"standby host ({ready[tag]})")
+        pod_specs = [ShardSpec(s, ready[s]["host"], ready[s]["port"])
+                     for s in shard_ids]
+        addr_of = {s: (ready[s]["host"], ready[s]["port"])
+                   for s in [*shard_ids, *standby_ids]}
+        # Condemnation-tolerant prober: the surge INTENDS to starve
+        # the shards, and a shard walked DOWN mid-overload both kills
+        # the demand signal (the router refuses its traffic) and trips
+        # the eject_inflight rail — the scenario under test is
+        # capacity, not death detection (that's --churn).
+        router = DcfRouter(
+            pod_specs, n_bytes=nb,
+            probe_interval_s=args.probe_interval,
+            probe_timeout_s=10.0, probe_fail_n=6, probe_recover_m=1,
+            reconnect_backoff_s=0.02,
+            max_backoff_s=max(min(args.probe_interval, 0.5), 0.02))
+        mc = MembershipController(
+            router, stores=stores,
+            eject_grace_s=float(args.eject_grace),
+            drain_grace_s=0.5, min_hosts=2,
+            poll_interval_s=min(args.probe_interval, 0.25))
+        tick = max(args.probe_interval, 0.25)
+        cap = CapacityController(
+            router, mc,
+            standby=[(ShardSpec(t, ready[t]["host"], ready[t]["port"]),
+                      stores[t]) for t in standby_ids],
+            interval_s=tick, scale_out_n=2, scale_in_m=3,
+            cooldown_s=max(2 * tick, 1.0),
+            min_hosts=n_shards, max_hosts=n_shards + n_standby,
+            queue_pressure_fraction=0.5, queue_idle_fraction=0.05)
+        log(f"pod up: ring={n_shards} standby={n_standby} "
+            f"queue-bound={qbound} pts; capacity tick={tick:g}s "
+            f"n={cap.scale_out_n} m={cap.scale_in_m} "
+            f"cooldown={cap.cooldown_s:g}s")
+
+        # Parity gate + warmup ladder (the surge must measure the
+        # controller, not the XLA compile storm).
+        xs_gate = rng.integers(0, 256, (64, nb), dtype=np.uint8)
+        for name, kb in bundles.items():
+            got = router.evaluate(name, xs_gate, b=0, timeout=300) ^ \
+                router.evaluate(name, xs_gate, b=1, timeout=300)
+            want = eval_batch_np(prg, 0, kb.for_party(0), xs_gate) ^ \
+                eval_batch_np(prg, 1, kb.for_party(1), xs_gate)
+            if not np.array_equal(got, want):
+                raise SystemExit(
+                    f"pod_bench parity mismatch vs numpy oracle on "
+                    f"{name}")
+        by_owner: dict = {}
+        for name in bundles:
+            by_owner.setdefault(ring.owner(name).host_id,
+                                []).append(name)
+        _pod_warmup(rng, nb, max_batch,
+                    [(router, [keys[0] for keys in by_owner.values()])])
+        router.start_health()
+        deadline = time.monotonic() + 60
+        while any(st != UP for st in router.health.states().values()):
+            if time.monotonic() > deadline:
+                raise SystemExit(
+                    f"pod_bench: prober never saw the pod UP "
+                    f"({router.health.states()})")
+            time.sleep(0.05)
+        log("routed parity + warmup ladder done; prober UP")
+
+        # Calibrate the pod's closed-loop capacity, then shape the
+        # surge: ramp to 1.5x, hold at 4x (sustained pressure against
+        # the small admission bound), fall quiet.
+        cal = closed_loop(router, sorted(bundles),
+                          duration_s=2.0, concurrency=args.concurrency,
+                          min_points=min_req, max_points=max_req,
+                          seed=args.seed + 3)
+        base_rps = max(cal.requests_ok / max(cal.duration_s, 1e-9),
+                       2.0)
+        ramp_s = max(float(args.duration) / 5, 3.0)
+        hold_s = max(float(args.duration) / 2, 6.0)
+        segments = [(ramp_s, 1.5 * base_rps), (hold_s, 2.5 * base_rps),
+                    (max(float(args.duration) / 6, 2.0), 0.0)]
+        log(f"calibrated {base_rps:,.1f} req/s closed-loop; surge "
+            f"schedule {[(round(d, 1), round(r, 1)) for d, r in segments]}")
+
+        ramp_res: dict = {}
+
+        def offer() -> None:
+            # NORMAL/BATCH carry the overload: a CRITICAL request is
+            # only ever shed when the queue holds too few lower-class
+            # points to evict, so the zero-CRITICAL-shed gate is
+            # exercised by the dedicated heartbeat stream below, not
+            # by drowning CRITICAL in its own flood.
+            ramp_res["res"] = open_loop_ramp(
+                router, sorted(bundles), segments=segments,
+                min_points=min_req, max_points=max_req,
+                seed=args.seed + 17, skew=skew, deadline_ms=2000.0,
+                priority_mix={"normal": 0.65, "batch": 0.35})
+
+        ramp_thread = threading.Thread(target=offer, daemon=True,
+                                       name="surge-ramp")
+        cap_events: list = []
+        hb_keys = sorted(bundles)
+        hb_rng = np.random.default_rng(args.seed + 29)
+        hb_futs: list = []
+        hb_i = 0
+        hb_refused_hinted = hb_refused_unhinted = 0
+        t_surge0 = time.monotonic()
+        ramp_thread.start()
+        t_out = t_in = None
+        # The elastic cycle: pump the controller (and the membership
+        # poller) on the tick until the surge scaled out, the idle
+        # window scaled back in, and the drain grace completed.
+        cycle_deadline = t_surge0 + sum(d for d, _r in segments) + 240
+        while time.monotonic() < cycle_deadline:
+            cap.pump()
+            mc.pump()
+            cap_events += cap.events()
+            if ramp_thread.is_alive():
+                # The CRITICAL heartbeat: one small two-party session
+                # per tick MUST ride out the surge — eviction clears
+                # lower-class room for it, never the other way around.
+                name = hb_keys[hb_i % len(hb_keys)]
+                hb_i += 1
+                xs_hb = hb_rng.integers(0, 256, (8, nb),
+                                        dtype=np.uint8)
+                try:
+                    f0 = router.submit(name, xs_hb, b=0,
+                                       priority="critical")
+                    f1 = router.submit(name, xs_hb, b=1,
+                                       priority="critical")
+                    hb_futs.append((name, xs_hb, f0, f1))
+                except DcfError as e:
+                    if getattr(e, "retry_after_s", None) is not None:
+                        hb_refused_hinted += 1
+                    else:
+                        hb_refused_unhinted += 1
+            now = time.monotonic()
+            if t_out is None and any(e.kind == "scale-out"
+                                     for e in cap_events):
+                t_out = now
+                log(f"scale-out committed {now - t_surge0:,.1f}s into "
+                    f"the surge (ring={router.map.host_ids()})")
+            if t_in is None and any(e.kind == "scale-in"
+                                    for e in cap_events):
+                t_in = now
+                log(f"scale-in committed {now - t_surge0:,.1f}s in "
+                    f"(ring={router.map.host_ids()})")
+            if t_out is not None and t_in is not None \
+                    and not ramp_thread.is_alive() \
+                    and not mc.draining():
+                break
+            time.sleep(tick)
+        ramp_thread.join()
+        res = ramp_res.get("res")
+        if res is None:
+            raise SystemExit("pod_bench: the surge schedule never "
+                             "completed")
+        cap_events += cap.events()
+        reaction_s = (t_out - t_surge0) if t_out is not None else None
+        drained_ids = {e.host_id for e in cap_events
+                       if e.kind == "scale-in"}
+
+        failures: list = []
+        # Collect the CRITICAL heartbeats: every accepted one must
+        # complete bit-exact vs the oracle, or fail typed WITH a hint
+        # (a membership swap mid-flight is a hinted refusal, not a
+        # loss); anything untyped fails the gate.
+        hb_ok = hb_unaccounted = 0
+        for name, xs_hb, f0, f1 in hb_futs:
+            try:
+                got = f0.result(120) ^ f1.result(120)
+            except DcfError as e:
+                if getattr(e, "retry_after_s", None) is not None:
+                    hb_refused_hinted += 1
+                else:
+                    hb_refused_unhinted += 1
+                continue
+            except Exception:  # fallback-ok: the gate's failure arm —
+                # an untyped CRITICAL loss is what the stream hunts
+                hb_unaccounted += 1
+                continue
+            kb = bundles[name]
+            want = eval_batch_np(prg, 0, kb.for_party(0), xs_hb) ^ \
+                eval_batch_np(prg, 1, kb.for_party(1), xs_hb)
+            if np.array_equal(got, want):
+                hb_ok += 1
+            else:
+                hb_unaccounted += 1
+        # Zero generation regressions + every placed holder serves the
+        # provisioned generation, over the wire DIGEST verb.
+        seen_gens = dict(gens)
+        digest_regressions = 0
+        for host_id in router.map.host_ids():
+            digest = _pod_wire_digest(addr_of[host_id], nb)
+            for k, g in digest.items():
+                if g < seen_gens.get(k, 0):
+                    digest_regressions += 1
+                seen_gens[k] = max(g, seen_gens.get(k, 0))
+            for name in sorted(bundles):
+                if host_id in router.map.placement_ids(
+                        name, replicas=1) \
+                        and digest.get(name) != gens[name]:
+                    failures.append(
+                        f"post-cycle holder {host_id} serves {name!r} "
+                        f"at generation {digest.get(name)} != "
+                        f"provisioned {gens[name]}")
+        lost = mc.lost_keys(exclude=drained_ids)
+
+        # The oscillation leg: scripted pressure/idle flapping inside
+        # the hysteresis windows must produce ZERO ring churn.
+        osc_epoch0 = router.ring_epoch
+        osc_n = {"n": 0}
+
+        def osc(kind, verdict) -> None:
+            osc_n["n"] += 1
+            raise ForcedVerdict(
+                PRESSURE if osc_n["n"] % 2 else IDLE)
+
+        osc_ticks = 4 * max(cap.scale_out_n, cap.scale_in_m)
+        with faults.inject("capacity.decide", handler=osc):
+            for _ in range(osc_ticks):
+                cap.pump()
+        osc_events = cap.events()
+        osc_epoch_moved = router.ring_epoch != osc_epoch0
+
+        # Post-shrink parity: EVERY key, both parties, vs the oracle.
+        xs_post = rng.integers(0, 256, (8, nb), dtype=np.uint8)
+        post_parity = True
+        for name, kb in sorted(bundles.items()):
+            got = router.evaluate(name, xs_post, b=0, timeout=300) ^ \
+                router.evaluate(name, xs_post, b=1, timeout=300)
+            want = eval_batch_np(prg, 0, kb.for_party(0), xs_post) ^ \
+                eval_batch_np(prg, 1, kb.for_party(1), xs_post)
+            if not np.array_equal(got, want):
+                post_parity = False
+                failures.append(
+                    f"{name!r} no longer serves bit-exact after the "
+                    "elastic cycle (lost or rolled back)")
+        metric_files = [procs[t][2] for t in [*shard_ids, *standby_ids]]
+        time.sleep(1.2)
+        roll = _pod_rollup(metric_files)
+        critical_shed = roll.get(labeled(
+            "serve_shed_by_class_total", priority="critical"), 0)
+
+        import jax
+
+        platform = jax.devices()[0].platform
+        rsnap = router.metrics_snapshot()
+        epochs = [e.epoch for e in cap_events]
+        rate = res.points_ok / max(res.duration_s, 1e-9)
+        extra = {
+            "mode": "surge",
+            "shards": n_shards,
+            "standby_hosts": n_standby,
+            "bundles": n_bundles,
+            "max_queued_points": qbound,
+            "skew": skew,
+            "calibrated_rps": round(base_rps, 1),
+            "segments": [[round(d, 2), round(r, 1)]
+                         for d, r in segments],
+            "offered_rps": round(res.offered_rps, 1),
+            "sent": res.sent,
+            "ok": res.ok,
+            "shed": res.shed,
+            "expired": res.expired,
+            "failed": res.failed,
+            "reaction_s": (None if reaction_s is None
+                           else round(reaction_s, 2)),
+            "reaction_bound_s": float(args.reaction_bound),
+            "capacity_events": [[e.kind, e.host_id, e.epoch]
+                                for e in cap_events],
+            "epochs": epochs,
+            "final_ring": router.map.host_ids(),
+            "standby_after": cap.standby(),
+            "osc_ticks": osc_ticks,
+            "osc_events": len(osc_events),
+            "osc_epoch_moved": osc_epoch_moved,
+            "digest_regressions": digest_regressions,
+            "lost_keys": len(lost),
+            "post_shrink_parity": post_parity,
+            "pod_critical_shed": critical_shed,
+            "critical_hb_ok": hb_ok,
+            "critical_hb_refused_hinted": hb_refused_hinted,
+            "critical_hb_refused_unhinted": hb_refused_unhinted,
+            "critical_hb_unaccounted": hb_unaccounted,
+            "capacity_skips": {
+                k.split("reason=", 1)[1].rstrip("}"): v
+                for k, v in rsnap.items()
+                if k.startswith("capacity_skips_total{")},
+            "scale_failures": rsnap.get(
+                "capacity_scale_failures_total", 0),
+            "probe_interval_s": args.probe_interval,
+            "platform": platform,
+            "repro": (f"python -m dcf_tpu.cli pod_bench --surge "
+                      f"--shards {n_shards} "
+                      f"--standby-hosts {n_standby} "
+                      f"--bundles {n_bundles} "
+                      f"--duration {float(args.duration):g} "
+                      f"--seed {args.seed}"),
+        }
+        unit = ("evals/s (open-loop Zipf surge through the pod "
+                "router, party 0)")
+        if platform != "tpu":
+            unit += (" [no TPU this session: XLA-CPU interpret mode, "
+                     "disclosed]")
+        _emit("pod_bench", backend, "evals_per_sec", rate, unit,
+              extra_fields=extra)
+
+        # Emitted-then-asserted, chaos_bench style.
+        if t_out is None:
+            failures.append(
+                "sustained pressure never admitted a standby host "
+                f"(skips={extra['capacity_skips']})")
+        elif reaction_s > float(args.reaction_bound):
+            failures.append(
+                f"scale-out took {reaction_s:.1f}s from the ramp "
+                f"start (> the {float(args.reaction_bound):g}s "
+                "reaction bound)")
+        if t_in is None:
+            failures.append(
+                "the post-surge idle window never drained a host "
+                "back to standby")
+        if len(router.map) != n_shards:
+            failures.append(
+                f"the ring ended at {len(router.map)} hosts, not the "
+                f"{n_shards} it started with")
+        if len(cap.standby()) != n_standby:
+            failures.append(
+                f"the standby pool ended at {cap.standby()}, not "
+                f"{n_standby} host(s)")
+        if any(b <= a for a, b in zip(epochs, epochs[1:])):
+            failures.append(
+                f"scaling epochs not strictly increasing: {epochs}")
+        if lost:
+            failures.append(f"keys lost across the cycle: {lost}")
+        if digest_regressions:
+            failures.append(
+                f"{digest_regressions} generation regressions across "
+                "the cycle")
+        if critical_shed:
+            failures.append(
+                f"{critical_shed} CRITICAL sheds across the pod "
+                "(CRITICAL must ride out a surge)")
+        if hb_ok < 1 or hb_unaccounted or hb_refused_unhinted:
+            failures.append(
+                f"CRITICAL heartbeat stream not clean through the "
+                f"surge: {hb_ok} bit-exact, {hb_unaccounted} "
+                f"unaccounted, {hb_refused_unhinted} refusals without "
+                "retry_after_s")
+        if osc_events or osc_epoch_moved:
+            failures.append(
+                f"the oscillating-load leg moved the ring: "
+                f"{len(osc_events)} events, epoch_moved="
+                f"{osc_epoch_moved} (flap damping failed)")
+        if res.sent < 10:
+            failures.append(
+                f"the surge offered only {res.sent} requests (the "
+                "schedule never stressed the pod)")
+        if failures:
+            raise SystemExit("pod_bench: " + "; ".join(failures))
+    finally:
+        if cap is not None:
+            try:
+                cap.close()
+            except Exception:  # fallback-ok: best-effort teardown
+                pass
+        if mc is not None:
+            try:
+                mc.close()
+            except Exception:  # fallback-ok: best-effort teardown
+                pass
+        if router is not None:
+            try:
+                router.close()
+            except Exception:  # fallback-ok: best-effort teardown
+                pass
+        for tag, (proc, _r, _m) in procs.items():
+            if proc.poll() is None:
+                proc.terminate()
+        for tag, (proc, _r, _m) in procs.items():
+            try:
+                proc.wait(15)
+            except Exception:  # fallback-ok: a shard that ignores
+                # SIGTERM gets the hard kill below
+                proc.kill()
+        if not keep_dirs:
+            shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_pod(args) -> None:
     """The pod-scale serving acceptance bench (ISSUE 13): N localhost
     shard PROCESSES behind the zero-copy DCFE router, vs the same
@@ -4201,7 +4741,18 @@ def bench_pod(args) -> None:
     ISSUE 15: ``--churn`` runs the autonomous-membership scenario
     instead (``bench_pod_churn``) — kill -> auto-eject ->
     re-replication verified -> heal -> graceful re-join, plus a drain
-    leg and the stale-epoch fence."""
+    leg and the stale-epoch fence.
+
+    ISSUE 16: ``--surge`` runs the demand-driven autoscaling scenario
+    instead (``bench_pod_surge``) — an open-loop Zipf ramp drives
+    scale-out from a standby pool within the reaction bound, the idle
+    tail drains back, and an oscillating-load leg pins zero churn."""
+    if args.surge:
+        if args.churn or args.partition or args.flap:
+            raise SystemExit(
+                "--surge and --churn/--partition/--flap are separate "
+                "scenarios; pick one")
+        return bench_pod_surge(args)
     if args.churn:
         if args.partition or args.flap:
             raise SystemExit(
@@ -4218,7 +4769,7 @@ def bench_pod(args) -> None:
 
     from dcf_tpu.backends.numpy_backend import eval_batch_np
     from dcf_tpu.ops.prg import HirosePrgNp
-    from dcf_tpu.serve import DcfRouter, KeyStore, ShardMap, ShardSpec
+    from dcf_tpu.serve import DcfRouter, ShardSpec
     from dcf_tpu.serve.loadgen import (
         closed_loop,
         open_loop,
@@ -4251,29 +4802,12 @@ def bench_pod(args) -> None:
     root = args.store_dir or tempfile.mkdtemp(prefix="dcf-pod-")
     os.makedirs(root, exist_ok=True)
     shard_ids = [f"shard-{i}" for i in range(n_shards)]
-    ring = ShardMap([ShardSpec(s) for s in shard_ids])
 
-    # Leg 1: provision.  Owner's store gets the durable put; the
-    # replica's copy goes through KeyStore.replicate_to (the pod
-    # replication primitive — same bytes, same generation); the solo
-    # store holds everything.
-    stores = {s: KeyStore(os.path.join(root, s)) for s in shard_ids}
-    stores["solo"] = KeyStore(os.path.join(root, "solo"))
-    bundles, gens, owners = {}, {}, {}
-    for i in range(n_bundles):
-        name = f"key-{i}"
-        alphas = rng.integers(0, 256, (1, nb), dtype=np.uint8)
-        betas = rng.integers(0, 256, (1, lam), dtype=np.uint8)
-        kb = dcf.gen(alphas, betas, rng=rng)
-        bundles[name] = kb
-        gens[name] = i + 1
-        placed = ring.placement(name, replicas=1)
-        owners[name] = placed[0].host_id
-        stores[placed[0].host_id].put(name, kb, generation=gens[name])
-        for rep in placed[1:]:
-            stores[placed[0].host_id].replicate_to(
-                stores[rep.host_id], name)
-        stores["solo"].put(name, kb, generation=gens[name])
+    # Leg 1: provision (the shared block — ``solo`` adds the
+    # single-shard comparison store holding everything).
+    ring, stores, bundles, gens = _pod_provision(
+        dcf, lam, nb, rng, root, shard_ids, n_bundles, solo=True)
+    owners = {n: ring.owner(n).host_id for n in bundles}
     by_owner: dict = {}
     for name, owner in owners.items():
         by_owner.setdefault(owner, []).append(name)
@@ -4345,21 +4879,10 @@ def bench_pod(args) -> None:
         log(f"routed parity vs numpy oracle: OK ({n_bundles} keys x "
             "128 pts, two-party, pod + solo)")
 
-        # Warm every padded pow-2 batch shape on every process (one
-        # key per shard reaches it; both parties — separate compiles).
-        xs_warm = rng.integers(0, 256, (max_batch, nb), dtype=np.uint8)
-        warm_keys = [names[0] for names in by_owner.values()] + \
-            ["key-0"]
-        m = 1
-        while m <= max_batch:
-            for target, keys in ((router, warm_keys[:-1]),
-                                 (solo, ["key-0"])):
-                for name in keys:
-                    target.evaluate(name, xs_warm[:m], b=0,
-                                    timeout=300)
-                    target.evaluate(name, xs_warm[:m], b=1,
-                                    timeout=300)
-            m *= 2
+        _pod_warmup(rng, nb, max_batch,
+                    [(router, [names[0]
+                               for names in by_owner.values()]),
+                     (solo, ["key-0"])])
         log("warmup ladder done (all shards + solo, both parties)")
         router.start_health()  # the control plane runs from here on
 
@@ -4843,6 +5366,27 @@ def main(argv=None) -> None:
                         "0), and a doctored stale-epoch frame is "
                         "refused E_EPOCH — gates: ledger clean, zero "
                         "generation regressions, zero lost keys")
+    p.add_argument("--surge", action="store_true",
+                   help="pod_bench: the demand-driven autoscaling "
+                        "scenario (ISSUE 16) — an open-loop Zipf ramp "
+                        "holds the pod at ~4x its calibrated capacity "
+                        "against a small admission bound; sustained "
+                        "pressure must admit a --standby host through "
+                        "the graceful join within --reaction-bound "
+                        "seconds, the idle tail must drain one back, "
+                        "and a scripted oscillating-load leg is pinned "
+                        "to ZERO ring churn — gates: zero lost keys, "
+                        "zero generation regressions, post-shrink "
+                        "parity vs the numpy oracle, zero CRITICAL "
+                        "sheds, strictly-increasing epochs")
+    p.add_argument("--standby-hosts", type=int, default=1,
+                   help="pod_bench --surge: provisioned-but-idle "
+                        "serve_host --standby processes declared to "
+                        "the capacity controller's standby pool")
+    p.add_argument("--reaction-bound", type=float, default=30.0,
+                   help="pod_bench --surge: max seconds from the ramp "
+                        "start to the scale-out commit (the "
+                        "autoscaler's reaction-time gate)")
     p.add_argument("--eject-grace", type=float, default=3.0,
                    help="pod_bench --churn: seconds a shard must stay "
                         "DOWN before the membership controller "
@@ -4857,6 +5401,17 @@ def main(argv=None) -> None:
     p.add_argument("--port", type=int, default=0,
                    help="serve_host: edge port (0 = pick a free one; "
                         "the bound port lands in --ready-file)")
+    p.add_argument("--standby", action="store_true",
+                   help="serve_host: come up provisioned but EMPTY — "
+                        "skip the store restore and wait; the "
+                        "capacity controller's graceful join ships "
+                        "keys warm-before-admit if demand ever admits "
+                        "this host (pod_bench --surge spawns these)")
+    p.add_argument("--max-queued-points", type=int, default=0,
+                   help="serve_host: admission-queue bound in points "
+                        "(0 = the ServeConfig default; pod_bench "
+                        "--surge pins a small bound so overload "
+                        "becomes visible demand within the window)")
     p.add_argument("--ready-file", default="",
                    help="serve_host: write a JSON {host, port, pid, "
                         "restored} line here (atomic rename) once "

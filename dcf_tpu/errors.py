@@ -25,6 +25,7 @@ grouping it with geometry staleness is what lets callers write one
       +-- KeyQuarantinedError    (RuntimeError) durable frame corrupt: set aside
       +-- BatchTimeoutError      (TimeoutError) batch overran its wall deadline
       +-- RingEpochError         (RuntimeError) frame fenced: sender's ring is stale
+      +-- StandbyExhaustedError  (RuntimeError) scale-out wanted, standby pool empty
 
 The serve-layer classes belong to the online serving layer
 (``dcf_tpu.serve``):
@@ -43,7 +44,12 @@ with ``KeyQuarantinedError`` — one damaged key must never be silently
 skipped NOR take the other restored keys down with it; and the
 hung-batch watchdog fails a dispatched batch that overran its
 configured wall deadline with ``BatchTimeoutError``, feeding the same
-breaker/retry machinery a plain failure would.
+breaker/retry machinery a plain failure would.  The capacity
+controller (``serve.capacity``, ISSUE 16) refuses an explicit
+scale-out when its declared standby pool is empty with
+``StandbyExhaustedError`` — the automatic loop merely counts the skip,
+but an operator-invoked ``scale_out()`` must fail typed, naming the
+exhausted pool, instead of silently doing nothing.
 
 Recovery is signalled, not silent: whenever the framework degrades to a
 slower-but-correct path (auto backend fallback, AES-NI -> portable native
@@ -66,6 +72,7 @@ __all__ = [
     "KeyQuarantinedError",
     "BatchTimeoutError",
     "RingEpochError",
+    "StandbyExhaustedError",
     "BackendFallbackWarning",
 ]
 
@@ -204,6 +211,17 @@ class RingEpochError(DcfError, RuntimeError):
     def __init__(self, *args, retry_after_s: float | None = None):
         super().__init__(*args)
         self.retry_after_s = retry_after_s
+
+
+class StandbyExhaustedError(DcfError, RuntimeError):
+    """An explicit scale-out (``CapacityController.scale_out``) found
+    the declared standby pool empty: there is no host to admit
+    (ISSUE 16, ``serve.capacity``).  The AUTOMATIC scaling loop never
+    raises this — it counts the skip
+    (``capacity_skips_total{reason=no_standby}``) and keeps watching —
+    but an operator asking for capacity that does not exist must get a
+    typed refusal, not a silent no-op.  Recovery is declaring more
+    standby hosts (``add_standby``), or draining elsewhere first."""
 
 
 class BackendFallbackWarning(UserWarning):

@@ -49,6 +49,14 @@ keys":
   warm-before-admit join, three-phase drain for planned decommission,
   and the monotonic ring-epoch fence (``RingEpochError``/``E_EPOCH``)
   that structurally refuses routers on a stale membership view;
+- ``serve.capacity``  demand-driven autoscaling (ISSUE 16): a
+  capacity controller aggregating per-shard load samples (piggybacked
+  on the health probes) through the metrics-rollup path into typed
+  pressure verdicts, with fail-N/recover-M hysteresis and a hard
+  cooldown lifted to scaling decisions — sustained pressure admits a
+  standby host through the graceful join, sustained idleness drains
+  the least-loaded host back to standby, oscillation produces zero
+  ring churn;
 - ``serve.router``    the pod routing tier (ISSUE 13): a DCFE-on-
   both-sides router forwarding frames header-decode-only (payload
   relayed as a memoryview through pooled ``EdgeClient``s) with
@@ -80,6 +88,11 @@ from dcf_tpu.serve.edge import (  # noqa: F401
     EdgeClientPool,
     EdgeServer,
 )
+from dcf_tpu.serve.capacity import (  # noqa: F401
+    CapacityController,
+    CapacityEvent,
+    CapacityVerdict,
+)
 from dcf_tpu.serve.frontier_cache import FrontierCache  # noqa: F401
 from dcf_tpu.serve.health import (  # noqa: F401
     HealthEvent,
@@ -100,8 +113,10 @@ from dcf_tpu.serve.store import KeyStore, RestoreReport  # noqa: F401
 
 __all__ = ["DcfService", "ServeConfig", "ServeFuture", "Priority",
            "TenantSpec", "EdgeServer", "EdgeClient", "EdgeClientPool",
-           "BreakerBoard", "DcfRouter", "FrontierCache", "HealthEvent",
-           "HealthProber", "KeyFactory", "Metrics", "KeyRegistry",
-           "KeyStore", "MembershipController", "MembershipEvent",
-           "PoolSpec", "Replicator", "RestoreReport",
-           "ShardMap", "ShardSpec", "rollup_snapshots"]
+           "BreakerBoard", "CapacityController", "CapacityEvent",
+           "CapacityVerdict", "DcfRouter", "FrontierCache",
+           "HealthEvent", "HealthProber", "KeyFactory", "Metrics",
+           "KeyRegistry", "KeyStore", "MembershipController",
+           "MembershipEvent", "PoolSpec", "Replicator",
+           "RestoreReport", "ShardMap", "ShardSpec",
+           "rollup_snapshots"]

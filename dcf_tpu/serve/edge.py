@@ -122,6 +122,17 @@ SHARE/ERROR the protocol carries four lightweight control verbs —
   DOWN and promote replicas against a host that is serving CRITICAL
   traffic fine.  PONG doubles as the generic ack (its ``value`` field
   carries the registration generation for REGISTER).
+
+  Load piggyback (ISSUE 16, ``serve.capacity``): a PING may append a
+  one-byte flags field with bit 0 set (``want_load``), asking the
+  responder to append a fixed ``LoadSample`` block to its PONG —
+  queue points vs bound, the brownout latch, and the cumulative
+  shed / tenant-refusal / key-factory-pool-miss counters, the demand
+  signals the capacity controller aggregates.  Both extensions are
+  version-gated by SIZE: a load-free v2 PING/PONG keeps its exact
+  legacy length and parses unchanged (old shards keep probing clean),
+  and a responder without a load surface simply answers with the base
+  PONG — the sampler reads "no sample", never an error.
 * **REGISTER** (type 6): a DCFK frame forwarded by reference —
   ``(key_id, generation, proto flag, frame bytes)``.  ``generation=0``
   asks the receiver to MINT one (the owner-side registration);
@@ -182,6 +193,7 @@ import ssl
 import struct
 import threading
 import zlib
+from collections import namedtuple
 
 import numpy as np
 
@@ -208,11 +220,11 @@ from dcf_tpu.testing.faults import fire
 from dcf_tpu.utils.benchtime import monotonic
 
 __all__ = ["EdgeServer", "EdgeClient", "EdgeClientPool", "TokenBucket",
-           "WIRE_CODES", "MAGIC", "VERSION", "T_REQUEST", "T_SHARE",
-           "T_ERROR", "T_PING", "T_PONG", "T_REGISTER", "T_DIGEST",
-           "T_SYNC", "encode_request", "encode_error", "encode_ping",
-           "encode_pong", "encode_register", "encode_digest",
-           "encode_sync"]
+           "LoadSample", "WIRE_CODES", "MAGIC", "VERSION", "T_REQUEST",
+           "T_SHARE", "T_ERROR", "T_PING", "T_PONG", "T_REGISTER",
+           "T_DIGEST", "T_SYNC", "encode_request", "encode_error",
+           "encode_ping", "encode_pong", "encode_register",
+           "encode_digest", "encode_sync"]
 
 MAGIC = b"DCFE"
 VERSION = 2  # v2 (ISSUE 15): REQUEST/PING/REGISTER carry a ring epoch
@@ -233,7 +245,22 @@ _REQ_HEAD = struct.Struct("<QBBdIHBBI")  # ..., tenant_len, key_len, epoch
 _RES_HEAD = struct.Struct("<QHIH")
 _ERR_HEAD = struct.Struct("<QHdH")
 _PING_HEAD = struct.Struct("<QI")    # req_id, ring epoch (0 = unfenced)
+_PING_FLAGS = struct.Struct("<B")    # optional: bit 0 = want_load
 _PONG_HEAD = struct.Struct("<QQ")    # req_id, value
+_PONG_LOAD = struct.Struct("<QQBQQQ")  # optional LoadSample block:
+#   queue_points, queue_limit, brownout (u8 bool), shed_total,
+#   refusals_total, pool_misses — appended only when the PING asked
+#   (want_load) AND the responder has a load surface; version-gated
+#   by size, so a load-free v2 PONG parses unchanged
+
+# One shard's demand signals, sampled off a PING/PONG round trip
+# (ISSUE 16): the capacity controller's per-shard input.  Counters
+# are CUMULATIVE (the controller differences consecutive samples);
+# ``queue_limit`` is the shard's configured queue-points bound, so
+# ``queue_points / queue_limit`` is its queue fraction.
+LoadSample = namedtuple("LoadSample", [
+    "queue_points", "queue_limit", "brownout", "shed_total",
+    "refusals_total", "pool_misses"])
 _REG_HEAD = struct.Struct("<QQIBB")  # req_id, generation, epoch, proto,
 #                                      key_len
 _DIG_HEAD = struct.Struct("<QBI")    # req_id, mode, entry count
@@ -434,26 +461,41 @@ def encode_error(req_id: int, code: int, message: str,
     return _frame([head, mb])
 
 
-def encode_ping(req_id: int, epoch: int = 0) -> bytes:
+def encode_ping(req_id: int, epoch: int = 0,
+                want_load: bool = False) -> bytes:
     """One PING frame (ISSUE 14: the health prober's liveness probe).
     ``epoch`` (ISSUE 15): the prober's ring epoch — probes DISSEMINATE
     membership epochs, so shards converge on a committed epoch within
-    about one probe interval; 0 = unfenced liveness only."""
+    about one probe interval; 0 = unfenced liveness only.
+    ``want_load`` (ISSUE 16): ask the responder to append its
+    ``LoadSample`` to the PONG — encoded as a trailing flags byte, so
+    a load-free ping keeps the exact legacy frame size."""
     if epoch < 0:
         raise ShapeError(f"ring epoch must be >= 0, got {epoch}")
     head = MAGIC + _FRAME_HEAD.pack(VERSION, T_PING) + _PING_HEAD.pack(
         req_id, int(epoch))
-    return _frame([head])
+    parts = [head]
+    if want_load:
+        parts.append(_PING_FLAGS.pack(1))
+    return _frame(parts)
 
 
-def encode_pong(req_id: int, value: int = 0) -> bytes:
+def encode_pong(req_id: int, value: int = 0, load=None) -> bytes:
     """PING/REGISTER ack; ``value`` echoes the registration generation
     (for REGISTER) or the receiver's current ring epoch (for PING —
     how the membership benches verify epoch convergence over the
-    wire)."""
+    wire).  ``load`` (ISSUE 16): a ``LoadSample`` (or 6-tuple) to
+    append — only a PING that asked (``want_load``) gets one; None
+    keeps the exact legacy frame size."""
     head = MAGIC + _FRAME_HEAD.pack(VERSION, T_PONG) + _PONG_HEAD.pack(
         req_id, value)
-    return _frame([head])
+    parts = [head]
+    if load is not None:
+        qp, ql, bo, shed, refused, misses = load
+        parts.append(_PONG_LOAD.pack(
+            int(qp), int(ql), 1 if bo else 0, int(shed), int(refused),
+            int(misses)))
+    return _frame(parts)
 
 
 def encode_register(req_id: int, key_id: str, frame, generation: int = 0,
@@ -589,19 +631,30 @@ def decode_request(body) -> dict:
 
 
 def decode_ping(body) -> tuple:
-    """Strict PING decode -> ``(req_id, epoch)`` (epoch 0 = unfenced
-    liveness only)."""
+    """Strict PING decode -> ``(req_id, epoch, want_load)`` (epoch 0 =
+    unfenced liveness only).  Exactly TWO sizes are legal: the legacy
+    load-free frame and the one-flags-byte extension (ISSUE 16) —
+    anything else dies typed like every other mangled frame."""
     view = _check_body(body, "a ping")
     _, ftype = _FRAME_HEAD.unpack_from(view, 4)
     if ftype != T_PING:
         raise KeyFormatError(f"frame type {ftype} is not a ping")
-    if view.nbytes != _BODY_MIN + _PING_HEAD.size + _CRC.size:
+    base = _BODY_MIN + _PING_HEAD.size + _CRC.size
+    if view.nbytes not in (base, base + _PING_FLAGS.size):
         raise KeyFormatError(
-            f"ping frame must be exactly "
-            f"{_BODY_MIN + _PING_HEAD.size + _CRC.size} bytes, "
+            f"ping frame must be exactly {base} bytes (or "
+            f"{base + _PING_FLAGS.size} with the load-request flags), "
             f"got {view.nbytes}")
     req_id, epoch = _PING_HEAD.unpack_from(view, _BODY_MIN)
-    return req_id, epoch
+    want_load = False
+    if view.nbytes == base + _PING_FLAGS.size:
+        (flags,) = _PING_FLAGS.unpack_from(
+            view, _BODY_MIN + _PING_HEAD.size)
+        if flags & ~1:
+            raise KeyFormatError(
+                f"ping flags {flags:#x} set reserved bits")
+        want_load = bool(flags & 1)
+    return req_id, epoch, want_load
 
 
 def decode_register(body) -> dict:
@@ -741,13 +794,23 @@ def decode_response(body) -> tuple:
         return ("error", req_id, code,
                 retry if retry >= 0 else None, msg)
     if ftype == T_PONG:
-        if view.nbytes != _BODY_MIN + _PONG_HEAD.size + _CRC.size:
+        base = _BODY_MIN + _PONG_HEAD.size + _CRC.size
+        if view.nbytes not in (base, base + _PONG_LOAD.size):
             raise KeyFormatError(
-                f"pong frame must be exactly "
-                f"{_BODY_MIN + _PONG_HEAD.size + _CRC.size} bytes, "
+                f"pong frame must be exactly {base} bytes (or "
+                f"{base + _PONG_LOAD.size} with the load block), "
                 f"got {view.nbytes}")
         req_id, value = _PONG_HEAD.unpack_from(view, _BODY_MIN)
-        return ("pong", req_id, value)
+        if view.nbytes == base:
+            return ("pong", req_id, value)
+        qp, ql, bo, shed, refused, misses = _PONG_LOAD.unpack_from(
+            view, _BODY_MIN + _PONG_HEAD.size)
+        if bo > 1:
+            raise KeyFormatError(
+                f"pong brownout byte must be 0 or 1, got {bo}")
+        return ("pong", req_id,
+                (value, LoadSample(qp, ql, bool(bo), shed, refused,
+                                   misses)))
     if ftype == T_SYNC:
         if view.nbytes < _BODY_MIN + _SYNC_HEAD.size + _CRC.size:
             raise KeyFormatError("truncated frame: no sync header")
@@ -1004,7 +1067,7 @@ class _Conn:
         if ftype == T_REQUEST:
             self._handle_request(body)
         elif ftype == T_PING:
-            req_id, epoch = decode_ping(body)
+            req_id, epoch, want_load = decode_ping(body)
             srv = self._srv
             srv._c_control.inc()
             # Admission-free by design: liveness, not serving capacity
@@ -1024,7 +1087,17 @@ class _Conn:
                     req_id, _code_for(e), str(e),
                     getattr(e, "retry_after_s", None)))
                 return
-            self._enqueue(("ctl", encode_pong(req_id, current)))
+            load = None
+            report = getattr(srv._service, "load_report", None)
+            if want_load and callable(report):
+                try:
+                    load = report()
+                except Exception:  # fallback-ok: the probe is
+                    # LIVENESS first — a load surface failing must
+                    # degrade to "no sample", never an unanswered ping
+                    load = None
+            self._enqueue(("ctl", encode_pong(req_id, current,
+                                              load=load)))
         elif ftype == T_REGISTER:
             self._handle_register(body)
         elif ftype == T_DIGEST:
@@ -1769,6 +1842,21 @@ class EdgeClient:
         return int(self._roundtrip(
             lambda rid: encode_ping(rid, epoch), timeout))
 
+    def ping_load(self, timeout: float | None = None,
+                  epoch: int = 0) -> tuple:
+        """PING asking for the peer's demand signals (ISSUE 16:
+        ``want_load``).  Returns ``(peer_epoch, LoadSample | None)`` —
+        None when the peer has no load surface (an older shard, or a
+        router front): the probe itself still succeeded.  Failure
+        modes are ``ping``'s."""
+        out = self._roundtrip(
+            lambda rid: encode_ping(rid, epoch, want_load=True),
+            timeout)
+        if isinstance(out, tuple):
+            value, load = out
+            return int(value), load
+        return int(out), None
+
     def register_frame(self, key_id: str, frame, generation: int = 0,
                        proto: bool = False,
                        timeout: float | None = None,
@@ -2047,6 +2135,12 @@ class EdgeClientPool:
     def ping_epoch(self, timeout: float | None = None,
                    epoch: int = 0) -> int:
         return self._lease().ping_epoch(timeout, epoch=epoch)
+
+    def ping_load(self, timeout: float | None = None,
+                  epoch: int = 0) -> tuple:
+        """``EdgeClient.ping_load`` through a leased connection — the
+        health prober's load-sampling probe (ISSUE 16)."""
+        return self._lease().ping_load(timeout, epoch=epoch)
 
     def register_frame(self, key_id: str, frame, generation: int = 0,
                        proto: bool = False,

@@ -55,6 +55,17 @@ Cardinality: ``remove_target`` (ring membership churn) forgets the
 host's state AND its labeled metric series — the ``BreakerBoard.forget``
 discipline applied to the health plane, so host churn cannot grow the
 snapshot without limit.
+
+Load sampling (ISSUE 16, ``serve.capacity``): a target that exposes
+``ping_load`` (the router's ``EdgeClientPool`` does) is probed with it
+instead of ``ping`` — the SAME round trip, now also carrying the
+shard's ``edge.LoadSample`` back (queue points vs bound, brownout,
+cumulative shed/refusal/pool-miss counters).  The freshest sample per
+host is readable via ``loads()`` / ``load(host_id)``, the capacity
+controller's input.  Gated exactly like the epoch kwarg: a scripted
+test target without ``ping_load`` keeps its one-argument ``ping``
+signature and simply yields no sample — liveness never depends on the
+load surface.
 """
 
 from __future__ import annotations
@@ -150,6 +161,10 @@ class HealthProber:
         self._pump_lock = threading.Lock()  # one probe round at a time
         self._targets = dict(targets)
         self._hosts = {hid: _HostHealth() for hid in self._targets}
+        # Freshest per-host LoadSample off the probe round trip
+        # (ISSUE 16): None = probed but no load surface; absent =
+        # never successfully probed (or removed).
+        self._loads: dict = {}
         self._events: list[HealthEvent] = []
         self._stop = threading.Event()
         self._worker: threading.Thread | None = None
@@ -189,6 +204,20 @@ class HealthProber:
             out, self._events = self._events, []
             return out
 
+    def loads(self) -> dict:
+        """Freshest ``{host_id: LoadSample | None}`` sampled off the
+        probe round trips (ISSUE 16).  None = the host answered but
+        has no load surface; a host that never answered a load probe
+        is absent.  A snapshot copy — safe to iterate while probing."""
+        with self._lock:
+            return dict(self._loads)
+
+    def load(self, host_id: str):
+        """The freshest ``LoadSample`` for one host (None if absent
+        or load-free)."""
+        with self._lock:
+            return self._loads.get(host_id)
+
     # -- membership (ISSUE 14 satellite: bounded cardinality) ---------
 
     def add_target(self, host_id: str, target) -> None:
@@ -206,6 +235,7 @@ class HealthProber:
         with self._lock:
             self._targets.pop(host_id, None)
             self._hosts.pop(host_id, None)
+            self._loads.pop(host_id, None)
         for name in ("router_health_state", "router_probes_total",
                      "router_probe_failures_total"):
             self._metrics.remove(labeled(name, shard=host_id))
@@ -223,12 +253,25 @@ class HealthProber:
             for host_id, target in targets:
                 self._metrics.counter(labeled(
                     "router_probes_total", shard=host_id)).inc()
+                sampler = getattr(target, "ping_load", None)
+                kwargs = {"timeout": self.timeout_s}
+                if self._epoch_source is not None:
+                    kwargs["epoch"] = int(self._epoch_source())
                 try:
-                    if self._epoch_source is not None:
-                        ok = bool(target.ping(
-                            timeout=self.timeout_s,
-                            epoch=int(self._epoch_source())))
+                    if callable(sampler):
+                        # One round trip, two facts: liveness AND the
+                        # shard's demand signals (ISSUE 16) — never a
+                        # second probe protocol.
+                        _, sample = sampler(**kwargs)
+                        with self._lock:
+                            if host_id in self._hosts:
+                                self._loads[host_id] = sample
+                        ok = True
+                    elif self._epoch_source is not None:
+                        ok = bool(target.ping(**kwargs))
                     else:
+                        # Scripted test targets keep their one-argument
+                        # signature (no epoch kwarg, no load surface).
                         ok = bool(target.ping(timeout=self.timeout_s))
                 except Exception:  # fallback-ok: ANY probe failure
                     # (transport death, dark-target backoff, timeout)

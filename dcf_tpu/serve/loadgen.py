@@ -32,6 +32,19 @@ stays clean under the dcflint determinism pass; it is the one
 measurement harness allowed to loop on the clock, and the loop bound is
 wall duration by design.
 
+``open_loop_ramp`` (ISSUE 16) generalizes the open-loop mode to a
+piecewise offered-rate SCHEDULE: ordered ``(duration_s, rate_rps)``
+segments driven by the same single seeded arrival process, so a surge
+is a first-class load shape — ramp up, hold, fall idle — instead of
+three stitched runs whose seams hide the transient.  A zero-rate
+segment offers nothing but still holds the schedule (the cool-down
+the autoscaler's scale-in hysteresis watches).  Latency stays
+anchored to each request's scheduled arrival across segment
+boundaries — the coordinated-omission discipline does not bend at
+the seams, which is exactly where a saturating ramp would otherwise
+hide its queueing delay.  ``open_loop`` is the one-segment special
+case and delegates.
+
 ``session_churn`` (ISSUE 11) is the fresh-key-per-session variant:
 each client registers a fresh key from a key-factory pool, evaluates
 one request for both parties, and unregisters — the provisioning-bound
@@ -51,7 +64,7 @@ from dcf_tpu.utils.benchtime import monotonic
 
 __all__ = ["LoadgenResult", "closed_loop", "ChurnResult",
            "session_churn", "OpenLoopResult", "open_loop",
-           "reconcile_against_rollup"]
+           "open_loop_ramp", "reconcile_against_rollup"]
 
 
 @dataclass
@@ -342,6 +355,10 @@ class OpenLoopResult:
     points_ok: int = 0
     latencies_s: list = field(default_factory=list)
     by_class: dict = field(default_factory=dict)
+    #: The offered-rate schedule (ISSUE 16): ``[(duration_s,
+    #: rate_rps), ...]`` — one entry for a plain ``open_loop`` run;
+    #: ``offered_rps`` is its duration-weighted mean.
+    offered_segments: list = field(default_factory=list)
 
     def _count(self, priority: str, outcome: str) -> None:
         cls = self.by_class.setdefault(
@@ -437,12 +454,52 @@ def open_loop(service, key_ids, *, rate_rps: float, duration_s: float,
     service converts queue delay into typed ``DeadlineExceededError``
     expiries, which the result counts separately from failures."""
     import math
-    import queue as _queue
 
     if not rate_rps > 0 or not math.isfinite(rate_rps):
         # api-edge: loadgen config contract at the harness edge
         raise ValueError(
             f"rate_rps must be finite and > 0, got {rate_rps}")
+    return open_loop_ramp(
+        service, key_ids, segments=[(duration_s, rate_rps)],
+        min_points=min_points, max_points=max_points, seed=seed,
+        party=party, clock=clock, priority_mix=priority_mix,
+        skew=skew, deadline_ms=deadline_ms, collectors=collectors)
+
+
+def open_loop_ramp(service, key_ids, *, segments, min_points: int,
+                   max_points: int, seed: int = 2026, party: int = 0,
+                   clock=monotonic, priority_mix: dict | None = None,
+                   skew: float = 0.0, deadline_ms: float | None = None,
+                   collectors: int = 4) -> OpenLoopResult:
+    """Offer a piecewise schedule of Poisson arrivals (ISSUE 16):
+    ``segments`` is an ordered list of ``(duration_s, rate_rps)``
+    pairs, played back-to-back by ONE seeded arrival process — the
+    surge shape (``ramp up -> hold -> fall idle``) as a single run, so
+    the transient at each boundary lands in the same
+    coordinated-omission-free latency population instead of being
+    split across stitched runs.  ``rate_rps`` may be 0: a quiet
+    segment offers nothing but still holds the schedule (the
+    autoscaler's idle window).  A draw that lands past its segment's
+    end is clamped to the boundary and the next segment's rate takes
+    over there (seeded-deterministic, like everything else here).
+    Everything not named ``segments`` behaves exactly as in
+    ``open_loop`` — which is the one-segment special case of this."""
+    import math
+    import queue as _queue
+
+    segs = [(float(d), float(r)) for d, r in segments]
+    if not segs:
+        # api-edge: loadgen config contract at the harness edge
+        raise ValueError("segments must be non-empty")
+    for d, r in segs:
+        if not (d > 0 and math.isfinite(d)) \
+                or not (r >= 0 and math.isfinite(r)):
+            # api-edge: loadgen config contract at the harness edge —
+            # a zero-duration or negative-rate segment is a schedule
+            # typo, not a load shape
+            raise ValueError(
+                f"each segment needs duration > 0 and rate >= 0, "
+                f"got ({d}, {r})")
     if min_points < 1 or min_points > max_points:
         # api-edge: loadgen config contract at the harness edge
         raise ValueError(
@@ -474,7 +531,10 @@ def open_loop(service, key_ids, *, rate_rps: float, duration_s: float,
 
     nb = _n_bytes_of(service)
     rng = np.random.default_rng(seed)
-    res = OpenLoopResult(duration_s=0.0, offered_rps=float(rate_rps))
+    total_s = sum(d for d, _r in segs)
+    mean_rps = sum(d * r for d, r in segs) / total_s
+    res = OpenLoopResult(duration_s=0.0, offered_rps=mean_rps,
+                         offered_segments=segs)
     lock = threading.Lock()
     out_q: _queue.Queue = _queue.Queue()
     pool = [threading.Thread(target=_open_collector,
@@ -488,43 +548,55 @@ def open_loop(service, key_ids, *, rate_rps: float, duration_s: float,
     # schedule check ends the loop.
     sleeper = threading.Event()
     t0 = clock()
-    t_next = t0
+    seg_end = t0
     # The scheduler loops on the clock by design: the arrival SCHEDULE
     # is the load definition, and latency is measured from it.
-    while True:
-        t_next += float(rng.exponential(1.0 / rate_rps))
-        if t_next - t0 >= duration_s:
-            break
-        wait = t_next - clock()
-        if wait > 0:
-            sleeper.wait(wait)
-        m = int(rng.integers(min_points, max_points + 1))
-        if key_probs is None:
-            key_id = key_ids[int(rng.integers(0, len(key_ids)))]
-        else:
-            key_id = key_ids[int(rng.choice(len(key_ids), p=key_probs))]
-        pr = priorities[int(rng.choice(len(priorities), p=weights))]
-        xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
-        try:
-            fut = service.submit(key_id, xs, b=party,
-                                 deadline_ms=deadline_ms, priority=pr)
-        except QueueFullError as e:
-            with lock:
-                res.shed += 1
-                if getattr(e, "retry_after_s", None) is not None:
-                    res.shed_hinted += 1
-                res._count(pr, "shed")
+    for seg_s, rate_rps in segs:
+        t_next = seg_end  # a draw past the boundary was clamped here
+        seg_end = seg_end + seg_s
+        if rate_rps == 0:
+            # Quiet segment: offer nothing, hold the schedule.
+            wait = seg_end - clock()
+            if wait > 0:
+                sleeper.wait(wait)
             continue
-        except Exception:  # fallback-ok: the scheduler must survive
-            # ANY submit-time failure (e.g. a hot-swapped key) — a
-            # dead scheduler silently truncates the offered load.
+        while True:
+            t_next += float(rng.exponential(1.0 / rate_rps))
+            if t_next >= seg_end:
+                break
+            wait = t_next - clock()
+            if wait > 0:
+                sleeper.wait(wait)
+            m = int(rng.integers(min_points, max_points + 1))
+            if key_probs is None:
+                key_id = key_ids[int(rng.integers(0, len(key_ids)))]
+            else:
+                key_id = key_ids[int(
+                    rng.choice(len(key_ids), p=key_probs))]
+            pr = priorities[int(rng.choice(len(priorities), p=weights))]
+            xs = rng.integers(0, 256, (m, nb), dtype=np.uint8)
+            try:
+                fut = service.submit(
+                    key_id, xs, b=party,
+                    deadline_ms=deadline_ms, priority=pr)
+            except QueueFullError as e:
+                with lock:
+                    res.shed += 1
+                    if getattr(e, "retry_after_s", None) is not None:
+                        res.shed_hinted += 1
+                    res._count(pr, "shed")
+                continue
+            except Exception:  # fallback-ok: the scheduler must
+                # survive ANY submit-time failure (e.g. a hot-swapped
+                # key) — a dead scheduler silently truncates the
+                # offered load.
+                with lock:
+                    res.failed += 1
+                    res._count(pr, "failed")
+                continue
             with lock:
-                res.failed += 1
-                res._count(pr, "failed")
-            continue
-        with lock:
-            res.sent += 1
-        out_q.put((fut, t_next, m, pr))
+                res.sent += 1
+            out_q.put((fut, t_next, m, pr))
     # Drain: every accepted future completes (the service's contract),
     # so the collectors empty the queue and exit on their sentinels.
     for _ in pool:

@@ -78,6 +78,17 @@ deterministic mode, on the injectable clock.  Every committed change
 is a typed ``MembershipEvent`` and a metrics write
 (``membership_*`` series — see ``serve.metrics``).
 
+Capacity delegation (ISSUE 16, ``serve.capacity``): the demand-driven
+``CapacityController`` scales the ring through the SAME two verbs —
+``join`` for scale-out (warm-before-admit), ``drain`` for scale-in
+(durable migration) — so autoscaling inherits every fence and safety
+rule above instead of growing a second reconfiguration path.  Its
+rails read this controller's state: ``eject_in_flight`` reports
+whether the health plane is mid-failure (a DOWN ring member, or an
+eject grace already running) so a scaling change never races a health
+eject, and ``store_for`` hands back a drained host's recorded store
+so the host can return to the standby pool intact.
+
 Secret hygiene: migrations move whole DCFK frames (key material) —
 this module logs names, hosts, epochs and counts only, and the frame
 buffers stay inside the edge-client calls.
@@ -220,6 +231,28 @@ class MembershipController:
         grace has not elapsed yet (``pump`` completes them)."""
         with self._state_lock:
             return dict(self._draining)
+
+    def eject_in_flight(self) -> bool:
+        """True while the health plane is mid-failure: some ring
+        member is DOWN, or an eject grace is already being tracked
+        (ISSUE 16 safety rail — the capacity controller must never
+        commit a scaling change concurrent with a health-driven eject;
+        two changes racing would each compute a ring that forgets the
+        other's, and a surge verdict during an outage is promotion
+        noise, not demand)."""
+        ring_ids = set(self._router.map.host_ids())
+        states = self._router.health.states()
+        if any(st == DOWN and h in ring_ids
+               for h, st in states.items()):
+            return True
+        with self._state_lock:
+            return any(h in ring_ids for h in self._down_since)
+
+    def store_for(self, host_id: str):
+        """The ``KeyStore`` recorded for ``host_id`` (None if never
+        provisioned here) — how the capacity controller returns a
+        drained host to the standby pool with its store attached."""
+        return self._stores.get(host_id)
 
     # -- the control loop ---------------------------------------------
 

@@ -97,6 +97,23 @@ ring is stale), shard-side ``serve_ring_epoch`` (observed maximum) /
 store's ``serve_store_replicate_retries_total`` counts
 ``replicate_to``'s transient-``OSError`` retries.
 
+Capacity series (ISSUE 16, recorded by ``serve.capacity``):
+``capacity_ticks_total`` (control ticks) split into
+``capacity_pressure_ticks_total`` / ``capacity_idle_ticks_total``
+(verdicts — steady is the remainder), ``capacity_scale_out_total`` /
+``capacity_scale_in_total`` (committed membership changes, which also
+bump the ISSUE 15 join/drain series — the controller delegates),
+``capacity_scale_failures_total`` (aborted changes, retried on a later
+streak), ``capacity_forced_verdicts_total`` (the ``capacity.decide``
+seam overriding a tick), and ``capacity_skips_total{reason=...}`` —
+``cooldown`` / ``eject_inflight`` / ``min_hosts`` / ``max_hosts`` /
+``no_standby`` / ``no_sample`` / ``frozen`` — every tick a scaling
+decision was due but a safety rail said no; gauges:
+``capacity_standby_hosts``, ``capacity_pressure_streak`` /
+``capacity_idle_streak`` (the hysteresis positions), and the last
+tick's aggregated ``capacity_queue_fraction`` /
+``capacity_brownout_fraction``.
+
 Secret hygiene: metric NAMES are static strings and metric values are
 scalars; key ids chosen by callers become label values via ``labeled``
 and must never be derived from key material (the dcflint secret-hygiene

@@ -367,6 +367,16 @@ class DcfRouter:
         self.health.start()
         return self
 
+    def loads(self) -> dict:
+        """The freshest per-shard ``edge.LoadSample`` by host id, as
+        sampled off the prober's PING/PONG round trips (ISSUE 16).
+        ``None`` means the shard answers probes but exposes no load
+        surface (a pre-16 shard); absent means it never answered.
+        The demand feed the capacity controller
+        (``serve.capacity``) aggregates — exposed here so operators
+        read pod load where they already read pod health."""
+        return self.health.loads()
+
     def suspect_remaining(self, host_id: str) -> float:
         """Seconds of suspicion left for ``host_id`` (0 = trusted).
         The REQUEST-signal cooldown only; the prober's states are read

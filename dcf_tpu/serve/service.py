@@ -1217,3 +1217,29 @@ class DcfService:
     def metrics_snapshot(self) -> dict:
         """Deterministic point-in-time metrics dict (see serve.metrics)."""
         return self.metrics.snapshot()
+
+    def load_report(self):
+        """This shard's demand signals as one ``edge.LoadSample``
+        (ISSUE 16): the capacity controller's per-shard input, served
+        over the PING/PONG round trip (a ``want_load`` probe's PONG
+        appends it — see ``serve.edge``).  Queue points and the
+        brownout latch are instantaneous; the shed / tenant-refusal /
+        key-factory-pool-miss fields are the CUMULATIVE counters (the
+        controller differences consecutive samples).  Cheap by design:
+        reads three existing instruments, never snapshots."""
+        from dcf_tpu.serve.edge import LoadSample
+
+        # Refresh the brownout gate first: on a FULLY quiet service the
+        # worker sits in its condvar wait and never pumps, so the latch
+        # set during a surge would otherwise read "browned out" forever
+        # — and the autoscaler could never see idle to scale back in.
+        self._update_brownout(self._clock())
+        m = self.metrics
+        return LoadSample(
+            queue_points=self.queue.points,
+            queue_limit=self.config.max_queued_points,
+            brownout=self.queue.brownout,
+            shed_total=m.counter("serve_shed_total").value,
+            refusals_total=m.counter("edge_refused_total").value,
+            pool_misses=m.counter(
+                "keyfactory_pool_misses_total").value)

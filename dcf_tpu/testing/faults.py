@@ -154,6 +154,20 @@ POINTS = (
     #                     the ring stays on its last committed epoch,
     #                     and the controller retries on a later pump —
     #                     never a half-migrated commit)
+    "capacity.decide",  # capacity-controller verdict seam
+    #                     (serve/capacity.py — fires once per control
+    #                     tick, after the signals are aggregated and
+    #                     the verdict computed but before the
+    #                     hysteresis/scaling act on it; handler args:
+    #                     verdict kind ("pressure"/"idle"/"steady"),
+    #                     the typed CapacityVerdict.  A handler raising
+    #                     ``capacity.ForcedVerdict(kind)`` FORCES that
+    #                     kind for the tick — how the surge bench's
+    #                     oscillation leg scripts load walks without
+    #                     timing games; ANY OTHER raise FREEZES the
+    #                     tick: no streak advance, no scaling, counted
+    #                     capacity_skips_total{reason=frozen} — the
+    #                     operator's emergency brake)
     "net.partition",    # pod network partition (serve/edge.py — fires
     #                     before each EdgeClient dial and each frame
     #                     send on a TAGGED client (the pod router tags

@@ -1,0 +1,686 @@
+"""dcf_tpu.serve.capacity: demand-driven autoscaling (ISSUE 16).
+
+Covers the capacity controller's whole decision surface on stub
+router/membership pairs driven by the injectable clock — verdict
+aggregation (queue/brownout fractions via the metrics-rollup path,
+cumulative-counter deltas with the restart clamp), the lifted
+fail-N/recover-M hysteresis, the epoch-observed hard cooldown, every
+counted safety rail, the ``capacity.decide`` seam's forced/frozen
+semantics, the typed operator verbs, and the PONG load-block wire-fuzz
+extension (the ISSUE 15 fuzz discipline applied to the new payload:
+mangled frames die typed, the pristine load-free v2 frames keep
+parsing).  The end-to-end elastic cycle against real processes rides
+``pod_bench --surge`` (see tests/test_cli.py for its fail-fast
+validation and the serial slow leg for the smoke).
+"""
+
+import pathlib
+import struct
+import zlib
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dcf_tpu.errors import KeyFormatError, StandbyExhaustedError
+from dcf_tpu.serve import CapacityController, CapacityEvent, ShardSpec
+from dcf_tpu.serve.capacity import (
+    IDLE,
+    PRESSURE,
+    STEADY,
+    ForcedVerdict,
+)
+from dcf_tpu.serve.edge import (
+    MAGIC,
+    T_PING,
+    T_PONG,
+    VERSION,
+    LoadSample,
+    _CRC,
+    _FRAME_HEAD,
+    _PING_FLAGS,
+    _PING_HEAD,
+    _PONG_HEAD,
+    _PONG_LOAD,
+    decode_ping,
+    decode_response,
+    encode_ping,
+    encode_pong,
+)
+from dcf_tpu.serve.metrics import Metrics, labeled
+from dcf_tpu.serve.shardmap import ShardMap
+from dcf_tpu.testing import faults
+from dcf_tpu.testing.faults import FakeClock
+
+pytestmark = pytest.mark.autoscale
+
+
+# ------------------------------------------------ stub pod plumbing
+
+
+class StubHealth:
+    """The prober surface the controller reads: ``loads()``."""
+
+    def __init__(self):
+        self.samples = {}
+
+    def loads(self):
+        return dict(self.samples)
+
+
+class StubRouter:
+    """The router surface the controller reads: ``map``, ``metrics``,
+    ``ring_epoch``, ``health``, and the injectable clock."""
+
+    def __init__(self, host_ids, clock):
+        self.map = ShardMap([ShardSpec(h) for h in host_ids])
+        self.metrics = Metrics()
+        self.health = StubHealth()
+        self.ring_epoch = 0
+        self._clock = clock
+
+
+class StubMembership:
+    """The membership surface the controller drives: joins and drains
+    commit a new epoch on the router, exactly like the real fences."""
+
+    def __init__(self, router, min_hosts=1):
+        self.router = router
+        self.min_hosts = min_hosts
+        self.joins = []
+        self.drains = []
+        self.stores = {}
+        self.eject = False
+        self.fail_join = False
+
+    def eject_in_flight(self):
+        return self.eject
+
+    def store_for(self, host_id):
+        return self.stores.get(host_id)
+
+    def join(self, spec, store=None):
+        if self.fail_join:
+            raise RuntimeError("injected join failure")
+        self.router.map = self.router.map.with_host(spec)
+        self.router.ring_epoch += 1
+        self.joins.append(spec.host_id)
+        return SimpleNamespace(kind="join", host_id=spec.host_id,
+                               epoch=self.router.ring_epoch)
+
+    def drain(self, host_id):
+        self.router.map = self.router.map.without_host(host_id)
+        self.router.ring_epoch += 1
+        self.drains.append(host_id)
+        return SimpleNamespace(kind="drain", host_id=host_id,
+                               epoch=self.router.ring_epoch)
+
+
+def S(qp=0, ql=100, bo=False, shed=0, refused=0, misses=0):
+    return LoadSample(qp, ql, bo, shed, refused, misses)
+
+
+def make_pod(hosts=("a", "b"), standby=("s1",), **kw):
+    ck = FakeClock()
+    r = StubRouter(hosts, ck)
+    mm = StubMembership(r)
+    kw.setdefault("scale_out_n", 2)
+    kw.setdefault("scale_in_m", 3)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("min_hosts", 1)
+    cap = CapacityController(
+        r, mm, standby=[ShardSpec(s) for s in standby], **kw)
+    return cap, r, mm, ck
+
+
+def tick(cap, ck, loads, dt=1.0):
+    """Set the sampled loads, advance the clock, run one inline
+    control tick."""
+    cap._router.health.samples = loads
+    ck.advance(dt)
+    return cap.pump()
+
+
+def skips(r, reason):
+    return r.metrics.counter(labeled(
+        "capacity_skips_total", reason=reason)).value
+
+
+# ------------------------------------------------ verdict aggregation
+
+
+def test_verdict_pressure_on_pooled_queue_fraction():
+    """The queue signal pools ACROSS shards (rollup summation): one
+    drowning shard next to one empty shard reads as the pod's true
+    fraction, not either extreme."""
+    cap, r, mm, ck = make_pod()
+    v = tick(cap, ck, {"a": S(qp=90), "b": S(qp=80)})
+    assert v.kind == PRESSURE and v.sampled == 2
+    assert v.queue_fraction == pytest.approx(170 / 200)
+    v = tick(cap, ck, {"a": S(qp=90), "b": S(qp=0)})
+    assert v.kind == STEADY  # 90/200 = 0.45 < 0.75: pooled, not max
+    assert r.metrics.counter("capacity_pressure_ticks_total").value == 1
+
+
+def test_verdict_pressure_on_brownout_fraction():
+    cap, r, mm, ck = make_pod()
+    v = tick(cap, ck, {"a": S(bo=True), "b": S()})
+    assert v.kind == PRESSURE
+    assert v.brownout_fraction == pytest.approx(0.5)
+
+
+def test_verdict_idle_steady_bands_and_empty_sample():
+    cap, r, mm, ck = make_pod()
+    assert tick(cap, ck, {"a": S(qp=2), "b": S(qp=2)}).kind == IDLE
+    assert tick(cap, ck, {"a": S(qp=30), "b": S(qp=30)}).kind == STEADY
+    # Brownout anywhere vetoes idle even with an empty queue.
+    assert tick(cap, ck, {"a": S(bo=True, qp=0),
+                          "b": S(qp=0)}).kind == PRESSURE
+    # No evidence is never a scaling reason: nothing sampled -> steady.
+    v = tick(cap, ck, {})
+    assert v.kind == STEADY and v.sampled == 0
+    v = tick(cap, ck, {"a": None, "b": None})  # answered, no surface
+    assert v.kind == STEADY and v.sampled == 0
+
+
+def test_verdict_counter_deltas_first_sample_and_restart_clamp():
+    """Cumulative counters difference against the PREVIOUS tick: a
+    host's first sample contributes zero (pre-existing totals are
+    history), and a counter that went BACKWARD reads as a restart,
+    never as negative demand."""
+    cap, r, mm, ck = make_pod()
+    v = tick(cap, ck, {"a": S(qp=1, shed=500), "b": S(qp=1)})
+    assert v.kind == IDLE and v.shed_delta == 0
+    v = tick(cap, ck, {"a": S(qp=1, shed=501), "b": S(qp=1)})
+    assert v.kind == PRESSURE and v.shed_delta == 1
+    # Shard restart: totals reset below the previous reading.
+    v = tick(cap, ck, {"a": S(qp=1, shed=3), "b": S(qp=1)})
+    assert v.kind == IDLE and v.shed_delta == 0
+    # Refusals and pool misses flag pressure the same way.
+    v = tick(cap, ck, {"a": S(qp=1, shed=3, refused=1), "b": S(qp=1)})
+    assert v.kind == PRESSURE and v.refusal_delta == 1
+    v = tick(cap, ck, {"a": S(qp=1, shed=3, refused=1, misses=2),
+                       "b": S(qp=1)})
+    assert v.kind == PRESSURE and v.pool_miss_delta == 2
+
+
+def test_verdict_ignores_hosts_outside_the_ring():
+    """A stale load sample for a host that already left the ring (or
+    a standby that answered a probe) must not steer scaling."""
+    cap, r, mm, ck = make_pod()
+    v = tick(cap, ck, {"a": S(qp=2), "b": S(qp=2),
+                       "ghost": S(qp=100, bo=True)})
+    assert v.kind == IDLE and v.sampled == 2 and v.ring_size == 2
+
+
+# ------------------------------------------------ hysteresis + cooldown
+
+
+def test_scale_out_only_after_n_consecutive_pressure_ticks():
+    cap, r, mm, ck = make_pod(scale_out_n=3)
+    hot = {"a": S(qp=90), "b": S(qp=90)}
+    calm = {"a": S(qp=30), "b": S(qp=30)}
+    tick(cap, ck, hot)
+    tick(cap, ck, hot)
+    tick(cap, ck, calm)  # streak broken one short of the threshold
+    assert mm.joins == []
+    tick(cap, ck, hot)
+    tick(cap, ck, hot)
+    assert mm.joins == []
+    tick(cap, ck, hot)  # third CONSECUTIVE -> commit
+    assert mm.joins == ["s1"]
+    assert cap.standby() == []
+    (ev,) = cap.events()
+    assert isinstance(ev, CapacityEvent)
+    assert (ev.kind, ev.host_id, ev.epoch) == ("scale-out", "s1", 1)
+    assert cap.events() == []  # events() drains
+    assert r.metrics.counter("capacity_scale_out_total").value == 1
+    assert r.metrics.gauge("capacity_standby_hosts").value == 0
+
+
+def test_scale_in_drains_least_loaded_into_back_of_pool():
+    cap, r, mm, ck = make_pod(hosts=("a", "b", "c"), scale_in_m=2)
+    mm.stores["b"] = store = object()
+    idle = {"a": S(qp=2), "b": S(qp=0), "c": S(qp=3)}
+    tick(cap, ck, idle)
+    assert mm.drains == []
+    tick(cap, ck, idle)
+    assert mm.drains == ["b"]  # smallest sampled queue_points
+    # The drained host queues BEHIND the declared standby, store
+    # attached — a future surge re-admits the coldest host last.
+    assert cap.standby() == ["s1", "b"]
+    assert cap._standby[-1] == (ShardSpec("b"), store)
+    (ev,) = cap.events()
+    assert (ev.kind, ev.host_id, ev.epoch) == ("scale-in", "b", 1)
+    assert r.metrics.counter("capacity_scale_in_total").value == 1
+
+
+def test_flap_damping_oscillating_load_zero_membership_changes():
+    """The flap pin: a load walk oscillating INSIDE the hysteresis
+    windows — however long — produces exactly zero ring churn."""
+    cap, r, mm, ck = make_pod(scale_out_n=2, scale_in_m=2)
+    hot = {"a": S(qp=90), "b": S(qp=90)}
+    calm = {"a": S(qp=1), "b": S(qp=1)}
+    for i in range(40):
+        tick(cap, ck, hot if i % 2 else calm)
+    assert mm.joins == [] and mm.drains == []
+    assert cap.events() == []
+    assert r.ring_epoch == 0
+    assert r.metrics.counter("capacity_ticks_total").value == 40
+
+
+def test_cooldown_two_back_to_back_surges_one_join():
+    """The cooldown pin: a second sustained surge arriving one tick
+    after a committed scale-out waits the cooldown out — exactly one
+    join, the re-surge counted as ``cooldown`` skips."""
+    cap, r, mm, ck = make_pod(standby=("s1", "s2"), scale_out_n=2,
+                              cooldown_s=10.0)
+    hot = {"a": S(qp=90), "b": S(qp=90)}
+    tick(cap, ck, hot)
+    tick(cap, ck, hot)  # surge 1 commits
+    assert mm.joins == ["s1"]
+    for _ in range(5):  # surge 2, one tick later, inside the cooldown
+        tick(cap, ck, hot)
+    assert mm.joins == ["s1"]
+    assert skips(r, "cooldown") >= 1
+    for _ in range(8):  # the clock clears the cooldown; surge holds
+        tick(cap, ck, hot)
+    assert mm.joins == ["s1", "s2"]
+
+
+def test_external_epoch_change_resets_streaks_and_cools_down():
+    """A membership commit the controller did NOT make (a health
+    eject) restarts the cooldown and voids the streak evidence."""
+    cap, r, mm, ck = make_pod(scale_out_n=2, cooldown_s=10.0)
+    hot = {"a": S(qp=90), "b": S(qp=90)}
+    tick(cap, ck, hot)  # streak 1
+    r.ring_epoch += 1   # the health plane ejected someone
+    tick(cap, ck, hot)  # observes the epoch: reset, streak rebuilds to 1
+    tick(cap, ck, hot)  # streak 2 -> threshold, but cooled down
+    assert mm.joins == []
+    assert skips(r, "cooldown") == 1
+    for _ in range(10):
+        tick(cap, ck, hot)
+    assert mm.joins == ["s1"]  # commits once the cooldown clears
+
+
+# ------------------------------------------------ safety rails
+
+
+def test_rail_max_hosts_and_no_standby_counted():
+    cap, r, mm, ck = make_pod(standby=("s1",), scale_out_n=1,
+                              cooldown_s=0.0, max_hosts=2)
+    hot = {"a": S(qp=90), "b": S(qp=90)}
+    tick(cap, ck, hot)
+    assert mm.joins == [] and skips(r, "max_hosts") == 1
+    cap.max_hosts = 4
+    tick(cap, ck, hot)
+    assert mm.joins == ["s1"]
+    tick(cap, ck, hot)  # pool is now empty
+    assert skips(r, "no_standby") == 1
+
+
+def test_rail_eject_inflight_blocks_both_directions():
+    cap, r, mm, ck = make_pod(scale_out_n=1, scale_in_m=1,
+                              cooldown_s=0.0)
+    mm.eject = True
+    tick(cap, ck, {"a": S(qp=90), "b": S(qp=90)})
+    tick(cap, ck, {"a": S(qp=1), "b": S(qp=1)})
+    assert mm.joins == [] and mm.drains == []
+    assert skips(r, "eject_inflight") == 2
+
+
+def test_rail_min_hosts_floors_scale_in():
+    cap, r, mm, ck = make_pod(scale_in_m=1, cooldown_s=0.0,
+                              min_hosts=2)
+    tick(cap, ck, {"a": S(qp=1), "b": S(qp=1)})
+    assert mm.drains == [] and skips(r, "min_hosts") == 1
+
+
+def test_rail_no_sample_blocks_a_blind_drain():
+    """A forced-idle tick with no load samples has no victim to pick
+    — counted, never a guess."""
+    cap, r, mm, ck = make_pod(scale_in_m=1, cooldown_s=0.0)
+
+    def force_idle(kind, verdict):
+        raise ForcedVerdict(IDLE)
+
+    with faults.inject("capacity.decide", handler=force_idle):
+        tick(cap, ck, {})
+    assert mm.drains == [] and skips(r, "no_sample") == 1
+
+
+# ------------------------------------------------ the decide seam
+
+
+def test_forced_verdict_forces_the_tick_and_counts():
+    cap, r, mm, ck = make_pod(scale_out_n=1, cooldown_s=0.0)
+
+    def force(kind, verdict):
+        assert kind == STEADY  # the seam sees the computed verdict
+        raise ForcedVerdict(PRESSURE)
+
+    with faults.inject("capacity.decide", handler=force):
+        v = tick(cap, ck, {"a": S(qp=30), "b": S(qp=30)})
+    assert v.kind == PRESSURE
+    assert mm.joins == ["s1"]  # the forced kind drives real scaling
+    assert r.metrics.counter(
+        "capacity_forced_verdicts_total").value == 1
+
+
+def test_any_other_seam_raise_freezes_the_tick():
+    cap, r, mm, ck = make_pod(scale_out_n=1, cooldown_s=0.0)
+    hot = {"a": S(qp=90), "b": S(qp=90)}
+    with faults.inject("capacity.decide", exc=RuntimeError("brake")):
+        assert tick(cap, ck, hot) is None
+    assert mm.joins == []
+    assert skips(r, "frozen") == 1
+    assert r.metrics.gauge("capacity_pressure_streak").value == 0
+    tick(cap, ck, hot)  # disarmed: the very next tick acts again
+    assert mm.joins == ["s1"]
+
+
+def test_forced_verdict_typo_fails_the_arming_test():
+    with pytest.raises(ValueError, match="verdict kind"):
+        ForcedVerdict("presure")
+
+
+# ------------------------------------------------ operator verbs
+
+
+def test_operator_scale_out_empty_pool_raises_typed():
+    cap, r, mm, ck = make_pod(standby=())
+    with pytest.raises(StandbyExhaustedError, match="standby pool"):
+        cap.scale_out()
+    assert mm.joins == []
+
+
+def test_operator_verbs_bypass_hysteresis_not_fences():
+    cap, r, mm, ck = make_pod(hosts=("a", "b"), cooldown_s=1e9)
+    ev = cap.scale_out()  # no streak, giant cooldown: still commits
+    assert (ev.kind, ev.host_id) == ("scale-out", "s1")
+    ev = cap.scale_in("a")
+    assert (ev.kind, ev.host_id) == ("scale-in", "a")
+    assert cap.standby() == ["a"]  # back of the pool
+    assert [e.kind for e in cap.events()] == ["scale-out", "scale-in"]
+
+
+def test_failed_join_returns_host_to_front_and_counts():
+    cap, r, mm, ck = make_pod(standby=("s1", "s2"), scale_out_n=1,
+                              cooldown_s=0.0)
+    mm.fail_join = True
+    hot = {"a": S(qp=90), "b": S(qp=90)}
+    tick(cap, ck, hot)
+    assert mm.joins == [] and cap.events() == []
+    # FRONT of the pool: the retry admits the same host, keeping the
+    # declared admission order.
+    assert cap.standby() == ["s1", "s2"]
+    assert r.metrics.counter(
+        "capacity_scale_failures_total").value == 1
+    mm.fail_join = False
+    tick(cap, ck, hot)
+    assert mm.joins == ["s1"]
+
+
+# ------------------------------------------------ config contracts
+
+
+@pytest.mark.parametrize("kw", [
+    {"interval_s": 0.0},
+    {"scale_out_n": 0},
+    {"scale_in_m": 0},
+    {"cooldown_s": -1.0},
+    {"brownout_pressure_fraction": 0.0},
+    {"queue_pressure_fraction": 1.5},
+    {"queue_idle_fraction": 0.75},   # == pressure threshold
+    {"min_hosts": 0},
+    {"max_hosts": 1, "min_hosts": 2},
+])
+def test_config_validation_api_edge(kw):
+    ck = FakeClock()
+    r = StubRouter(("a", "b"), ck)
+    with pytest.raises(ValueError):
+        CapacityController(r, StubMembership(r), **kw)
+
+
+def test_standby_entry_declaration_contract():
+    ck = FakeClock()
+    r = StubRouter(("a",), ck)
+    with pytest.raises(ValueError, match="standby entries"):
+        CapacityController(r, StubMembership(r),
+                           standby=[("not-a-spec", None)])
+    cap = CapacityController(r, StubMembership(r), min_hosts=1)
+    cap.add_standby(ShardSpec("late"), store=None)
+    assert cap.standby() == ["late"]
+    assert r.metrics.gauge("capacity_standby_hosts").value == 1
+
+
+# ------------------------------------------------ PONG load wire fuzz
+
+
+def _seal(*parts):
+    """A frame body with a VALID CRC trailer — corruption that beats
+    the checksum, so the tests prove the structural checks too."""
+    body = b"".join(parts)
+    return body + _CRC.pack(zlib.crc32(body))
+
+
+def test_pong_pristine_both_sizes_parse():
+    """The v2 compatibility pin: the legacy load-free PONG keeps its
+    exact frame size and decode; the extended one round-trips the
+    ``LoadSample``."""
+    assert decode_response(encode_pong(7, 5)[4:]) == ("pong", 7, 5)
+    sample = S(qp=17, ql=4096, bo=True, shed=3, refused=2, misses=9)
+    kind, req_id, payload = decode_response(
+        encode_pong(8, 6, load=sample)[4:])
+    assert (kind, req_id) == ("pong", 8)
+    assert payload == (6, sample)
+    assert isinstance(payload[1], LoadSample)
+    # And the request side: want_load is one flags byte, legacy pings
+    # keep the exact legacy size.
+    assert decode_ping(encode_ping(3, 9)[4:]) == (3, 9, False)
+    assert decode_ping(encode_ping(3, 9, want_load=True)[4:]) \
+        == (3, 9, True)
+    assert len(encode_ping(3, 9, want_load=True)) \
+        == len(encode_ping(3, 9)) + _PING_FLAGS.size
+
+
+def test_pong_load_block_byte_flips_die_typed():
+    frame = encode_pong(
+        11, 2, load=S(qp=40, ql=100, shed=5, refused=1, misses=2))
+    body = frame[4:]
+    rng = np.random.default_rng(0x16C)
+    for off in rng.integers(0, len(body), 32):
+        buf = bytearray(body)
+        buf[int(off)] ^= 0x41
+        with pytest.raises(KeyFormatError):
+            decode_response(bytes(buf))
+
+
+def test_pong_load_block_bad_sizes_die_typed_past_the_crc():
+    """Truncated and oversized load blocks WITH a valid CRC still die
+    on the strict two-sizes check — the size gate is load-bearing,
+    not an accident of the checksum."""
+    head = MAGIC + _FRAME_HEAD.pack(VERSION, T_PONG) \
+        + _PONG_HEAD.pack(11, 2)
+    load = _PONG_LOAD.pack(40, 100, 1, 5, 1, 2)
+    for cut in (1, _PONG_LOAD.size // 2, _PONG_LOAD.size - 1):
+        with pytest.raises(KeyFormatError, match="pong frame"):
+            decode_response(_seal(head, load[:cut]))
+    with pytest.raises(KeyFormatError, match="pong frame"):
+        decode_response(_seal(head, load, b"\x00\x00\x00"))
+    with pytest.raises(KeyFormatError, match="pong frame"):
+        decode_response(_seal(head, load, load))
+    # Raw truncations (CRC not recomputed) die typed as well.
+    full = encode_pong(11, 2, load=S(qp=40))[4:]
+    for n in (5, len(full) // 2, len(full) - 1):
+        with pytest.raises(KeyFormatError):
+            decode_response(full[:n])
+
+
+def test_pong_brownout_byte_range_checked():
+    head = MAGIC + _FRAME_HEAD.pack(VERSION, T_PONG) \
+        + _PONG_HEAD.pack(1, 0)
+    bad = _PONG_LOAD.pack(0, 100, 2, 0, 0, 0)  # brownout byte 2
+    with pytest.raises(KeyFormatError, match="brownout byte"):
+        decode_response(_seal(head, bad))
+
+
+def test_ping_reserved_flag_bits_die_typed():
+    head = MAGIC + _FRAME_HEAD.pack(VERSION, T_PING) \
+        + _PING_HEAD.pack(4, 0)
+    for flags in (0x02, 0x80, 0xFF):
+        with pytest.raises(KeyFormatError, match="reserved bits"):
+            decode_ping(_seal(head, _PING_FLAGS.pack(flags)))
+    # A flags byte is only legal at exactly base+1: two flag bytes is
+    # a mangled frame, not a bigger extension.
+    with pytest.raises(KeyFormatError, match="ping frame"):
+        decode_ping(_seal(head, _PING_FLAGS.pack(1),
+                          _PING_FLAGS.pack(1)))
+    with pytest.raises(KeyFormatError):
+        decode_ping(struct.pack("<I", 1 << 30) + b"junk")
+
+
+# ------------------------------------------------ the bench gate
+
+
+def _gate_dir(tmp_path, value, floors):
+    import json
+
+    bdir = tmp_path / "benchmarks"
+    bdir.mkdir()
+    (bdir / "RESULTS_pod.jsonl").write_text(
+        '{"value": 1.0, "note": "older line, not the claim"}\n'
+        + json.dumps({"value": value}) + "\n", encoding="utf-8")
+    fpath = bdir / "FLOORS.json"
+    fpath.write_text(json.dumps(floors), encoding="utf-8")
+    return bdir, fpath
+
+
+def test_bench_gate_passes_then_fails_on_a_doctored_regression(
+        tmp_path):
+    """The gate's reason to exist, pinned both ways: the committed
+    claim holds its floor, and a doctored regressed NEWEST line (the
+    silent walk-back) fails the gate — the older passing line does
+    not mask it."""
+    from tools.bench_gate import main, run_gate
+
+    pin = {"RESULTS_pod.jsonl": {
+        "field": "value", "direction": "at_least", "floor": 100.0,
+        "pinned_value": 143.0, "reason": "pinned by the surge run"}}
+    bdir, fpath = _gate_dir(tmp_path, 143.0, pin)
+    failures, report = run_gate(bdir, fpath)
+    assert failures == []
+    assert main(["--benchmarks", str(bdir), "--floors",
+                 str(fpath)]) == 0
+    # Doctor the newest line below the floor.
+    with open(bdir / "RESULTS_pod.jsonl", "a", encoding="utf-8") as f:
+        f.write('{"value": 12.0}\n')
+    failures, report = run_gate(bdir, fpath)
+    assert len(failures) == 1
+    assert "fell below the pinned floor" in failures[0]
+    assert "pinned by the surge run" in failures[0]  # the why travels
+    assert main(["--benchmarks", str(bdir), "--floors",
+                 str(fpath)]) == 1
+
+
+def test_bench_gate_at_most_ceiling_and_unpinned_skip(tmp_path):
+    from tools.bench_gate import run_gate
+
+    pin = {"_meta": {"doc": "ignored"},
+           "RESULTS_pod.jsonl": {
+               "field": "value", "direction": "at_most",
+               "floor": 200.0, "pinned_value": 143.0,
+               "reason": "latency-style"}}
+    bdir, fpath = _gate_dir(tmp_path, 143.0, pin)
+    (bdir / "RESULTS_new.jsonl").write_text('{"value": 9}\n',
+                                            encoding="utf-8")
+    failures, report = run_gate(bdir, fpath)
+    assert failures == []
+    # The unpinned file is DISCLOSED, never silently dropped.
+    assert any(r.startswith("SKIP RESULTS_new.jsonl") for r in report)
+    with open(bdir / "RESULTS_pod.jsonl", "a", encoding="utf-8") as f:
+        f.write('{"value": 250.0}\n')
+    failures, _ = run_gate(bdir, fpath)
+    assert len(failures) == 1 and "rose above" in failures[0]
+
+
+def test_bench_gate_broken_pins_are_fatal_not_skips(tmp_path):
+    """A floor that can no longer be read is a regression in the gate
+    itself: missing file, corrupt tail, missing field, malformed
+    entry — all exit-1, none reported as a pass."""
+    from tools.bench_gate import run_gate
+
+    pin = {
+        "RESULTS_gone.jsonl": {"field": "value",
+                               "direction": "at_least", "floor": 1.0},
+        "RESULTS_pod.jsonl": {"field": "no_such_field",
+                              "direction": "at_least", "floor": 1.0},
+        "RESULTS_bad.jsonl": {"field": "value",
+                              "direction": "sideways", "floor": 1.0},
+    }
+    bdir, fpath = _gate_dir(tmp_path, 143.0, pin)
+    (bdir / "RESULTS_bad.jsonl").write_text('{"value": 9}\n',
+                                            encoding="utf-8")
+    failures, _ = run_gate(bdir, fpath)
+    assert len(failures) == 3
+
+
+def test_bench_gate_update_requires_reason_and_repins(tmp_path):
+    """``--update`` without ``--reason`` is refused (a floor move
+    without a disclosed why IS the silent walk-back); with one, the
+    floor re-pins at ratio * the current newest value."""
+    import json
+
+    from tools.bench_gate import main
+
+    pin = {"RESULTS_pod.jsonl": {
+        "field": "value", "direction": "at_least", "floor": 1.0,
+        "pinned_value": None, "reason": "skeleton"}}
+    bdir, fpath = _gate_dir(tmp_path, 200.0, pin)
+    args = ["--benchmarks", str(bdir), "--floors", str(fpath)]
+    assert main(args + ["--update"]) == 2
+    assert json.loads(fpath.read_text())[
+        "RESULTS_pod.jsonl"]["floor"] == 1.0  # refused = untouched
+    assert main(args + ["--update", "--ratio", "1.5",
+                        "--reason", "x"]) == 2
+    assert main(args + ["--update", "--reason",
+                        "re-pin after the surge run"]) == 0
+    entry = json.loads(fpath.read_text())["RESULTS_pod.jsonl"]
+    assert entry["floor"] == pytest.approx(140.0)  # 0.7 * 200
+    assert entry["pinned_value"] == 200.0
+    assert entry["reason"] == "re-pin after the surge run"
+    assert main(args) == 0  # and the fresh pin holds
+
+
+def test_bench_gate_repo_floors_hold():
+    """The committed FLOORS.json must be green against the committed
+    RESULTS ledgers — the exact check CI runs."""
+    from tools.bench_gate import run_gate
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    failures, report = run_gate(repo / "benchmarks",
+                                repo / "benchmarks" / "FLOORS.json")
+    assert failures == [], "\n".join(report)
+    # Every pinned entry carries its disclosed why.
+    import json
+
+    floors = json.loads(
+        (repo / "benchmarks" / "FLOORS.json").read_text())
+    for name, entry in floors.items():
+        if not name.startswith("_"):
+            assert entry.get("reason"), f"{name}: floor without a why"
+
+
+# ------------------------------------------------ hygiene
+
+
+def test_capacity_layer_lint_clean():
+    """ISSUE 16: the autoscaling layer holds the repo's own bar —
+    clean under ALL dcflint passes.  Determinism is the load-bearing
+    one: every decision runs on the injectable clock."""
+    from tools.dcflint import run_path
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    assert run_path(repo / "dcf_tpu" / "serve" / "capacity.py") == []
+    assert run_path(repo / "tools" / "bench_gate.py") == []

@@ -565,6 +565,66 @@ def test_cli_pod_bench_churn_smoke(capsys):
     assert recs[0]["migrated_frames"] >= 1
 
 
+@pytest.mark.autoscale
+def test_cli_pod_bench_surge_validates_flags_fast():
+    """ISSUE 16: the surge scenario applies the same fail-fast flag
+    discipline — a solo ring, an empty standby pool, a dead probe
+    cadence, a non-positive reaction bound or a mixed scenario die
+    loudly before any subprocess is spawned."""
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="--shards >= 2"):
+        cli.main(["pod_bench", "--surge", "--shards=1"])
+    with pytest.raises(SystemExit, match="standby-hosts"):
+        cli.main(["pod_bench", "--surge", "--standby-hosts=0"])
+    with pytest.raises(SystemExit, match="probe-interval"):
+        cli.main(["pod_bench", "--surge", "--probe-interval=0"])
+    with pytest.raises(SystemExit, match="reaction-bound"):
+        cli.main(["pod_bench", "--surge", "--reaction-bound=-1"])
+    with pytest.raises(SystemExit, match="separate"):
+        cli.main(["pod_bench", "--surge", "--churn"])
+    with pytest.raises(SystemExit, match="separate"):
+        cli.main(["pod_bench", "--surge", "--flap"])
+
+
+@pytest.mark.slow
+@pytest.mark.autoscale
+def test_cli_pod_bench_surge_smoke(capsys):
+    """ISSUE 16: ``pod_bench --surge`` end to end — a calibrated
+    open-loop Zipf ramp overloads a 2-shard ring, the capacity
+    controller admits the standby host within the reaction bound and
+    drains the least-loaded host on the idle tail, a scripted
+    oscillating-verdict leg pins zero churn, and the harness raises
+    SystemExit unless every gate holds: zero lost keys, zero
+    generation regressions, zero CRITICAL sheds, the heartbeat
+    bit-exact, strictly-increasing epochs, post-shrink parity."""
+    recs = run_cli(
+        capsys,
+        ["pod_bench", "--surge", "--shards=2", "--standby-hosts=1",
+         "--bundles=2", "--duration=8", "--max-batch=256"],
+    )
+    assert recs[0]["bench"] == "pod_bench"
+    assert recs[0]["mode"] == "surge"
+    assert recs[0]["reaction_s"] is not None
+    assert recs[0]["reaction_s"] <= recs[0]["reaction_bound_s"]
+    kinds = [k for k, _h, _e in recs[0]["capacity_events"]]
+    assert kinds.count("scale-out") >= 1
+    assert kinds.count("scale-in") >= 1
+    epochs = recs[0]["epochs"]
+    assert all(b > a for a, b in zip(epochs, epochs[1:]))
+    assert recs[0]["lost_keys"] == 0
+    assert recs[0]["digest_regressions"] == 0
+    assert recs[0]["pod_critical_shed"] == 0
+    assert recs[0]["critical_hb_ok"] >= 1
+    assert recs[0]["critical_hb_refused_unhinted"] == 0
+    assert recs[0]["critical_hb_unaccounted"] == 0
+    assert recs[0]["osc_events"] == 0
+    assert recs[0]["osc_epoch_moved"] is False
+    assert recs[0]["post_shrink_parity"] is True
+    assert len(recs[0]["final_ring"]) == recs[0]["shards"]
+    assert len(recs[0]["standby_after"]) == recs[0]["standby_hosts"]
+
+
 @pytest.mark.slow
 @pytest.mark.selfheal
 def test_cli_pod_bench_partition_smoke(capsys):

@@ -1,0 +1,206 @@
+"""Benchmark regression gate: ``python -m tools.bench_gate`` (ISSUE 16).
+
+The repo's RESULTS files are append-only emit-then-assert ledgers: the
+NEWEST line of each ``benchmarks/RESULTS_*.jsonl`` is the current
+claim.  This gate pins a numeric floor under each claim in
+``benchmarks/FLOORS.json`` and fails CI when a newly committed line
+regresses below it — the per-PR analogue of the PR 3 floor-entry
+discipline (a perf claim you stop measuring is a perf claim you have
+silently walked back).
+
+``FLOORS.json`` maps RESULTS file names to entries::
+
+    {"RESULTS_pod.jsonl": {
+        "field": "value",          # JSON key holding the number
+        "floor": 123.4,            # the pinned bound
+        "direction": "at_least",   # or "at_most" (latency-style)
+        "pinned_value": 176.3,     # the value the floor was cut from
+        "reason": "..."            # WHY this pin (disclosed, audited)
+    }, ...}
+
+Semantics, all disclosed in the report (no silent caps):
+
+* a PINNED file whose newest line violates its floor -> **regression**
+  (exit 1);
+* a pinned file that is missing, empty, or lacks the pinned field ->
+  **broken pin** (exit 1: a floor that can no longer be read is a
+  regression in the gate itself, not a skip);
+* a ``RESULTS_*.jsonl`` with no floor entry -> reported unpinned
+  (exit 0: new benches pin on their first ``--update``);
+* entries under keys starting with ``_`` are metadata, ignored.
+
+``--update`` re-pins every entry from the CURRENT newest lines at
+``--ratio`` (default 0.7: headroom for host noise, same discipline as
+the serve floors) and REQUIRES ``--reason`` — a floor move without a
+disclosed why is exactly the silent walk-back this tool exists to
+prevent.  ``at_most`` entries re-pin at ``1/ratio``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+__all__ = ["newest_line", "check_entry", "run_gate", "update_floors",
+           "main"]
+
+AT_LEAST = "at_least"
+AT_MOST = "at_most"
+
+
+def newest_line(path: pathlib.Path) -> dict | None:
+    """The last non-empty JSON line of ``path`` (the current claim),
+    or None when the file is missing/empty/unparseable."""
+    try:
+        text = path.read_text(encoding="utf-8")
+    except OSError:
+        return None
+    for raw in reversed(text.splitlines()):
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            doc = json.loads(raw)
+        except ValueError:
+            return None  # a corrupt ledger tail is a broken pin
+        return doc if isinstance(doc, dict) else None
+    return None
+
+
+def check_entry(name: str, entry: dict,
+                benchmarks: pathlib.Path) -> tuple[str, str]:
+    """One pin's verdict: returns ``(status, detail)`` with status in
+    ``ok`` / ``regression`` / ``broken``."""
+    field = entry.get("field", "value")
+    floor = entry.get("floor")
+    direction = entry.get("direction", AT_LEAST)
+    if not isinstance(floor, (int, float)) \
+            or direction not in (AT_LEAST, AT_MOST):
+        return "broken", (f"{name}: malformed floor entry "
+                          f"(floor={floor!r}, direction={direction!r})")
+    doc = newest_line(benchmarks / name)
+    if doc is None:
+        return "broken", (f"{name}: pinned but missing/empty/corrupt "
+                          "(a floor that cannot be read is a "
+                          "regression in the gate)")
+    got = doc.get(field)
+    if not isinstance(got, (int, float)):
+        return "broken", (f"{name}: newest line has no numeric "
+                          f"{field!r} (got {got!r})")
+    if direction == AT_LEAST and got < floor:
+        return "regression", (
+            f"{name}: {field}={got:g} fell below the pinned floor "
+            f"{floor:g} (pinned from {entry.get('pinned_value')!r}: "
+            f"{entry.get('reason', 'no reason recorded')})")
+    if direction == AT_MOST and got > floor:
+        return "regression", (
+            f"{name}: {field}={got:g} rose above the pinned ceiling "
+            f"{floor:g} (pinned from {entry.get('pinned_value')!r}: "
+            f"{entry.get('reason', 'no reason recorded')})")
+    bound = "floor" if direction == AT_LEAST else "ceiling"
+    return "ok", f"{name}: {field}={got:g} vs {bound} {floor:g}"
+
+
+def run_gate(benchmarks: pathlib.Path,
+             floors_path: pathlib.Path) -> tuple[list, list]:
+    """Check every pin; returns ``(failures, report_lines)`` —
+    failures non-empty means exit 1."""
+    try:
+        floors = json.loads(floors_path.read_text(encoding="utf-8"))
+    except (OSError, ValueError) as e:
+        return ([f"cannot read {floors_path}: {e}"],
+                [f"FAIL {floors_path}: unreadable"])
+    failures: list = []
+    report: list = []
+    pinned = {k for k in floors if not k.startswith("_")}
+    for name in sorted(pinned):
+        status, detail = check_entry(name, floors[name], benchmarks)
+        report.append(f"{'PASS' if status == 'ok' else 'FAIL'} {detail}")
+        if status != "ok":
+            failures.append(detail)
+    for path in sorted(benchmarks.glob("RESULTS_*.jsonl")):
+        if path.name not in pinned:
+            # Disclosed, not fatal: a brand-new bench pins on its
+            # first --update; hiding it would be a silent cap.
+            report.append(f"SKIP {path.name}: no floor pinned "
+                          "(pin with --update --reason ...)")
+    return failures, report
+
+
+def update_floors(benchmarks: pathlib.Path, floors_path: pathlib.Path,
+                  ratio: float, reason: str) -> list:
+    """Re-pin every entry from the current newest lines; returns the
+    report lines.  Only existing entries move — pinning a NEW file is
+    an editorial act (add the entry skeleton by hand, then --update)."""
+    floors = json.loads(floors_path.read_text(encoding="utf-8"))
+    report = []
+    for name in sorted(k for k in floors if not k.startswith("_")):
+        entry = floors[name]
+        doc = newest_line(benchmarks / name)
+        got = (doc or {}).get(entry.get("field", "value"))
+        if not isinstance(got, (int, float)):
+            report.append(f"SKIP {name}: no current value to pin from")
+            continue
+        if entry.get("direction", AT_LEAST) == AT_MOST:
+            entry["floor"] = round(got / ratio, 4)
+        else:
+            entry["floor"] = round(got * ratio, 4)
+        entry["pinned_value"] = got
+        entry["reason"] = reason
+        report.append(f"PIN  {name}: floor={entry['floor']:g} from "
+                      f"{got:g} ({reason})")
+    floors_path.write_text(json.dumps(floors, indent=2, sort_keys=True)
+                           + "\n", encoding="utf-8")
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.bench_gate",
+        description="Pin and enforce floors under the newest "
+                    "RESULTS_*.jsonl lines (see tools/bench_gate.py).")
+    p.add_argument("--benchmarks", default="benchmarks",
+                   help="directory holding RESULTS_*.jsonl")
+    p.add_argument("--floors", default="benchmarks/FLOORS.json",
+                   help="the pinned-floors file")
+    p.add_argument("--update", action="store_true",
+                   help="re-pin every floor from the current newest "
+                        "lines (requires --reason)")
+    p.add_argument("--ratio", type=float, default=0.7,
+                   help="--update: floor = ratio * current value "
+                        "(ceilings pin at value / ratio)")
+    p.add_argument("--reason", default="",
+                   help="--update: the disclosed WHY for moving the "
+                        "floors (recorded per entry)")
+    args = p.parse_args(argv)
+    benchmarks = pathlib.Path(args.benchmarks)
+    floors_path = pathlib.Path(args.floors)
+    if args.update:
+        if not args.reason.strip():
+            print("error: --update requires --reason (a floor move "
+                  "without a disclosed why is a silent walk-back)",
+                  file=sys.stderr)
+            return 2
+        if not 0 < args.ratio <= 1:
+            print(f"error: --ratio must be in (0, 1], got {args.ratio}",
+                  file=sys.stderr)
+            return 2
+        for line in update_floors(benchmarks, floors_path,
+                                  args.ratio, args.reason):
+            print(line)
+        return 0
+    failures, report = run_gate(benchmarks, floors_path)
+    for line in report:
+        print(line)
+    if failures:
+        print(f"\nbench_gate: {len(failures)} regression(s)",
+              file=sys.stderr)
+        return 1
+    print("\nbench_gate: all pinned floors hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

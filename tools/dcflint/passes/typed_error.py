@@ -10,7 +10,11 @@ it silently erodes the ``except DcfError`` contract.  Allowed raises:
   marker — the documented constructor/argument contract at the public
   API edge, where builtin semantics are what callers expect (the
   taxonomy's ValueError-derived classes cover the rest);
-* ``SystemExit`` in ``cli.py`` (argparse-style usage errors).
+* ``SystemExit`` in ``cli.py`` (argparse-style usage errors);
+* ``ForcedVerdict`` (ISSUE 16) — the ``capacity.decide`` seam's
+  control-flow exception: raised only inside armed fault handlers and
+  consumed by the seam's own except clause, it can never reach an
+  ``except DcfError`` caller.
 
 Scope: all of ``dcf_tpu/`` except ``testing/`` (the fault-injection
 harness raises its own ``InjectedFault`` by design).
@@ -40,8 +44,9 @@ DCF_ERRORS = frozenset({
     "KeyQuarantinedError",
     "BatchTimeoutError",
     "RingEpochError",
+    "StandbyExhaustedError",
 })
-_ALWAYS_OK = DCF_ERRORS | {"NotImplementedError"}
+_ALWAYS_OK = DCF_ERRORS | {"NotImplementedError", "ForcedVerdict"}
 _MARKED_OK = frozenset({"ValueError", "TypeError"})
 
 
