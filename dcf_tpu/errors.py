@@ -26,6 +26,7 @@ grouping it with geometry staleness is what lets callers write one
       +-- BatchTimeoutError      (TimeoutError) batch overran its wall deadline
       +-- RingEpochError         (RuntimeError) frame fenced: sender's ring is stale
       +-- StandbyExhaustedError  (RuntimeError) scale-out wanted, standby pool empty
+      +-- LockOrderError         (RuntimeError) lock acquired against the recorded order
 
 The serve-layer classes belong to the online serving layer
 (``dcf_tpu.serve``):
@@ -73,6 +74,7 @@ __all__ = [
     "BatchTimeoutError",
     "RingEpochError",
     "StandbyExhaustedError",
+    "LockOrderError",
     "BackendFallbackWarning",
 ]
 
@@ -222,6 +224,32 @@ class StandbyExhaustedError(DcfError, RuntimeError):
     but an operator asking for capacity that does not exist must get a
     typed refusal, not a silent no-op.  Recovery is declaring more
     standby hosts (``add_standby``), or draining elsewhere first."""
+
+
+class LockOrderError(DcfError, RuntimeError):
+    """A lock acquisition would close a cycle in the observed
+    lock-order graph (ISSUE 17, ``dcf_tpu.testing.lockwatch``): some
+    thread has taken lock B while holding lock A, and this thread is
+    now taking A while holding B — the classic inversion that only
+    deadlocks under the right interleave, which is exactly why it
+    survives review and testing until production finds the interleave
+    for you.
+
+    Raised by the TSan-lite ``lockwatch`` harness, BEFORE the blocking
+    acquire (the detector fails fast instead of reproducing the hang),
+    and only when the harness is armed — chaos/soak CI legs and the
+    ``lockwatch`` pytest marker; production code never constructs it.
+    Carries ``cycle`` (the lock names along the inversion) and
+    ``stacks`` (where each edge was first observed), so the report
+    names both sides of the deadlock-to-be.  Deliberately has no wire
+    code (``WIRE_INTERNAL_ONLY``): it fires in-process in test
+    harnesses, never at a serving edge."""
+
+    def __init__(self, message: str, *, cycle: tuple = (),
+                 stacks: tuple = ()):
+        super().__init__(message)
+        self.cycle = tuple(cycle)
+        self.stacks = tuple(stacks)
 
 
 class BackendFallbackWarning(UserWarning):

@@ -222,9 +222,13 @@ class AdmissionQueue:
         self.brownout_retry_after_s = brownout_retry_after_s
         self._metrics = metrics if metrics is not None else Metrics()
         self.cond = threading.Condition()
+        # guarded-by: cond
         self._reqs: list[Request] = []
+        # guarded-by: cond
         self._points = 0
+        # guarded-by: cond
         self._closed = False
+        # guarded-by: cond
         self._brownout = False
         self._g_depth = self._metrics.gauge("serve_queue_depth")
         self._g_points = self._metrics.gauge("serve_queue_points")
@@ -253,6 +257,10 @@ class AdmissionQueue:
         policy — sustained pressure with hysteresis; the queue just
         enforces the refusal)."""
         on = bool(on)
+        # dcflint: disable=guarded-by hot-path no-op probe (see below):
+        # a torn/stale read at worst takes or skips the condvar once;
+        # the guarded write below re-checks nothing because same-value
+        # sets are idempotent.
         if self._brownout == on:
             # Hot-path no-op: the service calls this on every submit
             # and pump iteration while pressure holds; don't take the
@@ -265,12 +273,16 @@ class AdmissionQueue:
 
     @property
     def brownout(self) -> bool:
+        # dcflint: disable=guarded-by monitoring snapshot: a single
+        # bool read (atomic under the GIL), advisory by contract —
+        # admission decisions re-read it under the condvar in put()
         return self._brownout
 
     def _shed(self, req: Request) -> None:
         self._c_shed.inc()
         self._c_shed_by[req.priority].inc()
 
+    # holds-lock: cond
     def _pick_victims(self, req: Request) -> list[Request] | None:
         """Queued strictly-lower-class requests whose eviction makes
         ``req`` fit — lowest class first, newest first within a class —
@@ -361,13 +373,21 @@ class AdmissionQueue:
 
     @property
     def closed(self) -> bool:
+        # dcflint: disable=guarded-by monitoring snapshot: one atomic
+        # bool read; the admit path re-checks under the condvar
         return self._closed
 
     def __len__(self) -> int:
+        # dcflint: disable=guarded-by monitoring snapshot: len() of a
+        # list the GIL keeps internally consistent; depth gauges and
+        # tests tolerate one-update staleness by contract
         return len(self._reqs)
 
     @property
     def points(self) -> int:
+        # dcflint: disable=guarded-by monitoring snapshot: one atomic
+        # int read, used for gauges/pressure sampling only — admission
+        # re-reads it under the condvar
         return self._points
 
     def oldest_enq_t(self) -> float | None:
@@ -423,6 +443,7 @@ class AdmissionQueue:
             r.future.set_exception(make_error())
         return len(reqs)
 
+    # holds-lock: cond
     def _sync_gauges(self) -> None:
         self._g_depth.set(len(self._reqs))
         self._g_points.set(self._points)

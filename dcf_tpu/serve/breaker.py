@@ -175,7 +175,9 @@ class BreakerBoard:
         self._metrics = metrics if metrics is not None else Metrics()
         self._clock = clock
         self._lock = threading.Lock()
+        # guarded-by: _lock
         self._breakers: dict[tuple[str, str], CircuitBreaker] = {}
+        # guarded-by: _lock
         self._open = 0
         self._g_open = self._metrics.gauge("serve_breakers_open")
         self._c_transitions = self._metrics.counter(
@@ -183,6 +185,7 @@ class BreakerBoard:
 
     # -- internals (call under self._lock) ----------------------------------
 
+    # holds-lock: _lock
     def _get(self, key_id: str, family: str) -> CircuitBreaker:
         br = self._breakers.get((key_id, family))
         if br is None:
@@ -190,6 +193,7 @@ class BreakerBoard:
             self._breakers[(key_id, family)] = br
         return br
 
+    # holds-lock: _lock
     def _sync(self, key_id: str, family: str, br: CircuitBreaker,
               before: str) -> None:
         if br.state == before:

@@ -231,16 +231,26 @@ class CapacityController:
         self.queue_idle_fraction = float(queue_idle_fraction)
         self._clock = clock if clock is not None else router._clock
         self._max_events = int(max_events)
-        self._lock = threading.Lock()       # standby/streak/event state
+        self._lock = threading.Lock()       # standby/event state
         self._pump_lock = threading.Lock()  # one control tick at a time
+        # guarded-by: _lock
         self._standby: list = [self._standby_entry(e) for e in standby]
+        # Tick-cursor state: written only by the (serialized) control
+        # tick, so the pump lock IS its guard.
+        # guarded-by: _pump_lock
         self._prev_totals: dict = {}  # host -> (shed, refused, misses)
+        # guarded-by: _pump_lock
         self._last_loads: dict = {}
+        # guarded-by: _pump_lock
         self._pressure_streak = 0
+        # guarded-by: _pump_lock
         self._idle_streak = 0
+        # guarded-by: _pump_lock
         self._last_epoch = router.ring_epoch
+        # guarded-by: _pump_lock
         self._cooldown_until = 0.0
         self.last_verdict: CapacityVerdict | None = None
+        # guarded-by: _lock
         self._events: list[CapacityEvent] = []
         self._stop = threading.Event()
         self._worker: threading.Thread | None = None
@@ -311,6 +321,7 @@ class CapacityController:
 
     # -- the control tick ---------------------------------------------
 
+    # holds-lock: _pump_lock
     def _assess(self, now: float) -> CapacityVerdict:
         """Aggregate the freshest per-shard samples into one verdict
         (the metrics-rollup path: per-shard mini-snapshots summed by
@@ -425,6 +436,7 @@ class CapacityController:
 
     # -- scaling ------------------------------------------------------
 
+    # holds-lock: _pump_lock
     def _rails(self, now: float) -> str | None:
         """The shared rails, in announcement order; returns the
         counted skip reason or None (clear to scale)."""
@@ -434,19 +446,30 @@ class CapacityController:
             return "eject_inflight"
         return None
 
+    # holds-lock: _pump_lock
     def _maybe_scale_out(self, now: float) -> None:
         reason = self._rails(now)
         if reason is None and self.max_hosts is not None \
                 and len(self._router.map) >= self.max_hosts:
             reason = "max_hosts"
-        if reason is None and not self._standby:
-            reason = "no_standby"
+        entry = None
+        if reason is None:
+            # Emptiness check and pop under ONE lock acquisition
+            # (ISSUE 17 guarded-by sweep): the old unlocked
+            # `not self._standby` probe could race a concurrent pool
+            # mutation between check and pop — the claim must be
+            # atomic with the decision that the pool has something to
+            # claim.
+            with self._lock:
+                if self._standby:
+                    entry = self._standby.pop(0)
+                    self._g_standby.set(len(self._standby))
+                else:
+                    reason = "no_standby"
         if reason is not None:
             self._skip(reason)
             return
-        with self._lock:
-            spec, store = self._standby.pop(0)
-            self._g_standby.set(len(self._standby))
+        spec, store = entry
         try:
             ev = self._membership.join(spec, store=store)
         except Exception:  # fallback-ok: a failed join (the standby
@@ -462,6 +485,7 @@ class CapacityController:
         self._c_out.inc()
         self._record("scale-out", spec.host_id, ev.epoch)
 
+    # holds-lock: _pump_lock
     def _maybe_scale_in(self, now: float) -> None:
         reason = self._rails(now)
         if reason is None \
@@ -498,6 +522,7 @@ class CapacityController:
                 self._g_standby.set(len(self._standby))
         self._record("scale-in", victim, ev.epoch)
 
+    # holds-lock: _pump_lock
     def _after_change(self, now: float) -> None:
         """Bookkeeping after OUR OWN committed change: adopt the fresh
         epoch (so the next tick's observation does not double-restart

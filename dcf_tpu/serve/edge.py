@@ -204,10 +204,14 @@ from dcf_tpu.errors import (
     DcfError,
     DeadlineExceededError,
     KeyFormatError,
+    KeyQuarantinedError,
+    LockOrderError,
+    NativeBuildError,
     QueueFullError,
     RingEpochError,
     ShapeError,
     StaleStateError,
+    StandbyExhaustedError,
 )
 from dcf_tpu.serve.admission import (
     Priority,
@@ -318,6 +322,20 @@ WIRE_CODES = {
     E_STALE: StaleStateError,
     E_EPOCH: RingEpochError,
 }
+
+#: Taxonomy classes that DELIBERATELY cross the wire as ``E_INTERNAL``
+#: (via the ``DcfError`` entry in ``_EXC_CODES``): build/disk/test-
+#: harness faults that no remote caller can act on distinctly, so a
+#: dedicated code would be dead protocol surface.  The wire-taxonomy-
+#: sync dcflint pass enforces that every ``dcf_tpu.errors`` class is
+#: either wire-coded or declared here — a NEW typed error cannot ship
+#: with its wire behavior undecided.
+WIRE_INTERNAL_ONLY = frozenset({
+    NativeBuildError,       # build/load fault: host-local, operator-fixed
+    KeyQuarantinedError,    # disk-frame fault: surfaces via store reports
+    StandbyExhaustedError,  # operator scale-out misuse: never request-path
+    LockOrderError,         # test-harness detector: never constructed live
+})
 
 _EXC_CODES = (
     # Order matters: first match wins, subclasses before bases.
@@ -851,9 +869,11 @@ class TokenBucket:
         self.rate = float(points_per_sec)
         self.burst = float(burst_points) if burst_points > 0 \
             else max(self.rate, 1.0)
-        self._tokens = self.burst
-        self._last = float(now)
         self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._tokens = self.burst
+        # guarded-by: _lock
+        self._last = float(now)
 
     def admit(self, points: int, now: float) -> float:
         if self.rate <= 0:
@@ -917,6 +937,11 @@ class _Conn:
         self._srv = server
         self._sock = sock
         self._peer = peer
+        # Deliberately lock-free (hence no guarded-by annotations):
+        # the cross-thread state is ``_out`` — a queue.Queue, which
+        # owns its synchronization — and ``_closing``, a monotonic
+        # False->True sentinel both loops only poll (a stale read
+        # costs one extra 0.1 s put slice, never correctness).
         self._out: queue.Queue = queue.Queue(self.MAX_PENDING_RESPONSES)
         self._closing = False
         self._reader = threading.Thread(
@@ -1422,6 +1447,7 @@ class EdgeServer:
                 ctx.verify_mode = ssl.CERT_REQUIRED
             self._tls_ctx = ctx
         self._lock = threading.Lock()
+        # guarded-by: _lock
         self._conns: set[_Conn] = set()
         self._listener: socket.socket | None = None
         self._acceptor: threading.Thread | None = None
@@ -1574,8 +1600,9 @@ class EdgeServer:
                         sock.close()
                         return
                     self._conns.add(conn)
+                    n_open = len(self._conns)
                 self._c_connections.inc()
-                self._g_open.set(len(self._conns))
+                self._g_open.set(n_open)
                 conn.start()
             except Exception:  # fallback-ok: a peer that reset before
                 # setup, or thread/fd pressure at conn.start() — one
@@ -1688,8 +1715,11 @@ class EdgeClient:
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()       # id/pending/closed state
         self._send_lock = threading.Lock()  # frame writes stay whole
+        # guarded-by: _lock
         self._pending: dict[int, ServeFuture] = {}
+        # guarded-by: _lock
         self._next_id = 1
+        # guarded-by: _lock
         self._closed = False
         self._reader = threading.Thread(
             target=self._read_loop, name="edge-client-read",
@@ -1810,6 +1840,12 @@ class EdgeClient:
             with self._send_lock:
                 if self._tags is not None:
                     fire("net.partition", *self._tags)
+                # dcflint: disable=blocking-under-lock _send_lock exists
+                # precisely to serialize whole-frame socket writes —
+                # interleaved partial frames from two submitting threads
+                # would corrupt the stream for every request in flight.
+                # It guards no other state and is never nested inside
+                # another lock, so contenders wait on peer I/O by design.
                 self._sock.sendall(wire)
         except OSError as e:
             err = BackendUnavailableError(
@@ -1925,7 +1961,14 @@ class EdgeClient:
                 if self._recv_into(memoryview(body)) < body_len:
                     break  # mid-frame EOF: fail pending below
                 kind, req_id, *rest = decode_response(body)
-                fut = self._pending.pop(req_id, None)
+                # Claim the future under the lock (ISSUE 17 guarded-by
+                # sweep): an unlocked pop could race _fail_pending's
+                # swap-and-fail — both sides claiming the same future,
+                # one completing it with a result while the other
+                # fails it.  Holding _lock makes exactly one claimant
+                # win per future.
+                with self._lock:
+                    fut = self._pending.pop(req_id, None)
                 if kind in ("share", "pong", "sync"):
                     if fut is not None:
                         fut.set_result(rest[0])
@@ -1956,6 +1999,9 @@ class EdgeClient:
         signal for pooled clients — a request-level typed failure
         (deadline, shed, breaker) leaves the connection OPEN and this
         False."""
+        # dcflint: disable=guarded-by monitoring snapshot: one atomic
+        # bool read; submit/roundtrip re-check under _lock before
+        # registering a future
         return self._closed
 
     def _fail_pending(self, error: BaseException) -> None:
@@ -2045,10 +2091,15 @@ class EdgeClientPool:
             max_frame_bytes=max_frame_bytes, tls=tls, tls_ca=tls_ca,
             tls_cert=tls_cert, tls_key=tls_key, tags=tags)
         self._lock = threading.Lock()
+        # guarded-by: _lock
         self._slots: list[EdgeClient | None] = [None] * self.size
+        # guarded-by: _lock
         self._rr = 0
+        # guarded-by: _lock
         self._backoff = 0.0
+        # guarded-by: _lock
         self._dark_until: float | None = None
+        # guarded-by: _lock
         self._closed = False
         self.reconnects = 0  # dials that replaced a dead client
         self.dials = 0       # every successful connect

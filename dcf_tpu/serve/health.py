@@ -159,12 +159,16 @@ class HealthProber:
         self._max_events = int(max_events)
         self._lock = threading.Lock()
         self._pump_lock = threading.Lock()  # one probe round at a time
+        # guarded-by: _lock
         self._targets = dict(targets)
+        # guarded-by: _lock
         self._hosts = {hid: _HostHealth() for hid in self._targets}
         # Freshest per-host LoadSample off the probe round trip
         # (ISSUE 16): None = probed but no load surface; absent =
         # never successfully probed (or removed).
+        # guarded-by: _lock
         self._loads: dict = {}
+        # guarded-by: _lock
         self._events: list[HealthEvent] = []
         self._stop = threading.Event()
         self._worker: threading.Thread | None = None
@@ -397,6 +401,9 @@ class HealthProber:
         self._worker = None
 
     def __repr__(self) -> str:
+        # dcflint: disable=guarded-by diagnostic snapshot: sorted()
+        # copies under the GIL, and a repr racing add/remove_target may
+        # legitimately show either side of the change
         return (f"HealthProber(hosts={sorted(self._targets)}, "
                 f"interval_s={self.interval_s}, fail_n={self.fail_n}, "
                 f"recover_m={self.recover_m})")

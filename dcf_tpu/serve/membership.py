@@ -167,6 +167,7 @@ class MembershipController:
             raise ValueError(
                 f"poll_interval_s must be > 0, got {poll_interval_s}")
         self._router = router
+        # guarded-by: _op_lock
         self._stores = dict(stores) if stores else {}
         self.eject_grace_s = float(eject_grace_s)
         self.drain_grace_s = float(drain_grace_s)
@@ -180,12 +181,16 @@ class MembershipController:
         # changes could each compute a ring that forgets the other's.
         self._op_lock = threading.Lock()
         self._state_lock = threading.Lock()
+        # guarded-by: _state_lock
         self._down_since: dict[str, float] = {}
+        # guarded-by: _state_lock
         self._draining: dict[str, float] = {}  # host -> forget deadline
+        # guarded-by: _state_lock
         self._lost_counted: set[str] = set()  # keys already in the
         #                                       lost counter (audit
         #                                       polling must not
         #                                       re-count a loss)
+        # guarded-by: _state_lock
         self._events: list[MembershipEvent] = []
         self._stop = threading.Event()
         self._worker: threading.Thread | None = None
@@ -252,6 +257,9 @@ class MembershipController:
         """The ``KeyStore`` recorded for ``host_id`` (None if never
         provisioned here) — how the capacity controller returns a
         drained host to the standby pool with its store attached."""
+        # dcflint: disable=guarded-by single dict .get() under the GIL,
+        # and the capacity controller calls this only AFTER its drain
+        # committed — the entry it reads cannot be mid-mutation
         return self._stores.get(host_id)
 
     # -- the control loop ---------------------------------------------
@@ -512,6 +520,7 @@ class MembershipController:
                 timeout=self._timeout_s)
         return moved
 
+    # holds-lock: _op_lock
     def _replicate_durable(self, ring: ShardMap, exclude: set) -> int:
         """The durable half of a migration: for every frame any known
         store holds, ensure each store of the frame's NEW placement
@@ -593,6 +602,10 @@ class MembershipController:
         exclude = exclude or set()
         held: set = set()
         everywhere: set = set()
+        # dcflint: disable=guarded-by read-only audit sweep: .items()
+        # snapshots under the GIL; an audit racing a join may count or
+        # miss the newcomer's store, and either answer is a valid
+        # point-in-time audit (the bench re-polls)
         for host_id, store in self._stores.items():
             try:
                 keys = {k for k in store.digest()

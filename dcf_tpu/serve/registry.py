@@ -165,6 +165,7 @@ class KeyRegistry:
         self._frontier_cache = frontier_cache
         self._ticks = (frontier_cache.ticks if frontier_cache is not None
                        else TickSource())
+        # guarded-by: _lock
         self._staging_keep = None  # the residency mid-staging (RLock-
         # guarded): a frontier warm's budget sweep must not evict it
         if frontier_cache is not None:
@@ -179,7 +180,9 @@ class KeyRegistry:
         # — forgets it.
         self._breakers = breakers
         self._lock = threading.RLock()
+        # guarded-by: _lock
         self._entries: dict[str, _Entry] = {}
+        # guarded-by: _lock
         self._generation = 0
         g = self._metrics.gauge
         self._g_resident_bytes = g("serve_resident_device_bytes")
@@ -487,6 +490,7 @@ class KeyRegistry:
 
     # -- eviction -----------------------------------------------------------
 
+    # holds-lock: _lock
     def _iter_residents(self):
         for entry in self._entries.values():
             for slot, res in list(entry.residents.items()):
@@ -501,6 +505,7 @@ class KeyRegistry:
             self._enforce_budget(keep=self._staging_keep)
             self._update_gauges()
 
+    # holds-lock: _lock
     def _enforce_budget(self, keep) -> None:
         """Evict least-recently-used holdings until the summed device
         bytes fit the budget.  Staged key images AND serve-cached
@@ -553,6 +558,7 @@ class KeyRegistry:
             else:
                 total -= fc.evict(victim)
 
+    # holds-lock: _lock
     def _evict_entry(self, key_id: str, entry: _Entry) -> None:
         """The ONE entry-invalidation hook: hot-swap, unregister and
         failure eviction all route here, which (a) drops the entry's
@@ -600,6 +606,7 @@ class KeyRegistry:
             for key_id, entry in self._entries.items():
                 self._evict_entry(key_id, entry)
 
+    # holds-lock: _lock
     def _update_gauges(self) -> None:
         total = n = 0
         for _, _, res in self._iter_residents():

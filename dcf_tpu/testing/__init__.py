@@ -1,5 +1,8 @@
-"""Deterministic test instrumentation (fault injection).  Not part of the
-serving API surface; production code paths only touch ``faults.fire``,
-which is a dict lookup returning immediately when nothing is armed."""
+"""Deterministic test instrumentation (fault injection + the
+lock-order watchdog).  Not part of the serving API surface; production
+code paths only touch ``faults.fire``, which is a dict lookup
+returning immediately when nothing is armed — ``lockwatch`` patches
+the lock factories only inside an explicitly armed window (chaos/soak
+CI legs, the ``lockwatch`` pytest marker)."""
 
-from dcf_tpu.testing import faults  # noqa: F401
+from dcf_tpu.testing import faults, lockwatch  # noqa: F401

@@ -36,3 +36,30 @@ if not ON_TPU_LANE:
 from dcf_tpu.utils.provision import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()
+
+
+# --------------------------------------------------------------- lockwatch
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _lockwatch_armed(request):
+    """Arm the TSan-lite lock-order watchdog for tests carrying the
+    ``lockwatch`` marker (ISSUE 17).  Arming patches the
+    ``threading.Lock``/``RLock`` factories, so every lock the test (and
+    the system it constructs) creates is order-checked: an inversion
+    raises ``LockOrderError`` with the offending cycle and stacks
+    instead of deadlocking under the right interleave.  The patch is
+    process-global — the marker rides the SERIAL CI legs (chaos/serve
+    soaks), never a parallel runner."""
+    if request.node.get_closest_marker("lockwatch") is None:
+        yield None
+        return
+    from dcf_tpu.testing import lockwatch
+
+    watch = lockwatch.arm()
+    try:
+        yield watch
+    finally:
+        lockwatch.disarm(watch)

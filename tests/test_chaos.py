@@ -603,6 +603,7 @@ def test_flaky_fault_pattern_is_seed_deterministic(dcf, bundles, prg,
 
 
 @pytest.mark.slow
+@pytest.mark.lockwatch  # serial leg: every lock order this soak takes is proven acyclic
 def test_soak_flapping_windows_threaded_bit_exact(dcf, bundles, prg,
                                                   rng):
     """Serial-leg soak: 3 client threads of closed-loop load while the

@@ -4,7 +4,7 @@ Two halves, both load-bearing:
 
 * ``test_package_clean`` pins the repo-wide contract the CI lint job
   enforces (``python -m tools.dcflint dcf_tpu`` exits 0) — a regression
-  here means a PR introduced an unmarked violation of one of the six
+  here means a PR introduced an unmarked violation of one of the nine
   machine-checked invariants.
 * the seeded-violation fixtures prove each pass actually FIRES on the
   exact defect class it exists for (a checker nobody has seen fire is a
@@ -424,9 +424,27 @@ def test_cli_contract(tmp_path):
     rep = json.loads(proc.stdout)
     assert rep["count"] == 1
     assert rep["violations"][0]["pass_name"] == "determinism"
-    assert len(rep["passes"]) == 6
+    assert len(rep["passes"]) == 9
     assert run_cli(str(tmp_path), "--pass", "bogus").returncode == 2
     assert run_cli(str(tmp_path / "absent")).returncode == 2
+    # ISSUE 17 satellite: SARIF + output file + changed-only + the
+    # --json/--format conflict are all part of the CLI contract.
+    sarif_path = tmp_path / "report.sarif"
+    proc = run_cli(str(tmp_path), "--format", "sarif",
+                   "--output", str(sarif_path))
+    assert proc.returncode == 1
+    sarif = json.loads(sarif_path.read_text())
+    assert sarif["version"] == "2.1.0"
+    assert sarif["runs"][0]["results"][0]["ruleId"] == "determinism"
+    assert run_cli(str(tmp_path), "--json", "--format",
+                   "sarif").returncode == 2
+    assert run_cli(str(tmp_path), "--changed-only",
+                   "no-such-ref").returncode == 2
+    # Changed-only vs HEAD: the fixture files are outside the repo, so
+    # the narrowed sweep scans nothing and exits clean even though the
+    # full sweep of the same path exits 1 — the exact miss CI's
+    # unconditional full sweep exists to cover.
+    assert run_cli(str(tmp_path), "--changed-only", "HEAD").returncode == 0
 
 
 def test_exception_hygiene_shim_removed():
@@ -468,3 +486,256 @@ def test_secret_hygiene_covers_replication_frames(tmp_path):
            if v.path.endswith("podding.py")]
     assert [v.line for v in got] == [2, 3]
     assert "repl_frame" in got[0].message
+
+
+# ------------------------------------------- ISSUE 17: concurrency suite
+
+
+def test_guarded_by_detects(tmp_path):
+    """The guarded-by contract fires on exactly the access shapes the
+    serve-tier review rounds kept catching by hand: unguarded writes,
+    unguarded reads outside __init__, and the closure trap (a nested
+    def/lambda body does NOT inherit the enclosing ``with`` — it runs
+    after the critical section is gone)."""
+    write(tmp_path, "mod.py", (
+        "import threading\n"
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        # guarded-by: _lock\n"
+        "        self._items = []\n"
+        "        self._items.append('warm')\n"   # __init__: pre-publication
+        "    def good(self):\n"
+        "        with self._lock:\n"
+        "            self._items.append(2)\n"
+        "    def bad_write(self):\n"
+        "        self._items = []\n"                       # line 12
+        "    def bad_read(self):\n"
+        "        return len(self._items)\n"                # line 14
+        "    # holds-lock: _lock\n"
+        "    def evict_locked(self):\n"
+        "        return self._items.pop()\n"               # marked: fine
+        "    def closure_trap(self):\n"
+        "        with self._lock:\n"
+        "            return lambda: self._items.count(0)\n"  # line 20
+        "    def suppressed(self):\n"
+        "        # dcflint: disable=guarded-by snapshot read, len is atomic\n"
+        "        return len(self._items)\n"))
+    got = run_path(tmp_path, ["guarded-by"])
+    assert names(got) == ["guarded-by"]
+    assert [v.line for v in got] == [12, 14, 20]
+    assert "written" in got[0].message
+    assert "read" in got[1].message and "read" in got[2].message
+
+
+def test_guarded_by_annotation_hygiene(tmp_path):
+    """A contract that silently fails to bind is worse than none: a
+    guard naming a lock __init__ never assigns, a malformed name, and
+    an orphaned marker are all findings in their own right."""
+    write(tmp_path, "mod.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"                        # line 3
+        "        # guarded-by: _ghost\n"
+        "        self._x = 0\n"
+        "        # guarded-by: _lock (plus prose that breaks the name)\n"
+        "        self._y = 0\n"
+        "# guarded-by: _lock\n"                            # line 8: orphan
+        "Z = 1\n"))
+    got = run_path(tmp_path, ["guarded-by"])
+    msgs = {v.line: v.message for v in got}
+    assert sorted(msgs) == [3, 6, 8]
+    assert "never assigns self._ghost" in msgs[3]
+    assert "malformed" in msgs[6]
+    assert "orphaned" in msgs[8]
+
+
+def test_blocking_under_lock_detects(tmp_path):
+    """Every blocking family fires inside a ``with <lock>`` body; the
+    deliberate non-findings (timed waits, str.join, nested defs,
+    non-lock with-subjects, code outside the with) stay silent."""
+    write(tmp_path, "mod.py", (
+        "import subprocess, time\n"
+        "def f(self, sock, ev, t, parts, path):\n"
+        "    with self._lock:\n"
+        "        sock.sendall(b'x')\n"                     # line 4
+        "        subprocess.run(['ls'])\n"                 # line 5
+        "        time.sleep(0.1)\n"                        # line 6
+        "        ev.wait()\n"                              # line 7
+        "        t.join()\n"                               # line 8
+        "        ev.wait(1.0)\n"                           # timed: fine
+        "        t.join(timeout=1.0)\n"                    # timed: fine
+        "        s = ', '.join(parts)\n"                   # str.join: fine
+        "        fn = lambda: time.sleep(1)\n"             # later: fine
+        "    with open(path) as fh:\n"                     # not a lock
+        "        time.sleep(0.1)\n"
+        "        fh.read()\n"
+        "    time.sleep(0.1)\n"                            # outside: fine
+        "    return s, fn\n"))
+    got = run_path(tmp_path, ["blocking-under-lock"])
+    assert names(got) == ["blocking-under-lock"]
+    assert [v.line for v in got] == [4, 5, 6, 7, 8]
+    assert all("with _lock" in v.message for v in got)
+    # testing/ holds locks around arbitrary seams by design: exempt.
+    write(tmp_path, "testing/h.py", (
+        "import time\n"
+        "def g(self):\n"
+        "    with self._lock:\n"
+        "        time.sleep(0.1)\n"))
+    assert [v for v in run_path(tmp_path, ["blocking-under-lock"])
+            if v.path.endswith("h.py")] == []
+    # the mandatory-reason suppression grammar applies as everywhere
+    write(tmp_path, "mod2.py", (
+        "def h(self, sock, wire):\n"
+        "    with self._send_lock:\n"
+        "        # dcflint: disable=blocking-under-lock the send lock\n"
+        "        # exists precisely to serialize whole-frame writes\n"
+        "        sock.sendall(wire)\n"))
+    assert [v for v in run_path(tmp_path, ["blocking-under-lock"])
+            if v.path.endswith("mod2.py")] == []
+
+
+def test_wire_taxonomy_sync_detects_errors_drift(tmp_path):
+    """An errors.py whose DcfError closure disagrees with DCF_ERRORS
+    is flagged in both directions (new class missing from the list;
+    listed class missing from the module)."""
+    write(tmp_path, "errors.py", (
+        "class DcfError(Exception):\n"
+        "    pass\n"
+        "class RogueError(DcfError):\n"                    # line 3
+        "    pass\n"))
+    got = run_path(tmp_path, ["wire-taxonomy-sync"])
+    by_msg = "\n".join(v.message for v in got)
+    assert any(v.line == 3 and "RogueError is missing from DCF_ERRORS"
+               in v.message for v in got)
+    assert "DCF_ERRORS names ShapeError" in by_msg  # dead-entry side
+    # basename scoping: the same content elsewhere is not the taxonomy
+    write(tmp_path, "other.py", (tmp_path / "errors.py").read_text())
+    assert [v for v in run_path(tmp_path, ["wire-taxonomy-sync"])
+            if v.path.endswith("other.py")] == []
+
+
+def test_wire_taxonomy_sync_detects_edge_drift(tmp_path):
+    """The edge.py side: orphan codes, duplicate wire bytes, unnamed
+    keys, missing WIRE_INTERNAL_ONLY, uncovered taxonomy classes, and
+    an encode/decode table that does not round-trip are each their own
+    finding."""
+    write(tmp_path, "edge.py", (
+        "E_SHAPE = 2\n"
+        "E_ORPHAN = 3\n"                       # no WIRE_CODES entry
+        "E_DUP = 2\n"                          # same byte as E_SHAPE
+        "WIRE_CODES = {\n"
+        "    E_SHAPE: ShapeError,\n"
+        "    99: BackendUnavailableError,\n"   # unnamed key
+        "}\n"
+        "_EXC_CODES = (\n"
+        "    (BackendUnavailableError, E_SHAPE),\n"  # broken round trip
+        ")\n"))
+    msgs = "\n".join(v.message for v in
+                     run_path(tmp_path, ["wire-taxonomy-sync"]))
+    assert "E_ORPHAN has no WIRE_CODES entry" in msgs
+    assert "duplicate E_* code value(s) [2]" in msgs
+    assert "key is not a module-level E_*" in msgs
+    assert "defines no WIRE_INTERNAL_ONLY" in msgs
+    assert "BackendUnavailableError has no wire code" in msgs  # coverage
+    assert "decodes to ShapeError but _EXC_CODES never encodes" in msgs
+    assert "encodes BackendUnavailableError but no WIRE_CODES entry" \
+        in msgs
+    assert "round trip changes the exception type" in msgs
+
+
+def test_wire_taxonomy_sync_internal_only_rules(tmp_path):
+    """WIRE_INTERNAL_ONLY is a checked declaration, not a dumping
+    ground: a coded class may not also be declared internal-only, and
+    only taxonomy classes belong in the set."""
+    write(tmp_path, "edge.py", (
+        "E_SHAPE = 2\n"
+        "WIRE_CODES = {E_SHAPE: ShapeError}\n"
+        "WIRE_INTERNAL_ONLY = frozenset({ShapeError, NotAnError})\n"
+        "_EXC_CODES = ((ShapeError, E_SHAPE),)\n"))
+    msgs = "\n".join(v.message for v in
+                     run_path(tmp_path, ["wire-taxonomy-sync"]))
+    assert "ShapeError is declared WIRE_INTERNAL_ONLY but has a wire" \
+        in msgs
+    assert "names NotAnError, which is not in the DCF_ERRORS taxonomy" \
+        in msgs
+
+
+# ---------------------------------------- ISSUE 17: repo-wide clean pins
+
+
+def test_guardedby_repo_clean():
+    """The tentpole pin — and the regression test for the three real
+    races the annotation sweep surfaced and fixed:
+
+    * ``EdgeServer`` accept loop: the open-connection gauge read
+      ``self._conns`` outside ``_lock`` (now: snapshot under the lock,
+      publish outside);
+    * ``EdgeClient._read_loop``: ``self._pending.pop`` raced
+      ``_fail_pending``'s swap-and-fail (now: popped under ``_lock``);
+    * ``CapacityController._maybe_scale_out``: standby emptiness check
+      and pop were two separate lock acquisitions (now: one atomic
+      check-and-claim).
+
+    Reverting any of them reintroduces an unguarded access to an
+    annotated attribute, and this pin fails."""
+    assert run_path(REPO / "dcf_tpu", ["guarded-by"]) == []
+    # The pin has teeth only while the annotations exist: the serving
+    # tier's contract surface must stay annotated.
+    for mod in ["edge.py", "capacity.py", "registry.py", "breaker.py",
+                "admission.py", "health.py", "membership.py"]:
+        src = (REPO / "dcf_tpu" / "serve" / mod).read_text()
+        assert "# guarded-by:" in src, f"{mod} lost its annotations"
+
+
+def test_blocking_under_lock_repo_clean():
+    assert run_path(REPO / "dcf_tpu", ["blocking-under-lock"]) == []
+
+
+def test_wire_taxonomy_sync():
+    """The triangle — errors.py classes, edge.py wire tables,
+    DCF_ERRORS — holds on the real tree, and the declaration that
+    makes coverage checkable (WIRE_INTERNAL_ONLY) is present."""
+    assert run_path(REPO / "dcf_tpu", ["wire-taxonomy-sync"]) == []
+    from dcf_tpu.serve import edge
+    assert edge.WIRE_INTERNAL_ONLY  # the declaration itself exists
+
+
+# ------------------------------------- ISSUE 17: SARIF and changed-only
+
+
+def test_sarif_render(tmp_path):
+    """SARIF 2.1.0 shape: one rule per pass (plus the synthetic
+    parse/suppression rules), results referencing rules by id and
+    index, 1-based regions, srcroot-relative URIs."""
+    from tools.dcflint import all_passes, render_sarif
+
+    write(tmp_path, "dirty.py", "import time\nT = time.time()\n")
+    violations = run_path(tmp_path)
+    sarif = json.loads(render_sarif(violations, str(tmp_path)))
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    rules = run["tool"]["driver"]["rules"]
+    assert {r["id"] for r in rules} == \
+        set(all_passes()) | {"parse", "suppression"}
+    (res,) = run["results"]
+    assert res["ruleId"] == "determinism"
+    assert rules[res["ruleIndex"]]["id"] == "determinism"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] == 2
+    assert loc["artifactLocation"]["uri"].endswith("dirty.py")
+
+
+def test_changed_only_narrowing_vs_full_sweep(tmp_path):
+    """The ISSUE 17 pin: ``only`` narrows the walk, so a violation in
+    a file OUTSIDE the changed set is invisible to the narrowed run —
+    and therefore the full sweep next to it in CI is load-bearing,
+    not belt-and-braces."""
+    changed = write(tmp_path, "changed.py",
+                    "import time\nT = time.time()\n")
+    write(tmp_path, "untouched.py", "import time\nU = time.time()\n")
+    narrowed = run_path(tmp_path, ["determinism"], only=[changed])
+    assert {pathlib.Path(v.path).name for v in narrowed} == {"changed.py"}
+    full = run_path(tmp_path, ["determinism"])
+    assert {pathlib.Path(v.path).name for v in full} == \
+        {"changed.py", "untouched.py"}

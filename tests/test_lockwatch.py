@@ -1,0 +1,218 @@
+"""lockwatch: the TSan-lite lock-order watchdog (ISSUE 17).
+
+The static half of the concurrency suite (guarded-by,
+blocking-under-lock) proves lexical discipline; this harness proves
+the one property no lexical pass can — that no two locks are ever
+taken in opposite orders by different threads.  The tests here are
+the detector's own detection-power fixtures:
+
+* a SEEDED inversion — thread 1 completes ``A then B`` and hands off
+  deterministically before the main thread tries ``B then A`` — must
+  raise ``LockOrderError`` BEFORE the closing acquire blocks (the
+  test would deadlock, not fail, if the detector ever regressed into
+  needing the lucky interleave);
+* consistent orders, reentrant RLocks, per-instance identity,
+  try-locks and bounded waits must all stay silent — the watchdog
+  rides the chaos/serve soaks, so a false positive there is a broken
+  CI leg.
+
+Tests carrying the ``lockwatch`` marker are armed by the autouse
+conftest fixture (patched ``threading.Lock``/``RLock`` factories);
+the unmarked tests pin the disarm/restore contract.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from dcf_tpu.errors import DcfError, LockOrderError
+from dcf_tpu.testing import lockwatch
+
+
+@pytest.mark.lockwatch
+def test_seeded_inversion_detected():
+    """The canonical two-lock inversion, deterministically interleaved:
+    thread 1 takes A then B and fully exits before the main thread
+    takes B and tries A.  No timing window — the graph remembers the
+    A->B edge, so the closing B->A acquire raises instead of
+    deadlocking."""
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    t1_done = threading.Event()
+
+    def t1():
+        with lock_a:
+            with lock_b:  # records the edge A -> B
+                pass
+        t1_done.set()
+
+    worker = threading.Thread(target=t1, name="t1-a-then-b")
+    worker.start()
+    worker.join(10.0)
+    assert t1_done.is_set(), "seed thread did not complete"
+
+    with lock_b:
+        with pytest.raises(LockOrderError) as ei:
+            lock_a.acquire()  # would close the cycle: refused pre-block
+    err = ei.value
+    # Typed and taxonomy-rooted, with the evidence attached.
+    assert isinstance(err, DcfError) and isinstance(err, RuntimeError)
+    assert len(err.cycle) == 3  # A -> B -> A (names carry file:line#seq)
+    assert err.cycle[0] == err.cycle[-1]
+    assert all("#" in name for name in err.cycle)
+    assert err.stacks and "closing acquire" in err.stacks[-1]
+    assert "first observed" in err.stacks[0]
+    # The refused acquire never took the lock: A is still free.
+    assert lock_a.acquire(blocking=False)
+    lock_a.release()
+
+
+@pytest.mark.lockwatch
+def test_consistent_order_stays_silent():
+    """Two threads hammering the SAME order never trip the detector —
+    the property that lets the watchdog ride the soaks."""
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(50):
+                with lock_a:
+                    with lock_b:
+                        pass
+        except LockOrderError as e:  # pragma: no cover - the failure
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+    assert errors == []
+
+
+@pytest.mark.lockwatch
+def test_per_instance_identity_no_alias():
+    """Identity is per lock INSTANCE, not per allocation site: two
+    independent pairs born at the same lines may be taken in opposite
+    orders without a (false) cycle."""
+
+    def make_pair():
+        return threading.Lock(), threading.Lock()
+
+    a1, b1 = make_pair()
+    a2, b2 = make_pair()
+    with a1:
+        with b1:
+            pass
+    with b2:  # the reverse order, but on distinct instances
+        with a2:
+            pass
+
+
+@pytest.mark.lockwatch
+def test_trylock_and_bounded_acquire_skip_the_check():
+    """Non-blocking and timeout-bounded acquires cannot deadlock, so
+    they are allowed to run against the recorded order — but they still
+    maintain the held stack (a blocking acquire under them is checked
+    with them counted as held)."""
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    with lock_a:
+        with lock_b:
+            pass
+    with lock_b:
+        assert lock_a.acquire(timeout=0.2)  # against the order: allowed
+        lock_a.release()
+        assert lock_a.acquire(blocking=False)
+        lock_a.release()
+        with pytest.raises(LockOrderError):
+            lock_a.acquire()  # the blocking spelling is still refused
+
+
+@pytest.mark.lockwatch
+def test_rlock_reentrancy_and_condition_protocol():
+    """Reentrant re-acquires are depth-counted, never self-edges; a
+    ``Condition`` built on a watched RLock completes a real
+    wait/notify round trip through the ``_release_save`` /
+    ``_acquire_restore`` protocol."""
+    rlock = threading.RLock()
+    with rlock:
+        with rlock:  # reentrant: no edge, no error
+            pass
+
+    cond = threading.Condition(threading.RLock())
+    log = []
+
+    def waiter():
+        with cond:
+            while not log:
+                cond.wait(1.0)
+            log.append("woke")
+
+    worker = threading.Thread(target=waiter)
+    worker.start()
+    # The waiter's timed wait re-checks the predicate, so a notify
+    # that lands before it parks is merely unobserved, never lost.
+    with cond:
+        log.append("go")
+        cond.notify()
+    worker.join(10.0)
+    assert log == ["go", "woke"]
+
+
+@pytest.mark.lockwatch
+def test_queue_and_event_survive_armed_window():
+    """stdlib synchronization built while armed (queue.Queue's
+    mutex+Conditions, Event's Condition-on-Lock) works unmodified —
+    the soaks construct whole serve stacks inside the armed window."""
+    import queue
+
+    q = queue.Queue()
+    ev = threading.Event()
+
+    def producer():
+        q.put("payload")
+        ev.set()
+
+    worker = threading.Thread(target=producer)
+    worker.start()
+    assert q.get(timeout=5.0) == "payload"
+    assert ev.wait(5.0)
+    worker.join(5.0)
+
+
+@pytest.mark.lockwatch
+def test_double_arm_rejected():
+    """One armed session at a time: the marker fixture already armed,
+    so a second arm is a usage error (ValueError, not a lock-order
+    finding)."""
+    with pytest.raises(ValueError):
+        lockwatch.arm()
+
+
+def test_unarmed_locks_are_native():
+    """Without the marker the factories are untouched — production
+    code never pays the wrapper, and the fixture's disarm restored
+    the world after the armed tests above."""
+    assert not isinstance(threading.Lock(), lockwatch.WatchedLock)
+    assert "lock" in type(threading.Lock()).__name__.lower()
+
+
+def test_disarm_restores_and_watched_locks_keep_working():
+    """Explicit arm/disarm round trip: locks created while armed keep
+    functioning after disarm (they wrap real locks; only the graph
+    stops growing)."""
+    watch = lockwatch.arm()
+    try:
+        survivor = threading.Lock()
+        assert isinstance(survivor, lockwatch.WatchedLock)
+    finally:
+        lockwatch.disarm(watch)
+    assert not isinstance(threading.Lock(), lockwatch.WatchedLock)
+    with survivor:
+        assert survivor.locked()
+    assert not survivor.locked()

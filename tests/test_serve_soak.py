@@ -17,7 +17,11 @@ from dcf_tpu.ops.prg import HirosePrgNp
 from dcf_tpu.serve.loadgen import closed_loop
 from dcf_tpu.testing import faults
 
-pytestmark = [pytest.mark.serve, pytest.mark.slow]
+# lockwatch: the soak runs on the serial CI leg with the lock-order
+# watchdog armed, so every lock order the service takes under load is
+# continuously proven acyclic (inversions raise LockOrderError instead
+# of deadlocking once in a thousand runs).
+pytestmark = [pytest.mark.serve, pytest.mark.slow, pytest.mark.lockwatch]
 
 NB, LAM = 2, 16
 

@@ -14,6 +14,12 @@ a shared file walk:
     secret-hygiene      key material never reaches print/logging; key
                         classes define a redacting __repr__
     determinism         no wall-clock/unseeded randomness in library code
+    guarded-by          ``# guarded-by:`` annotated attributes touched
+                        only under their lock (or ``# holds-lock:``)
+    blocking-under-lock no socket/subprocess/sleep/untimed-wait inside
+                        a ``with <lock>:`` body
+    wire-taxonomy-sync  errors.py taxonomy, edge.py wire codes, and the
+                        typed-error DCF_ERRORS list mutually exhaustive
 
 Each pass is a ``LintPass`` subclass registered by module import (see
 ``tools/dcflint/passes/``); the framework owns the file walk, the
@@ -56,6 +62,7 @@ __all__ = [
     "run_path",
     "render_human",
     "render_json",
+    "render_sarif",
 ]
 
 _SUPPRESS_RE = re.compile(
@@ -185,9 +192,19 @@ def _iter_files(root: pathlib.Path) -> Iterator[pathlib.Path]:
 
 
 def run_path(root: str | pathlib.Path,
-             pass_names: Iterable[str] | None = None) -> list[Violation]:
+             pass_names: Iterable[str] | None = None,
+             only: Iterable[str | pathlib.Path] | None = None,
+             ) -> list[Violation]:
     """Run the suite (or the named subset) over every ``*.py`` under
-    ``root``; returns the surviving (unsuppressed) violations."""
+    ``root``; returns the surviving (unsuppressed) violations.
+
+    ``only``: an optional file filter — when given, only files whose
+    resolved path is in the set are scanned (the ``--changed-only``
+    mode: the CLI passes ``git diff --name-only`` output).  It narrows
+    the walk, never widens it, so a violation OUTSIDE the filter is
+    deliberately invisible to a filtered run — which is why CI keeps
+    an unconditional full sweep next to the changed-only fast path.
+    """
     registry = all_passes()
     if pass_names is None:
         selected = list(registry.values())
@@ -198,8 +215,12 @@ def run_path(root: str | pathlib.Path,
                 f"unknown pass(es) {unknown}; known: {sorted(registry)}")
         selected = [registry[n] for n in pass_names]
     root = pathlib.Path(root)
+    only_set = (None if only is None
+                else {pathlib.Path(p).resolve() for p in only})
     out: list[Violation] = []
     for path in _iter_files(root):
+        if only_set is not None and path.resolve() not in only_set:
+            continue
         # Single-file mode keeps the path's own directory segments so the
         # directory-scoped rules (ops//backends/ inclusion, testing/ and
         # bench-layer exemptions) behave exactly as in a directory scan —
@@ -246,3 +267,57 @@ def render_json(violations: list[Violation], root: str) -> str:
          "count": len(violations),
          "violations": [asdict(v) for v in violations]},
         indent=2)
+
+
+def render_sarif(violations: list[Violation], root: str) -> str:
+    """SARIF 2.1.0 report — the format CI code-scanning uploads speak,
+    so findings annotate the PR diff instead of hiding in a log.  One
+    rule per registered pass (violations reference rules by index),
+    one result per finding; parse/suppression findings get synthetic
+    rules so they annotate too."""
+    passes = all_passes()
+    rule_ids = sorted(passes) + ["parse", "suppression"]
+    rules = []
+    for rid in rule_ids:
+        desc = (passes[rid].description if rid in passes else
+                "file does not parse" if rid == "parse" else
+                "malformed dcflint suppression comment")
+        rules.append({
+            "id": rid,
+            "shortDescription": {"text": desc},
+            "defaultConfiguration": {"level": "error"},
+        })
+    index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = []
+    for v in violations:
+        results.append({
+            "ruleId": v.pass_name,
+            "ruleIndex": index.get(v.pass_name, 0),
+            "level": "error",
+            "message": {"text": v.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": pathlib.Path(v.path).as_posix(),
+                        "uriBaseId": "SRCROOT",
+                    },
+                    # SARIF regions are 1-based; parse errors with no
+                    # line report the top of the file.
+                    "region": {"startLine": max(1, v.line)},
+                },
+            }],
+        })
+    return json.dumps({
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "dcflint",
+                "informationUri":
+                    "https://example.invalid/tools/dcflint",
+                "rules": rules,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": f"{root}/"}},
+            "results": results,
+        }],
+    }, indent=2)

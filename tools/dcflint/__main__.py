@@ -1,18 +1,49 @@
-"""CLI: ``python -m tools.dcflint [paths...] [--json] [--pass NAME]``.
+"""CLI: ``python -m tools.dcflint [paths...] [--format F] [--pass NAME]``.
 
 Exit 0 when every scanned file is clean, 1 when violations survive
-suppression, 2 on usage errors.  ``--json`` emits a machine-readable
-report for CI annotation; the default output is one ``path:line:
-[pass] message`` line per finding (clickable in editors and CI logs).
+suppression, 2 on usage errors.  ``--format json`` emits a
+machine-readable report, ``--format sarif`` a SARIF 2.1.0 report for
+CI code-scanning upload; the default (human) output is one
+``path:line: [pass] message`` line per finding (clickable in editors
+and CI logs).  ``--changed-only REF`` narrows the scan to the files
+``git diff --name-only REF`` reports — a PR fast path only; it can
+miss violations a change causes in UNCHANGED files (wire-taxonomy-sync
+spans errors.py/edge.py), so CI pairs it with an unconditional full
+sweep.
 """
 
 from __future__ import annotations
 
 import argparse
 import pathlib
+import subprocess
 import sys
 
-from tools.dcflint import all_passes, render_human, render_json, run_path
+from tools.dcflint import (
+    all_passes,
+    render_human,
+    render_json,
+    render_sarif,
+    run_path,
+)
+
+
+def _changed_files(ref: str) -> set[pathlib.Path]:
+    """Resolved paths of the ``*.py`` files differing from ``ref``
+    (committed, staged, and working-tree changes alike)."""
+    proc = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"git diff --name-only {ref} failed: "
+            f"{proc.stderr.strip() or proc.stdout.strip()}")
+    out = set()
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.endswith(".py"):
+            out.add(pathlib.Path(line).resolve())
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -22,8 +53,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("paths", nargs="*", default=["dcf_tpu"],
                    help="package directories or files to scan "
                         "(default: dcf_tpu)")
+    p.add_argument("--format", dest="format", default=None,
+                   choices=["human", "json", "sarif"],
+                   help="report format (default: human)")
     p.add_argument("--json", action="store_true",
-                   help="machine-readable report on stdout")
+                   help="alias for --format json (back-compat)")
+    p.add_argument("--output", metavar="FILE", default=None,
+                   help="write the report to FILE instead of stdout")
+    p.add_argument("--changed-only", metavar="REF", default=None,
+                   help="scan only *.py files that differ from git REF "
+                        "(fast path; pair with a full sweep in CI)")
     p.add_argument("--pass", dest="passes", action="append", default=None,
                    metavar="NAME",
                    help="run only the named pass (repeatable)")
@@ -31,10 +70,24 @@ def main(argv: list[str] | None = None) -> int:
                    help="list registered passes and exit")
     args = p.parse_args(argv)
 
+    if args.format is not None and args.json and args.format != "json":
+        print("error: --json conflicts with "
+              f"--format {args.format}", file=sys.stderr)
+        return 2
+    fmt = args.format or ("json" if args.json else "human")
+
     if args.list_passes:
         for name, inst in sorted(all_passes().items()):
             print(f"{name}: {inst.description}")
         return 0
+
+    only = None
+    if args.changed_only is not None:
+        try:
+            only = _changed_files(args.changed_only)
+        except RuntimeError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
 
     violations = []
     for raw in args.paths or ["dcf_tpu"]:
@@ -43,15 +96,23 @@ def main(argv: list[str] | None = None) -> int:
             print(f"error: no such path {raw!r}", file=sys.stderr)
             return 2
         try:
-            violations += run_path(root, args.passes)
+            violations += run_path(root, args.passes, only=only)
         except KeyError as e:
             print(f"error: {e.args[0]}", file=sys.stderr)
             return 2
     label = ", ".join(str(p) for p in args.paths)
-    if args.json:
-        print(render_json(violations, label))
+    render = {"human": render_human,
+              "json": render_json,
+              "sarif": render_sarif}[fmt]
+    report = render(violations, label)
+    if args.output:
+        pathlib.Path(args.output).write_text(report + "\n")
+        if fmt == "human" and violations:
+            # Keep failures visible in the CI log even when the report
+            # goes to a file.
+            print(report, file=sys.stderr)
     else:
-        print(render_human(violations, label))
+        print(report)
     return 1 if violations else 0
 
 

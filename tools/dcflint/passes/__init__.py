@@ -7,10 +7,13 @@ power (a pass nobody has seen fire is a pass nobody can trust).
 """
 
 from tools.dcflint.passes import (  # noqa: F401
+    blocking_under_lock,
     compat_shim,
     crypto_dtype,
     determinism,
     exception_hygiene,
+    guarded_by,
     secret_hygiene,
     typed_error,
+    wire_taxonomy,
 )

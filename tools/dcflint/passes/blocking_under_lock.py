@@ -1,0 +1,162 @@
+"""blocking-under-lock: locks bound critical sections, never I/O.
+
+The PR 11 ``_mint_sync`` review round established the rule this pass
+generalizes: a lock protects shared STATE, and everything slow —
+network, subprocesses, sleeps, unbounded waits — happens outside it,
+or every other thread contending for that lock inherits the latency
+(and, for the serve tier's pump/admission locks, the pod inherits a
+convoy).  Inside any ``with <lock>:`` body — a with-subject whose
+final name segment is ``lock``/``mutex``/``cond``/``condition`` or
+ends in ``_lock``/``_mutex`` — the pass flags:
+
+* ``socket`` traffic: any ``.connect/.accept/.send*/.recv*`` method
+  call, and ``socket.create_connection(...)``;
+* ``subprocess.*`` calls (build/exec under a lock serializes the
+  world on an external process);
+* ``<x>.wait()`` with no timeout (``Event.wait``/``Condition.wait``
+  — an unbounded wait under a lock is a deadlock with extra steps;
+  pass a timeout and re-check the predicate);
+* ``<x>.join()`` with no arguments (``Thread.join`` — same reason;
+  ``str.join``/``os.path.join`` always take an argument and are not
+  flagged);
+* ``time.sleep(...)`` (the PR 11 rule verbatim).
+
+The analysis is lexical: nested ``def``/``lambda`` bodies are NOT
+treated as inside the ``with`` (they run later, when the lock is
+long released).  Deliberate exceptions — e.g. the edge client's
+``_send_lock``, which exists precisely to serialize whole-frame
+socket writes — carry the mandatory-reason suppression grammar, so
+the justification is in the diff.  ``testing/`` is exempt (the fault
+and lock-order harnesses hold locks around arbitrary seams by
+design).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.dcflint import FileContext, LintPass, register
+
+_LOCK_SUFFIXES = ("lock", "mutex")
+_LOCK_NAMES = frozenset({"lock", "mutex", "cond", "condition"})
+
+_SOCKET_METHODS = frozenset({
+    "connect", "connect_ex", "accept",
+    "send", "sendall", "sendto", "sendmsg", "sendfile",
+    "recv", "recv_into", "recvfrom", "recvfrom_into", "recvmsg",
+    "recvmsg_into",
+})
+
+
+def _final_name(node: ast.AST) -> str:
+    """The last dotted segment of a name/attribute expression."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _is_lock_name(name: str) -> bool:
+    name = name.lower()
+    return (name in _LOCK_NAMES
+            or any(name.endswith("_" + s) or name == s
+                   for s in _LOCK_SUFFIXES))
+
+
+def _dotted(node: ast.AST) -> str:
+    """Dotted call-target name (``a.b.c``) or '' when not a plain
+    name chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if parts:
+        # x().attr / subscripted chains: keep the method name so
+        # socket-method detection still sees it.
+        return "." + ".".join(reversed(parts))
+    return ""
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return bool(call.args) or any(
+        kw.arg == "timeout" for kw in call.keywords)
+
+
+def _flag_call(call: ast.Call) -> str | None:
+    dotted = _dotted(call.func)
+    if not dotted:
+        return None
+    head = dotted.split(".", 1)[0]
+    last = dotted.rsplit(".", 1)[-1]
+    is_method = "." in dotted
+    if dotted == "time.sleep":
+        return ("time.sleep under a lock stalls every contender; "
+                "sleep outside the critical section")
+    if head == "subprocess":
+        return (f"{dotted}(...) under a lock serializes every "
+                "contender on an external process; run it outside "
+                "and publish the result under the lock")
+    if dotted == "socket.create_connection" \
+            or (is_method and last in _SOCKET_METHODS):
+        return (f"socket {last}() under a lock holds every contender "
+                "hostage to the peer; do the I/O outside and take "
+                "the lock only to publish the result")
+    if is_method and last == "wait" and not _has_timeout(call):
+        return ("wait() with no timeout under a lock is an unbounded "
+                "stall (lost wakeup => deadlock); pass a timeout and "
+                "re-check the predicate")
+    if is_method and last == "join" and not call.args and not any(
+            kw.arg == "timeout" for kw in call.keywords):
+        return ("join() with no timeout under a lock waits on a "
+                "thread that may need this very lock to exit; pass a "
+                "timeout (str.join/os.path.join take arguments and "
+                "are not flagged)")
+    return None
+
+
+@register
+class BlockingUnderLockPass(LintPass):
+    name = "blocking-under-lock"
+    description = ("no socket/subprocess/untimed-wait/untimed-join/"
+                   "sleep calls inside 'with <lock>' bodies")
+
+    def check(self, ctx: FileContext) -> Iterator[tuple[int, str]]:
+        if "testing" in ctx.parts[:-1]:
+            return
+
+        findings: list[tuple[int, str]] = []
+
+        def visit(node: ast.AST, under: str | None) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                # Runs after the with-block exits: not under the lock.
+                for child in ast.iter_child_nodes(node):
+                    visit(child, None)
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                locks = [n for n in
+                         (_final_name(i.context_expr)
+                          for i in node.items)
+                         if _is_lock_name(n)]
+                inner = locks[0] if locks else under
+                for item in node.items:
+                    visit(item, under)
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if under is not None and isinstance(node, ast.Call):
+                msg = _flag_call(node)
+                if msg:
+                    findings.append(
+                        (node.lineno,
+                         f"inside 'with {under}': {msg}"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, under)
+
+        visit(ctx.tree, None)
+        yield from findings

@@ -1,0 +1,260 @@
+"""guarded-by: annotated shared state is only touched under its lock.
+
+The serving tier (``dcf_tpu/serve/``) is ~15 threaded modules whose
+correctness rests on "attribute X is only read/written under lock L"
+contracts that, before this pass, lived in comments and reviewer
+memory — and that is exactly where the PR 6/7/11/12 review-round bugs
+(unguarded hysteresis timestamps, double-invalidation, a pump-lock
+race on worker spawn) kept appearing.  This pass turns the comment
+into a checked annotation:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._standby = []
+
+declares ``self._standby`` guarded by ``self._lock``.  From then on,
+every write to ``self._standby`` — and every read outside
+``__init__`` — must occur lexically inside a ``with self._lock:``
+block, or inside a method whose ``def`` line (or the contiguous
+standalone-comment block above it) carries ``# holds-lock: _lock``
+(the documented "caller holds the lock" helper idiom, e.g. the
+registry's eviction sweep).  Both markers accept a comma-separated
+lock list.
+
+The analysis is *lexical* by design: it proves the cheap 95% (the
+access sits inside the right ``with``) and leaves the clever 5% —
+lock handoffs, benign unlocked fast-path reads, ``__repr__``
+diagnostics — to the mandatory-reason suppression grammar, where the
+justification is visible in the diff that introduces it.  Code inside
+nested ``def``/``lambda`` bodies does NOT inherit the enclosing
+``with``: a closure outlives the critical section it was created in
+(worker-thread targets being the canonical trap), so it must take the
+lock itself or be suppressed with a reason.
+
+Annotation hygiene is checked too: a ``# guarded-by:`` that names no
+lock, names a lock attribute never assigned in ``__init__``, or is
+not attached to a ``self.<attr> = ...`` statement in ``__init__`` is
+itself a finding — a contract that silently fails to bind is worse
+than none.  The pass is opt-in per attribute, so it needs no
+directory scoping: un-annotated classes are untouched.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from tools.dcflint import FileContext, LintPass, register
+
+GUARDED_MARKER = "guarded-by"
+HOLDS_MARKER = "holds-lock"
+
+_MARKER_RE = re.compile(r"#\s*(guarded-by|holds-lock):\s*([^#]*)")
+
+_ATTR_NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+def _marker_lines(ctx: FileContext) -> dict[int, tuple[str, str]]:
+    """lineno -> (marker kind, raw name list) for every annotation
+    comment in the file."""
+    out = {}
+    for i, line in enumerate(ctx.lines, start=1):
+        m = _MARKER_RE.search(line)
+        if m:
+            out[i] = (m.group(1), m.group(2))
+    return out
+
+
+def _names_at(ctx: FileContext, markers: dict, lineno: int,
+              kind: str, consumed: set[int]) -> list[tuple[int, str]]:
+    """Annotation names attached to ``lineno``: markers of ``kind`` on
+    the line itself or anywhere in the contiguous standalone-comment
+    block directly above (the framework's suppression placement rules,
+    so multi-line justifications wrap freely).  Marks the lines it
+    reads as consumed so orphaned markers can be reported."""
+    found: list[tuple[int, str]] = []
+
+    def take(i: int) -> None:
+        entry = markers.get(i)
+        if entry and entry[0] == kind:
+            consumed.add(i)
+            for raw in entry[1].split(","):
+                found.append((i, raw.strip()))
+
+    take(lineno)
+    i = lineno - 1
+    while i >= 1 and ctx.line_text(i).strip().startswith("#"):
+        take(i)
+        i -= 1
+    return found
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``X`` for an ``self.X`` attribute expression, else None."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassContract:
+    """One class's annotation table: attr -> guarding lock(s)."""
+
+    def __init__(self) -> None:
+        self.guards: dict[str, set[str]] = {}
+        self.lock_attrs: set[str] = set()
+        self.findings: list[tuple[int, str]] = []
+
+
+def _collect_contract(ctx: FileContext, cls: ast.ClassDef,
+                      markers: dict,
+                      consumed: set[int]) -> _ClassContract:
+    contract = _ClassContract()
+    init = next((n for n in cls.body
+                 if isinstance(n, ast.FunctionDef)
+                 and n.name == "__init__"), None)
+    if init is None:
+        return contract
+    for node in ast.walk(init):
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        else:
+            continue
+        attrs = [a for a in (_self_attr(t) for t in targets) if a]
+        if not attrs:
+            continue
+        # Every self-assignment in __init__ may declare a lock attr
+        # (used to validate guard names) …
+        contract.lock_attrs.update(attrs)
+        # … and may carry a guarded-by annotation.
+        for lineno, name in _names_at(ctx, markers, node.lineno,
+                                      GUARDED_MARKER, consumed):
+            if not _ATTR_NAME_RE.match(name):
+                contract.findings.append(
+                    (lineno, f"malformed '# {GUARDED_MARKER}:' — write "
+                             f"'# {GUARDED_MARKER}: <lock-attr>' (a "
+                             "self attribute name, comma-separated "
+                             "for several)"))
+                continue
+            for attr in attrs:
+                contract.guards.setdefault(attr, set()).add(name)
+    for attr, locks in sorted(contract.guards.items()):
+        for lock in sorted(locks - contract.lock_attrs):
+            contract.findings.append(
+                (init.lineno,
+                 f"attribute self.{attr} is guarded-by self.{lock}, "
+                 f"but __init__ never assigns self.{lock} — the "
+                 "contract names a lock that does not exist"))
+    return contract
+
+
+def _with_locks(node: ast.With | ast.AsyncWith) -> set[str]:
+    """Lock attrs this ``with`` statement acquires (``with self.X:``,
+    including tuple/multiple items)."""
+    out = set()
+    for item in node.items:
+        attr = _self_attr(item.context_expr)
+        if attr:
+            out.add(attr)
+    return out
+
+
+def _check_method(ctx: FileContext, contract: _ClassContract,
+                  fn: ast.FunctionDef, markers: dict,
+                  consumed: set[int]) -> Iterator[tuple[int, str]]:
+    held: set[str] = set()
+    for lineno, name in _names_at(ctx, markers, fn.lineno,
+                                  HOLDS_MARKER, consumed):
+        if not _ATTR_NAME_RE.match(name):
+            yield (lineno, f"malformed '# {HOLDS_MARKER}:' — write "
+                           f"'# {HOLDS_MARKER}: <lock-attr>'")
+            continue
+        held.add(name)
+
+    findings: list[tuple[int, str]] = []
+
+    def visit(node: ast.AST, held: frozenset[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held | _with_locks(node)
+            for item in node.items:
+                visit(item, held)
+            for child in node.body:
+                visit(child, frozenset(inner))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # A nested def/lambda runs OUTSIDE this critical section
+            # (thread targets, callbacks): it inherits nothing.
+            for child in ast.iter_child_nodes(node):
+                visit(child, frozenset())
+            return
+        attr = _self_attr(node)
+        if attr is not None and attr in contract.guards:
+            need = contract.guards[attr]
+            if not (need & held):
+                lock = "/".join(sorted(need))
+                verb = ("written" if isinstance(
+                    node.ctx, (ast.Store, ast.Del)) else "read")
+                findings.append(
+                    (node.lineno,
+                     f"self.{attr} {verb} without holding "
+                     f"self.{lock} (guarded-by contract): wrap the "
+                     f"access in 'with self.{lock}:' or mark the "
+                     f"method '# {HOLDS_MARKER}: {lock}'"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fn.body:
+        visit(stmt, frozenset(held))
+    yield from findings
+
+
+@register
+class GuardedByPass(LintPass):
+    name = "guarded-by"
+    description = ("'# guarded-by: <lock>' attributes are accessed "
+                   "only under 'with self.<lock>' or in "
+                   "'# holds-lock:' methods")
+
+    def check(self, ctx: FileContext) -> Iterator[tuple[int, str]]:
+        if f"# {GUARDED_MARKER}:" not in ctx.source \
+                and f"# {HOLDS_MARKER}:" not in ctx.source:
+            return
+        markers = _marker_lines(ctx)
+        consumed: set[int] = set()
+        for cls in [n for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            contract = _collect_contract(ctx, cls, markers, consumed)
+            yield from contract.findings
+            if not contract.guards:
+                # holds-lock markers still need consuming (and
+                # validating) even in a class with no guarded attrs in
+                # THIS file — but without a contract there is nothing
+                # to check against.
+                for fn in cls.body:
+                    if isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        _names_at(ctx, markers, fn.lineno,
+                                  HOLDS_MARKER, consumed)
+                continue
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                        and fn.name != "__init__":
+                    yield from _check_method(ctx, contract, fn,
+                                             markers, consumed)
+        for lineno, (kind, _) in sorted(markers.items()):
+            if lineno not in consumed:
+                where = ("a 'self.<attr> = ...' statement in __init__"
+                         if kind == GUARDED_MARKER
+                         else "a method 'def' line")
+                yield (lineno,
+                       f"orphaned '# {kind}:' annotation — it must sit "
+                       f"on (or in the comment block directly above) "
+                       f"{where}, otherwise it binds nothing")

@@ -45,6 +45,7 @@ DCF_ERRORS = frozenset({
     "BatchTimeoutError",
     "RingEpochError",
     "StandbyExhaustedError",
+    "LockOrderError",
 })
 _ALWAYS_OK = DCF_ERRORS | {"NotImplementedError", "ForcedVerdict"}
 _MARKED_OK = frozenset({"ValueError", "TypeError"})

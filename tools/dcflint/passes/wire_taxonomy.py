@@ -1,0 +1,202 @@
+"""wire-taxonomy-sync: errors.py, the edge wire codes, and dcflint's
+own DCF_ERRORS list stay mutually exhaustive.
+
+Three artifacts describe the SAME error taxonomy: the class tree in
+``dcf_tpu/errors.py``; the wire mapping in ``dcf_tpu/serve/edge.py``
+(``E_*`` codes, the decode table ``WIRE_CODES``, the encode table
+``_EXC_CODES``, and ``WIRE_INTERNAL_ONLY`` — the explicit list of
+taxonomy classes that deliberately cross the wire as ``E_INTERNAL``);
+and the ``DCF_ERRORS`` frozenset the typed-error pass enforces raises
+against.  Before this pass, one pairing was runtime-tested
+(``test_taxonomy_list_in_sync``) and the rest was reviewer memory —
+so a new typed error could ship raisable but wire-opaque (every pod
+hop collapses it to ``E_INTERNAL``, the router loses the signal it
+routes failover on), or a wire code could outlive its class.
+
+This pass proves the triangle statically, using ``DCF_ERRORS`` as the
+hub.  On ``errors.py`` (any file of that basename defining
+``DcfError``): the ``DcfError``-rooted class closure must equal
+``DCF_ERRORS``, both directions.  On ``edge.py`` (any file of that
+basename defining ``WIRE_CODES``):
+
+* every ``E_*`` constant is a ``WIRE_CODES`` key, values unique,
+  every key an ``E_*`` constant — no orphan codes either way;
+* every ``DCF_ERRORS`` class either appears as a ``WIRE_CODES`` value
+  or is declared in ``WIRE_INTERNAL_ONLY`` (never both — a class
+  cannot be simultaneously coded and internal-only), and
+  ``WIRE_INTERNAL_ONLY`` names only taxonomy classes;
+* the encode table ``_EXC_CODES`` covers exactly the decode table's
+  classes, and each ``(cls, code)`` entry round-trips
+  (``WIRE_CODES[code] is cls``) — flavor codes like ``E_EVICTED``/
+  ``E_RATE_LIMITED`` are decode-side aliases and exempt from the
+  reverse direction.
+
+All checks are AST-level (no imports of the scanned file), so the
+pass works on fixtures and fails loudly on the real tree the moment
+any corner of the triangle drifts.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.dcflint import FileContext, LintPass, register
+from tools.dcflint.passes.typed_error import DCF_ERRORS
+
+
+def _name_set(node: ast.AST) -> set[str] | None:
+    """Names inside ``frozenset({A, B})`` / ``{A, B}`` / ``(A, B)``."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("frozenset", "set") and node.args:
+        return _name_set(node.args[0])
+    if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        out = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Name):
+                out.add(elt.id)
+        return out
+    return None
+
+
+def _check_errors_module(ctx: FileContext) -> Iterator[tuple[int, str]]:
+    classes: dict[str, ast.ClassDef] = {
+        n.name: n for n in ctx.tree.body if isinstance(n, ast.ClassDef)}
+    if "DcfError" not in classes:
+        return
+    # The DcfError-rooted closure, in definition order (bases are
+    # defined before subclasses in straight-line Python).
+    taxonomy = {"DcfError"}
+    for name, node in classes.items():
+        bases = {b.id for b in node.bases if isinstance(b, ast.Name)}
+        if bases & taxonomy:
+            taxonomy.add(name)
+    for name in sorted(taxonomy - DCF_ERRORS):
+        yield (classes[name].lineno,
+               f"taxonomy class {name} is missing from DCF_ERRORS in "
+               "tools/dcflint/passes/typed_error.py — the typed-error "
+               "pass would reject raising it")
+    for name in sorted(DCF_ERRORS - taxonomy):
+        yield (1, f"DCF_ERRORS names {name} but this module defines "
+                  "no such DcfError subclass — dead entry or missing "
+                  "class")
+
+
+def _check_edge_module(ctx: FileContext) -> Iterator[tuple[int, str]]:
+    e_consts: dict[str, tuple[int, int]] = {}  # name -> (value, line)
+    wire_codes: ast.Dict | None = None
+    wire_line = internal_line = exc_line = 1
+    internal_only: set[str] | None = None
+    exc_codes: list[tuple[str, str, int]] | None = None
+    for node in ctx.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name):
+            continue
+        target = node.targets[0].id
+        if target.startswith("E_") and isinstance(node.value,
+                                                  ast.Constant) \
+                and isinstance(node.value.value, int):
+            e_consts[target] = (node.value.value, node.lineno)
+        elif target == "WIRE_CODES" and isinstance(node.value, ast.Dict):
+            wire_codes, wire_line = node.value, node.lineno
+        elif target == "WIRE_INTERNAL_ONLY":
+            internal_only = _name_set(node.value)
+            internal_line = node.lineno
+        elif target == "_EXC_CODES" and isinstance(
+                node.value, (ast.Tuple, ast.List)):
+            exc_line = node.lineno
+            exc_codes = []
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Tuple) and len(elt.elts) == 2 \
+                        and all(isinstance(e, ast.Name)
+                                for e in elt.elts):
+                    exc_codes.append((elt.elts[0].id, elt.elts[1].id,
+                                      elt.lineno))
+    if wire_codes is None:
+        return
+
+    # -- E_* <-> WIRE_CODES keys ------------------------------------
+    key_names: set[str] = set()
+    decode: dict[str, str] = {}  # E_ name -> class name
+    for key, value in zip(wire_codes.keys, wire_codes.values):
+        if not isinstance(key, ast.Name) \
+                or key.id not in e_consts:
+            yield (getattr(key, "lineno", wire_line),
+                   "WIRE_CODES key is not a module-level E_* integer "
+                   "constant — codes must be named, documented "
+                   "constants")
+            continue
+        key_names.add(key.id)
+        if isinstance(value, ast.Name):
+            decode[key.id] = value.id
+    for name, (_, lineno) in sorted(e_consts.items()):
+        if name not in key_names:
+            yield (lineno,
+                   f"wire code {name} has no WIRE_CODES entry — the "
+                   "client cannot decode it (it would raise "
+                   "KeyFormatError on a frame the server legally "
+                   "sends)")
+    values = [v for v, _ in e_consts.values()]
+    if len(values) != len(set(values)):
+        dupes = sorted({v for v in values if values.count(v) > 1})
+        yield (wire_line,
+               f"duplicate E_* code value(s) {dupes}: two names, one "
+               "wire byte — the decode table cannot be injective")
+
+    # -- taxonomy coverage ------------------------------------------
+    coded = set(decode.values()) & DCF_ERRORS
+    if internal_only is None:
+        yield (wire_line,
+               "edge.py defines no WIRE_INTERNAL_ONLY — declare "
+               "(possibly empty) the taxonomy classes that "
+               "deliberately cross the wire as E_INTERNAL, so "
+               "coverage is a checked decision, not an accident")
+        internal_only = set()
+    for name in sorted(DCF_ERRORS - coded - internal_only):
+        yield (wire_line,
+               f"taxonomy class {name} has no wire code and is not "
+               "declared in WIRE_INTERNAL_ONLY: a pod hop would "
+               "silently collapse it to E_INTERNAL — add a code or "
+               "declare the collapse")
+    for name in sorted(internal_only & coded):
+        yield (internal_line,
+               f"{name} is declared WIRE_INTERNAL_ONLY but has a "
+               "wire code — it cannot be both; drop one")
+    for name in sorted(internal_only - DCF_ERRORS):
+        yield (internal_line,
+               f"WIRE_INTERNAL_ONLY names {name}, which is not in "
+               "the DCF_ERRORS taxonomy")
+
+    # -- encode table <-> decode table ------------------------------
+    if exc_codes is not None:
+        enc_names = {c for c, _, _ in exc_codes}
+        dec_names = set(decode.values())
+        for name in sorted(dec_names - enc_names):
+            yield (exc_line,
+                   f"WIRE_CODES decodes to {name} but _EXC_CODES "
+                   "never encodes it — the server would collapse it "
+                   "to E_INTERNAL and the code is dead")
+        for name in sorted(enc_names - dec_names):
+            yield (exc_line,
+                   f"_EXC_CODES encodes {name} but no WIRE_CODES "
+                   "entry decodes to it")
+        for cls, code, lineno in exc_codes:
+            if code in decode and decode[code] != cls:
+                yield (lineno,
+                       f"_EXC_CODES maps {cls} -> {code}, but "
+                       f"{code} decodes to {decode[code]} — the "
+                       "round trip changes the exception type")
+
+
+@register
+class WireTaxonomySyncPass(LintPass):
+    name = "wire-taxonomy-sync"
+    description = ("errors.py classes, edge.py E_*/WIRE_CODES/"
+                   "WIRE_INTERNAL_ONLY, and DCF_ERRORS stay mutually "
+                   "exhaustive")
+
+    def check(self, ctx: FileContext) -> Iterator[tuple[int, str]]:
+        if ctx.basename == "errors.py":
+            yield from _check_errors_module(ctx)
+        elif ctx.basename == "edge.py":
+            yield from _check_edge_module(ctx)
