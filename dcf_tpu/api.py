@@ -812,6 +812,22 @@ class Dcf:
         ``scale_in_m`` consecutive idle ticks drain the least-loaded
         one back, with a hard ``cooldown_s`` after any observed
         membership change — oscillating load produces zero churn.
+
+        Mesh co-evaluation (ISSUE 18, README "Mesh co-evaluation"):
+        routing scales keys, the mesh scales the BATCH — the router's
+        ``co_eval`` / ``co_eval_min_points`` knobs pick, per request,
+        between route-mode (one host walks all points) and
+        co-evaluate (``set_mesh()`` forms an epoch-fenced
+        ``serve.MeshGroup`` over the ring; the batch's 32-aligned
+        point slices scatter over EVERY worker and the share slices
+        gather back in plan order).  ``co_eval="auto"`` (default)
+        co-evaluates only at ``>= co_eval_min_points`` points — set
+        the threshold to the crossover measured by ``pod_bench
+        --mesh`` — and degrades typed mesh trouble
+        (``MeshUnavailableError``) back to route-mode, counted and
+        warned, never silent.  A co-evaluated key must be resident
+        mesh-wide: ``router.register_mesh_key`` registers it on every
+        worker under one generation.
         """
         from dcf_tpu.serve import DcfService, ServeConfig
 

@@ -38,7 +38,10 @@ from dcf_tpu.utils.bits import bits_lsb_to_bytes, unpack_lanes
 
 __all__ = ["DeviceKeyGen"]
 
-_ONES = jnp.uint32(0xFFFFFFFF)
+# numpy scalar, not jnp: a module-scope jnp constant would initialize
+# the JAX backend at import, breaking jax.distributed.initialize (which
+# must precede any computation); promotes identically inside jit.
+_ONES = np.uint32(0xFFFFFFFF)
 
 
 @partial(jax.jit, static_argnames=("n", "lam"))

@@ -4686,6 +4686,272 @@ def bench_pod_surge(args) -> None:
             shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_pod_mesh(args) -> None:
+    """``pod_bench --mesh`` (ISSUE 18): route-mode vs co-evaluate on
+    the SAME pod, recording the dispatch crossover.
+
+    Route-mode sends one batch to one host (its key's owner walks all
+    ``m`` points); co-evaluate scatters the same batch's 32-aligned
+    point slices over EVERY mesh worker through the zero-copy DCFE
+    relay and gathers the share slices back in plan order — the wall
+    clock for one big batch is the slowest slice, not the whole walk.
+    The crossover batch size (where co-evaluate first beats route-mode)
+    is what the router's ``co_eval_min_points`` threshold should be set
+    to on a given pod, so this bench measures and EMITS it.
+
+    Legs, in order:
+
+    1. **provision** — ``--bundles`` two-party bundles written durably
+       to EVERY shard's store (mesh-wide residency: a co-evaluated key
+       must be resident on all workers; the live-registration twin is
+       ``DcfRouter.register_mesh_key``, exercised in the mesh suite);
+    2. **spawn** — ``--shards`` serve_host subprocesses warm-restore
+       ALL keys; the parent builds a route-only router
+       (``co_eval="never"``) and a mesh router (``co_eval="always"``,
+       group formed over the full ring) over the identical pod;
+    3. **parity gate** — every key, both parties: the co-evaluated
+       reconstruction is bit-exact vs route-mode AND the numpy oracle
+       (scatter/gather must be invisible in the bytes);
+    4. **crossover ladder** — interleaved route/co-eval segments (one
+       ``--reps``-sampled leg pair per rung) over a geometric batch
+       ladder; per rung the median single-batch wall time becomes
+       evals/s per mode, and the crossover is the smallest rung where
+       co-evaluate wins;
+    5. **health check** — zero ``router_mesh_degraded_total`` (a
+       degrade mid-bench means the ladder silently measured route-mode
+       twice), co_evals accounted.
+
+    The crossover gate applies only when the host offers the pod
+    parallelism co-evaluation exists to exploit (>= shards + 1 CPUs);
+    on a smaller host the measured ladder is EMITTED with the gate
+    recorded environment-gated (the PR 3 floor-entry discipline — a
+    1-core container must not "pass" or "fail" a parallel-speedup
+    claim it cannot test).  Emits one ``RESULTS_mesh`` JSONL line."""
+    import os
+    import shutil
+    import statistics
+    import tempfile
+
+    from dcf_tpu.backends.numpy_backend import eval_batch_np
+    from dcf_tpu.ops.prg import HirosePrgNp
+    from dcf_tpu.serve import DcfRouter, ShardSpec
+
+    n_shards = args.shards
+    if n_shards < 2:
+        raise SystemExit(
+            f"--mesh needs --shards >= 2 (co-evaluating over one "
+            f"worker IS route-mode), got {n_shards}")
+    dcf, lam, nb, backend, rng = _serve_host_facade(args)
+    prg = HirosePrgNp(lam, dcf.cipher_keys)
+    n_bundles = args.bundles or 4
+    max_batch = args.max_batch or (1 << 10)
+    base = args.min_req_points or 128
+    top = args.max_req_points or (1 << 13)
+    if not 1 <= base <= top:
+        raise SystemExit(f"bad ladder range [{base}, {top}]")
+    reps = max(args.reps, 3)
+
+    keep_dirs = bool(args.store_dir)
+    root = args.store_dir or tempfile.mkdtemp(prefix="dcf-pod-")
+    os.makedirs(root, exist_ok=True)
+    shard_ids = [f"shard-{i}" for i in range(n_shards)]
+
+    # Leg 1: provision, then replicate every key to EVERY shard.
+    ring, stores, bundles, gens = _pod_provision(
+        dcf, lam, nb, rng, root, shard_ids, n_bundles)
+    for name in bundles:
+        placed = {s.host_id for s in ring.placement(name, replicas=1)}
+        owner = ring.owner(name).host_id
+        for tag in shard_ids:
+            if tag not in placed:
+                stores[owner].replicate_to(stores[tag], name)
+    log(f"provisioned {n_bundles} keys mesh-wide "
+        f"(every key on all {n_shards} shards)")
+
+    procs: dict = {}
+    routers: list = []
+    try:
+        for tag in shard_ids:
+            procs[tag] = _pod_spawn(tag, os.path.join(root, tag),
+                                    root, args)
+        ready = _pod_wait_ready(procs)
+        for tag, doc in ready.items():
+            if doc["restored"] != n_bundles or doc["quarantined"]:
+                raise SystemExit(
+                    f"pod_bench --mesh: shard {tag} restored "
+                    f"{doc['restored']}/{n_bundles} keys "
+                    f"({doc['quarantined']} quarantined)")
+        pod_specs = [ShardSpec(s, ready[s]["host"], ready[s]["port"])
+                     for s in shard_ids]
+        route_router = DcfRouter(pod_specs, n_bytes=nb,
+                                 co_eval="never")
+        mesh_router = DcfRouter(pod_specs, n_bytes=nb,
+                                co_eval="always")
+        mesh_router.set_mesh()
+        routers = [route_router, mesh_router]
+
+        # Leg 3: parity gate (both parties, both modes, numpy oracle).
+        xs_gate = rng.integers(0, 256, (3 * 32 + 7, nb), dtype=np.uint8)
+        for name, kb in bundles.items():
+            via_mesh = mesh_router.evaluate(name, xs_gate, b=0,
+                                            timeout=300) \
+                ^ mesh_router.evaluate(name, xs_gate, b=1, timeout=300)
+            via_route = route_router.evaluate(name, xs_gate, b=0,
+                                              timeout=300) \
+                ^ route_router.evaluate(name, xs_gate, b=1, timeout=300)
+            want = eval_batch_np(prg, 0, kb.for_party(0), xs_gate) \
+                ^ eval_batch_np(prg, 1, kb.for_party(1), xs_gate)
+            if not np.array_equal(via_mesh, want):
+                raise SystemExit(
+                    f"pod_bench --mesh: co-evaluated parity mismatch "
+                    f"vs numpy oracle on {name}")
+            if not np.array_equal(via_route, want):
+                raise SystemExit(
+                    f"pod_bench --mesh: route-mode parity mismatch "
+                    f"vs numpy oracle on {name}")
+        log(f"co-evaluated parity vs route-mode + numpy oracle: OK "
+            f"({n_bundles} keys x {xs_gate.shape[0]} pts, two-party)")
+
+        # Warm every padded batch shape on every worker, both dispatch
+        # modes, up to the ladder top (compile storms stay out of the
+        # timed region).
+        rungs = []
+        m = base
+        while m < top:
+            rungs.append(m)
+            m *= 4
+        rungs.append(top)
+        key0 = sorted(bundles)[0]
+        _pod_warmup(rng, nb, top,
+                    [(route_router,
+                      [names[0] for names in _group_by_owner(
+                          ring, bundles).values()]),
+                     (mesh_router, [key0])])
+        log(f"warmup ladder done (route + co-eval, top={top})")
+
+        # Leg 4: the crossover ladder — interleaved route/co-eval
+        # segments per rung, median single-batch wall time.
+        ladder = []
+        crossover = None
+        for m in rungs:
+            xs_m = rng.integers(0, 256, (m, nb), dtype=np.uint8)
+            times: dict = {"route": [], "coeval": []}
+            for rep in range(reps):
+                for leg, target in (("route", route_router),
+                                    ("coeval", mesh_router)):
+                    name = sorted(bundles)[rep % n_bundles]
+                    t0 = time.monotonic()
+                    target.evaluate(name, xs_m, b=0, timeout=300)
+                    times[leg].append(time.monotonic() - t0)
+            route_rate = m / statistics.median(times["route"])
+            coeval_rate = m / statistics.median(times["coeval"])
+            ladder.append({"points": m,
+                           "route_evals_per_sec": round(route_rate, 1),
+                           "coeval_evals_per_sec": round(coeval_rate,
+                                                         1)})
+            if crossover is None and coeval_rate >= route_rate:
+                crossover = m
+            log(f"ladder m={m}: route {route_rate:,.1f} vs co-eval "
+                f"{coeval_rate:,.1f} evals/s")
+
+        top_rung = ladder[-1]
+        coeval_vs_route = (top_rung["coeval_evals_per_sec"]
+                           / max(top_rung["route_evals_per_sec"], 1e-9))
+        cpus = len(os.sched_getaffinity(0))
+        gate_applies = cpus >= n_shards + 1
+        snap = mesh_router.metrics_snapshot()
+        co_evals = snap.get("router_co_evals_total", 0)
+        degraded = snap.get("router_mesh_degraded_total", 0)
+        log(f"crossover: {crossover} pts "
+            f"(coeval_vs_route@top={coeval_vs_route:.3f}, cpus={cpus}, "
+            f"gate {'applies' if gate_applies else 'environment-gated'})")
+
+        import jax
+
+        platform = jax.devices()[0].platform
+        extra = {
+            "mode": "mesh",
+            "shards": n_shards,
+            "bundles": n_bundles,
+            "mesh_workers": len(mesh_router.mesh_group),
+            "ladder": ladder,
+            "crossover_points": crossover,
+            "coeval_vs_route_at_top": round(coeval_vs_route, 3),
+            "co_evals": co_evals,
+            "mesh_degraded": degraded,
+            "reps": reps,
+            "max_batch": max_batch,
+            "crossover_gate": (
+                "applies (co-evaluate must win by the top rung)"
+                if gate_applies else
+                f"environment-gated: {cpus} CPU(s) visible for "
+                f"{n_shards} shard processes + router — the scattered "
+                "slices serialize onto the same core, so co-evaluate "
+                "pays its relay overhead with no parallel payback; "
+                f"the committed repro on a >= {n_shards + 1}-core "
+                "host (or a chip) is the gate"),
+            "platform": platform,
+            "repro": (f"python -m dcf_tpu.cli pod_bench --mesh "
+                      f"--shards {n_shards} "
+                      f"--bundles {n_bundles} --reps {reps} "
+                      f"--max-req-points {top} --seed {args.seed}"),
+        }
+        extra.update(_serve_pinned_ratio(
+            top_rung["coeval_evals_per_sec"], platform))
+        unit = ("evals/s (one co-evaluated batch spanning every "
+                "worker, top rung, party 0)")
+        if platform != "tpu":
+            unit += (" [no TPU this session: XLA-CPU interpret mode, "
+                     "disclosed]")
+        _emit("pod_bench", backend, "evals_per_sec",
+              top_rung["coeval_evals_per_sec"], unit,
+              extra_fields=extra)
+
+        # Emitted-then-asserted.  Warmup co-evals ride on top of the
+        # accounted ones, so the counter may only disagree upward.
+        failures = []
+        if co_evals < 2 * n_bundles + len(rungs) * reps:
+            failures.append(
+                f"router_co_evals_total={co_evals} does not cover the "
+                f"{2 * n_bundles + len(rungs) * reps} accounted "
+                "co-evaluated dispatches")
+        if degraded:
+            failures.append(
+                f"{degraded} co-evaluations degraded to route-mode "
+                "mid-bench (the ladder measured route twice)")
+        if gate_applies and crossover is None:
+            failures.append(
+                f"co-evaluate never beat route-mode by the top rung "
+                f"({top} pts) with {cpus} CPUs for {n_shards} workers")
+        if failures:
+            raise SystemExit("pod_bench --mesh: " + "; ".join(failures))
+    finally:
+        for target in routers:
+            try:
+                target.close()
+            except Exception:  # fallback-ok: best-effort teardown
+                pass
+        for tag, (proc, _r, _m) in procs.items():
+            if proc.poll() is None:
+                proc.terminate()
+        for tag, (proc, _r, _m) in procs.items():
+            try:
+                proc.wait(15)
+            except Exception:  # fallback-ok: a shard that ignores
+                # SIGTERM gets the hard kill below
+                proc.kill()
+        if not keep_dirs:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def _group_by_owner(ring, bundles) -> dict:
+    """{owner_host_id: [key, ...]} over the ring's placements."""
+    by_owner: dict = {}
+    for name in bundles:
+        by_owner.setdefault(ring.owner(name).host_id, []).append(name)
+    return by_owner
+
+
 def bench_pod(args) -> None:
     """The pod-scale serving acceptance bench (ISSUE 13): N localhost
     shard PROCESSES behind the zero-copy DCFE router, vs the same
@@ -4746,7 +5012,18 @@ def bench_pod(args) -> None:
     ISSUE 16: ``--surge`` runs the demand-driven autoscaling scenario
     instead (``bench_pod_surge``) — an open-loop Zipf ramp drives
     scale-out from a standby pool within the reaction bound, the idle
-    tail drains back, and an oscillating-load leg pins zero churn."""
+    tail drains back, and an oscillating-load leg pins zero churn.
+
+    ISSUE 18: ``--mesh`` runs the co-evaluation crossover scenario
+    instead (``bench_pod_mesh``) — route-mode vs one batch scattered
+    over every worker, on the same pod, recording the dispatch
+    crossover batch size."""
+    if getattr(args, "mesh", ""):
+        if args.surge or args.churn or args.partition or args.flap:
+            raise SystemExit(
+                "--mesh and --surge/--churn/--partition/--flap are "
+                "separate scenarios; pick one")
+        return bench_pod_mesh(args)
     if args.surge:
         if args.churn or args.partition or args.flap:
             raise SystemExit(
@@ -5229,11 +5506,12 @@ def main(argv=None) -> None:
     p.add_argument("--seed", type=int, default=2026)
     p.add_argument("--check", action="store_true",
                    help="verify parity vs the C++ core before timing")
-    p.add_argument("--mesh", default="",
+    p.add_argument("--mesh", default="", nargs="?", const="pod",
                    help="mesh shape KxP (e.g. 4x2) for the sharded "
                         "backends; with --backend=hybrid or "
                         "--backend=tree it switches to their mesh-sharded "
-                        "variants")
+                        "variants; bare --mesh on pod_bench runs the "
+                        "co-evaluation crossover scenario (ISSUE 18)")
     p.add_argument("--profile", default="",
                    help="write a jax.profiler trace of the timed region")
     p.add_argument("--n-bits", type=int, default=0,

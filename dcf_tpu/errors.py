@@ -27,6 +27,7 @@ grouping it with geometry staleness is what lets callers write one
       +-- RingEpochError         (RuntimeError) frame fenced: sender's ring is stale
       +-- StandbyExhaustedError  (RuntimeError) scale-out wanted, standby pool empty
       +-- LockOrderError         (RuntimeError) lock acquired against the recorded order
+      +-- MeshUnavailableError   (RuntimeError) co-evaluate mesh down: route-mode serves
 
 The serve-layer classes belong to the online serving layer
 (``dcf_tpu.serve``):
@@ -50,7 +51,14 @@ controller (``serve.capacity``, ISSUE 16) refuses an explicit
 scale-out when its declared standby pool is empty with
 ``StandbyExhaustedError`` — the automatic loop merely counts the skip,
 but an operator-invoked ``scale_out()`` must fail typed, naming the
-exhausted pool, instead of silently doing nothing.
+exhausted pool, instead of silently doing nothing.  The mesh
+co-evaluation tier (``serve.meshgroup``, ISSUE 18) reports a mesh that
+cannot take the scattered batch — a worker DOWN/suspect, the group's
+epoch fenced behind a membership commit, or no group formed — with
+``MeshUnavailableError``; in the router's default ``auto`` policy the
+error never reaches the caller (co-evaluate degrades to route-mode,
+counted and warned), but a caller who FORCED co-evaluation gets it
+typed with the probe interval as ``retry_after_s``.
 
 Recovery is signalled, not silent: whenever the framework degrades to a
 slower-but-correct path (auto backend fallback, AES-NI -> portable native
@@ -75,6 +83,7 @@ __all__ = [
     "RingEpochError",
     "StandbyExhaustedError",
     "LockOrderError",
+    "MeshUnavailableError",
     "BackendFallbackWarning",
 ]
 
@@ -250,6 +259,31 @@ class LockOrderError(DcfError, RuntimeError):
         super().__init__(message)
         self.cycle = tuple(cycle)
         self.stacks = tuple(stacks)
+
+
+class MeshUnavailableError(DcfError, RuntimeError):
+    """The device-mesh co-evaluation tier cannot take this batch
+    (ISSUE 18, ``serve.meshgroup``): a mesh worker is DOWN or suspect,
+    the mesh group's formation epoch is fenced behind a newer
+    membership commit (the ring moved; the group must be re-formed),
+    or the router simply has no group configured while the caller
+    forced co-evaluation.  Route-mode — one host, one key — remains
+    available: under the default ``co_eval="auto"`` policy the router
+    absorbs this error itself (degrades the batch to route-mode,
+    counted ``router_mesh_degraded_total`` + ``BackendFallbackWarning``,
+    zero lost keys), so only a caller who demanded the mesh
+    (``co_eval="always"``) ever sees it.
+
+    ``retry_after_s``: one health-probe interval — the next probe
+    round either recovers the worker or promotes its replacement, and
+    a fenced group is one ``set_mesh`` re-formation away.  Crosses the
+    wire as its own code (``E_MESH_UNAVAILABLE``) so a pod client can
+    tell "the mesh is down, route-mode still serves" from
+    ``E_UNAVAILABLE``'s backend-down signal."""
+
+    def __init__(self, *args, retry_after_s: float | None = None):
+        super().__init__(*args)
+        self.retry_after_s = retry_after_s
 
 
 class BackendFallbackWarning(UserWarning):

@@ -17,8 +17,14 @@ from dcf_tpu.ops.aes import SBOX_NP, SHIFT_ROWS_NP
 
 __all__ = ["aes256_encrypt_jax"]
 
-_SBOX_J = jnp.asarray(SBOX_NP)
-_SHIFT_J = jnp.asarray(SHIFT_ROWS_NP)
+def _tables() -> tuple[jnp.ndarray, jnp.ndarray]:
+    # Built per call, never at module scope or cached: a module-scope
+    # jnp.asarray would initialize the JAX backend at import time
+    # (jax.distributed.initialize in parallel/_compat must precede ANY
+    # computation), and a cache primed inside a jit/scan trace would
+    # leak that trace's constant tracer into every later trace.  Under
+    # jit these are folded constants; the eager cost is a 272-byte put.
+    return jnp.asarray(SBOX_NP), jnp.asarray(SHIFT_ROWS_NP)
 
 
 def _xtime(a: jnp.ndarray) -> jnp.ndarray:
@@ -28,10 +34,11 @@ def _xtime(a: jnp.ndarray) -> jnp.ndarray:
 
 def aes256_encrypt_jax(round_keys: jnp.ndarray, blocks: jnp.ndarray) -> jnp.ndarray:
     """Encrypt uint8 blocks [..., 16] under round_keys uint8 [15, 16]."""
+    sbox_j, shift_j = _tables()
     s = blocks ^ round_keys[0]
     for rnd in range(1, 14):
-        s = jnp.take(_SBOX_J, s)
-        s = s[..., _SHIFT_J]
+        s = jnp.take(sbox_j, s)
+        s = s[..., shift_j]
         a = s.reshape(*s.shape[:-1], 4, 4)
         a0, a1, a2, a3 = a[..., 0], a[..., 1], a[..., 2], a[..., 3]
         mixed = jnp.stack(
@@ -44,6 +51,6 @@ def aes256_encrypt_jax(round_keys: jnp.ndarray, blocks: jnp.ndarray) -> jnp.ndar
             axis=-1,
         )
         s = mixed.reshape(*blocks.shape) ^ round_keys[rnd]
-    s = jnp.take(_SBOX_J, s)
-    s = s[..., _SHIFT_J]
+    s = jnp.take(sbox_j, s)
+    s = s[..., shift_j]
     return s ^ round_keys[14]

@@ -21,6 +21,10 @@ from dcf_tpu.parallel.mesh import (  # noqa: F401
     ShardedBitslicedBackend,
     ShardedJaxBackend,
     make_mesh,
+    make_pod_mesh,
+)
+from dcf_tpu.parallel.mesh_eval import (  # noqa: F401
+    MeshLargeLambdaBackend,
 )
 from dcf_tpu.parallel.pallas_sharded import (  # noqa: F401
     ShardedKeyLanesBackend,

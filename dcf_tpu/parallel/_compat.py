@@ -6,6 +6,15 @@ namespace, and its replication-check kwarg was renamed ``check_rep`` ->
 the current API; this shim keeps them importable and runnable on older
 jax instead of dying on ``AttributeError``/``TypeError`` — the same
 degrade-don't-crash rule the rest of the fault-tolerance layer follows.
+
+ISSUE 18 widens the shim to the MULTI-PROCESS surface the pod mesh
+rides: ``jax.distributed.initialize`` (whose CPU-collectives knob has
+moved between a config option and an env var across versions) and the
+host-local -> process-spanning-global array conversion (which has lived
+in ``jax.experimental.multihost_utils`` and grown a sibling spelling in
+the ``jax`` namespace).  The dcflint compat-shim pass enforces that no
+other module touches these names raw — a future rename is one shim
+edit, not an AttributeError scattered over the mesh tier.
 """
 
 from __future__ import annotations
@@ -13,6 +22,8 @@ from __future__ import annotations
 import inspect
 
 import jax
+
+from dcf_tpu.errors import BackendUnavailableError
 
 _sm = getattr(jax, "shard_map", None)
 if _sm is None:  # pre-move jax: the experimental location
@@ -23,10 +34,90 @@ _CHECK_KW = (
     else "check_rep"
 )
 
-__all__ = ["shard_map"]
+try:  # the host-local -> global conversion's long-term home
+    from jax.experimental import multihost_utils as _mhu
+except ImportError:  # pragma: no cover - ancient jax: single-host only
+    _mhu = None
+
+__all__ = ["shard_map", "distributed_initialize", "process_index",
+           "process_count", "host_to_global"]
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
     """``jax.shard_map`` with the kwarg spelling this jax understands."""
     return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                **{_CHECK_KW: check_vma})
+
+
+def distributed_initialize(coordinator_address: str, num_processes: int,
+                           process_id: int,
+                           cpu_collectives: str = "gloo") -> None:
+    """``jax.distributed.initialize`` with the skew handled (ISSUE 18).
+
+    Joins this process to the pod's multi-process runtime: after it
+    returns, ``jax.devices()`` enumerates EVERY process's devices and a
+    mesh built over them spans hosts.  ``cpu_collectives`` selects the
+    CPU cross-process collectives backend where this jax exposes the
+    knob (the config option has come and gone across versions; where
+    absent, jax's own default stands).  Idempotent: a repeat call on an
+    already-initialized runtime is a no-op, not an error — the serving
+    tier may race a test harness to it.
+
+    Failure to reach the coordinator (or an unusable runtime) raises a
+    typed ``BackendUnavailableError`` instead of an opaque runtime
+    traceback — the same contract as ``make_mesh``'s provisioning seam.
+    """
+    try:
+        jax.config.update("jax_cpu_collectives_implementation",
+                          cpu_collectives)
+    except Exception:  # fallback-ok: the knob was removed (newer jax
+        # picks the collectives implementation itself) or never existed
+        pass
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=int(num_processes),
+            process_id=int(process_id))
+    except RuntimeError as e:
+        if "already initialized" in str(e).lower():
+            return  # idempotent re-entry
+        raise BackendUnavailableError(
+            f"jax.distributed.initialize failed for process "
+            f"{process_id}/{num_processes} at {coordinator_address!r} "
+            f"({type(e).__name__}: {e})") from e
+    except Exception as e:  # fallback-ok: typed re-raise, any runtime
+        # or protocol error joining the pod
+        raise BackendUnavailableError(
+            f"jax.distributed.initialize failed for process "
+            f"{process_id}/{num_processes} at {coordinator_address!r} "
+            f"({type(e).__name__}: {e})") from e
+
+
+def process_index() -> int:
+    """This process's index in the distributed runtime (0 standalone)."""
+    return int(jax.process_index())
+
+
+def process_count() -> int:
+    """Total processes in the distributed runtime (1 standalone)."""
+    return int(jax.process_count())
+
+
+def host_to_global(arr, mesh, spec) -> jax.Array:
+    """Host-local array -> process-spanning global array on ``mesh``.
+
+    Along ``spec`` dimensions whose mesh axes span processes, each
+    process contributes its LOCAL slice and the global array is their
+    concatenation in mesh order; along everything else the inputs must
+    be identical across processes (replication).  On a single-process
+    mesh (or a jax too old for multihost_utils) this degrades to a
+    plain placed ``device_put`` — same result, no cross-process step.
+    """
+    import numpy as np
+
+    from jax.sharding import NamedSharding
+
+    arr = np.asarray(arr)
+    if _mhu is None or jax.process_count() == 1:
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+    return _mhu.host_local_array_to_global_array(arr, mesh, spec)

@@ -28,7 +28,8 @@ from dcf_tpu.ops.aes import expand_key_np
 from dcf_tpu.spec import hirose_used_cipher_indices
 from dcf_tpu.testing.faults import fire
 
-__all__ = ["make_mesh", "ShardedJaxBackend", "ShardedBitslicedBackend"]
+__all__ = ["make_mesh", "make_pod_mesh", "ShardedJaxBackend",
+           "ShardedBitslicedBackend"]
 
 
 def make_mesh(
@@ -71,6 +72,49 @@ def make_mesh(
     return Mesh(
         np.array(devs[: keys_dim * points]).reshape(keys_dim, points), axis_names
     )
+
+
+def make_pod_mesh(
+    axis_names: tuple[str, str] = ("keys", "points"),
+    shape: tuple[int, int] | None = None,
+) -> Mesh:
+    """Build the POD mesh: a 2D (keys x points) mesh over EVERY device
+    of every process in the distributed runtime (ISSUE 18).
+
+    Where ``make_mesh`` factorizes one host's devices (and defaults the
+    larger factor to the keys axis), the pod mesh exists for
+    co-evaluation — one batch laid across all hosts — so it must cover
+    ALL global devices and it defaults to ``(1, n_global)``: the ring
+    already shards *keys* across hosts (``serve.shardmap``), so the
+    mesh's job is to shard *points*; a keys axis wider than 1 would
+    re-shard what the ring placed.  Call
+    ``parallel._compat.distributed_initialize`` on every process first;
+    standalone (single-process) the "pod" is just this host's devices,
+    which is exactly what the parity tests exercise.
+
+    ``shape=(keys_dim, points_dim)`` must cover the global device count
+    exactly — a pod mesh with idle devices is a configuration error,
+    not a fallback.  Same typed provisioning contract and
+    ``faults.fire("mesh.provision")`` seam as ``make_mesh``.
+    """
+    try:
+        fire("mesh.provision")
+        devs = jax.devices()
+    except Exception as e:  # fallback-ok: typed re-raise, any runtime error
+        raise BackendUnavailableError(
+            f"pod mesh provisioning failed: could not enumerate devices "
+            f"({type(e).__name__}: {e})") from e
+    n = len(devs)
+    if shape is None:
+        keys_dim, points = 1, n
+    else:
+        keys_dim, points = shape
+        if keys_dim * points != n:
+            raise ValueError(  # api-edge: documented pod-mesh contract —
+                # the pod mesh must span every global device exactly
+                f"pod mesh shape {shape} does not cover all {n} global "
+                f"devices")
+    return Mesh(np.array(devs).reshape(keys_dim, points), axis_names)
 
 
 class ShardedJaxBackend:

@@ -1,0 +1,128 @@
+"""Pod-mesh co-evaluation: one batch spanning every host's devices.
+
+ISSUE 18's device-placement half.  ``ShardedLargeLambdaBackend`` lays a
+batch across ONE process's devices; ``MeshLargeLambdaBackend`` extends
+it across every process of a ``jax.distributed`` runtime — each host
+contributes its equal-length slice of the points batch, the bundle image
+is replicated (the pod mesh's keys axis is pinned to 1: the RING shards
+keys across hosts via ``serve.shardmap``, so the mesh's only job is to
+shard POINTS), the narrow Pallas walk + wide MXU tail run as the same
+pure map per device block, and the two-party verification scalar
+(``points_mismatch_count``) is the one collective at the end — a
+replicated device int32 every process can read.
+
+Contract per process (all processes must make the same calls in the
+same order — jax's multi-process SPMD rule):
+
+* ``distributed_initialize`` (``parallel._compat``), then
+  ``make_pod_mesh()`` — default shape ``(1, n_global_devices)``.
+* ``put_bundle(bundle)`` with the IDENTICAL bundle everywhere.
+* ``stage(xs_local)`` with THIS process's slice of the batch; slices
+  must be equal length (pad the tail slice — pad points are genuine
+  x=0 evaluations and self-verify).
+* ``eval_staged`` returns the process-spanning global [K, M, lam];
+  ``staged_to_bytes`` reads back THIS process's local slice of it.
+
+Single-process (no distributed runtime) every conversion degrades to a
+plain placed ``device_put`` and the backend is bit-identical to
+``ShardedLargeLambdaBackend`` over the same devices — which is exactly
+the equivalence the parity suite pins.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dcf_tpu.errors import ShapeError, StaleStateError
+from dcf_tpu.parallel._compat import host_to_global, process_count
+from dcf_tpu.parallel.pallas_sharded import ShardedLargeLambdaBackend
+
+__all__ = ["MeshLargeLambdaBackend"]
+
+
+class MeshLargeLambdaBackend(ShardedLargeLambdaBackend):
+    """The large-lambda hybrid over a multi-process pod mesh.
+
+    All staging, kernel dispatch, and verification logic is inherited;
+    this subclass only swaps the two placement seams
+    (``_place_bundle_array`` / ``_place_xs``) for the host-local ->
+    global conversion and re-derives the points granule from the LOCAL
+    device count (each process pads its own slice).  From-root narrow
+    walk only (``prefix_levels=0``): the prefix frontier build walks an
+    eager single-device pallas_call, which has no multi-process story
+    yet.
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes], mesh: Mesh,
+                 col_chunk: int = 1 << 15, interpret: bool = False):
+        kaxis = mesh.axis_names[0]
+        if mesh.shape[kaxis] != 1:
+            raise ShapeError(
+                f"pod mesh keys axis must be 1 (the ring shards keys "
+                f"across hosts; the mesh shards points), got "
+                f"{mesh.shape[kaxis]}")
+        super().__init__(lam, cipher_keys, mesh, col_chunk=col_chunk,
+                         interpret=interpret, prefix_levels=0)
+        self._nproc = process_count()
+        if self._psize % self._nproc:
+            raise ShapeError(
+                f"points-axis size {self._psize} not divisible by "
+                f"process count {self._nproc}")
+        # Devices this process contributes to the points axis — the
+        # padding granule below is per-LOCAL-slice, not per-pod.
+        self._local_psize = self._psize // self._nproc
+        # The parent commits these to a local device at construction;
+        # re-place as replicated globals so the jitted shard_map sees
+        # consistently-addressed operands on every process.
+        self.rk2 = host_to_global(np.asarray(self.rk2), mesh, P())
+        self._inv_perm = host_to_global(
+            np.asarray(self._inv_perm), mesh, P())
+
+    def _place_bundle_array(self, v):
+        # Keys axis is 1 => no mesh axis of the spec spans processes:
+        # replication semantics, every process passes the identical
+        # bundle-derived array (the put_bundle contract).
+        return host_to_global(np.asarray(v), self.mesh, self._spec_keyed)
+
+    def _place_xs(self, xs: np.ndarray):
+        # Points axis spans processes: each process contributes its
+        # local slice and the global batch is their concatenation in
+        # process order.
+        return host_to_global(
+            np.ascontiguousarray(xs)[None], self.mesh, self._spec_xs)
+
+    def stage(self, xs: np.ndarray) -> dict:
+        """Stage THIS process's slice ``xs`` uint8 [M_local, nb].
+
+        Every process must stage an equal-length slice; ``m`` in the
+        returned dict is the LOCAL point count (what this process's
+        ``staged_to_bytes`` clips to)."""
+        if self._dev is None:
+            raise StaleStateError(
+                "no key bundle on device; call put_bundle first")
+        if xs.ndim != 2:
+            raise ShapeError(
+                "MeshLargeLambdaBackend wants this process's shared-"
+                "points slice [M_local, nb]")
+        m = xs.shape[0]
+        per_dev = -(-m // self._local_psize)
+        granule = self._local_psize * (4096 if per_dev > 4096 else 32)
+        m_pad = -(-m // granule) * granule
+        if m_pad != m:
+            xs = np.pad(xs, [(0, m_pad - m), (0, 0)])
+        return {"xs": self._place_xs(xs), "m": m}
+
+    def staged_to_bytes(self, y, m: int) -> np.ndarray:
+        """This process's slice of the global output, uint8 [K, m, lam].
+
+        The global [K, M_global, lam] is only partially addressable
+        here; concatenate the local shards in points order and clip the
+        local padding."""
+        shards = sorted(y.addressable_shards,
+                        key=lambda s: s.index[1].start or 0)
+        local = np.concatenate([np.asarray(s.data) for s in shards],
+                               axis=1)
+        return local[:, :m, :]

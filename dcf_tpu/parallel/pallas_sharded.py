@@ -367,27 +367,44 @@ class ShardedLargeLambdaBackend(LargeLambdaBackend):
         # for it (prefix path only; the from-root path has no consumer
         # and must not pin a duplicate of the plane image).
         self._dev_host = dict(self._dev) if self.prefix_levels else None
-        sh = NamedSharding(self.mesh, self._spec_keyed)
-        self._dev = {k: jax.device_put(v, sh) for k, v in self._dev.items()}
+        self._dev = {k: self._place_bundle_array(v)
+                     for k, v in self._dev.items()}
         if self.prefix_levels:
             self._slice_cw_rem()  # re-slice from the PLACED image
 
     def _narrow_dev_for_build(self) -> dict:
         return self._dev_host
 
+    def _place_bundle_array(self, v) -> jax.Array:
+        """Place one keys-axis array (bundle plane, frontier table, wide
+        factor) on the mesh.  Every bundle-image placement funnels
+        through here so the pod-mesh subclass
+        (``parallel.mesh_eval.MeshLargeLambdaBackend``) can swap in the
+        host-local -> process-spanning-global conversion without
+        re-implementing staging."""
+        return jax.device_put(v, NamedSharding(self.mesh, self._spec_keyed))
+
+    def _place_xs(self, xs: np.ndarray) -> jax.Array:
+        """Place the padded points batch as [1, M, nb] sharded over the
+        points axis — the other placement seam the pod subclass
+        overrides (there, each process contributes its local slice)."""
+        return jax.device_put(
+            np.ascontiguousarray(xs)[None],
+            NamedSharding(self.mesh, self._spec_xs))
+
     def _build_frontier_tables(self, b: int):
         """Build, then place across the mesh's keys axis — the cache
         (instance store or serve frontier cache) holds the PLACED copy,
         so a cache hit never re-broadcasts from device 0."""
         state_tbl, traj_tbl = super()._build_frontier_tables(b)
-        sh = NamedSharding(self.mesh, self._spec_keyed)
-        return jax.device_put(state_tbl, sh), jax.device_put(traj_tbl, sh)
+        return (self._place_bundle_array(state_tbl),
+                self._place_bundle_array(traj_tbl))
 
     def _wide_staged(self):
         if self._wide is None:
             super()._wide_staged()
-            sh = NamedSharding(self.mesh, self._spec_keyed)
-            self._wide = tuple(jax.device_put(a, sh) for a in self._wide)
+            self._wide = tuple(self._place_bundle_array(a)
+                               for a in self._wide)
         return self._wide
 
     def stage(self, xs: np.ndarray) -> dict:
@@ -402,9 +419,7 @@ class ShardedLargeLambdaBackend(LargeLambdaBackend):
         m_pad = -(-m // granule) * granule
         if m_pad != m:
             xs = np.pad(xs, [(0, m_pad - m), (0, 0)])
-        xs_dev = jax.device_put(
-            np.ascontiguousarray(xs)[None],
-            NamedSharding(self.mesh, self._spec_xs))
+        xs_dev = self._place_xs(xs)
         staged = {"xs": xs_dev, "m": m}
         if self.prefix_levels:
             fields = self._prefix_stage_fields(

@@ -40,6 +40,12 @@ keys":
   with per-tenant token buckets (``TenantSpec`` in
   ``ServeConfig.tenants``), and typed wire error frames carrying
   retry-after hints; ``EdgeClient`` is the pipelining counterpart;
+- ``serve.meshgroup`` the co-evaluation group (ISSUE 18): device
+  placement for one batch spanning every host — 32-aligned contiguous
+  point slices per mesh worker, epoch-fenced formation; the router's
+  "co-evaluate" dispatch mode scatters over it and gathers shares
+  back in plan order, degrading typed to route-mode when the mesh
+  cannot take the batch;
 - ``serve.shardmap``  the pod shard ring (ISSUE 13): rendezvous
   placement of keys onto host shards — deterministic keyed-digest
   scores, minimal disruption under membership change, the replica
@@ -103,6 +109,7 @@ from dcf_tpu.serve.membership import (  # noqa: F401
     MembershipController,
     MembershipEvent,
 )
+from dcf_tpu.serve.meshgroup import MeshGroup, MeshSlice  # noqa: F401
 from dcf_tpu.serve.metrics import Metrics, rollup_snapshots  # noqa: F401
 from dcf_tpu.serve.registry import KeyRegistry  # noqa: F401
 from dcf_tpu.serve.replicate import Replicator  # noqa: F401
@@ -117,6 +124,7 @@ __all__ = ["DcfService", "ServeConfig", "ServeFuture", "Priority",
            "CapacityVerdict", "DcfRouter", "FrontierCache",
            "HealthEvent", "HealthProber", "KeyFactory", "Metrics",
            "KeyRegistry", "KeyStore", "MembershipController",
-           "MembershipEvent", "PoolSpec", "Replicator",
+           "MembershipEvent", "MeshGroup", "MeshSlice", "PoolSpec",
+           "Replicator",
            "RestoreReport", "ShardMap", "ShardSpec",
            "rollup_snapshots"]

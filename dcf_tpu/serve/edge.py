@@ -206,6 +206,7 @@ from dcf_tpu.errors import (
     KeyFormatError,
     KeyQuarantinedError,
     LockOrderError,
+    MeshUnavailableError,
     NativeBuildError,
     QueueFullError,
     RingEpochError,
@@ -304,6 +305,13 @@ E_EPOCH = 14  # RingEpochError (ISSUE 15): the SENDER's ring is stale —
 #               one this frame carries.  Neither a shard-health signal
 #               (the shard is fine) nor a key-level outcome: the
 #               sender must refresh its ring before retrying
+E_MESH_UNAVAILABLE = 15  # MeshUnavailableError (ISSUE 18): the pod's
+#               device-mesh co-evaluation tier cannot take the batch
+#               (worker down, group epoch fenced, no group) while the
+#               caller FORCED co-evaluation.  Distinct from
+#               E_UNAVAILABLE: route-mode still serves — the caller's
+#               recovery is "retry without forcing the mesh", not
+#               "back off from a dead backend"
 
 #: code -> exception class the client raises (see ``_raise_wire``).
 WIRE_CODES = {
@@ -321,6 +329,7 @@ WIRE_CODES = {
     E_EVICTED: QueueFullError,
     E_STALE: StaleStateError,
     E_EPOCH: RingEpochError,
+    E_MESH_UNAVAILABLE: MeshUnavailableError,
 }
 
 #: Taxonomy classes that DELIBERATELY cross the wire as ``E_INTERNAL``
@@ -347,6 +356,7 @@ _EXC_CODES = (
     (ShapeError, E_SHAPE),
     (RingEpochError, E_EPOCH),
     (StaleStateError, E_STALE),
+    (MeshUnavailableError, E_MESH_UNAVAILABLE),
     (BackendUnavailableError, E_UNAVAILABLE),
     (DcfError, E_INTERNAL),
     (ValueError, E_BAD_REQUEST),
@@ -1629,7 +1639,7 @@ def _raise_wire(code: int, retry_after_s: float | None, msg: str):
     if cls is QueueFullError:
         err = cls(msg, retry_after_s=retry_after_s,
                   evicted=code == E_EVICTED)
-    elif cls in (CircuitOpenError, RingEpochError):
+    elif cls in (CircuitOpenError, RingEpochError, MeshUnavailableError):
         err = cls(msg, retry_after_s=retry_after_s)
     elif cls is ValueError:
         # api-edge: the server flagged a request-contract violation

@@ -95,6 +95,25 @@ whose group snapshot predates the swap fails ``StaleStateError``
 exactly as an in-process one would (PR 5's guard) — the router relays
 the typed error and never pairs stale images.
 
+Mesh co-evaluation (ISSUE 18): next to "route" — one host, one key —
+the router speaks a second dispatch mode, "co-evaluate": ONE batch
+laid across EVERY mesh worker.  ``set_mesh`` forms a ``MeshGroup``
+(``serve.meshgroup`` — device placement, deliberately separate from
+the ring's key placement) fenced at the current ring epoch;
+``register_mesh_key`` makes a key pod-resident (owner mints through
+the ring walk, every other worker applies preserved); a qualifying
+request (``co_eval`` policy x ``co_eval_min_points`` threshold) is
+SCATTERED as zero-copy sub-views of the same frame buffer — each
+worker takes a 32-aligned contiguous point slice through the existing
+DCFE relay — and the shares are GATHERED back in plan order.  The
+mesh is an optimization, never a liability: a worker death, a fenced
+epoch, or a missing group degrades the whole batch to route-mode —
+counted ``router_mesh_degraded_total``, warned
+``BackendFallbackWarning``, zero lost keys — unless the caller FORCED
+the mesh (``co_eval="always"``), who gets ``MeshUnavailableError``
+typed with the probe interval as the hint.  Fault seam:
+``faults.fire("mesh.collective")`` at each co-evaluated dispatch.
+
 TLS (ISSUE 13 satellite): give the router ``tls_*`` client knobs and
 each shard's ``EdgeServer`` a cert (plus ``tls_client_ca`` to PIN the
 router's client cert) and the router<->shard links are encrypted and
@@ -111,12 +130,15 @@ observation, not consensus.
 from __future__ import annotations
 
 import threading
+import warnings
 
 import numpy as np
 
 from dcf_tpu.errors import (
+    BackendFallbackWarning,
     BackendUnavailableError,
     CircuitOpenError,
+    MeshUnavailableError,
     ShapeError,
 )
 from dcf_tpu.serve.admission import Priority, parse_priority
@@ -128,10 +150,12 @@ from dcf_tpu.serve.edge import (
     EdgeServer,
 )
 from dcf_tpu.serve.health import DOWN, SUSPECT, HealthProber
+from dcf_tpu.serve.meshgroup import MeshGroup
 from dcf_tpu.serve.metrics import Metrics, labeled
 from dcf_tpu.serve.replicate import Replicator
 from dcf_tpu.serve.service import ServeConfig
 from dcf_tpu.serve.shardmap import ShardMap, ShardSpec
+from dcf_tpu.testing.faults import fire
 from dcf_tpu.utils.benchtime import monotonic
 
 __all__ = ["DcfRouter"]
@@ -205,6 +229,69 @@ class _RelayFuture:
                 self._args = None  # one inline failover per request
 
 
+class _MeshFuture:
+    """The future a co-evaluated submit returns: waits on every
+    scattered slice IN PLAN ORDER and concatenates the share planes
+    back along the point axis.  Owns the response-time half of the
+    degradation policy: a worker that dies mid-batch (shard-indicting
+    signal) is marked suspect and — unless the caller forced the mesh
+    — the WHOLE batch is re-routed once through route-mode (zero lost
+    keys; the surviving workers' partial shares are discarded, not
+    stitched to a re-evaluation).  Key-level outcomes pass through
+    verbatim, same contract as the relay future."""
+
+    __slots__ = ("_router", "_parts", "_args")
+
+    def __init__(self, router: "DcfRouter", parts, args: tuple | None):
+        self._router = router
+        self._parts = parts  # [(inner, ShardSpec, MeshSlice)], plan order
+        self._args = args  # (key_id, data, m, b, deadline_ms, pri),
+        # or None when degradation is spent / forced-mesh (no re-route)
+
+    def done(self) -> bool:
+        return all(inner.done() for inner, _s, _sl in self._parts)
+
+    def result(self, timeout: float | None = None) -> np.ndarray:
+        # One deadline across the gather AND a possible degradation:
+        # a caller's result(5) budget is shared by every slice wait
+        # and the route-mode re-submission, not multiplied by them.
+        deadline = None if timeout is None \
+            else self._router._clock() + timeout
+        shares = []
+        for inner, spec, _sl in self._parts:
+            remaining = None if deadline is None else max(
+                deadline - self._router._clock(), 0.0)
+            try:
+                shares.append(inner.result(remaining))
+            except TimeoutError:
+                raise
+            except Exception as e:  # fallback-ok: classified below —
+                # worker death degrades (or surfaces typed when the
+                # mesh was forced); key-level outcomes are the
+                # caller's, verbatim
+                if not _suspect_signal(e):
+                    if getattr(e, "wire_code", None) == E_EPOCH:
+                        self._router._c_stale_epoch.inc()
+                    raise
+                self._router.mark_suspect(
+                    spec.host_id, getattr(e, "retry_after_s", None))
+                if self._args is None:
+                    raise MeshUnavailableError(
+                        f"mesh worker {spec.host_id!r} died mid-batch "
+                        f"({type(e).__name__}: {e})",
+                        retry_after_s=self._router.health.interval_s
+                    ) from e
+                key_id, data, m, b, deadline_ms, pri = self._args
+                self._router._mesh_degrade(
+                    f"worker {spec.host_id!r} died mid-batch", e)
+                fut = self._router._submit_route(
+                    key_id, data, m, b, deadline_ms, pri)
+                remaining = None if deadline is None else max(
+                    deadline - self._router._clock(), 0.0)
+                return fut.result(remaining)
+        return np.concatenate(shares, axis=1)
+
+
 class DcfRouter:
     """DCFE router over a shard ring (see the module docstring).
 
@@ -225,6 +312,14 @@ class DcfRouter:
     ``health.pump()`` deterministically.  ``local_tag`` names this
     router on the ``net.partition`` fault seam.
 
+    ``co_eval`` / ``co_eval_min_points`` (ISSUE 18): the co-evaluate
+    dispatch policy.  ``"auto"`` (default) scatters a request across
+    the mesh group when one is formed AND the batch reaches
+    ``co_eval_min_points`` (the measured crossover — see ``pod_bench
+    --mesh``), degrading to route-mode on any mesh trouble;
+    ``"never"`` disables the mesh path; ``"always"`` forces it and
+    surfaces mesh trouble typed (``MeshUnavailableError``).
+
     ``start(host, port)`` fronts the router with its own
     ``EdgeServer`` (DCFE downstream); in-process callers can skip it
     and drive ``submit``/``submit_bytes``/``evaluate`` directly (the
@@ -243,6 +338,8 @@ class DcfRouter:
                  tls_key: str = "", probe_interval_s: float = 0.25,
                  probe_timeout_s: float | None = None,
                  probe_fail_n: int = 3, probe_recover_m: int = 2,
+                 co_eval: str = "auto",
+                 co_eval_min_points: int = 4096,
                  local_tag: str = "router"):
         self.map = shards if isinstance(shards, ShardMap) \
             else ShardMap(shards)
@@ -255,6 +352,22 @@ class DcfRouter:
             raise ValueError(
                 f"suspect_cooldown_s must be > 0, "
                 f"got {suspect_cooldown_s}")
+        if co_eval not in ("auto", "never", "always"):
+            # api-edge: router config contract
+            raise ValueError(
+                f"co_eval must be 'auto', 'never' or 'always', "
+                f"got {co_eval!r}")
+        if co_eval_min_points < 1:
+            # api-edge: router config contract
+            raise ValueError(
+                f"co_eval_min_points must be >= 1, "
+                f"got {co_eval_min_points}")
+        self.co_eval = co_eval
+        self.co_eval_min_points = int(co_eval_min_points)
+        # The co-evaluation group (ISSUE 18): formed by ``set_mesh``,
+        # consulted by the dispatch policy, epoch-fenced at every
+        # scatter.  None = route-mode only.
+        self.mesh_group: MeshGroup | None = None
         self.n_bytes = int(n_bytes)
         self.replicas = int(replicas)
         self.suspect_cooldown_s = float(suspect_cooldown_s)
@@ -292,6 +405,14 @@ class DcfRouter:
         self._c_promoted = m.counter("router_promoted_forwards_total")
         self._c_down_refused = m.counter("router_down_refusals_total")
         self._g_suspects = m.gauge("router_suspect_shards")
+        # Mesh co-evaluation series (ISSUE 18): dispatches that took
+        # the mesh path, batches degraded back to route-mode, keys
+        # made pod-resident, and the formed group's width.
+        self._c_co_evals = m.counter("router_co_evals_total")
+        self._c_mesh_degraded = m.counter("router_mesh_degraded_total")
+        self._c_mesh_registered = m.counter(
+            "router_mesh_registered_total")
+        self._g_mesh_workers = m.gauge("router_mesh_workers")
         # The self-healing control plane (ISSUE 14): live-registration
         # fan-out + anti-entropy over the SAME pools the forwards use,
         # and the active health prober whose DOWN/UP transitions drive
@@ -487,7 +608,13 @@ class DcfRouter:
         mirrors ``DcfService.submit_bytes``).  Returns a future whose
         failure modes are the shard's own typed taxonomy plus the
         routing tier's suspect refusal (``CircuitOpenError`` with
-        ``retry_after_s``)."""
+        ``retry_after_s``) — and, with ``co_eval="always"``, the mesh
+        tier's ``MeshUnavailableError``.
+
+        Dispatch (ISSUE 18): the co-evaluate policy decides first —
+        a qualifying batch is scattered across the mesh group, with
+        any mesh trouble degrading the WHOLE batch to route-mode
+        (counted + warned) unless the caller forced the mesh."""
         pri = parse_priority(priority)
         view = memoryview(data).cast("B")
         if view.nbytes == 0 or view.nbytes % self.n_bytes:
@@ -495,6 +622,121 @@ class DcfRouter:
                 f"payload of {view.nbytes} bytes is not a positive "
                 f"multiple of n_bytes={self.n_bytes}")
         m = view.nbytes // self.n_bytes
+        if self._co_eval_applies(m):
+            try:
+                return self._submit_mesh(key_id, view, m, b,
+                                         deadline_ms, pri)
+            except MeshUnavailableError as e:
+                if self.co_eval == "always":
+                    raise
+                self._mesh_degrade("mesh dispatch refused", e)
+        return self._submit_route(key_id, view, m, b, deadline_ms, pri)
+
+    def _co_eval_applies(self, m: int) -> bool:
+        """Does the co-evaluate policy claim an ``m``-point batch?
+        ``"always"`` claims everything (no group -> the mesh path
+        refuses typed, which ``"always"`` surfaces); ``"auto"`` claims
+        batches at or past the crossover when a group is formed."""
+        if self.co_eval == "never":
+            return False
+        if self.co_eval == "always":
+            return True
+        return (self.mesh_group is not None
+                and m >= self.co_eval_min_points)
+
+    def _mesh_degrade(self, what: str, exc: BaseException) -> None:
+        """Account one mesh -> route degradation: counted (the soak
+        test's zero-lost-keys ledger) and warned (an operator watching
+        stderr sees the pod quietly lose its co-evaluation tier)."""
+        self._c_mesh_degraded.inc()
+        warnings.warn(
+            BackendFallbackWarning(f"mesh co-evaluate ({what})",
+                                   "route-mode", exc),
+            stacklevel=2)
+
+    def _submit_mesh(self, key_id: str, view, m: int, b: int,
+                     deadline_ms, pri):
+        """Scatter one batch across the mesh group (co-evaluate
+        dispatch).  Raises ``MeshUnavailableError`` — absorbed into a
+        route-mode degradation by the dispatcher unless the caller
+        forced the mesh — when no group is formed, the group's
+        formation epoch trails the ring (membership moved; re-form
+        with ``set_mesh``), a worker is unroutable (DOWN, suspect, or
+        linkless), or a scatter send dies."""
+        group = self.mesh_group
+        try:
+            fire("mesh.collective", m, 0 if group is None else len(group))
+        except Exception as e:  # fallback-ok: the armed seam models a
+            # collective that cannot form — same typed refusal as a
+            # real dead mesh, so tests drive the degradation path
+            # without killing a worker
+            raise MeshUnavailableError(
+                f"mesh collective failed ({type(e).__name__}: {e})",
+                retry_after_s=self.health.interval_s) from e
+        if group is None:
+            raise MeshUnavailableError(
+                "no mesh group formed (call set_mesh first)",
+                retry_after_s=self.health.interval_s)
+        if group.epoch != self.ring_epoch:
+            raise MeshUnavailableError(
+                f"mesh group formed at ring epoch {group.epoch} but "
+                f"the ring is at {self.ring_epoch}; re-form with "
+                "set_mesh",
+                retry_after_s=self.health.interval_s)
+        plan = group.plan(m)
+        for sl in plan:
+            if self.health.state(sl.host_id) == DOWN \
+                    or self._routable_remaining(sl.host_id) > 0:
+                raise MeshUnavailableError(
+                    f"mesh worker {sl.host_id!r} is not routable",
+                    retry_after_s=self.health.interval_s)
+            if self._pools.get(sl.host_id) is None:
+                raise MeshUnavailableError(
+                    f"mesh worker {sl.host_id!r} has no link (left "
+                    "the ring; re-form with set_mesh)",
+                    retry_after_s=self.health.interval_s)
+        parts = []
+        for sl in plan:
+            spec = self.map.get(sl.host_id)
+            pool = self._pools.get(sl.host_id)
+            if spec is None or pool is None:
+                raise MeshUnavailableError(
+                    f"mesh worker {sl.host_id!r} left the ring "
+                    "mid-scatter; re-form with set_mesh",
+                    retry_after_s=self.health.interval_s)
+            # The scattered slice is a SUB-VIEW of the same received
+            # frame buffer — the zero-copy relay contract holds across
+            # the scatter (32-aligned boundaries keep the shard-side
+            # pack word-aligned too).
+            sub = view[sl.offset * self.n_bytes:
+                       (sl.offset + sl.count) * self.n_bytes]
+            try:
+                inner = pool.submit_bytes(
+                    key_id, sub, m=sl.count, b=b,
+                    deadline_ms=deadline_ms, priority=pri,
+                    epoch=self.ring_epoch)
+            except BackendUnavailableError as e:
+                # Scatter-time transport death: the worker is suspect
+                # and the batch is NOT partially in flight from the
+                # caller's perspective — the already-scattered slices
+                # complete server-side and are discarded; route-mode
+                # re-evaluates the whole batch.
+                self.mark_suspect(sl.host_id)
+                raise MeshUnavailableError(
+                    f"mesh worker {sl.host_id!r} is unreachable "
+                    f"({e})",
+                    retry_after_s=self.health.interval_s) from e
+            self._count_forward(sl.host_id)
+            parts.append((inner, spec, sl))
+        self._c_co_evals.inc()
+        relay_args = None if self.co_eval == "always" else \
+            (key_id, view, m, b, deadline_ms, pri)
+        return _MeshFuture(self, parts, relay_args)
+
+    def _submit_route(self, key_id: str, view, m: int, b: int,
+                      deadline_ms, pri):
+        """Route-mode dispatch: walk the key's ring placement (one
+        host, one key) — the PR 13/14 semantics, unchanged."""
         ranked = self.map.placement(key_id, self.replicas)
         # PROMOTION (ISSUE 14): a host the prober holds DOWN leaves the
         # walk for EVERY class — its replica serves as acting owner (no
@@ -614,6 +856,84 @@ class DcfRouter:
         proto = isinstance(bundle, ProtocolBundle)
         return self.register_frame(key_id, bundle.to_bytes(),
                                    proto=proto)
+
+    # -- mesh co-evaluation (ISSUE 18) --------------------------------
+
+    def set_mesh(self, host_ids=None, *, epoch: int | None = None
+                 ) -> MeshGroup:
+        """Form (or re-form) the co-evaluation mesh group from ring
+        members — default: every current member.  The group is fenced
+        at the CURRENT ring epoch (or an explicit ``epoch``, for a
+        controller forming the group inside the same membership
+        commit): a later ``set_ring`` epoch bump invalidates it, and
+        the next qualifying dispatch degrades to route-mode until the
+        group is re-formed — a scatter can never land on an ejected
+        host's successor ring by accident."""
+        ids = self.map.host_ids() if host_ids is None else list(host_ids)
+        for host_id in ids:
+            if host_id not in self.map:
+                # api-edge: mesh membership contract — a worker outside
+                # the ring has no pool, no health target, no keys
+                raise ValueError(
+                    f"mesh worker {host_id!r} is not in the ring "
+                    f"({self.map.host_ids()})")
+        group = MeshGroup(
+            ids, epoch=self.ring_epoch if epoch is None else int(epoch))
+        self.mesh_group = group
+        self._g_mesh_workers.set(len(group))
+        return group
+
+    def clear_mesh(self) -> None:
+        """Dissolve the mesh group: subsequent dispatch is route-mode
+        only (``co_eval="always"`` callers get ``MeshUnavailableError``
+        typed).  In-flight co-evaluations keep the plan they started
+        with (``MeshGroup`` is immutable)."""
+        self.mesh_group = None
+        self._g_mesh_workers.set(0)
+
+    def register_mesh_frame(self, key_id: str, frame,
+                            proto: bool = False) -> int:
+        """Register one DCFK frame on EVERY mesh worker: co-evaluation
+        scatters a batch pod-wide, so the key must be resident beyond
+        its ring placement.  The ring walk goes first (the OWNER mints
+        the generation — ``Replicator.register``, durable semantics
+        unchanged), then each remaining mesh worker applies it
+        preserved; a worker that cannot apply (dark, fenced) is
+        skipped — the dispatch-time health check keeps a batch off a
+        worker that missed the key's registration window, and
+        anti-entropy converges it on recovery."""
+        if self.mesh_group is None:
+            raise MeshUnavailableError(
+                "no mesh group formed (call set_mesh first)",
+                retry_after_s=self.health.interval_s)
+        gen = self.replicator.register(key_id, frame, proto=bool(proto))
+        placed = self.map.placement_ids(key_id, self.replicas)
+        for host_id in self.mesh_group.host_ids():
+            if host_id in placed:
+                continue  # the ring walk already registered it here
+            pool = self._pools.get(host_id)
+            if pool is None:
+                continue  # left the ring mid-flight; set_mesh re-forms
+            try:
+                pool.register_frame(key_id, frame, generation=gen,
+                                    proto=bool(proto),
+                                    epoch=self.ring_epoch)
+            except Exception:  # fallback-ok: a dark or fenced worker
+                # must not fail an owner-acked registration — the
+                # scatter-time health gate covers the window, and
+                # anti-entropy heals the copy
+                continue
+        self._c_mesh_registered.inc()
+        return int(gen)
+
+    def register_mesh_key(self, key_id: str, bundle) -> int:
+        """In-process convenience twin of ``register_mesh_frame``:
+        accepts a ``KeyBundle`` or ``protocols.ProtocolBundle``."""
+        from dcf_tpu.protocols import ProtocolBundle
+
+        proto = isinstance(bundle, ProtocolBundle)
+        return self.register_mesh_frame(key_id, bundle.to_bytes(),
+                                        proto=proto)
 
     # -- ring membership (ISSUE 14 satellite: bounded state) ----------
 

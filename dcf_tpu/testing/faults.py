@@ -168,6 +168,19 @@ POINTS = (
     #                     tick: no streak advance, no scaling, counted
     #                     capacity_skips_total{reason=frozen} — the
     #                     operator's emergency brake)
+    "mesh.collective",  # pod mesh co-evaluate dispatch (serve/router.py
+    #                     — fires at the start of each co-evaluated
+    #                     batch, after the dispatch-policy decision but
+    #                     before any slice is scattered to a worker;
+    #                     handler args: batch points, worker count.  A
+    #                     raising handler models a dead mesh (a
+    #                     collective that cannot form): the router must
+    #                     degrade the batch to route-mode — counted
+    #                     router_mesh_degraded_total, warned via
+    #                     BackendFallbackWarning, zero lost keys — or,
+    #                     when the caller FORCED co-evaluation, refuse
+    #                     typed with MeshUnavailableError; never a bare
+    #                     crash)
     "net.partition",    # pod network partition (serve/edge.py — fires
     #                     before each EdgeClient dial and each frame
     #                     send on a TAGGED client (the pod router tags

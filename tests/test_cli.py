@@ -625,6 +625,68 @@ def test_cli_pod_bench_surge_smoke(capsys):
     assert len(recs[0]["standby_after"]) == recs[0]["standby_hosts"]
 
 
+@pytest.mark.mesh
+def test_cli_pod_bench_mesh_validates_flags_fast():
+    """ISSUE 18: the mesh scenario applies the same fail-fast flag
+    discipline — a solo "mesh" (co-evaluating over one worker IS
+    route-mode), a mixed scenario, or a bad ladder range die loudly
+    before any subprocess is spawned."""
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="shards >= 2"):
+        cli.main(["pod_bench", "--mesh", "--shards=1"])
+    with pytest.raises(SystemExit, match="separate"):
+        cli.main(["pod_bench", "--mesh", "--surge"])
+    with pytest.raises(SystemExit, match="separate"):
+        cli.main(["pod_bench", "--mesh", "--churn"])
+    with pytest.raises(SystemExit, match="separate"):
+        cli.main(["pod_bench", "--mesh", "--partition"])
+    with pytest.raises(SystemExit, match="ladder range"):
+        cli.main(["pod_bench", "--mesh", "--min-req-points=4096",
+                  "--max-req-points=128"])
+
+
+@pytest.mark.slow
+@pytest.mark.mesh
+def test_cli_pod_bench_mesh_smoke(capsys):
+    """ISSUE 18: ``pod_bench --mesh`` end to end — 2 serve_host shard
+    processes warm-restore the mesh-wide-replicated keys, a route-only
+    and a co-evaluate router form over the identical pod, the two-party
+    parity gate pins the scattered/gathered reconstruction bit-exact vs
+    route-mode AND the numpy oracle, and the crossover ladder runs with
+    every co-evaluation accounted and zero degrades (the harness raises
+    SystemExit if any gate fails).  The crossover gate itself applies
+    only where the host offers >= shards + 1 CPUs; on smaller hosts the
+    emitted line records it environment-gated — asserted either way."""
+    recs = run_cli(
+        capsys,
+        ["pod_bench", "--mesh", "--shards=2", "--bundles=2",
+         "--reps=3", "--max-batch=512", "--min-req-points=128",
+         "--max-req-points=512"],
+    )
+    assert recs[0]["bench"] == "pod_bench"
+    assert recs[0]["mode"] == "mesh"
+    assert recs[0]["shards"] == 2
+    assert recs[0]["mesh_workers"] == 2
+    assert recs[0]["mesh_degraded"] == 0
+    # parity gate (2 keys x 2 parties) + ladder legs, warmup on top
+    assert recs[0]["co_evals"] >= 2 * 2 + 2 * 3
+    ladder = recs[0]["ladder"]
+    assert [r["points"] for r in ladder] == [128, 512]
+    for rung in ladder:
+        assert rung["route_evals_per_sec"] > 0
+        assert rung["coeval_evals_per_sec"] > 0
+    gate = recs[0]["crossover_gate"]
+    assert gate.startswith("applies") or \
+        gate.startswith("environment-gated")
+    if gate.startswith("applies"):
+        assert recs[0]["crossover_points"] is not None
+        assert recs[0]["crossover_points"] <= 512
+    assert "crossover_points" in recs[0]
+    assert recs[0]["repro"].startswith(
+        "python -m dcf_tpu.cli pod_bench --mesh")
+
+
 @pytest.mark.slow
 @pytest.mark.selfheal
 def test_cli_pod_bench_partition_smoke(capsys):

@@ -739,3 +739,33 @@ def test_changed_only_narrowing_vs_full_sweep(tmp_path):
     full = run_path(tmp_path, ["determinism"])
     assert {pathlib.Path(v.path).name for v in full} == \
         {"changed.py", "untouched.py"}
+
+
+def test_mesh_layer_lint_clean(tmp_path):
+    """The ISSUE 18 compat satellite: every file of the mesh
+    co-evaluation layer sweeps clean under ALL passes — in particular
+    compat-shim, now that it also flags ``jax.distributed`` /
+    ``jax.experimental.multihost_utils`` imports outside
+    ``parallel/_compat.py`` (the one allowed resolution site)."""
+    for rel in (("dcf_tpu", "parallel", "_compat.py"),
+                ("dcf_tpu", "parallel", "mesh.py"),
+                ("dcf_tpu", "parallel", "mesh_eval.py"),
+                ("dcf_tpu", "serve", "meshgroup.py"),
+                ("dcf_tpu", "serve", "router.py")):
+        assert run_path(REPO.joinpath(*rel)) == [], "/".join(rel)
+    # Detection power for the extension: a multi-process touchpoint
+    # outside the shim is flagged, with the shim hint in the message.
+    write(tmp_path, "rogue.py", (
+        "import jax.distributed\n"
+        "from jax.experimental import multihost_utils\n"
+        "from jax.distributed import initialize\n"))
+    got = [v for v in run_path(tmp_path, ["compat-shim"])
+           if v.path.endswith("rogue.py")]
+    assert [v.line for v in got] == [1, 2, 3]
+    assert all("parallel._compat" in v.message for v in got)
+    # ...and the shim module itself stays the allowed site.
+    write(tmp_path, "_compat.py",
+          "import jax.distributed\n"
+          "from jax.experimental import multihost_utils  # noqa\n")
+    assert not [v for v in run_path(tmp_path)
+                if v.path.endswith("_compat.py")]

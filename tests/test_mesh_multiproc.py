@@ -1,0 +1,188 @@
+"""Two-process device-mesh parity (ISSUE 18): the co-evaluate tentpole's
+ground truth.
+
+Spawns ``nproc=2`` real OS processes that rendezvous through
+``parallel._compat.distributed_initialize`` (jax.distributed + gloo CPU
+collectives), form ONE global pod mesh spanning both processes' devices,
+and co-evaluate one batch: each process stages only its local point
+slice, ``host_to_global`` concatenates the slices into the global sharded
+batch, the walk runs as a pure map, and the two-party mismatch counter is
+the end collective (a cross-process device psum that must read 0 on every
+process).
+
+The parent then gathers each process's locally-addressable share bytes
+and pins them byte-identical against BOTH oracles computed single-process:
+``eval_batch_np`` (host numpy) and ``ShardedLargeLambdaBackend`` (the
+single-process sharded path the mesh backend subclasses) — the same
+equivalence ``parallel/mesh_eval.py`` promises in its module contract.
+
+Rides the serial CI leg (``mesh and slow``): two interpreter-mode JAX
+processes on shared cores is not threaded-leg material.  Skips typed when
+``jax.distributed`` cannot initialize in this container.
+"""
+
+import os
+import random
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dcf_tpu import spec
+from dcf_tpu.backends.numpy_backend import eval_batch_np
+from dcf_tpu.gen import gen_batch, random_s0s
+from dcf_tpu.ops.prg import HirosePrgNp
+
+pytestmark = [pytest.mark.mesh, pytest.mark.slow]
+
+REPO = Path(__file__).resolve().parents[1]
+
+LAM = 64
+NB2 = 2   # 16-bit domain
+M = 70    # ragged: 35 local points per process, padded per shard
+
+NPROC = 2
+WORKER_TIMEOUT_S = 420
+
+
+def material(k_num: int):
+    """Deterministic key material + points, identical in every process
+    (the SPMD contract: same bundle bytes everywhere, only the staged
+    point slice differs per process)."""
+    rng = random.Random(1804)
+    ck = [bytes(rng.getrandbits(8) for _ in range(32)) for _ in range(18)]
+    prg = HirosePrgNp(LAM, ck)
+    nprng = np.random.default_rng(1805 + k_num)
+    alphas = nprng.integers(0, 256, (k_num, NB2), dtype=np.uint8)
+    betas = nprng.integers(0, 256, (k_num, LAM), dtype=np.uint8)
+    bundle = gen_batch(prg, alphas, betas, random_s0s(k_num, LAM, nprng),
+                       spec.Bound.LT_BETA)
+    xs = nprng.integers(0, 256, (M, NB2), dtype=np.uint8)
+    xs[0] = alphas[0]  # exercise the x == alpha boundary
+    return ck, prg, alphas, betas, bundle, xs
+
+
+# The worker half: written to disk by the parent, run once per process.
+# argv: port nproc pid outdir k_num.  Exits 0 printing a typed marker if
+# the distributed runtime is unavailable (parent skips), asserts the end
+# collective reads zero, and leaves its local share bytes as .npy files.
+WORKER = '''\
+import os
+import sys
+
+port, nproc, pid, outdir, k_num = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+    int(sys.argv[5]))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # one real device per process
+
+from dcf_tpu.errors import BackendUnavailableError
+from dcf_tpu.parallel._compat import distributed_initialize
+
+try:
+    distributed_initialize("127.0.0.1:" + port, nproc, pid)
+except BackendUnavailableError as e:
+    print("DIST-INIT-UNAVAILABLE:", e, flush=True)
+    sys.exit(0)
+
+import numpy as np
+
+from dcf_tpu.parallel import MeshLargeLambdaBackend, make_pod_mesh
+from tests.test_mesh_multiproc import LAM, material
+
+ck, prg, alphas, betas, bundle, xs = material(k_num)
+mesh = make_pod_mesh()
+be = {b: MeshLargeLambdaBackend(LAM, ck, mesh, interpret=True)
+      for b in (0, 1)}
+m_local = xs.shape[0] // nproc
+xs_local = xs[pid * m_local:(pid + 1) * m_local]
+ys = {}
+staged = None
+for b in (0, 1):
+    be[b].put_bundle(bundle.for_party(b))
+    if staged is None:
+        staged = be[b].stage(xs_local)
+    ys[b] = be[b].eval_staged(b, staged)
+    local = be[b].staged_to_bytes(ys[b], staged["m"])
+    np.save(os.path.join(outdir, "shares_K%d_b%d_p%d.npy"
+                         % (k_num, b, pid)), local)
+# The end collective: a device psum spanning every process's shard.
+bad = int(be[0].points_mismatch_count(ys[0], ys[1], alphas, betas, staged))
+assert bad == 0, "pid %d: %d mismatching (key, point) pairs" % (pid, bad)
+print("PARITY-OK pid=%d" % pid, flush=True)
+'''
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_workers(tmp_path: Path, k_num: int) -> list[str]:
+    script = tmp_path / "mesh_worker.py"
+    script.write_text(WORKER)
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=str(REPO) + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(port), str(NPROC), str(pid),
+             str(tmp_path), str(k_num)],
+            cwd=str(REPO), env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for pid in range(NPROC)]
+    outs = []
+    try:
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=WORKER_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                pytest.fail(f"mesh worker hung past {WORKER_TIMEOUT_S}s "
+                            "(a peer likely died before the collective)")
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if any("DIST-INIT-UNAVAILABLE" in o for o in outs):
+        pytest.skip("jax.distributed cannot initialize in this container: "
+                    + "".join(outs)[:200])
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+        assert "PARITY-OK" in out, out
+    return outs
+
+
+@pytest.mark.parametrize("k_num", [1, 3])
+def test_two_process_mesh_parity(tmp_path, k_num):
+    """One batch, two OS processes, one mesh: the gathered shares are
+    byte-identical to the numpy oracle AND the single-process sharded
+    backend, both parties; the cross-process mismatch psum read 0 in
+    every worker (asserted worker-side before this parent check)."""
+    _run_workers(tmp_path, k_num)
+
+    import jax
+
+    from dcf_tpu.parallel import ShardedLargeLambdaBackend, make_mesh
+
+    ck, prg, alphas, betas, bundle, xs = material(k_num)
+    sp_mesh = make_mesh(shape=(1, len(jax.devices())))
+    for b in (0, 1):
+        parts = [np.load(tmp_path / f"shares_K{k_num}_b{b}_p{pid}.npy")
+                 for pid in range(NPROC)]
+        assert all(p.shape == (k_num, M // NPROC, LAM) for p in parts), \
+            [p.shape for p in parts]
+        got = np.concatenate(parts, axis=1)  # process order = points order
+        want_np = eval_batch_np(prg, b, bundle.for_party(b), xs)
+        assert np.array_equal(got, want_np), f"party {b} vs numpy oracle"
+        sp = ShardedLargeLambdaBackend(LAM, ck, sp_mesh, interpret=True)
+        sp.put_bundle(bundle.for_party(b))
+        staged = sp.stage(xs)
+        want_sp = sp.staged_to_bytes(sp.eval_staged(b, staged), staged["m"])
+        assert np.array_equal(got, want_sp), \
+            f"party {b} vs single-process sharded backend"

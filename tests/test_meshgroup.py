@@ -1,0 +1,431 @@
+"""dcf_tpu.serve.meshgroup + the router's co-evaluate dispatch
+(ISSUE 18).
+
+Covers the pure placement plan (32-aligned contiguous coverage,
+sorted-worker determinism, zero-word-worker elision, membership
+contracts), the in-process co-evaluated parity (one batch scattered
+across every shard of a real-TCP mini pod, both parties, gathered
+shares bit-exact vs the numpy oracle and vs the same router's
+route-mode answer), the dispatch policy (threshold, never/always,
+forced-mode typed refusal with ``retry_after_s``), the degradation
+discipline (armed ``mesh.collective`` seam, epoch fence, dead-worker
+scatter — each counted ``router_mesh_degraded_total`` + warned
+``BackendFallbackWarning`` + still answering bit-exact from
+route-mode), and pod-wide mesh registration.  The kill-one-mesh-
+worker soak (mesh and slow) — a worker dying MID-BATCH degrades
+typed with zero lost keys and zero generation regressions — rides
+the serial CI leg.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dcf_tpu.errors import (
+    BackendFallbackWarning,
+    MeshUnavailableError,
+)
+from dcf_tpu.serve import EdgeServer, MeshGroup, ShardMap, ShardSpec
+from dcf_tpu.serve.meshgroup import SLICE_ALIGN, MeshSlice
+from dcf_tpu.testing import faults
+from tests.test_pod import (  # the pod tier's shared fixtures/helpers
+    LAM,
+    NB,
+    MiniPod,
+    bundles,
+    ck,
+    dcf,
+    prg,
+    recon_oracle,
+    rng,
+)
+
+pytestmark = pytest.mark.mesh
+
+__all__ = ["bundles", "ck", "dcf", "prg", "rng"]  # re-exported fixtures
+
+
+# -------------------------------------------------- the placement plan
+
+
+def test_plan_covers_aligned_and_ordered():
+    g = MeshGroup(["w2", "w0", "w1"], epoch=3)
+    assert g.epoch == 3
+    assert g.host_ids() == ["w0", "w1", "w2"]  # sorted: set, not list
+    for m in (1, 31, 32, 33, 96, 97, 1000, 4096, 4097):
+        plan = g.plan(m)
+        # Contiguous coverage in worker order, boundaries 32-aligned
+        # except the batch end.
+        offset = 0
+        seen = []
+        for sl in plan:
+            assert sl.offset == offset
+            assert sl.count > 0
+            if sl is not plan[-1]:
+                assert (sl.offset + sl.count) % SLICE_ALIGN == 0
+            offset += sl.count
+            seen.append(sl.host_id)
+        assert offset == m, m
+        assert seen == sorted(seen)
+        # Balanced: lane words per worker differ by at most one.
+        words = [-(-sl.count // SLICE_ALIGN) for sl in plan]
+        assert max(words) - min(words) <= 1, (m, words)
+
+
+def test_plan_elides_zero_word_workers():
+    g = MeshGroup([f"w{i}" for i in range(8)])
+    # 17 points = one lane word: ONE slice, not seven empty scatters.
+    assert g.plan(17) == [MeshSlice("w0", 0, 17)]
+    # 3 words over 8 workers: exactly three slices.
+    plan = g.plan(3 * SLICE_ALIGN)
+    assert [sl.host_id for sl in plan] == ["w0", "w1", "w2"]
+
+
+def test_meshgroup_membership_contracts():
+    with pytest.raises(ValueError):
+        MeshGroup([])
+    with pytest.raises(ValueError):
+        MeshGroup(["a", "a"])
+    with pytest.raises(ValueError):
+        MeshGroup(["a"]).plan(0)
+    g = MeshGroup(["a", "b"])
+    assert len(g) == 2 and "a" in g and "c" not in g
+
+
+# ------------------------------------------- co-evaluated parity
+
+
+def _mesh_pod(dcf, bundles, n=3, **router_kw):
+    kw = dict(co_eval="auto", co_eval_min_points=64)
+    kw.update(router_kw)
+    pod = MiniPod(dcf, bundles, n=n, router_kw=kw)
+    pod.router.set_mesh()
+    for name, kb in sorted(bundles.items()):
+        pod.router.register_mesh_key(name, kb)
+    return pod
+
+
+def test_co_evaluated_parity_vs_oracle_and_route(dcf, bundles, prg, rng):
+    """One batch scattered across all 3 shards, both parties: the
+    gathered shares are bit-exact vs the numpy oracle AND vs the same
+    router's route-mode answer, and the dispatch demonstrably took the
+    mesh path (co_evals counted, every worker forwarded)."""
+    pod = _mesh_pod(dcf, bundles)
+    try:
+        name, kb = sorted(bundles.items())[0]
+        xs = rng.integers(0, 256, (96, NB), dtype=np.uint8)
+        got = pod.router.evaluate(name, xs, b=0, timeout=60) ^ \
+            pod.router.evaluate(name, xs, b=1, timeout=60)
+        assert np.array_equal(got, recon_oracle(prg, kb, xs))
+        # The identical batch through route-mode (below threshold per
+        # request is not possible here, so force the policy off).
+        pod.router.co_eval = "never"
+        routed = pod.router.evaluate(name, xs, b=0, timeout=60) ^ \
+            pod.router.evaluate(name, xs, b=1, timeout=60)
+        assert np.array_equal(got, routed)
+        snap = pod.router.metrics_snapshot()
+        assert snap["router_co_evals_total"] == 2
+        assert snap["router_mesh_degraded_total"] == 0
+        assert snap["router_mesh_workers"] == 3
+        for s in pod.map.host_ids():
+            assert snap[f"router_forwards_total{{shard={s}}}"] > 0, snap
+    finally:
+        pod.close()
+
+
+def test_co_eval_ragged_sizes_parity(dcf, bundles, prg, rng):
+    """Batch sizes straddling every alignment edge stay bit-exact
+    (the gather's concatenation order and padding discipline)."""
+    pod = _mesh_pod(dcf, bundles, co_eval_min_points=1)
+    try:
+        name, kb = sorted(bundles.items())[1]
+        for m in (1, 31, 33, 64, 97):
+            xs = rng.integers(0, 256, (m, NB), dtype=np.uint8)
+            got = pod.router.evaluate(name, xs, b=0, timeout=60) ^ \
+                pod.router.evaluate(name, xs, b=1, timeout=60)
+            assert np.array_equal(got, recon_oracle(prg, kb, xs)), m
+    finally:
+        pod.close()
+
+
+# ------------------------------------------------ the dispatch policy
+
+
+def test_policy_threshold_and_never(dcf, bundles, rng):
+    pod = _mesh_pod(dcf, bundles)  # co_eval_min_points=64
+    try:
+        name = sorted(bundles)[0]
+        xs_small = rng.integers(0, 256, (8, NB), dtype=np.uint8)
+        xs_big = rng.integers(0, 256, (64, NB), dtype=np.uint8)
+        pod.router.evaluate(name, xs_small, timeout=60)  # below: routed
+        assert pod.router.metrics_snapshot()[
+            "router_co_evals_total"] == 0
+        pod.router.evaluate(name, xs_big, timeout=60)  # at: co-evaluated
+        assert pod.router.metrics_snapshot()[
+            "router_co_evals_total"] == 1
+        pod.router.co_eval = "never"
+        pod.router.evaluate(name, xs_big, timeout=60)
+        assert pod.router.metrics_snapshot()[
+            "router_co_evals_total"] == 1  # unchanged
+    finally:
+        pod.close()
+
+
+def test_forced_mesh_without_group_refuses_typed(dcf, bundles, rng):
+    """``co_eval="always"`` with no group formed: the caller gets
+    ``MeshUnavailableError`` with the probe interval as the hint —
+    never a silent route-mode answer they explicitly declined."""
+    pod = MiniPod(dcf, bundles, n=2, router_kw=dict(co_eval="always"))
+    try:
+        xs = rng.integers(0, 256, (8, NB), dtype=np.uint8)
+        with pytest.raises(MeshUnavailableError) as ei:
+            pod.router.evaluate(sorted(bundles)[0], xs, timeout=60)
+        assert ei.value.retry_after_s == pod.router.health.interval_s
+    finally:
+        pod.close()
+
+
+def test_router_config_contracts():
+    from dcf_tpu.serve import DcfRouter
+
+    ring = ShardMap([ShardSpec("a", port=1)])
+    with pytest.raises(ValueError):
+        DcfRouter(ring, n_bytes=NB, co_eval="sometimes")
+    with pytest.raises(ValueError):
+        DcfRouter(ring, n_bytes=NB, co_eval_min_points=0)
+    router = DcfRouter(ring, n_bytes=NB)
+    try:
+        with pytest.raises(ValueError):
+            router.set_mesh(["not-a-member"])
+    finally:
+        router.close()
+
+
+# --------------------------------------------- degradation discipline
+
+
+def test_collective_fault_degrades_counted_and_warned(
+        dcf, bundles, prg, rng):
+    """An armed ``mesh.collective`` seam (a collective that cannot
+    form): the batch is still answered bit-exact — served route-mode —
+    with the degradation counted and warned, never a bare crash."""
+    pod = _mesh_pod(dcf, bundles)
+    try:
+        name, kb = sorted(bundles.items())[2]
+        xs = rng.integers(0, 256, (96, NB), dtype=np.uint8)
+        with faults.inject("mesh.collective"):
+            with pytest.warns(BackendFallbackWarning):
+                got = pod.router.evaluate(name, xs, b=0, timeout=60) ^ \
+                    pod.router.evaluate(name, xs, b=1, timeout=60)
+        assert np.array_equal(got, recon_oracle(prg, kb, xs))
+        snap = pod.router.metrics_snapshot()
+        assert snap["router_mesh_degraded_total"] == 2
+        assert snap["router_co_evals_total"] == 0
+        # Forced mode surfaces the same trouble typed instead.
+        pod.router.co_eval = "always"
+        with faults.inject("mesh.collective"):
+            with pytest.raises(MeshUnavailableError):
+                pod.router.evaluate(name, xs, timeout=60)
+    finally:
+        pod.close()
+
+
+def test_epoch_fence_degrades_until_reformed(dcf, bundles, prg, rng):
+    """A membership commit after formation fences the group: dispatch
+    degrades (counted + warned) until ``set_mesh`` re-forms it at the
+    new epoch — a scatter can never ride a stale worker set."""
+    pod = _mesh_pod(dcf, bundles)
+    try:
+        name, kb = sorted(bundles.items())[0]
+        xs = rng.integers(0, 256, (96, NB), dtype=np.uint8)
+        pod.router.set_ring(pod.map, epoch=pod.router.ring_epoch + 1)
+        with pytest.warns(BackendFallbackWarning):
+            got = pod.router.evaluate(name, xs, b=0, timeout=60)
+        assert np.array_equal(
+            got ^ pod.router.evaluate(name, xs, b=1, timeout=60),
+            recon_oracle(prg, kb, xs))
+        assert pod.router.metrics_snapshot()[
+            "router_mesh_degraded_total"] >= 1
+        pod.router.set_mesh()  # re-formed at the current epoch
+        pod.router.evaluate(name, xs, timeout=60)
+        assert pod.router.metrics_snapshot()[
+            "router_co_evals_total"] >= 1
+    finally:
+        pod.close()
+
+
+def test_clear_mesh_returns_to_route_only(dcf, bundles, rng):
+    pod = _mesh_pod(dcf, bundles)
+    try:
+        name = sorted(bundles)[0]
+        xs = rng.integers(0, 256, (96, NB), dtype=np.uint8)
+        pod.router.clear_mesh()
+        pod.router.evaluate(name, xs, timeout=60)  # routed, no co-eval
+        snap = pod.router.metrics_snapshot()
+        assert snap["router_co_evals_total"] == 0
+        assert snap["router_mesh_workers"] == 0
+    finally:
+        pod.close()
+
+
+def test_dead_worker_scatter_degrades_zero_lost_keys(
+        dcf, bundles, prg, rng):
+    """A mesh worker already dead at scatter time: the dispatch
+    degrades (worker marked suspect, counted, warned) and EVERY key
+    still answers bit-exact — zero lost keys."""
+    pod = _mesh_pod(dcf, bundles)
+    try:
+        # Kill a worker that is neither owner nor replica of the probe
+        # key, so the degraded route walk stays on trusted hosts.
+        name, kb = sorted(bundles.items())[0]
+        placed = pod.map.placement_ids(name, replicas=1)
+        victim = next(h for h in pod.map.host_ids()
+                      if h not in placed)
+        pod.kill(victim)
+        xs = rng.integers(0, 256, (96, NB), dtype=np.uint8)
+        with pytest.warns(BackendFallbackWarning):
+            got = pod.router.evaluate(name, xs, b=0, timeout=60) ^ \
+                pod.router.evaluate(name, xs, b=1, timeout=60)
+        assert np.array_equal(got, recon_oracle(prg, kb, xs))
+        snap = pod.router.metrics_snapshot()
+        assert snap["router_mesh_degraded_total"] == 2
+        assert snap[f"router_suspected_total{{shard={victim}}}"] >= 1
+        # Zero lost keys: every registered key still answers (small
+        # batches — route-mode — avoiding the dead worker's ownership
+        # where a replica exists).
+        for kname, kkb in sorted(bundles.items()):
+            if victim not in pod.map.placement_ids(kname, replicas=1):
+                xs2 = rng.integers(0, 256, (4, NB), dtype=np.uint8)
+                got2 = pod.router.evaluate(kname, xs2, b=0,
+                                           timeout=60) ^ \
+                    pod.router.evaluate(kname, xs2, b=1, timeout=60)
+                assert np.array_equal(got2,
+                                      recon_oracle(prg, kkb, xs2))
+    finally:
+        pod.close()
+
+
+# ----------------------------------------------- the mid-batch soak
+
+
+@pytest.mark.slow
+def test_kill_mesh_worker_mid_batch_soak(dcf, bundles, prg, rng):
+    """The acceptance soak: a mesh worker dies MID-BATCH — after its
+    slice was scattered, before its share came back.  The gather
+    degrades the WHOLE batch to route-mode (typed signal, counted,
+    warned), the answer stays bit-exact, and afterwards every key
+    still serves with no generation regression — zero lost keys."""
+    # A custom pod: big max_batch + a long coalesce delay give a
+    # deterministic window in which the victim holds its slice
+    # un-evaluated while we kill it.
+    svcs, servers, specs = [], [], []
+    for i in range(3):
+        svc = dcf.serve(max_batch=4096, max_delay_ms=300.0)
+        svc.start()
+        srv = EdgeServer(svc).start()
+        svcs.append(svc)
+        servers.append(srv)
+        specs.append(ShardSpec(f"shard-{i}", *srv.address))
+    ring = ShardMap(specs)
+    index = {s.host_id: i for i, s in enumerate(specs)}
+    from dcf_tpu.serve import DcfRouter
+
+    router = DcfRouter(ring, n_bytes=NB, co_eval="auto",
+                       co_eval_min_points=64)
+    try:
+        for name, kb in sorted(bundles.items()):
+            for spec in ring.placement(name, replicas=1):
+                svcs[index[spec.host_id]].register_key(name, kb)
+        router.set_mesh()
+        for name, kb in sorted(bundles.items()):
+            router.register_mesh_key(name, kb)
+        gens_before = {
+            name: svcs[index[ring.owner(name).host_id]]
+            .registry.digest()[name]
+            for name in sorted(bundles)}
+        name, kb = sorted(bundles.items())[0]
+        placed = ring.placement_ids(name, replicas=1)
+        victim = next(h for h in ring.host_ids() if h not in placed)
+        xs = rng.integers(0, 256, (96, NB), dtype=np.uint8)
+        fut = router.submit(name, xs, b=0)  # scattered: 3 slices
+        # Kill the victim inside the coalesce window — its slice is
+        # accepted but unanswered; the pending share future dies with
+        # the connection.
+        servers[index[victim]].close()
+        svcs[index[victim]].close(drain=False)
+        with pytest.warns(BackendFallbackWarning):
+            got0 = fut.result(60)
+        got1 = router.evaluate(name, xs, b=1, timeout=60)
+        assert np.array_equal(got0 ^ got1, recon_oracle(prg, kb, xs))
+        snap = router.metrics_snapshot()
+        assert snap["router_mesh_degraded_total"] >= 1
+        assert snap[f"router_suspected_total{{shard={victim}}}"] >= 1
+        # Zero lost keys, zero generation regressions: every key whose
+        # placement survives the victim still answers bit-exact, at a
+        # generation no older than before the kill.
+        for kname, kkb in sorted(bundles.items()):
+            if victim in ring.placement_ids(kname, replicas=1):
+                continue
+            xs2 = rng.integers(0, 256, (8, NB), dtype=np.uint8)
+            got = router.evaluate(kname, xs2, b=0, timeout=60) ^ \
+                router.evaluate(kname, xs2, b=1, timeout=60)
+            assert np.array_equal(got, recon_oracle(prg, kkb, xs2))
+            gen_now = svcs[index[ring.owner(kname).host_id]] \
+                .registry.digest()[kname]
+            assert gen_now >= gens_before[kname], kname
+    finally:
+        router.close()
+        for srv in servers:
+            srv.close()
+        for svc in svcs:
+            try:
+                svc.close(drain=False)
+            except Exception:  # fallback-ok: best-effort teardown of
+                # the killed shard
+                pass
+
+
+# ------------------------------------------- pod-wide registration
+
+
+def test_register_mesh_key_resident_everywhere(dcf, bundles):
+    """``register_mesh_key`` makes the key resident on EVERY worker —
+    including those outside its ring placement — at ONE generation."""
+    pod = _mesh_pod(dcf, bundles)
+    try:
+        name = sorted(bundles)[0]
+        gens = {h: pod.svc_of(h).registry.digest()[name]
+                for h in pod.map.host_ids()}
+        assert len(set(gens.values())) == 1, gens
+        assert pod.router.metrics_snapshot()[
+            "router_mesh_registered_total"] == len(bundles)
+        # Without a group, mesh registration refuses typed.
+        pod.router.clear_mesh()
+        with pytest.raises(MeshUnavailableError):
+            pod.router.register_mesh_key(name, bundles[name])
+    finally:
+        pod.close()
+
+
+def test_mesh_future_is_threadsafe_waitable(dcf, bundles, prg, rng):
+    """Gathers from a different thread than the submitter (the edge
+    writer's pattern) — no thread affinity in the mesh future."""
+    pod = _mesh_pod(dcf, bundles)
+    try:
+        name, kb = sorted(bundles.items())[0]
+        xs = rng.integers(0, 256, (96, NB), dtype=np.uint8)
+        fut = pod.router.submit(name, xs, b=0)
+        out = {}
+
+        def waiter():
+            out["y"] = fut.result(60)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        t.join(60)
+        assert not t.is_alive()
+        y1 = pod.router.evaluate(name, xs, b=1, timeout=60)
+        assert np.array_equal(out["y"] ^ y1, recon_oracle(prg, kb, xs))
+    finally:
+        pod.close()

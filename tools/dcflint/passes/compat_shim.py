@@ -8,6 +8,15 @@ are allowed to touch the raw names and resolve whichever this jax ships:
 ``dcf_tpu/ops/_compat.py`` and ``dcf_tpu/parallel/_compat.py``.  Every
 other file must import the resolved symbol from them, so a future rename
 is one shim edit, not an AttributeError scattered over ten backends.
+
+ISSUE 18 adds the multi-process surface to the guarded set:
+``jax.distributed`` (its CPU-collectives knob has moved between a
+config option and an env var) and ``jax.experimental.multihost_utils``
+(the host-local -> global conversion has grown a ``jax``-namespace
+sibling spelling) resolve ONLY through
+``dcf_tpu.parallel._compat.distributed_initialize`` /
+``host_to_global`` — the mesh tier must not re-scatter the skew the
+shim exists to contain.
 """
 
 from __future__ import annotations
@@ -20,6 +29,12 @@ from tools.dcflint import FileContext, LintPass, register
 _RENAMED_ATTRS = ("TPUCompilerParams", "CompilerParams")
 _SHIM_HINT = ("resolve it through dcf_tpu.ops._compat / "
               "dcf_tpu.parallel._compat instead")
+# Multi-process modules (ISSUE 18) whose APIs skew across jax
+# versions: any import of / attribute walk into them outside the
+# _compat shims is flagged.
+_MP_MODULES = ("jax.distributed", "jax.experimental.multihost_utils")
+_MP_HINT = ("use dcf_tpu.parallel._compat (distributed_initialize / "
+            "host_to_global), which resolves the skew")
 
 
 def _dotted(node: ast.AST) -> str:
@@ -38,7 +53,8 @@ def _dotted(node: ast.AST) -> str:
 class CompatShimPass(LintPass):
     name = "compat-shim"
     description = ("skew-renamed jax APIs (shard_map location/kwarg, "
-                   "pallas CompilerParams) only inside _compat.py shims")
+                   "pallas CompilerParams, jax.distributed/"
+                   "multihost_utils) only inside _compat.py shims")
 
     def check(self, ctx: FileContext) -> Iterator[tuple[int, str]]:
         if ctx.basename == "_compat.py":
@@ -55,6 +71,19 @@ class CompatShimPass(LintPass):
                            f"direct import of {node.module}.shard_map "
                            "(location moved across jax versions); "
                            + _SHIM_HINT)
+                if any(node.module == m or node.module.startswith(m + ".")
+                       for m in _MP_MODULES):
+                    yield (node.lineno,
+                           f"direct import from {node.module} (multi-"
+                           "process API, skews across jax versions); "
+                           + _MP_HINT)
+                elif node.module in ("jax", "jax.experimental"):
+                    for a in node.names:
+                        if a.name in ("distributed", "multihost_utils"):
+                            yield (node.lineno,
+                                   f"direct import of {node.module}."
+                                   f"{a.name} (multi-process API, skews "
+                                   "across jax versions); " + _MP_HINT)
                 if node.module.split(".")[0] == "jax":
                     # importing the resolved name FROM a _compat shim is
                     # the sanctioned pattern; only raw jax imports skew
@@ -71,6 +100,12 @@ class CompatShimPass(LintPass):
                         yield (node.lineno,
                                "direct import of jax.experimental."
                                "shard_map; " + _SHIM_HINT)
+                    elif any(a.name == m or a.name.startswith(m + ".")
+                             for m in _MP_MODULES):
+                        yield (node.lineno,
+                               f"direct import of {a.name} (multi-"
+                               "process API, skews across jax versions); "
+                               + _MP_HINT)
             elif isinstance(node, ast.Attribute):
                 dotted = _dotted(node)
                 if dotted in ("jax.shard_map",
@@ -78,6 +113,11 @@ class CompatShimPass(LintPass):
                     yield (node.lineno,
                            f"direct use of {dotted} (location moved "
                            "across jax versions); " + _SHIM_HINT)
+                elif any(dotted == m or dotted.startswith(m + ".")
+                         for m in _MP_MODULES):
+                    yield (node.lineno,
+                           f"direct use of {dotted} (multi-process API, "
+                           "skews across jax versions); " + _MP_HINT)
                 elif node.attr in _RENAMED_ATTRS:
                     yield (node.lineno,
                            f"direct use of .{node.attr} (renamed "

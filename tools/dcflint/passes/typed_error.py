@@ -46,6 +46,7 @@ DCF_ERRORS = frozenset({
     "RingEpochError",
     "StandbyExhaustedError",
     "LockOrderError",
+    "MeshUnavailableError",
 })
 _ALWAYS_OK = DCF_ERRORS | {"NotImplementedError", "ForcedVerdict"}
 _MARKED_OK = frozenset({"ValueError", "TypeError"})
