@@ -1,0 +1,113 @@
+"""Measure and pin the pir_bench single-core numpy-EvalAll denominator.
+
+``pir_bench``'s ``vs_baseline`` compares served PIR queries/s against
+"what would the obviously-correct host implementation serve": the
+single-core numpy full-domain expansion (``backends.evalall
+.dpf_tree_expand_np`` + ``dpf_finalize_np``) of one DPF key over the
+n=16 domain — one EvalAll IS one PIR query's dominant cost (the GF(2)
+inner product is noise next to 2^17 PRG calls).  Same pinning
+discipline as ``cpu_baseline.py`` (CPU_BASELINE.md): fixed workload,
+warmup passes, >= 40 timed samples, median pinned with the p10-p90
+band and host state recorded alongside, committed once — the
+denominator must not move between bench runs.
+
+Fixed workload: 1 key, lam=32 (the DPF device width), n=16 domain,
+party 0, drawn from the same seed the bench uses.  ``pir_bench``
+rescales the pin by 2^16 / 2^n for its other domain sizes — EvalAll
+cost is linear in leaf count.
+
+Writes the ``"dpf": {"evalall_n16": ...}`` entry into
+``benchmarks/cpu_baseline.json`` (other fields untouched) and prints
+the record.
+
+Usage: python benchmarks/dpf_baseline.py [--samples N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+import warnings
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_BITS = 16
+LAM = 32
+KEYS = 1
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--samples", type=int, default=40)
+    args = ap.parse_args()
+
+    from benchmarks.cpu_baseline import host_state
+    from dcf_tpu.backends.evalall import dpf_finalize_np, dpf_tree_expand_np
+    from dcf_tpu.spec import ReferenceContractWarning
+    from dcf_tpu.ops.prg import HirosePrgNp
+    from dcf_tpu.protocols.dpf import dpf_gen_batch
+
+    rng = np.random.default_rng(2026)
+    cipher_keys = [bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+                   for _ in range(18)]
+    with warnings.catch_warnings():
+        # lam=32 is the documented reference-contract deviation the DPF
+        # device kernel requires; the warning is the facade's job.
+        warnings.simplefilter("ignore", ReferenceContractWarning)
+        prg = HirosePrgNp(LAM, cipher_keys)
+    n_bytes = N_BITS // 8
+    alphas = np.array(
+        [list(int(a).to_bytes(n_bytes, "big"))
+         for a in rng.integers(0, 1 << N_BITS, KEYS)], dtype=np.uint8)
+    betas = rng.integers(0, 256, (KEYS, LAM), dtype=np.uint8)
+    s0s = rng.integers(0, 256, (KEYS, 2, LAM), dtype=np.uint8)
+    bundle = dpf_gen_batch(prg, alphas, betas, s0s)
+    kb = bundle.for_party(0)
+
+    def one_query():
+        s, t = dpf_tree_expand_np(prg, kb, 0, N_BITS)
+        dpf_finalize_np(kb, s, t)
+
+    for _ in range(4):  # warmup (turbo burst / cache warm)
+        one_query()
+    rates = []
+    for _ in range(max(args.samples, 8)):
+        t0 = time.perf_counter()
+        one_query()
+        rates.append(KEYS / (time.perf_counter() - t0))
+    rates = np.array(rates)
+    entry = {
+        "queries_per_sec": round(float(np.median(rates)), 3),
+        "band_queries_per_sec": [
+            round(float(np.percentile(rates, 10)), 3),
+            round(float(np.percentile(rates, 90)), 3)],
+        "band": "p10-p90 of per-sample rates",
+        "samples": len(rates),
+        "keys": KEYS,
+        "n_bits": N_BITS,
+        "workload": (f"numpy dpf_tree_expand_np + dpf_finalize_np, "
+                     f"K={KEYS} key, n={N_BITS} domain, lam={LAM}, "
+                     "single core, one party (one query = one EvalAll)"),
+        "date": datetime.date.today().isoformat(),
+        **host_state(),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cpu_baseline.json")
+    with open(path) as f:
+        pinned = json.load(f)
+    pinned.setdefault("dpf", {})[f"evalall_n{N_BITS}"] = entry
+    with open(path, "w") as f:
+        json.dump(pinned, f, indent=1)
+        f.write("\n")
+    print(json.dumps(entry, indent=1))
+
+
+if __name__ == "__main__":
+    main()

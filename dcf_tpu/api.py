@@ -396,6 +396,7 @@ class Dcf:
         # never constructs the other party's backend.
         self._eval_backends: dict = {}
         self._shipped_bundle: dict = {}
+        self._dpf_evalall = None  # lazy (eval_all's device path)
         # Shared invalidation wiring: remember what was ASKED for (auto
         # may re-select after a health reset) and subscribe to resets.
         self._requested_backend = backend
@@ -411,6 +412,7 @@ class Dcf:
         reset stays cheap for instances that never evaluate again."""
         self._eval_backends.clear()
         self._shipped_bundle.clear()
+        self._dpf_evalall = None
         if self._requested_backend == "auto" and self.mesh is None:
             self._needs_reselect = True
 
@@ -945,6 +947,111 @@ class Dcf:
         from dcf_tpu.protocols import eval_piecewise
 
         return eval_piecewise(self, b, pb, np.asarray(xs, dtype=np.uint8))
+
+    # -- DPF / PIR (point functions + full-domain eval; README "DPF / PIR")
+
+    def dpf(self, alphas: np.ndarray, betas: np.ndarray | None = None,
+            s0s: np.ndarray | None = None,
+            rng: np.random.Generator | None = None,
+            device: bool = False):
+        """Generate K DPF keys for ``f(x) = beta_k * 1_{x == alpha_k}``.
+
+        The GGM walk minus the comparison accumulation (no ``cw_v`` —
+        ``protocols.dpf`` derivation): alphas uint8 [K, n_bytes], betas
+        uint8 [K, lam] (default all-ones — PIR reads only the leaf
+        t-bits, so the payload rarely matters), s0s uint8 [K, 2, lam]
+        fresh random root seeds.  Returns the two-party
+        ``protocols.DpfBundle`` (DCFK v3 ``proto=2`` on the wire; ship
+        ``bundle.for_party(b)``).  Evaluate pointwise with
+        ``protocols.dpf_eval_points`` or full-domain with
+        :meth:`eval_all`; registering the bundle in ``Dcf.serve(...)``
+        / the pod router serves it (``workloads.pir.PirServer``).
+        ``device=True`` runs the K-packed keygen kernel (lam=32; falls
+        back to the host walk counted + warned, like :meth:`gen`).
+        """
+        from dcf_tpu.protocols.dpf import dpf_gen_batch, dpf_gen_on_device
+
+        alphas = np.asarray(alphas, dtype=np.uint8)
+        if alphas.ndim != 2 or alphas.shape[1] != self.n_bytes:
+            raise ShapeError(f"alphas must be [K, {self.n_bytes}]")
+        if betas is None:
+            betas = np.full((alphas.shape[0], self.lam), 0xFF,
+                            dtype=np.uint8)
+        betas = np.asarray(betas, dtype=np.uint8)
+        if s0s is None:
+            s0s = random_s0s(
+                alphas.shape[0], self.lam,
+                # dcflint: disable=determinism fresh key seeds MUST be
+                # unpredictable (OS entropy); pass rng= to reproduce
+                rng if rng is not None else np.random.default_rng())
+        if device:
+            return dpf_gen_on_device(
+                self.lam, self.cipher_keys, alphas, betas, s0s)
+        return dpf_gen_batch(self._prg, alphas, betas, s0s)
+
+    def eval_all(self, b: int, bundle, device: bool = False):
+        """Party ``b``'s FULL-DOMAIN DPF evaluation — every leaf at
+        once, ~2^{n+1} PRG calls instead of n * 2^n per-point walks.
+
+        Returns ``(y, t)``: leaf shares uint8 [K, 2^n_bits, lam] and
+        leaf t-bits uint8 [K, 2^n_bits], in bitreverse_n leaf order
+        (position p holds domain point bitreverse(p) — the level-order
+        doubling's order; ``workloads.pir.PirDatabase`` packs records
+        the same way, so PIR never reorders).  XOR the two parties:
+        ``y0^y1`` is beta at alpha and 0 elsewhere; ``t0^t1`` is the
+        one-hot selection vector.
+
+        ``device=False``: the portable host expansion (any lam).
+        ``device=True``: the Pallas EvalAll kernel (lam=32 only —
+        ``backends.evalall.DpfEvalAll``, off-TPU interpreter rule),
+        fetched back to host bytes; throughput-sensitive callers (PIR
+        servers, benches) use ``DpfEvalAll`` directly to keep the leaf
+        planes device-resident.
+        """
+        from dcf_tpu.backends.evalall import (
+            dpf_finalize_np,
+            dpf_tree_expand_np,
+            leaf_planes_to_bytes,
+        )
+
+        kb = bundle.for_party(b) if bundle.s0s.shape[1] == 2 else bundle
+        if device:
+            ev = self._dpf_evalall
+            if ev is None:
+                import jax
+
+                from dcf_tpu.backends.evalall import DpfEvalAll
+
+                ev = DpfEvalAll(
+                    self.lam, self.cipher_keys,
+                    interpret=jax.devices()[0].platform != "tpu")
+                self._dpf_evalall = ev
+            y0, y1, t = ev.eval_party(b, kb, kb.n_bits)
+            return leaf_planes_to_bytes(y0, y1, t)
+        s, t = dpf_tree_expand_np(self._prg, kb, b, kb.n_bits)
+        return dpf_finalize_np(kb, s, t), t
+
+    def pir_query(self, indices, s0s: np.ndarray | None = None,
+                  rng: np.random.Generator | None = None):
+        """Client-side 2-server-PIR query keygen: one DPF key pair per
+        record index (``workloads.pir.pir_query_bundle`` over this
+        facade's PRG/domain).  Register the returned bundle with both
+        servers (``PodRouter.register_key`` serves a pod), collect
+        ``PirServer.answer(key_id, b)`` from each, and XOR the shares
+        (``workloads.pir.pir_reconstruct``) — the record comes back
+        bit-exact while neither server learns which one.
+        """
+        from dcf_tpu.workloads.pir import pir_query_bundle
+
+        indices = [int(i) for i in np.asarray(indices).reshape(-1)]
+        if s0s is None:
+            s0s = random_s0s(
+                len(indices), self.lam,
+                # dcflint: disable=determinism fresh key seeds MUST be
+                # unpredictable (OS entropy); pass rng= to reproduce
+                rng if rng is not None else np.random.default_rng())
+        return pir_query_bundle(self._prg, indices, 8 * self.n_bytes,
+                                s0s)
 
     # -- eval (reference eval, src/lib.rs:163-204) --------------------------
 

@@ -1,0 +1,275 @@
+"""Full-domain DPF evaluation (EvalAll) — the PIR engine backend.
+
+``backends.fulldomain`` expands the lam=16 DCF tree; this is the DPF
+twin at the device DPF width (lam=32): the host numpy walk expands the
+tiny irregular top (levels 0..k0, 2^k0 nodes, K keys at once), ships
+the frontier planes to the device, and ``ops.pallas_evalall`` doubles
+the node arrays level by level until the leaves.  Total PRG work drops
+from n * 2^n per-point walks to ~2^{n+1} level-order calls per key —
+the classic EvalAll optimization, and the reason 2-server PIR is
+economic: every query touches the whole database, so the per-leaf cost
+IS the query cost (workloads.py rides ``eval_party``'s leaf t-bit
+planes directly as the selection-vector share).
+
+Leaves come out in bitreverse_n order (each level stacks
+[left-children; right-children]); verification computes each position's
+domain value arithmetically, so nothing is ever gathered back to
+natural order.  Interpret-mode rule: Mosaic on TPU, the Pallas
+interpreter elsewhere — callers pass ``interpret=True`` off-TPU, same
+as every other Pallas backend.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcf_tpu.errors import ShapeError
+from dcf_tpu.ops.aes_bitsliced import round_key_masks_bitmajor
+from dcf_tpu.ops.pallas_evalall import dpf_tree_expand_device
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.protocols.dpf import DPF_DEVICE_LAM, DpfBundle
+from dcf_tpu.spec import hirose_used_cipher_indices
+from dcf_tpu.utils.bits import (
+    bitmajor_perm,
+    bitmajor_plane_masks,
+    bits_lsb_to_bytes,
+    byte_bits_lsb,
+    pack_lanes,
+    unpack_lanes,
+)
+
+__all__ = ["DpfEvalAll", "dpf_finalize_np", "dpf_tree_expand_np",
+           "leaf_planes_to_bytes"]
+
+_PERM = bitmajor_perm(16)
+_INV_PERM = np.argsort(_PERM)
+
+
+def dpf_tree_expand_np(prg: HirosePrgNp, bundle: DpfBundle, b: int,
+                       levels: int):
+    """Host breadth-first expansion of party ``b``'s K keys to
+    ``levels`` deep.
+
+    Returns (s [K, N, lam], t [K, N]) with N = 2^levels in bitreverse
+    order (position = Σ dir_i 2^i over the MSB-first walk directions).
+    Doubles as the oracle the device kernel is tested against, and as
+    the portable EvalAll for hosts without an accelerator.
+    """
+    col = b if bundle.s0s.shape[1] == 2 else 0
+    s = bundle.s0s[:, col, None, :].copy()  # [K, 1, lam]
+    t = np.full((bundle.num_keys, 1), b, dtype=np.uint8)
+    for i in range(levels):
+        p = prg.gen(s)
+        cs = bundle.cw_s[:, None, i, :]
+        ctl = bundle.cw_t[:, None, i, 0]
+        ctr = bundle.cw_t[:, None, i, 1]
+        tc = t[..., None]
+        s_l = p.s_l ^ cs * tc
+        s_r = p.s_r ^ cs * tc
+        t_l = p.t_l ^ (t & ctl)
+        t_r = p.t_r ^ (t & ctr)
+        s = np.concatenate([s_l, s_r], axis=1)
+        t = np.concatenate([t_l, t_r], axis=1)
+    return s, t
+
+
+def dpf_finalize_np(bundle: DpfBundle, s: np.ndarray,
+                    t: np.ndarray) -> np.ndarray:
+    """Leaf shares from a host expansion at full depth:
+    ``y = s ^ cw_np1 * t``, uint8 [K, N, lam]."""
+    return s ^ bundle.cw_np1[:, None, :] * t[..., None]
+
+
+def leaf_planes_to_bytes(y0, y1, t):
+    """Device EvalAll outputs back to host bytes: the facade's fetch.
+
+    ``(y0, y1 int32 [K, 128, N/32], t int32 [K, 1, N/32])`` from
+    ``eval_party`` -> ``(y uint8 [K, N, 32], t uint8 [K, N])``, leaf
+    order unchanged (bitreverse_n).  The exact inverse of the
+    ``_frontier`` plane packing, block-concatenated.
+    """
+    def blk(a):  # int32 [K, 128, N/32] -> uint8 [K, N, 16]
+        bits = unpack_lanes(np.asarray(a).view(np.uint32))  # [K, 128, N]
+        return bits_lsb_to_bytes(np.swapaxes(bits, 1, 2)[..., _INV_PERM])
+
+    y = np.concatenate([blk(y0), blk(y1)], axis=-1)
+    t_bits = unpack_lanes(np.asarray(t).view(np.uint32))[:, 0, :]
+    return y, t_bits.astype(np.uint8)
+
+
+def leaf_pair_mismatch_count(y0b0, y0b1, y1b0, y1b1, beta0_m, beta1_m,
+                             inside):
+    """Count leaves whose XOR reconstruction differs from the expected
+    ``beta if inside else 0`` across BOTH 16-byte blocks.
+
+    y{party}b{block}: leaf-share planes [K, 128, W]; beta masks
+    [K, 128, 1]; inside: 0/-1 lane words [K, 1, W] (or broadcastable).
+    The two-block twin of ``fulldomain.leaf_mismatch_count``, shared by
+    the unsharded and mesh-sharded verifiers."""
+    diff = (jnp.bitwise_or.reduce(y0b0 ^ y1b0 ^ (beta0_m & inside),
+                                  axis=-2)
+            | jnp.bitwise_or.reduce(y0b1 ^ y1b1 ^ (beta1_m & inside),
+                                    axis=-2))
+    return jnp.sum(jax.lax.population_count(
+        jax.lax.bitcast_convert_type(diff, jnp.uint32)).astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _dpf_tree_mismatch(y0b0, y0b1, y1b0, y1b1, beta0_m, beta1_m, alphas,
+                       n: int):
+    """Mismatching-leaf count for bitrev-order K-keyed leaf planes
+    [K, 128, 2^n / 32]; ``alphas`` uint32 [K], one point per key."""
+    m = 32 * y0b0.shape[-1]
+    pos = jnp.arange(m, dtype=jnp.uint32)
+    value = jnp.zeros(m, dtype=jnp.uint32)
+    for k in range(n):  # domain value = bitreverse_n(position)
+        value = value | (((pos >> k) & 1) << (n - 1 - k))
+    hit = (value[None, :] == alphas[:, None]).astype(jnp.uint32)
+    bits = hit.reshape(hit.shape[0], -1, 32)
+    inside = jax.lax.bitcast_convert_type(
+        jnp.sum(bits << jnp.arange(32, dtype=jnp.uint32), axis=-1,
+                dtype=jnp.uint32), jnp.int32)[:, None, :]  # [K, 1, W]
+    return leaf_pair_mismatch_count(
+        y0b0, y0b1, y1b0, y1b1, beta0_m, beta1_m, inside)
+
+
+class DpfEvalAll:
+    """Full-domain K-packed DPF evaluator/verifier (lam=32).
+
+    The DPF mirror of ``fulldomain.TreeFullDomain``: host-expand the
+    top ``host_levels`` of each key's GGM tree, run the Pallas EvalAll
+    kernel for the rest, finalize on device.  ``eval_party`` returns
+    the leaf shares as two-block planes PLUS the leaf t-bit lane words
+    — the PIR selection-vector share.  Repeated calls on the same
+    bundle object reuse the staged CW image and frontiers (identity
+    -keyed ship-once cache, same discipline as TreeFullDomain).
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes],
+                 host_levels: int = 6, interpret: bool = False):
+        if lam != DPF_DEVICE_LAM:
+            # api-edge: constructor lam contract (the two-block narrow
+            # width; other lams take the host dpf_tree_expand_np walk)
+            raise ValueError(
+                f"DpfEvalAll supports lam={DPF_DEVICE_LAM} only, "
+                f"got {lam}")
+        used = hirose_used_cipher_indices(lam, len(cipher_keys))
+        self.lam = lam
+        self.host_levels = host_levels
+        self.interpret = interpret
+        self.rk2 = jnp.asarray(np.concatenate(
+            [round_key_masks_bitmajor(cipher_keys[i]) for i in used],
+            axis=2))  # [15, 128, 2]
+        self._prg = HirosePrgNp(lam, cipher_keys)
+        # Ship-once cache for repeated evals of the SAME bundle (the
+        # PIR serving pattern: one resident key image, many queries).
+        # Keyed on the caller's object by IDENTITY and RETAINING it.
+        self._cache = None
+
+    def _stage_cw(self, bundle: DpfBundle):
+        """Ship the (party-independent) correction words once."""
+        def masks(a):  # uint8 [..., 16] -> int32 [..., 128, 1]
+            return jnp.asarray(bitmajor_plane_masks(a)[..., None])
+
+        return (masks(bundle.cw_s[..., :16]), masks(bundle.cw_s[..., 16:]),
+                jnp.asarray(bundle.cw_t.astype(np.int32) * -1),
+                masks(bundle.cw_np1[:, :16]),
+                masks(bundle.cw_np1[:, 16:]))
+
+    def _frontier(self, bundle: DpfBundle, b: int, k0: int):
+        """Host-expand to level k0 and pack to device plane layout:
+        (s0, s1 int32 [K, 128, N/32], t int32 [K, 1, N/32])."""
+        s, t = dpf_tree_expand_np(self._prg, bundle, b, k0)
+
+        def planes(a):  # [K, N, 16] -> int32 [K, 128, N/32]
+            bits = byte_bits_lsb(a)[..., _PERM]  # [K, N, 128]
+            return jnp.asarray(pack_lanes(np.ascontiguousarray(
+                np.swapaxes(bits, 1, 2))).view(np.int32))
+
+        t_m = jnp.asarray(pack_lanes(t[:, None, :]).view(np.int32))
+        return planes(s[..., :16]), planes(s[..., 16:]), t_m
+
+    def eval_party(self, b: int, bundle: DpfBundle, n_bits: int,
+                   staged_cw=None, frontier=None):
+        """Party ``b`` full-domain leaf shares: DEVICE int32 planes
+        ``(y0, y1 [K, 128, 2^n_bits / 32], t [K, 1, 2^n_bits / 32])``
+        — the two 16-byte blocks plus the leaf t-bit lane words, all
+        bitreverse_n order.  ``bundle`` must be party-restricted
+        (``for_party(b)``).  ``staged_cw``/``frontier`` reuse prior
+        ``_stage_cw``/``_frontier`` results (the CW image is
+        party-independent; the frontier is per party).
+
+        ``n_bits < bundle.n_bits`` is a PREFIX evaluation: the walk
+        stops at depth ``n_bits``, where the t lane words are the
+        one-hot indicator of alpha's top-``n_bits`` bits — the PIR
+        selection vector for a database domain that need not be
+        byte-granular (the wire format is; see ``pir_query_bundle``).
+        The y payload planes are only meaningful at FULL depth (the
+        leaf correction lands on internal-node seeds otherwise);
+        prefix callers must read only ``t``."""
+        if bundle.n_bits < n_bits:
+            raise ShapeError(
+                f"bundle walks {bundle.n_bits} levels, cannot evaluate "
+                f"{n_bits} deep")
+        if bundle.s0s.shape[1] != 1:
+            raise ShapeError("eval_party wants a party-restricted bundle")
+        k0 = min(self.host_levels, n_bits)
+        if k0 < 5:
+            # api-edge: constructor host_levels contract
+            raise ValueError("need at least 5 host levels (one lane word)")
+        cs0_t, cs1_t, ct_pm, np10_t, np11_t = (
+            staged_cw if staged_cw is not None else self._stage_cw(bundle))
+        s0, s1, t = (frontier if frontier is not None
+                     else self._frontier(bundle, b, k0))
+        return dpf_tree_expand_device(
+            self.rk2, cs0_t, cs1_t, ct_pm, np10_t, np11_t, s0, s1, t,
+            k0=k0, n=n_bits, interpret=self.interpret)
+
+    def invalidate(self) -> None:
+        """Drop the ship-once staged image (the serve layer's
+        retry-then-evict discipline: a faulted eval must not hand its
+        possibly-poisoned device residency to the retry)."""
+        self._cache = None
+
+    def _staged_for(self, bundle: DpfBundle, n_bits: int):
+        """Staged CW image + both parties' frontiers for ``bundle``,
+        shipped to the device ONCE and reused while the caller keeps
+        evaluating the same bundle object (the PIR server's resident
+        key pattern)."""
+        c = self._cache
+        if c is not None and c[0] is bundle and c[1] == n_bits:
+            return c[2], c[3], c[4]
+        k0 = min(self.host_levels, n_bits)
+        staged_cw = self._stage_cw(bundle)
+        parts = {b: bundle.for_party(b) for b in (0, 1)}
+        fronts = {b: self._frontier(parts[b], b, k0) for b in (0, 1)}
+        self._cache = (bundle, n_bits, staged_cw, fronts, parts)
+        return staged_cw, fronts, parts
+
+    def check_device(self, bundle: DpfBundle, alphas: np.ndarray,
+                     betas: np.ndarray, n_bits: int) -> jax.Array:
+        """Two-party full-domain reconstruction vs the point function,
+        entirely on device; returns the mismatching-leaf count (over
+        ALL keys and the WHOLE 2^n domain) as a DEVICE scalar.
+        ``bundle`` is the full two-party bundle; ``alphas`` are the K
+        point values (ints < 2^n_bits, n_bits <= 32 for the device
+        comparison), ``betas`` uint8 [K, 32]."""
+        staged_cw, fronts, parts = self._staged_for(bundle, n_bits)
+        y0 = self.eval_party(0, parts[0], n_bits, staged_cw, fronts[0])
+        y1 = self.eval_party(1, parts[1], n_bits, staged_cw, fronts[1])
+        betas = np.asarray(betas, dtype=np.uint8)
+        beta0_m = jnp.asarray(bitmajor_plane_masks(betas[:, :16])[..., None])
+        beta1_m = jnp.asarray(bitmajor_plane_masks(betas[:, 16:])[..., None])
+        alphas_u = jnp.asarray(np.asarray(alphas, dtype=np.uint32))
+        return _dpf_tree_mismatch(
+            y0[0], y0[1], y1[0], y1[1], beta0_m, beta1_m, alphas_u,
+            n=n_bits)
+
+    def check(self, bundle: DpfBundle, alphas, betas,
+              n_bits: int) -> int:
+        return int(self.check_device(bundle, alphas, betas, n_bits))

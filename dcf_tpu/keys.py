@@ -312,6 +312,15 @@ class KeyBundle:
                 _HEADER3, data, 4)
             header_size = _HEADER3_SIZE
             if proto != 0:
+                # proto ids live in dcf_tpu.protocols (keygen.PROTO_MIC=1,
+                # dpf.PROTO_DPF=2); named here literally to keep keys.py
+                # import-free of the protocol layer.
+                if proto == 2:
+                    raise KeyFormatError(
+                        f"frame carries protocol section {proto} (DPF "
+                        "point-function key, no cw_v); decode with "
+                        "dcf_tpu.protocols.DpfBundle.from_bytes — reading "
+                        "it as a plain bundle would misparse the sections")
                 raise KeyFormatError(
                     f"frame carries protocol section {proto} (interval "
                     "combine masks); decode with dcf_tpu.protocols."

@@ -1,0 +1,150 @@
+"""Pallas TPU kernel for full-domain DPF evaluation (EvalAll, lam=32).
+
+``ops.pallas_tree`` expands the lam=16 DCF tree breadth-first; this is
+its DPF twin at the device DPF width (lam=32, two AES blocks — the
+``narrow_prg_expand`` shape every narrow kernel shares), generalized
+from "cache the top k levels" (the PR 3/7 frontier build) to "emit
+every leaf": per-point full-domain evaluation costs n * 2^n PRG calls,
+the level-order expansion costs sum_i 2^i ≈ 2^{n+1} — the classic FSS
+EvalAll optimization, and the engine of 2-server PIR (every query
+touches the whole database, so the per-leaf cost IS the query cost).
+
+One kernel application = one (key, tile) of one level: a tile of parent
+nodes (packed 32 per uint32 lane word, bit-major planes) expands into
+left/right child tiles with the seed correction applied; there is no
+value accumulator — the DPF key has no ``cw_v`` (protocols.dpf).  The
+batch grid is (K, words/tile): K-packed like the keygen kernel, nodes
+in lanes like the eval kernels.
+
+Children per Hirose at lam=32 (blocks 0/1 = bytes 0..15 / 16..31):
+
+    s_l = (E0(s0)^s0, s1)    s_r = (s0, E17(s1)^s1)    (src/prg.rs:48-62)
+
+with the global 8*lam-1 mask bit falling INSIDE block 1 (bit-major
+plane 15), so block-1 child quantities mask with ``lbm`` and block 0 is
+never masked.  t-bits are the pre-mask plane 0 of the two half-0
+buffers, exactly what ``narrow_prg_expand`` returns.
+
+Levels double the node arrays as [all-left ; all-right], so leaf array
+position p holds domain point bitreverse_n(p) — consumers account for
+it arithmetically (``backends.evalall``).  The top of the tree
+(< 2^k0 nodes) is host-expanded; the device runs levels k0..n-1.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dcf_tpu.ops._compat import CompilerParams as _CompilerParams
+from dcf_tpu.ops.pallas_narrow import make_narrow_aes, narrow_prg_expand
+
+__all__ = ["dpf_tree_expand_device", "dpf_tree_expand_raw"]
+
+
+def _expand_kernel(rk2_ref, cs0_ref, cs1_ref, ct_ref,
+                   s0_ref, s1_ref, t_ref,
+                   sl0_o, sl1_o, tl_o, sr0_o, sr1_o, tr_o,
+                   *, interpret: bool):
+    ones = jnp.int32(-1)
+    wt = t_ref.shape[2]
+    aes = make_narrow_aes(rk2_ref, wt, interpret)
+    lbm = jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (128, 1), 0) == 15,
+        jnp.int32(0), ones)
+
+    s0 = s0_ref[0]
+    s1 = s1_ref[0]
+    t = t_ref[0]  # [1, wt]
+    e_s0, _e_v0, e_s1, _e_v1, _sp0, _sp1, t_l, t_r = \
+        narrow_prg_expand(aes, s0, s1)
+    cs0g = cs0_ref[0] & t
+    cs1g = cs1_ref[0] & t
+    sl0_o[0] = e_s0 ^ cs0g
+    sl1_o[0] = (s1 & lbm) ^ cs1g
+    sr0_o[0] = s0 ^ cs0g
+    sr1_o[0] = (e_s1 & lbm) ^ cs1g
+    tl_o[0] = t_l ^ (t & ct_ref[0, 0])
+    tr_o[0] = t_r ^ (t & ct_ref[0, 1])
+
+
+def _expand_level(rk2, cs0, cs1, ct, s0, s1, t, *, interpret: bool):
+    """One tree level for K packed keys: [K, 128, W] parents -> six
+    [K, .., W] child halves."""
+    k_num, _, w = s0.shape
+    wt = min(128, w)
+    grid = (k_num, w // wt)
+    state_spec = pl.BlockSpec((1, 128, wt), lambda k, j: (k, 0, j))
+    t_spec = pl.BlockSpec((1, 1, wt), lambda k, j: (k, 0, j))
+    cw_spec = pl.BlockSpec((1, 128, 1), lambda k, j: (k, 0, 0))
+    params = (dict() if interpret else dict(
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024)))
+    return pl.pallas_call(
+        partial(_expand_kernel, interpret=interpret),
+        **params,
+        out_shape=(
+            jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((k_num, 1, w), jnp.int32),
+            jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((k_num, 1, w), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((15, 128, 2), lambda k, j: (0, 0, 0)),
+            cw_spec, cw_spec,
+            pl.BlockSpec((1, 2), lambda k, j: (k, 0),
+                         memory_space=pltpu.SMEM),
+            state_spec, state_spec, t_spec,
+        ],
+        out_specs=(state_spec, state_spec, t_spec,
+                   state_spec, state_spec, t_spec),
+        interpret=interpret,
+    )(rk2, cs0, cs1, ct, s0, s1, t)
+
+
+@partial(jax.jit, static_argnames=("k0", "k1", "interpret"))
+def dpf_tree_expand_raw(rk2, cs0_t, cs1_t, ct_pm, s0, s1, t,
+                        k0: int, k1: int, interpret: bool = False):
+    """Expand levels k0..k1-1 WITHOUT finalizing: returns the raw
+    (s0, s1, t) node planes at level k1 (int32 [K, 128, 2^k1 / 32] x2 +
+    [K, 1, 2^k1 / 32]), leaf order bitreverse_k1 per key.
+
+    rk2 int32 [15, 128, 2]; cs0_t/cs1_t int32 [K, n, 128, 1] bit-major
+    seed-CW plane masks (blocks 0/1); ct_pm int32 [K, n, 2] (0/-1);
+    s0/s1/t the level-k0 frontier planes.
+    """
+    for i in range(k0, k1):
+        sl0, sl1, tl, sr0, sr1, tr = _expand_level(
+            rk2, cs0_t[:, i], cs1_t[:, i], ct_pm[:, i], s0, s1, t,
+            interpret=interpret)
+        s0 = jnp.concatenate([sl0, sr0], axis=2)
+        s1 = jnp.concatenate([sl1, sr1], axis=2)
+        t = jnp.concatenate([tl, tr], axis=2)
+    return s0, s1, t
+
+
+@partial(jax.jit, static_argnames=("k0", "n", "interpret"))
+def dpf_tree_expand_device(rk2, cs0_t, cs1_t, ct_pm, np10_t, np11_t,
+                           s0, s1, t, k0: int, n: int,
+                           interpret: bool = False):
+    """Expand levels k0..n-1 and finalize leaves.
+
+    np10_t/np11_t int32 [K, 128, 1]: the leaf-CW plane masks (blocks
+    0/1).  Returns ``(y0, y1, t)``: the two 16-byte BLOCKS of the leaf
+    shares as int32 planes [K, 128, 2^n / 32] plus the leaf t-bit lane
+    words [K, 1, 2^n / 32], all in bitreverse_n order.  The t planes
+    are the PIR selection-vector share: t0 ^ t1 is 1 exactly at
+    bitreverse_n(alpha) (workloads.py consumes them directly — the
+    leaf-share planes are only needed when the DPF payload beta itself
+    matters).
+    """
+    s0, s1, t = dpf_tree_expand_raw(rk2, cs0_t, cs1_t, ct_pm, s0, s1, t,
+                                    k0=k0, k1=n, interpret=interpret)
+    return s0 ^ (np10_t & t), s1 ^ (np11_t & t), t

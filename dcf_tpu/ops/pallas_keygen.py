@@ -70,7 +70,8 @@ from dcf_tpu.utils.bits import (
     unpack_lanes,
 )
 
-__all__ = ["PallasKeyGen", "dcf_keygen_walk_pallas"]
+__all__ = ["PallasDpfKeyGen", "PallasKeyGen", "dcf_keygen_walk_pallas",
+           "dpf_keygen_walk_pallas"]
 
 NARROW = 32  # bytes covered by the encrypted blocks (ciphers 0, 17)
 
@@ -385,6 +386,23 @@ class PallasKeyGen:
                   for b in (0, 1)}
         return self._assemble_bundle(out, padded, s0s, bound, k), planes
 
+    def _lane_blocks(self, b0, b1, k: int) -> np.ndarray:
+        """Kernel lane planes [..., 128, W] x2 -> narrow key bytes
+        uint8 [K, ..., 32] (shared by the DCF and DPF assemblers)."""
+        by = [bits_lsb_to_bytes(
+            np.moveaxis(unpack_lanes(np.asarray(
+                jax.lax.bitcast_convert_type(a, jnp.uint32))),
+                -1, 0)[:k][..., self._inv_perm])
+            for a in (b0, b1)]
+        return np.concatenate(by, axis=-1)
+
+    def _lane_bits(self, a, k: int) -> np.ndarray:
+        """Kernel t-bit planes [n, 1, W] -> uint8 [K, n]."""
+        return np.moveaxis(
+            unpack_lanes(np.asarray(
+                jax.lax.bitcast_convert_type(a, jnp.uint32))),
+            -1, 0)[:k, :, 0]
+
     def _assemble_bundle(self, out, padded, s0s, bound: Bound,
                          k: int) -> KeyBundle:
         cs0, cs1, cv0, cv1, tl, tr, np10, np11, tr_a, tr_b = out
@@ -395,32 +413,20 @@ class PallasKeyGen:
             jnp.asarray(byte_bits_msb(alphas_p)),
             tr_a, tr_b, lam=self.lam,
             lt_beta=(bound is Bound.LT_BETA), k_num=alphas_p.shape[0])
-
-        def blocks(b0, b1):  # [..., 128, W] x2 -> uint8 [K, ..., 32]
-            by = [bits_lsb_to_bytes(
-                np.moveaxis(unpack_lanes(np.asarray(
-                    jax.lax.bitcast_convert_type(a, jnp.uint32))),
-                    -1, 0)[:k][..., self._inv_perm])
-                for a in (b0, b1)]
-            return np.concatenate(by, axis=-1)
-
-        def bits(a):  # [n, 1, W] -> uint8 [K, n]
-            return np.moveaxis(
-                unpack_lanes(np.asarray(
-                    jax.lax.bitcast_convert_type(a, jnp.uint32))),
-                -1, 0)[:k, :, 0]
-
         cw_s = np.concatenate(
-            [blocks(cs0, cs1), np.asarray(cw_s_w)[:k]], axis=-1)
+            [self._lane_blocks(cs0, cs1, k), np.asarray(cw_s_w)[:k]],
+            axis=-1)
         cw_v = np.concatenate(
-            [blocks(cv0, cv1), np.asarray(cw_v_w)[:k]], axis=-1)
+            [self._lane_blocks(cv0, cv1, k), np.asarray(cw_v_w)[:k]],
+            axis=-1)
         cw_np1 = np.concatenate(
-            [blocks(np10[None], np11[None])[:, 0],
+            [self._lane_blocks(np10[None], np11[None], k)[:, 0],
              np.asarray(np1_w)[:k]], axis=-1)
         return KeyBundle(
             s0s=s0s.copy(),
             cw_s=cw_s, cw_v=cw_v,
-            cw_t=np.stack([bits(tl), bits(tr)], axis=2),
+            cw_t=np.stack(
+                [self._lane_bits(tl, k), self._lane_bits(tr, k)], axis=2),
             cw_np1=cw_np1,
         )
 
@@ -464,3 +470,188 @@ class PallasKeyGen:
     def _assemble_planes(self, out, s0s_p, k: int, b: int) -> dict:
         return dict(self._shared_planes(out, k),
                     **self._party_seed_planes(s0s_p, k, b))
+
+
+# -- the DPF twin -------------------------------------------------------------
+
+
+def _dpf_kernel(rk2_ref, s0a0_ref, s0a1_ref, s0b0_ref, s0b1_ref,
+                beta0_ref, beta1_ref, am_ref,
+                cs0_ref, cs1_ref, tl_ref, tr_ref, np10_ref, np11_ref,
+                *, n: int, interpret: bool):
+    """The DCF keygen walk minus the whole v column (protocols.dpf):
+    same PRG core, same lose-side seed CW and keep-side t algebra, beta
+    entering only through the leaf CW ``np1 = s_a ^ s_b ^ beta``.
+
+    Unlike ``_kernel`` (hybrid, lam >= 48: the global masked byte is
+    wide), lam == NARROW puts the Hirose 8*lam-1 mask bit INSIDE block 1
+    — bit-major plane 15 (bit 0 of byte 15) — so every block-1 seed
+    quantity masks with ``lbm`` exactly where the host PRG masks its
+    outputs (src/prg.rs:65-68).  Block 0 is never masked."""
+    wt = am_ref.shape[2]
+    ones = jnp.int32(-1)
+    aes = make_narrow_aes(rk2_ref, wt, interpret)
+    lbm = jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (128, 1), 0) == 15,
+        jnp.int32(0), ones)
+
+    def mux(m, if_one, if_zero):
+        return (if_one & m) | (if_zero & (m ^ ones))
+
+    z = jnp.zeros((128, wt), jnp.int32)
+    sa0 = s0a0_ref[...] ^ z  # party 0 seed planes, blocks 0/1
+    sa1 = s0a1_ref[...] ^ z
+    sb0 = s0b0_ref[...] ^ z  # party 1
+    sb1 = s0b1_ref[...] ^ z
+    t_a = jnp.zeros((1, wt), jnp.int32)       # t^(0)_0 = 0
+    t_b = jnp.full((1, wt), ones, jnp.int32)  # t^(0)_1 = 1
+
+    def level(i, carry):
+        sa0, sa1, sb0, sb1, t_a, t_b = carry
+        am = am_ref[i]  # [1, wt]: -1 where the walk bit of alpha is 1
+        ea_s0, _ea_v0, ea_s1, _ea_v1, _spa0, _spa1, tla, tra = \
+            narrow_prg_expand(aes, sa0, sa1)
+        eb_s0, _eb_v0, eb_s1, _eb_v1, _spb0, _spb1, tlb, trb = \
+            narrow_prg_expand(aes, sb0, sb1)
+        # lose side: L when the alpha bit is 1, R when 0
+        s_cw0 = mux(am, ea_s0 ^ eb_s0, sa0 ^ sb0)
+        s_cw1 = mux(am, sa1 ^ sb1, ea_s1 ^ eb_s1) & lbm
+        tl_cw = tla ^ tlb ^ am ^ ones
+        tr_cw = tra ^ trb ^ am
+        t_cw_keep = mux(am, tr_cw, tl_cw)
+        new_sa0 = mux(am, sa0, ea_s0) ^ (s_cw0 & t_a)
+        new_sa1 = (mux(am, ea_s1, sa1) & lbm) ^ (s_cw1 & t_a)
+        new_sb0 = mux(am, sb0, eb_s0) ^ (s_cw0 & t_b)
+        new_sb1 = (mux(am, eb_s1, sb1) & lbm) ^ (s_cw1 & t_b)
+        new_t_a = mux(am, tra, tla) ^ (t_a & t_cw_keep)
+        new_t_b = mux(am, trb, tlb) ^ (t_b & t_cw_keep)
+        cs0_ref[pl.dslice(i, 1)] = s_cw0[None]
+        cs1_ref[pl.dslice(i, 1)] = s_cw1[None]
+        tl_ref[pl.dslice(i, 1)] = tl_cw[None]
+        tr_ref[pl.dslice(i, 1)] = tr_cw[None]
+        return (new_sa0, new_sa1, new_sb0, new_sb1, new_t_a, new_t_b)
+
+    sa0, sa1, sb0, sb1, _t_a, _t_b = jax.lax.fori_loop(
+        0, n, level, (sa0, sa1, sb0, sb1, t_a, t_b))
+    np10_ref[...] = sa0 ^ sb0 ^ beta0_ref[...]  # cw_{n+1} = s_a^s_b^beta
+    np11_ref[...] = sa1 ^ sb1 ^ beta1_ref[...]
+
+
+def dpf_keygen_walk_pallas(
+    rk2,        # int32 [15, 128, 2]  bit-major round keys (ciphers 0, 17)
+    s0a0, s0a1,  # int32 [128, W]     party-0 seed planes, blocks 0/1
+    s0b0, s0b1,  # int32 [128, W]     party-1 seed planes
+    beta0, beta1,  # int32 [128, W]   beta planes, blocks 0/1
+    alpha_mask,  # int32 [n, 1, W]    per-level walk-order alpha-bit masks
+    *,
+    tile_words: int = 128,
+    interpret: bool = False,
+):
+    """The full n-level DPF keygen walk for W*32 lane-packed keys.
+
+    Returns ``(cs0, cs1 [n, 128, W], cw_tl, cw_tr [n, 1, W], np1_0,
+    np1_1 [128, W])``.  lam == NARROW exactly — no wide tail, no
+    trajectories: the two narrow blocks ARE the whole key."""
+    n = alpha_mask.shape[0]
+    w = alpha_mask.shape[2]
+    wt = min(tile_words, w)
+    if w % wt != 0:
+        raise ShapeError(f"key words {w} not a multiple of tile {wt}")
+
+    grid = (w // wt,)
+    plane = pl.BlockSpec((128, wt), lambda j: (0, j))
+    level_out = pl.BlockSpec((n, 128, wt), lambda j: (0, 0, j))
+    bit_out = pl.BlockSpec((n, 1, wt), lambda j: (0, 0, j))
+    params = (dict() if interpret else dict(
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024)))
+    return pl.pallas_call(
+        partial(_dpf_kernel, n=n, interpret=interpret),
+        **params,
+        out_shape=(
+            jax.ShapeDtypeStruct((n, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((n, 128, w), jnp.int32),
+            jax.ShapeDtypeStruct((n, 1, w), jnp.int32),
+            jax.ShapeDtypeStruct((n, 1, w), jnp.int32),
+            jax.ShapeDtypeStruct((128, w), jnp.int32),
+            jax.ShapeDtypeStruct((128, w), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((15, 128, 2), lambda j: (0, 0, 0)),
+            plane, plane, plane, plane, plane, plane,
+            pl.BlockSpec((n, 1, wt), lambda j: (0, 0, j)),
+        ],
+        out_specs=(
+            level_out, level_out, bit_out, bit_out, plane, plane,
+        ),
+        interpret=interpret,
+    )(rk2, s0a0, s0a1, s0b0, s0b1, beta0, beta1, alpha_mask)
+
+
+class PallasDpfKeyGen(PallasKeyGen):
+    """On-device K-packed DPF keygen at lam == NARROW (= 32).
+
+    The DPF key is two AES blocks wide — exactly ``narrow_prg_expand``'s
+    shape — so the walk is one Pallas kernel with NO wide tail; the
+    assembler reuses the DCF lane converters.  ``gen`` returns the host
+    two-party ``DpfBundle``, byte-identical to
+    ``protocols.dpf.dpf_gen_batch`` on the same inputs.  Prefer the
+    ``protocols.dpf.dpf_gen_on_device`` router (fault seam + counted
+    fallback) over direct construction.
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes],
+                 interpret: bool = False, tile_words: int = 128):
+        if lam != NARROW:
+            # api-edge: constructor lam contract (the device DPF width;
+            # other lams take the host dpf_gen_batch walk)
+            raise ValueError(
+                f"PallasDpfKeyGen wants lam == {NARROW} (two narrow AES "
+                f"blocks), got {lam}")
+        used = hirose_used_cipher_indices(lam, len(cipher_keys),
+                                          warn=False)
+        assert tuple(used) == (0, 17)
+        self.lam = lam
+        self.interpret = interpret
+        self.tile_words = tile_words
+        self.rk2 = jnp.asarray(np.concatenate(
+            [round_key_masks_bitmajor(cipher_keys[i]) for i in used],
+            axis=2))  # [15, 128, 2]
+        self._perm = bitmajor_perm(16)
+        self._inv_perm = np.argsort(self._perm)
+
+    def gen(self, alphas: np.ndarray, betas: np.ndarray,
+            s0s: np.ndarray):
+        """Generate K DPF keys on device: alphas uint8 [K, n_bytes],
+        betas uint8 [K, 32], s0s uint8 [K, 2, 32].  Returns the
+        two-party host ``DpfBundle`` (K padded to a lane-word multiple
+        internally; pad keys are generated and discarded)."""
+        from dcf_tpu.protocols.dpf import DpfBundle
+
+        k = self._check(alphas, betas, s0s)
+        k_pad = (k + 31) // 32 * 32
+        s0s_p = s0s
+        if k_pad != k:
+            pad = [(0, k_pad - k)]
+            alphas = np.pad(alphas, pad + [(0, 0)])
+            betas = np.pad(betas, pad + [(0, 0)])
+            s0s_p = np.pad(s0s, pad + [(0, 0), (0, 0)])
+        am = pack_lanes(np.ascontiguousarray(
+            byte_bits_msb(alphas).T)).view(np.int32)[:, None, :]
+        cs0, cs1, tl, tr, np10, np11 = dpf_keygen_walk_pallas(
+            self.rk2,
+            self._block_planes(s0s_p[:, 0, :16]),
+            self._block_planes(s0s_p[:, 0, 16:32]),
+            self._block_planes(s0s_p[:, 1, :16]),
+            self._block_planes(s0s_p[:, 1, 16:32]),
+            self._block_planes(betas[:, :16]),
+            self._block_planes(betas[:, 16:32]),
+            jnp.asarray(am),
+            tile_words=self.tile_words, interpret=self.interpret)
+        return DpfBundle(
+            s0s=s0s.copy(),
+            cw_s=self._lane_blocks(cs0, cs1, k),
+            cw_t=np.stack(
+                [self._lane_bits(tl, k), self._lane_bits(tr, k)], axis=2),
+            cw_np1=self._lane_blocks(np10[None], np11[None], k)[:, 0])

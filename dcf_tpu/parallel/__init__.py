@@ -27,6 +27,7 @@ from dcf_tpu.parallel.mesh_eval import (  # noqa: F401
     MeshLargeLambdaBackend,
 )
 from dcf_tpu.parallel.pallas_sharded import (  # noqa: F401
+    ShardedDpfEvalAll,
     ShardedKeyLanesBackend,
     ShardedLargeLambdaBackend,
     ShardedPallasBackend,

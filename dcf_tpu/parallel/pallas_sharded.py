@@ -27,6 +27,11 @@ existed solely as single-chip programs.  This module runs them under
   axes gang up on nodes); each device expands its disjoint sub-frontier
   to the leaves and verifies them locally with a shard-aware
   position->domain-value map, returning one counter per shard.
+* ``ShardedDpfEvalAll`` — the DPF full-domain kernel
+  (``ops.pallas_evalall``, the PIR engine): the K-keyed level-k0
+  frontier shards its lane-word axis over all mesh devices; same
+  disjoint-subtree expansion and shard-local verification as the DCF
+  tree, minus the value accumulator.
 
 Both are testable without hardware: construct with ``interpret=True`` on a
 virtual CPU mesh (tests/test_sharding.py) — the Pallas interpreter lowers
@@ -52,6 +57,7 @@ from dcf_tpu.backends.pallas_backend import (
     _from_planes_jit,
     _stage_xs,
 )
+from dcf_tpu.backends.evalall import DpfEvalAll, leaf_pair_mismatch_count
 from dcf_tpu.backends.fulldomain import TreeFullDomain, leaf_mismatch_count
 from dcf_tpu.backends.large_lambda import (
     LargeLambdaBackend,
@@ -67,12 +73,13 @@ from dcf_tpu.backends.pallas_prefix import (
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.pallas_eval import DEFAULT_TILE_WORDS, dcf_eval_pallas
 from dcf_tpu.ops.pallas_keylanes import dcf_eval_keylanes_pallas
+from dcf_tpu.ops.pallas_evalall import dpf_tree_expand_device
 from dcf_tpu.ops.pallas_tree import tree_expand_device
 from dcf_tpu.utils.bits import bitmajor_plane_masks
 
 __all__ = ["ShardedPallasBackend", "ShardedKeyLanesBackend",
-           "ShardedTreeFullDomain", "ShardedLargeLambdaBackend",
-           "ShardedPrefixBackend"]
+           "ShardedTreeFullDomain", "ShardedDpfEvalAll",
+           "ShardedLargeLambdaBackend", "ShardedPrefixBackend"]
 
 
 class ShardedPallasBackend(PallasBackend):
@@ -300,6 +307,137 @@ class ShardedTreeFullDomain(TreeFullDomain):
     def _frontier(self, bundle: KeyBundle, b: int, k0: int):
         s, v, t = super()._frontier(bundle, b, k0)
         return self._put_nodes(s), self._put_nodes(v), self._put_nodes(t)
+
+
+class ShardedDpfEvalAll(DpfEvalAll):
+    """Full-domain DPF evaluation/verification sharded over a mesh.
+
+    The DPF twin of ``ShardedTreeFullDomain`` with one extra axis: the
+    node arrays are K-keyed ([K, 128, W] / [K, 1, W]), so the level-k0
+    frontier shards its LANE-WORD axis over all devices of the
+    (keys, points) mesh while every device holds all K keys — PIR
+    serves one resident bundle of few keys against a domain of many
+    leaves, so the leaf axis is the one worth cutting.  Device q takes
+    the contiguous frontier slice [q*2^k0/P, (q+1)*2^k0/P) of every
+    key and expands it to depth n independently — disjoint subtrees,
+    no collectives.  Verification is shard-local: local leaf l =
+    e*2^c + fl (c frontier-local bits, e device-level bits) has global
+    walk directions (fl bits, then q bits, then e bits), hence domain
+    value sum(d_i * 2^(n-1-i)); the caller sums the P counters.
+
+    ``host_levels`` must give every device at least one 32-node lane
+    word per key: k0 >= 5 + log2(P) (the default raises the base
+    class's 6 as needed).
+    """
+
+    def __init__(self, lam: int, cipher_keys: Sequence[bytes], mesh: Mesh,
+                 host_levels: int | None = None, interpret: bool = False):
+        p_total = 1
+        for ax in mesh.axis_names:
+            p_total *= mesh.shape[ax]
+        if p_total & (p_total - 1):
+            # api-edge: documented mesh-size contract
+            raise ValueError(f"device count {p_total} must be a power of 2")
+        self._log2p = p_total.bit_length() - 1
+        min_k0 = 5 + self._log2p
+        if host_levels is None:
+            host_levels = max(6, min_k0)
+        if host_levels < min_k0:
+            raise ValueError(  # api-edge: constructor host_levels contract
+                f"host_levels={host_levels} gives some device less than "
+                f"one lane word of frontier; need >= {min_k0} for "
+                f"{p_total} devices")
+        super().__init__(lam, cipher_keys, host_levels=host_levels,
+                         interpret=interpret)
+        self.mesh = mesh
+        self._ptotal = p_total
+        self._axes = tuple(mesh.axis_names)
+        # [K, 128|1, W] frontier/leaf planes: shard the lane-word axis
+        self._spec_nodes = P(None, None, self._axes)
+        self._fns: dict = {}
+
+    def _put_nodes(self, arr) -> jax.Array:
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, self._spec_nodes))
+
+    def _frontier(self, bundle, b: int, k0: int):
+        s0, s1, t = super()._frontier(bundle, b, k0)
+        return self._put_nodes(s0), self._put_nodes(s1), self._put_nodes(t)
+
+    def _check_fn(self, n_bits: int):
+        fn = self._fns.get(n_bits)
+        if fn is not None:
+            return fn
+        k0 = min(self.host_levels, n_bits)
+        c = k0 - self._log2p  # frontier-local node bits per shard
+        kaxis = self._axes[0]
+        psize = self.mesh.shape[self._axes[1]]
+        interp = self.interpret
+        log2p = self._log2p
+
+        def shard(rk2, cs0_t, cs1_t, ct_pm, np10_t, np11_t,
+                  s0_0, s1_0, t_0, s0_1, s1_1, t_1,
+                  beta0_m, beta1_m, alphas):
+            ys = [dpf_tree_expand_device(rk2, cs0_t, cs1_t, ct_pm,
+                                         np10_t, np11_t, s0, s1, t,
+                                         k0=k0, n=n_bits, interpret=interp)
+                  for (s0, s1, t) in ((s0_0, s1_0, t_0),
+                                      (s0_1, s1_1, t_1))]
+            q = jax.lax.axis_index(kaxis) * psize + jax.lax.axis_index(
+                self._axes[1])
+            m_local = 32 * ys[0][0].shape[-1]
+            pos = jnp.arange(m_local, dtype=jnp.uint32)
+            fl = pos & jnp.uint32((1 << c) - 1)
+            e = pos >> c
+            value = jnp.zeros(m_local, dtype=jnp.uint32)
+            for i in range(c):  # frontier-local direction bits
+                value = value | (((fl >> i) & 1) << (n_bits - 1 - i))
+            for i in range(log2p):  # shard-index direction bits
+                qbit = ((q.astype(jnp.uint32) >> i) & 1).astype(jnp.uint32)
+                value = value | (qbit << (n_bits - 1 - c - i))
+            for j in range(n_bits - k0):  # device-level direction bits
+                value = value | (((e >> j) & 1) << (n_bits - 1 - k0 - j))
+            hit = (value[None, :] == alphas[:, None]).astype(jnp.uint32)
+            bits = hit.reshape(hit.shape[0], -1, 32)
+            inside = jax.lax.bitcast_convert_type(
+                jnp.sum(bits << jnp.arange(32, dtype=jnp.uint32), axis=-1,
+                        dtype=jnp.uint32), jnp.int32)[:, None, :]
+            return leaf_pair_mismatch_count(
+                ys[0][0], ys[0][1], ys[1][0], ys[1][1],
+                beta0_m, beta1_m, inside).reshape(1, 1)
+
+        fn = jax.jit(
+            shard_map(
+                shard, mesh=self.mesh,
+                in_specs=(P(), P(), P(), P(), P(), P(),
+                          *([self._spec_nodes] * 6), P(), P(), P()),
+                out_specs=P(*self._axes),  # [K, P] per-shard counters
+                check_vma=False,  # disjoint subtrees, no collectives
+            ))
+        self._fns[n_bits] = fn
+        return fn
+
+    def check_device(self, bundle, alphas, betas, n_bits: int) -> jax.Array:
+        """Two-party full-domain reconstruction vs the point function,
+        sharded over the mesh; returns the TOTAL mismatching-leaf count
+        (all keys, whole domain) as a device scalar.  NOTE the sharded
+        global leaf order differs from the unsharded one (the shard
+        index splices into the middle of the bit-reversal) — parity is
+        against the point function, not element order."""
+        if n_bits < self.host_levels:
+            raise ShapeError(
+                f"n_bits={n_bits} smaller than the {self.host_levels} "
+                "host levels the mesh frontier needs; use the unsharded "
+                "DpfEvalAll")
+        staged_cw, fronts, _parts = self._staged_for(bundle, n_bits)
+        betas = np.asarray(betas, dtype=np.uint8)
+        beta0_m = jnp.asarray(bitmajor_plane_masks(betas[:, :16])[..., None])
+        beta1_m = jnp.asarray(bitmajor_plane_masks(betas[:, 16:])[..., None])
+        alphas_u = jnp.asarray(np.asarray(alphas, dtype=np.uint32))
+        fn = self._check_fn(n_bits)
+        counts = fn(self.rk2, *staged_cw, *fronts[0], *fronts[1],
+                    beta0_m, beta1_m, alphas_u)
+        return jnp.sum(counts)
 
 
 class ShardedLargeLambdaBackend(LargeLambdaBackend):

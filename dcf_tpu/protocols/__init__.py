@@ -23,7 +23,13 @@ group:
   ``MicEvaluator`` (put_bundle/stage/eval_staged once, combine on
   device);
 - ``protocols.piecewise`` piecewise-constant lookup as a MIC over a
-  domain partition, XOR-reduced to one value per point.
+  domain partition, XOR-reduced to one value per point;
+- ``protocols.dpf``       distributed point functions: the GGM walk
+  minus the comparison accumulation (no ``cw_v``), K-packed host and
+  device keygen, the per-point reference evaluator, and the DCFK v3
+  ``proto=PROTO_DPF`` wire frame — the engine of the 2-server PIR
+  workload (``workloads.py``) via the full-domain EvalAll backends
+  (``backends.evalall``).
 
 Entry points: ``Dcf.interval`` / ``Dcf.mic`` / ``Dcf.piecewise`` (key
 generation) and ``Dcf.eval_interval`` / ``Dcf.eval_mic`` /
@@ -37,6 +43,16 @@ from dcf_tpu.protocols.combine import (  # noqa: F401
     combine_pair_shares,
     xor_reconstruct_stream,
 )
+from dcf_tpu.protocols.dpf import (  # noqa: F401
+    DPF_DEVICE_LAM,
+    DpfBundle,
+    PROTO_DPF,
+    decode_proto_frame,
+    dpf_device_fallback_count,
+    dpf_eval_points,
+    dpf_gen_batch,
+    dpf_gen_on_device,
+)
 from dcf_tpu.protocols.ic import eval_interval  # noqa: F401
 from dcf_tpu.protocols.keygen import (  # noqa: F401
     ProtocolBundle,
@@ -45,6 +61,7 @@ from dcf_tpu.protocols.keygen import (  # noqa: F401
 )
 from dcf_tpu.protocols.mic import MicEvaluator, eval_mic  # noqa: F401
 from dcf_tpu.protocols.oracle import (  # noqa: F401
+    dpf_oracle,
     ic_oracle,
     mic_oracle,
     piecewise_oracle,
@@ -55,9 +72,18 @@ from dcf_tpu.protocols.piecewise import (  # noqa: F401
 )
 
 __all__ = [
-    "ProtocolBundle",
+    "DPF_DEVICE_LAM",
+    "DpfBundle",
     "MicEvaluator",
+    "PROTO_DPF",
+    "ProtocolBundle",
     "combine_pair_shares",
+    "decode_proto_frame",
+    "dpf_device_fallback_count",
+    "dpf_eval_points",
+    "dpf_gen_batch",
+    "dpf_gen_on_device",
+    "dpf_oracle",
     "eval_interval",
     "eval_mic",
     "eval_piecewise",

@@ -234,6 +234,10 @@ class ProtocolBundle:
                 f"version {version} frames carry no protocol section; "
                 "decode with KeyBundle.from_bytes")
         if proto != PROTO_MIC:
+            if proto == 2:  # protocols.dpf.PROTO_DPF (no import cycle)
+                raise KeyFormatError(
+                    f"proto field {proto} is a DPF point-function frame; "
+                    "decode with dcf_tpu.protocols.DpfBundle.from_bytes")
             raise KeyFormatError(
                 f"proto field {proto} is not the interval-containment "
                 f"family ({PROTO_MIC}); plain v3 frames (proto=0) decode "

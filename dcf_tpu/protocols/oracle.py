@@ -33,6 +33,7 @@ import numpy as np
 from dcf_tpu.errors import ShapeError
 
 __all__ = [
+    "dpf_oracle",
     "ic_oracle",
     "interval_indicator",
     "mic_oracle",
@@ -68,6 +69,26 @@ def interval_indicator(xs: np.ndarray, p: int, q: int) -> np.ndarray:
     else:
         inside = [x >= p or x < q for x in vals]
     return np.asarray(inside, dtype=bool)
+
+
+def dpf_oracle(xs: np.ndarray, alpha: int, beta: np.ndarray) -> np.ndarray:
+    """Distributed point function 1_{x == alpha} * beta: uint8 [M, lam].
+
+    The DPF golden model: ``beta`` at the single point ``alpha``, zero
+    everywhere else — the degenerate interval ``[alpha, alpha+1)`` of
+    the IC family, kept separate because the DPF key (protocols.dpf)
+    carries no comparison accumulation and its evaluators are validated
+    against this directly.
+    """
+    n_total = 1 << (8 * xs.shape[1])
+    if not 0 <= alpha < n_total:
+        # api-edge: documented point contract (alpha is a domain VALUE,
+        # so N itself is out of range — unlike interval bounds)
+        raise ValueError(f"alpha must lie in [0, {n_total}), got {alpha}")
+    beta = np.asarray(beta, dtype=np.uint8)
+    hit = np.asarray([x == alpha for x in points_to_ints(xs)], dtype=bool)
+    return np.where(hit[:, None], beta[None, :],
+                    np.zeros_like(beta)[None, :])
 
 
 def ic_oracle(xs: np.ndarray, p: int, q: int, beta: np.ndarray) -> np.ndarray:

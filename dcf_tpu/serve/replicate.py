@@ -60,18 +60,24 @@ __all__ = ["Replicator", "decode_key_frame", "apply_frame",
 
 def decode_key_frame(frame, proto: bool):
     """One DCFK frame off the wire -> the registrable object (the
-    existing codecs verbatim — ``KeyBundle`` v2 or ``ProtocolBundle``
-    v3; corruption dies typed ``KeyFormatError`` inside them)."""
+    existing codecs verbatim — ``KeyBundle`` v2, or the v3 proto
+    dispatcher for protocol frames (``ProtocolBundle`` for MIC,
+    ``DpfBundle`` for DPF); corruption dies typed ``KeyFormatError``
+    inside them)."""
     frame_bytes = bytes(frame)
     if proto:
-        from dcf_tpu.protocols import ProtocolBundle
+        from dcf_tpu.protocols import decode_proto_frame
 
-        return ProtocolBundle.from_bytes(frame_bytes)
+        return decode_proto_frame(frame_bytes)
     return KeyBundle.from_bytes(frame_bytes)
 
 
 def _unwrap(obj):
-    """``(inner KeyBundle, protocol-or-None)`` for either bundle kind."""
+    """``(registrable bundle, protocol-or-None)`` for any bundle kind.
+    A ``DpfBundle`` is self-contained (its frame IS the key material,
+    no combine-mask wrapper), so it registers directly with no
+    protocol record — the registry only needs the two-party ``s0s``
+    shape and the geometry props, which it shares with ``KeyBundle``."""
     from dcf_tpu.protocols import ProtocolBundle
 
     if isinstance(obj, ProtocolBundle):
@@ -150,10 +156,11 @@ def sync_frames(registry, digest: dict,
             continue
         frame_bytes = (protocol.to_bytes() if protocol is not None
                        else bundle.to_bytes())
+        is_proto = (protocol is not None
+                    or getattr(bundle, "WIRE_PROTO", 0) != 0)
         if entries and total + len(frame_bytes) > max_bytes:
             break  # this response is full; the puller comes back
-        entries.append((key_id, generation, protocol is not None,
-                        frame_bytes))
+        entries.append((key_id, generation, is_proto, frame_bytes))
         total += len(frame_bytes)
     return entries
 

@@ -849,11 +849,13 @@ class DcfRouter:
 
     def register_key(self, key_id: str, bundle) -> int:
         """In-process convenience twin of ``register_frame``: accepts
-        a ``KeyBundle`` or ``protocols.ProtocolBundle`` and fans its
-        frame out across the ring."""
+        a ``KeyBundle``, ``protocols.ProtocolBundle`` or
+        ``protocols.DpfBundle`` and fans its frame out across the
+        ring."""
         from dcf_tpu.protocols import ProtocolBundle
 
-        proto = isinstance(bundle, ProtocolBundle)
+        proto = (isinstance(bundle, ProtocolBundle)
+                 or getattr(bundle, "WIRE_PROTO", 0) != 0)
         return self.register_frame(key_id, bundle.to_bytes(),
                                    proto=proto)
 
@@ -928,10 +930,12 @@ class DcfRouter:
 
     def register_mesh_key(self, key_id: str, bundle) -> int:
         """In-process convenience twin of ``register_mesh_frame``:
-        accepts a ``KeyBundle`` or ``protocols.ProtocolBundle``."""
+        accepts a ``KeyBundle``, ``protocols.ProtocolBundle`` or
+        ``protocols.DpfBundle``."""
         from dcf_tpu.protocols import ProtocolBundle
 
-        proto = isinstance(bundle, ProtocolBundle)
+        proto = (isinstance(bundle, ProtocolBundle)
+                 or getattr(bundle, "WIRE_PROTO", 0) != 0)
         return self.register_mesh_frame(key_id, bundle.to_bytes(),
                                         proto=proto)
 

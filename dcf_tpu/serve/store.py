@@ -316,6 +316,11 @@ class KeyStore:
             raise ValueError("key_id must be a non-empty string")
         payload = (protocol.to_bytes() if protocol is not None
                    else bundle.to_bytes())
+        # A self-describing protocol frame (DpfBundle carries
+        # WIRE_PROTO) is flagged proto in the manifest even without a
+        # wrapper, so load() routes it through the proto dispatcher.
+        is_proto = (protocol is not None
+                    or getattr(bundle, "WIRE_PROTO", 0) != 0)
         fname = _frame_name(key_id, generation)
         with self._lock:
             entries = self._read_manifest()
@@ -334,7 +339,7 @@ class KeyStore:
             entries[key_id] = {
                 "file": fname,
                 "generation": int(generation),
-                "proto": protocol is not None,
+                "proto": is_proto,
                 "parties": 2,
             }
             self._write_manifest(entries)
@@ -376,8 +381,9 @@ class KeyStore:
                 raise ValueError("key_id must be a non-empty string")
             payload = (protocol.to_bytes() if protocol is not None
                        else bundle.to_bytes())
-            staged.append((key_id, payload, protocol is not None,
-                           int(generation)))
+            is_proto = (protocol is not None
+                        or getattr(bundle, "WIRE_PROTO", 0) != 0)
+            staged.append((key_id, payload, is_proto, int(generation)))
         if not staged:
             return 0
         with self._lock:
@@ -514,10 +520,16 @@ class KeyStore:
                 f"({e}); manifest entry dropped") from e
         try:
             if ent["proto"]:
-                from dcf_tpu.protocols import ProtocolBundle
+                from dcf_tpu.protocols import (
+                    ProtocolBundle,
+                    decode_proto_frame,
+                )
 
-                pb = ProtocolBundle.from_bytes(data)
-                kb = pb.keys
+                obj = decode_proto_frame(data)
+                if isinstance(obj, ProtocolBundle):
+                    pb, kb = obj, obj.keys
+                else:  # DpfBundle: self-contained, no wrapper record
+                    pb, kb = None, obj
             else:
                 pb = None
                 kb = KeyBundle.from_bytes(data)

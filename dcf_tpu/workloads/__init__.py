@@ -1,0 +1,37 @@
+"""Reference and extension workloads as runnable functions.
+
+``workloads.core`` holds the BASELINE.json reference configs (config 3
+full-domain check, config 5 secure-ReLU); ``workloads.pir`` holds the
+2-server PIR workload built on the DPF EvalAll subsystem (the served
+selection-vector inner product).  Everything re-exports here, so
+``from dcf_tpu.workloads import full_domain_check`` keeps working from
+the flat-module days.
+"""
+
+from dcf_tpu.workloads.core import (  # noqa: F401
+    domain_points,
+    full_domain_check,
+    full_domain_check_device,
+    secure_relu_check_device,
+    secure_relu_eval,
+)
+from dcf_tpu.workloads.pir import (  # noqa: F401
+    PirDatabase,
+    PirServer,
+    pir_answer_share,
+    pir_query_bundle,
+    pir_reconstruct,
+)
+
+__all__ = [
+    "PirDatabase",
+    "PirServer",
+    "domain_points",
+    "full_domain_check",
+    "full_domain_check_device",
+    "pir_answer_share",
+    "pir_query_bundle",
+    "pir_reconstruct",
+    "secure_relu_check_device",
+    "secure_relu_eval",
+]

@@ -1,0 +1,267 @@
+"""2-server PIR over DPF full-domain evaluation (EvalAll).
+
+The textbook construction (Boyle-Gilboa-Ishai): a client who wants
+record ``alpha`` of a database both servers hold splits a DPF for the
+point function ``f(alpha) = 1`` into two keys and sends one to each
+server.  Each server EvalAll's its key over the whole domain — the leaf
+t-bits are an XOR sharing of the one-hot selection vector — takes the
+inner product with the database over GF(2), and returns its
+``record_bytes`` answer share.  XOR of the two shares is the record;
+each server alone saw only a pseudorandom key, so neither learns
+``alpha``.  Every query touches the whole database (information
+-theoretically necessary), which is why the EvalAll kernel's
+~2^{n+1}-PRG-call cost IS the query cost and the per-leaf throughput of
+``backends.evalall`` is the number that matters.
+
+Layout contract: ``PirDatabase`` packs records in bitreverse_n order —
+the order EvalAll emits leaves in — as GF(2) bit-plane lane words, so
+the inner product is ``popcount(t_word & db_plane) mod 2`` per database
+bit plane with no gather anywhere: leaf position p of the t-planes and
+packed-record position p refer to the same domain point, and the hit at
+position bitreverse_n(alpha) selects exactly ``db[alpha]``.
+
+Serving: ``PirServer`` snapshots DPF bundles from a ``KeyRegistry``
+(they arrive over the ring as DCFK v3 ``proto=2`` frames —
+``PodRouter.register_key`` / ``serve.replicate``), keeps the staged key
+image and selection shares resident across queries (ship-once), and
+answers per party with the same ``serve.eval`` fault seam + bounded
+retry/evict discipline as the point-batch service: an injected eval
+fault evicts the possibly-poisoned staged state and retries from the
+registry snapshot.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dcf_tpu.errors import ShapeError
+from dcf_tpu.protocols.dpf import DpfBundle, dpf_gen_batch
+from dcf_tpu.testing.faults import fire
+from dcf_tpu.utils.bits import bits_lsb_to_bytes, byte_bits_lsb, pack_lanes
+
+__all__ = [
+    "PirDatabase",
+    "PirServer",
+    "pir_answer_share",
+    "pir_query_bundle",
+    "pir_reconstruct",
+]
+
+
+def _bitrev_values(n_bits: int) -> np.ndarray:
+    """Domain value of each bitreverse-order position: value[p] =
+    bitreverse_n(p) — the EvalAll leaf-order map."""
+    pos = np.arange(1 << n_bits, dtype=np.uint64)
+    value = np.zeros(1 << n_bits, dtype=np.uint64)
+    for k in range(n_bits):
+        value |= ((pos >> np.uint64(k)) & np.uint64(1)) << np.uint64(
+            n_bits - 1 - k)
+    return value
+
+
+class PirDatabase:
+    """The resident GF(2) bit-plane image of a 2^n-record database.
+
+    ``records`` uint8 [2^n_bits, record_bytes] is permuted to
+    bitreverse_n order and packed to int32 lane words
+    [8 * record_bytes, 2^n_bits / 32]: plane r, word w, bit i holds bit
+    r of the record at leaf position 32*w + i.  Packed once, resident
+    for the server's lifetime — queries only read it.  The plaintext
+    array is NOT retained (both PIR servers legitimately know the
+    database; holding a second copy is just memory).
+    """
+
+    def __init__(self, records: np.ndarray, n_bits: int):
+        records = np.asarray(records)
+        if records.dtype != np.uint8 or records.ndim != 2:
+            raise ShapeError(
+                f"records must be uint8 [num_records, record_bytes], got "
+                f"{records.dtype} {records.shape}")
+        if n_bits < 5:
+            # api-edge: leaf planes are 32-leaf lane words, so the
+            # domain must fill at least one (the DPF key domain is
+            # byte-granular, but the database domain is not: a depth-d
+            # prefix evaluation of a deeper key serves any d >= 5 —
+            # see pir_query_bundle)
+            raise ValueError(f"n_bits={n_bits} must be >= 5")
+        if records.shape[0] != 1 << n_bits:
+            raise ShapeError(
+                f"{records.shape[0]} records do not fill the 2^{n_bits} "
+                "domain; pad with zero records — PIR touches every "
+                "record, so the domain must be exact")
+        import jax.numpy as jnp
+
+        self.n_bits = int(n_bits)
+        self.record_bytes = int(records.shape[1])
+        self.num_records = int(records.shape[0])
+        db_br = records[_bitrev_values(n_bits)]  # leaf order
+        bits = byte_bits_lsb(db_br)  # [N, 8R]
+        self.planes = jnp.asarray(pack_lanes(
+            np.ascontiguousarray(bits.T)).view(np.int32))  # [8R, N/32]
+
+    def __repr__(self) -> str:
+        return (f"PirDatabase(n_bits={self.n_bits}, "
+                f"record_bytes={self.record_bytes})")
+
+
+_answer_fn = None
+
+
+def _pir_answer_device(t_words, planes):
+    global _answer_fn
+    if _answer_fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        def f(t_words, planes):
+            x = jax.lax.bitcast_convert_type(
+                t_words[:, 0][:, None, :] & planes[None], jnp.uint32)
+            ones = jax.lax.population_count(x)  # [K, 8R, W]
+            return jnp.sum(ones.astype(jnp.uint32),
+                           axis=-1) & jnp.uint32(1)  # [K, 8R] parities
+
+        _answer_fn = jax.jit(f)
+    return _answer_fn(t_words, planes)
+
+
+def pir_answer_share(t_words, db: PirDatabase) -> np.ndarray:
+    """One party's answer shares from its selection-vector share.
+
+    ``t_words``: the leaf t-bit lane words int32 [K, 1, 2^n / 32] that
+    ``DpfEvalAll.eval_party`` returns (bitreverse order, matching the
+    database packing).  Inner product over GF(2) per database bit plane
+    — ``popcount(t & plane) mod 2`` — entirely on device; only the
+    K x record_bytes answer comes back.  uint8 [K, record_bytes].
+    """
+    if t_words.shape[-1] * 32 != db.num_records:
+        raise ShapeError(
+            f"selection share covers {t_words.shape[-1] * 32} leaves, "
+            f"database has {db.num_records} records")
+    parity = np.asarray(_pir_answer_device(t_words, db.planes))
+    return bits_lsb_to_bytes(parity)
+
+
+def pir_query_bundle(prg, indices, n_bits: int, s0s: np.ndarray,
+                     betas: np.ndarray | None = None) -> DpfBundle:
+    """Client-side query keygen: one DPF key pair per record index.
+
+    ``indices``: the K record indices being retrieved (each in
+    [0, 2^n_bits)); ``s0s`` uint8 [K, 2, lam]: fresh random root seeds
+    — the client's secret randomness, caller-supplied like every keygen
+    in this repo (key material is never silently minted).  ``betas``
+    defaults to the all-ones payload; the PIR answer path reads only
+    the leaf t-bits, so the payload never matters to retrieval — it
+    exists so the same bundle can also drive payload-carrying
+    ``eval_party`` uses and the reconstruction self-check.
+
+    The DCFK wire domain is byte-granular but the database domain need
+    not be: for ``n_bits`` that is not a multiple of 8 the key is
+    generated over the next byte-granular domain with the index in the
+    TOP ``n_bits`` (``alpha = index << pad``), and servers evaluate
+    only ``n_bits`` levels deep — the depth-d t-planes are the one-hot
+    indicator of alpha's d-bit prefix, i.e. exactly the selection
+    vector (``DpfEvalAll.eval_party`` prefix contract).
+    """
+    idx = [int(i) for i in np.asarray(indices).reshape(-1)]
+    n_key = 8 * ((n_bits + 7) // 8)  # wire (key) domain, byte-granular
+    pad = n_key - n_bits
+    for i in idx:
+        if not 0 <= i < (1 << n_bits):
+            # api-edge: query contract at the client edge
+            raise ValueError(
+                f"record index {i} outside the 2^{n_bits}-record "
+                "database")
+    alphas = np.array(
+        [list((i << pad).to_bytes(n_key // 8, "big")) for i in idx],
+        dtype=np.uint8)
+    if betas is None:
+        betas = np.full((len(idx), s0s.shape[-1]), 0xFF, dtype=np.uint8)
+    return dpf_gen_batch(prg, alphas, betas, s0s)
+
+
+def pir_reconstruct(a0: np.ndarray, a1: np.ndarray) -> np.ndarray:
+    """Client-side XOR reconstruction of the two answer shares."""
+    if a0.shape != a1.shape:
+        raise ShapeError(
+            f"answer shares disagree on shape: {a0.shape} vs {a1.shape}")
+    return (np.asarray(a0) ^ np.asarray(a1)).astype(np.uint8)
+
+
+class PirServer:
+    """One 2-server-PIR server over the serving tier's key plumbing.
+
+    ``registry``: anything with ``snapshot(key_id) -> (bundle,
+    protocol, generation)`` — in practice a ``serve.KeyRegistry`` the
+    DPF bundles reached as DCFK v3 ``proto=2`` frames through
+    ``PodRouter.register_key`` / store restore.  The server serves BOTH
+    parties (same contract as ``DcfService``): ``answer(key_id, b)``
+    returns party ``b``'s uint8 [K, record_bytes] answer shares.
+
+    Unlike the point-batch service, a PIR query has no input points —
+    the key IS the query — so the server keeps its own full-domain
+    evaluator (``backends.evalall.DpfEvalAll``) instead of a staged
+    point backend, and caches each key's selection-vector shares per
+    (key_id, party, generation): repeat queries under the same key
+    re-run only the device inner product.  The ``serve.eval`` fault
+    seam fires per answer with bounded retry; a faulted attempt evicts
+    both the selection cache entry and the evaluator's staged image
+    before retrying from the registry snapshot, so a poisoned
+    device residency cannot serve the retry (the service's
+    retry-then-evict discipline, transplanted).
+    """
+
+    def __init__(self, evaluator, db: PirDatabase, registry, *,
+                 retries: int = 1):
+        if retries < 0:
+            # api-edge: retry contract (0 = single attempt)
+            raise ValueError(f"retries must be >= 0, got {retries}")
+        self.evaluator = evaluator
+        self.db = db
+        self.registry = registry
+        self.retries = int(retries)
+        self.eval_faults = 0  # attempts lost to the serve.eval seam
+        self._sel: dict = {}  # (key_id, b) -> (generation, t_words)
+
+    def _selection(self, key_id: str, b: int, bundle: DpfBundle,
+                   generation: int):
+        ent = self._sel.get((key_id, b))
+        if ent is not None and ent[0] == generation:
+            return ent[1]
+        staged_cw, fronts, parts = self.evaluator._staged_for(
+            bundle, self.db.n_bits)
+        _y0, _y1, t = self.evaluator.eval_party(
+            b, parts[b], self.db.n_bits, staged_cw, fronts[b])
+        self._sel[(key_id, b)] = (generation, t)
+        return t
+
+    def answer(self, key_id: str, b: int) -> np.ndarray:
+        """Party ``b``'s answer shares for the K queries registered
+        under ``key_id``: uint8 [K, record_bytes]."""
+        if b not in (0, 1):
+            # api-edge: party selector contract at the serve edge
+            raise ValueError(f"party must be 0 or 1, got {b}")
+        bundle, _protocol, generation = self.registry.snapshot(key_id)
+        if not isinstance(bundle, DpfBundle):
+            raise ShapeError(
+                f"key {key_id!r} is a {type(bundle).__name__}, not the "
+                "DpfBundle a PIR query needs — register the query "
+                "through the DPF keygen path")
+        if bundle.n_bits < self.db.n_bits:
+            raise ShapeError(
+                f"key {key_id!r} walks a {bundle.n_bits}-bit domain, "
+                f"too shallow for 2^{self.db.n_bits} records (deeper "
+                "keys are fine: the selection vector is a depth-"
+                f"{self.db.n_bits} prefix evaluation)")
+        last: Exception | None = None
+        for _attempt in range(self.retries + 1):
+            try:
+                fire("serve.eval", key_id, bundle.num_keys)
+                t = self._selection(key_id, b, bundle, generation)
+                return pir_answer_share(t, self.db)
+            except Exception as e:  # fallback-ok: counted, bounded
+                # retry below; exhaustion re-raises the last error
+                last = e
+                self.eval_faults += 1
+                self._sel.pop((key_id, b), None)
+                self.evaluator.invalidate()
+        raise last  # retries exhausted — typed cause intact

@@ -717,3 +717,50 @@ def test_cli_pod_bench_partition_smoke(capsys):
     assert recs[0]["anti_entropy_runs"] >= 1
     assert recs[0]["anti_entropy_frames"] >= 1
     assert len(recs[0]["promoted_serve_s"]) == 1
+
+
+def test_cli_pir_bench_validates_flags_fast():
+    """pir_bench's domain and batch contracts die loudly BEFORE any
+    database packing or kernel compile work."""
+    from dcf_tpu import cli
+
+    with pytest.raises(SystemExit, match="5 <= n <= 24"):
+        cli.main(["pir_bench", "--n-bits=3"])
+    with pytest.raises(SystemExit, match="5 <= n <= 24"):
+        cli.main(["pir_bench", "--n-bits=25"])
+    with pytest.raises(SystemExit, match="queries-per-batch"):
+        cli.main(["pir_bench", "--keys=-1"])
+
+
+@pytest.mark.pir
+def test_dpf_pinned_ratio_shapes(tmp_path):
+    """_dpf_pinned_ratio: the pir_bench denominator comes from the
+    dpf.evalall_n16 pin, rescaled by leaf count for other domains,
+    interpret runs keep the ratio but disclose the numerator, and a
+    missing/corrupt pin yields {} (no silent in-run fallback)."""
+    import json
+
+    from dcf_tpu.cli import _dpf_pinned_ratio
+
+    pin = tmp_path / "cpu_baseline.json"
+    pin.write_text(json.dumps(
+        {"dpf": {"evalall_n16": {"queries_per_sec": 2.0}}}))
+    rec = _dpf_pinned_ratio(16, 4.0, baseline_path=str(pin))
+    assert rec["vs_baseline"] == 2.0
+    assert "dpf.evalall_n16" in rec["baseline"]
+    assert "interpret" not in rec["baseline"]
+    # n=14 has 4x fewer leaves -> the denominator scales up 4x
+    rec14 = _dpf_pinned_ratio(14, 4.0, baseline_path=str(pin))
+    assert rec14["vs_baseline"] == 0.5
+    assert "rescaled x 2^16/2^14" in rec14["baseline"]
+    rec_i = _dpf_pinned_ratio(16, 4.0, interpreted=True,
+                              baseline_path=str(pin))
+    assert "interpret-mode numerator" in rec_i["baseline"]
+    other = tmp_path / "other.json"
+    other.write_text(json.dumps({"keygen": {}}))
+    assert _dpf_pinned_ratio(16, 4.0, baseline_path=str(other)) == {}
+    corrupt = tmp_path / "corrupt.json"
+    corrupt.write_text("{nope")
+    assert _dpf_pinned_ratio(16, 4.0, baseline_path=str(corrupt)) == {}
+    assert _dpf_pinned_ratio(
+        16, 4.0, baseline_path=str(tmp_path / "absent.json")) == {}

@@ -769,3 +769,40 @@ def test_mesh_layer_lint_clean(tmp_path):
           "from jax.experimental import multihost_utils  # noqa\n")
     assert not [v for v in run_path(tmp_path)
                 if v.path.endswith("_compat.py")]
+
+
+# ------------------------------------------------ ISSUE 19: DPF + PIR
+
+
+def test_dpf_layer_lint_clean():
+    """The ISSUE-19 CI satellite: the whole DPF/PIR column —
+    ``protocols/dpf.py`` (keygen + wire), ``ops/pallas_evalall.py``
+    (the level-order kernel), ``backends/evalall.py`` (host walk +
+    device driver) and ``workloads/pir.py`` (the served retrieval) —
+    sweeps clean under ALL nine passes.  Crypto-dtype and
+    secret-hygiene are the load-bearing ones: DPF seeds/correction
+    words are key material and the leaf t-planes are selection-vector
+    shares, so a float on the walk or a logged plane is a broken key
+    or a leaked query."""
+    for rel in (("dcf_tpu", "protocols", "dpf.py"),
+                ("dcf_tpu", "ops", "pallas_evalall.py"),
+                ("dcf_tpu", "backends", "evalall.py"),
+                ("dcf_tpu", "workloads", "pir.py")):
+        assert run_path(REPO.joinpath(*rel)) == [], "/".join(rel)
+
+
+def test_secret_hygiene_covers_selection_shares(tmp_path):
+    """ISSUE 19: ``t_word(s)``/``sel_vec``/``selection_vec`` joined
+    the key-material name set — one party's leaf t-bit lane words are
+    its share of the PIR selection vector, and two logged shares
+    reconstruct WHICH record the client asked for."""
+    write(tmp_path, "workloads/piry.py", (
+        "def serve(key_id, t_words, sel_vec, n, selected):\n"
+        "    log(f'leaves {t_words}')\n"           # name leak
+        "    counter.inc(len(sel_vec))\n"          # metric sink
+        "    counter.inc(n)\n"                     # scalar: fine
+        "    log(f'state {selected}')\n"))  # ordinary state name
+    got = [v for v in run_path(tmp_path, ["secret-hygiene"])
+           if v.path.endswith("piry.py")]
+    assert [v.line for v in got] == [2, 3]
+    assert "t_words" in got[0].message

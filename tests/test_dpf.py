@@ -1,0 +1,152 @@
+"""DPF protocol layer (ISSUE 19): keygen, per-point eval, wire format.
+
+The contract under test: ``protocols.dpf`` — the GGM walk minus the
+comparison accumulation.  ``dpf_gen_on_device`` (the PR 10 K-packed
+keygen kernel minus the v column) must be BYTE-IDENTICAL to the host
+``dpf_gen_batch``; both parties' per-point shares must XOR to the
+``dpf_oracle`` golden model (beta at alpha, zero elsewhere, including
+the exact point x = alpha); and the DCFK v3 ``proto=2`` frame must
+round-trip bit-exact with the version gate holding both ways (the
+cross-reader fuzz rides tests/test_keys_fuzz.py).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from dcf_tpu.errors import ShapeError
+from dcf_tpu.gen import random_s0s
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.protocols import decode_proto_frame
+from dcf_tpu.protocols.dpf import (
+    DPF_DEVICE_LAM,
+    DpfBundle,
+    dpf_device_fallback_count,
+    dpf_eval_points,
+    dpf_gen_batch,
+    dpf_gen_on_device,
+)
+from dcf_tpu.protocols.oracle import dpf_oracle
+
+pytestmark = pytest.mark.dpf
+
+NB = 2  # 16-bit domain
+
+
+def _cipher_keys(rng, lam: int) -> list:
+    n = max(2, 2 * (lam // 16))
+    if lam >= 32:
+        n = max(n, 18)
+    return [bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+            for _ in range(n)]
+
+
+def _prg(lam, ck):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return HirosePrgNp(lam, ck)
+
+
+def _alpha_bytes(vals, nb: int) -> np.ndarray:
+    return np.array([list(int(v).to_bytes(nb, "big")) for v in vals],
+                    dtype=np.uint8)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0xD9F)
+
+
+def test_device_keygen_byte_identical_to_host(rng):
+    """The Pallas DPF keygen walk produces the same bytes as the host
+    walk — same K-packed kernel as PR 10 keygen, minus cw_v — with no
+    counted fallback along the way."""
+    lam = DPF_DEVICE_LAM
+    ck = _cipher_keys(rng, lam)
+    before = dpf_device_fallback_count()
+    for k_num in (1, 3):
+        alphas = rng.integers(0, 256, (k_num, NB), dtype=np.uint8)
+        betas = rng.integers(0, 256, (k_num, lam), dtype=np.uint8)
+        s0s = random_s0s(k_num, lam, rng)
+        dev = dpf_gen_on_device(lam, ck, alphas, betas, s0s)
+        host = dpf_gen_batch(_prg(lam, ck), alphas, betas, s0s)
+        assert dev.to_bytes() == host.to_bytes()
+    assert dpf_device_fallback_count() == before
+
+
+def test_eval_points_vs_oracle_both_parties(rng):
+    """XOR of the two per-point share walks equals the golden model at
+    every probed point — the boundary x = alpha, its neighbours, and
+    random points — for every packed key."""
+    lam = 16
+    ck = _cipher_keys(rng, lam)
+    prg = _prg(lam, ck)
+    alpha_vals = [0, 0xFFFF, int(rng.integers(1, 0xFFFF))]
+    alphas = _alpha_bytes(alpha_vals, NB)
+    betas = rng.integers(0, 256, (len(alpha_vals), lam), dtype=np.uint8)
+    bundle = dpf_gen_batch(prg, alphas, betas,
+                           random_s0s(len(alpha_vals), lam, rng))
+    probe = sorted({v for a in alpha_vals
+                    for v in (max(a - 1, 0), a, min(a + 1, 0xFFFF))}
+                   | {int(x) for x in rng.integers(0, 1 << 16, 8)})
+    xs = _alpha_bytes(probe, NB)
+    y0 = dpf_eval_points(prg, bundle.for_party(0), 0, xs)
+    y1 = dpf_eval_points(prg, bundle.for_party(1), 1, xs)
+    recon = y0 ^ y1
+    for i, a in enumerate(alpha_vals):
+        want = dpf_oracle(xs, a, betas[i])
+        np.testing.assert_array_equal(recon[i], want)
+
+
+def test_wire_roundtrip_and_party_restriction(rng):
+    lam = 16
+    bundle = dpf_gen_batch(
+        _prg(lam, _cipher_keys(rng, lam)),
+        rng.integers(0, 256, (2, NB), dtype=np.uint8),
+        rng.integers(0, 256, (2, lam), dtype=np.uint8),
+        random_s0s(2, lam, rng))
+    frame = bundle.to_bytes()
+    back = DpfBundle.from_bytes(frame)
+    for name in ("s0s", "cw_s", "cw_t", "cw_np1"):
+        np.testing.assert_array_equal(getattr(back, name),
+                                      getattr(bundle, name))
+    # the typed-frame dispatcher routes proto=2 here
+    assert isinstance(decode_proto_frame(frame), DpfBundle)
+    # party restriction drops the other seed column, nothing else
+    p0 = bundle.for_party(0)
+    assert p0.s0s.shape[1] == 1
+    np.testing.assert_array_equal(p0.s0s[:, 0], bundle.s0s[:, 0])
+    with pytest.raises(ShapeError, match="already party-restricted"):
+        p0.for_party(0)
+    with pytest.raises(ValueError, match="party must be 0 or 1"):
+        bundle.for_party(2)
+
+
+def test_keygen_input_validation(rng):
+    lam = 16
+    prg = _prg(lam, _cipher_keys(rng, lam))
+    good_a = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+    good_b = rng.integers(0, 256, (2, lam), dtype=np.uint8)
+    good_s = random_s0s(2, lam, rng)
+    with pytest.raises(ShapeError):
+        dpf_gen_batch(prg, good_a.astype(np.int64), good_b, good_s)
+    with pytest.raises(ShapeError):
+        dpf_gen_batch(prg, good_a, good_b[:1], good_s)
+    with pytest.raises(ShapeError):
+        dpf_gen_batch(prg, good_a, good_b, good_s[:, :1])
+    with pytest.raises(ValueError, match="party must be 0 or 1"):
+        dpf_eval_points(prg, dpf_gen_batch(prg, good_a, good_b, good_s),
+                        2, good_a)
+
+
+def test_repr_redacts_key_material(rng):
+    lam = 16
+    bundle = dpf_gen_batch(
+        _prg(lam, _cipher_keys(rng, lam)),
+        rng.integers(0, 256, (1, NB), dtype=np.uint8),
+        rng.integers(0, 256, (1, lam), dtype=np.uint8),
+        random_s0s(1, lam, rng))
+    text = repr(bundle)
+    assert "redacted" in text
+    assert bundle.s0s.tobytes().hex()[:16] not in text

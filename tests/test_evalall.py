@@ -1,0 +1,218 @@
+"""Full-domain DPF EvalAll (ISSUE 19): host walk, device kernel, mesh.
+
+Parity discipline, innermost out: the host breadth-first expansion
+(``dpf_tree_expand_np``) must agree with the per-point reference walk
+AND the ``dpf_oracle`` golden model over an ENTIRE domain; the Pallas
+kernel must be byte-identical to that host expansion at the device
+width; the mesh-sharded kernel must reconstruct the point function over
+the whole domain on every shard; and a depth-d prefix evaluation of a
+deeper key must hand back exactly the depth-d one-hot t-planes — the
+contract 2-server PIR rides for non-byte-granular database domains.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from dcf_tpu.backends.evalall import (
+    DpfEvalAll,
+    dpf_finalize_np,
+    dpf_tree_expand_np,
+    leaf_planes_to_bytes,
+)
+from dcf_tpu.errors import ShapeError
+from dcf_tpu.gen import random_s0s
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.parallel import ShardedDpfEvalAll, make_mesh
+from dcf_tpu.protocols.dpf import DPF_DEVICE_LAM, dpf_gen_batch
+from dcf_tpu.protocols.dpf import dpf_eval_points
+from dcf_tpu.protocols.oracle import dpf_oracle
+from dcf_tpu.utils.bits import unpack_lanes
+
+pytestmark = pytest.mark.dpf
+
+LAM = DPF_DEVICE_LAM  # 32: the two-block device width
+
+
+def _cipher_keys(rng, lam: int) -> list:
+    n = 18 if lam >= 32 else max(2, 2 * (lam // 16))
+    return [bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+            for _ in range(n)]
+
+
+def _prg(lam, ck):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return HirosePrgNp(lam, ck)
+
+
+def _bitrev_values(n_bits: int) -> np.ndarray:
+    pos = np.arange(1 << n_bits, dtype=np.uint32)
+    value = np.zeros(1 << n_bits, dtype=np.uint32)
+    for k in range(n_bits):
+        value |= ((pos >> k) & 1) << (n_bits - 1 - k)
+    return value
+
+
+def _bundle(rng, prg, alpha_vals, n_bits, lam):
+    nb = n_bits // 8
+    alphas = np.array([list(int(a).to_bytes(nb, "big"))
+                       for a in alpha_vals], dtype=np.uint8)
+    betas = rng.integers(0, 256, (len(alpha_vals), lam), dtype=np.uint8)
+    s0s = random_s0s(len(alpha_vals), lam, rng)
+    return dpf_gen_batch(prg, alphas, betas, s0s), betas
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0xEA11)
+
+
+@pytest.fixture(scope="module")
+def ck(rng):
+    return _cipher_keys(rng, LAM)
+
+
+@pytest.fixture(scope="module")
+def evaluator(ck):
+    return DpfEvalAll(LAM, ck, interpret=True)
+
+
+def test_host_evalall_vs_per_point_walk_and_oracle_full_domain(rng):
+    """The breadth-first expansion over the WHOLE 2^8 domain is
+    bit-exact against the per-point reference walk at every point, and
+    the two parties' leaves XOR to the golden point function."""
+    lam, n = 16, 8
+    prg = _prg(lam, _cipher_keys(rng, lam))
+    alpha_vals = [0, 137, 255]
+    bundle, betas = _bundle(rng, prg, alpha_vals, n, lam)
+    values = _bitrev_values(n)  # domain value at each leaf position
+    xs = values.astype(np.uint8)[:, None]  # [N, 1] bytes, leaf order
+    leaves = {}
+    for b in (0, 1):
+        part = bundle.for_party(b)
+        s, t = dpf_tree_expand_np(prg, part, b, n)
+        y = dpf_finalize_np(bundle, s, t)
+        np.testing.assert_array_equal(y, dpf_eval_points(prg, part, b, xs))
+        leaves[b] = y
+    recon = leaves[0] ^ leaves[1]
+    for i, a in enumerate(alpha_vals):
+        np.testing.assert_array_equal(recon[i], dpf_oracle(xs, a, betas[i]))
+
+
+def test_device_evalall_byte_identical_to_host_n16(rng, ck, evaluator):
+    """The Pallas kernel's leaf planes, unpacked back to bytes, equal
+    the host expansion exactly — payload AND t column, both parties,
+    K-packed — over the full 2^16 domain."""
+    n = 16
+    prg = _prg(LAM, ck)
+    alpha_vals = [0, 0xBEEF]
+    bundle, _betas = _bundle(rng, prg, alpha_vals, n, LAM)
+    staged_cw, fronts, parts = evaluator._staged_for(bundle, n)
+    for b in (0, 1):
+        y0, y1, t = evaluator.eval_party(b, parts[b], n, staged_cw,
+                                         fronts[b])
+        y_dev, t_dev = leaf_planes_to_bytes(y0, y1, t)
+        s, t_host = dpf_tree_expand_np(prg, parts[b], b, n)
+        np.testing.assert_array_equal(y_dev, dpf_finalize_np(
+            bundle, s, t_host))
+        np.testing.assert_array_equal(t_dev, t_host)
+
+
+def test_device_check_clean_and_tamper_detected(rng, ck, evaluator):
+    """The on-device verifier sees zero mismatching leaves for honest
+    keys and a nonzero count once a payload byte is cooked (n=8 keeps
+    this fast; depth coverage rides the n=16 parity tests)."""
+    n = 8
+    prg = _prg(LAM, ck)
+    alpha_vals = [3, 129]
+    bundle, betas = _bundle(rng, prg, alpha_vals, n, LAM)
+    assert evaluator.check(bundle, alpha_vals, betas, n) == 0
+    bad = betas.copy()
+    bad[1, 0] ^= 0x40
+    evaluator.invalidate()
+    assert evaluator.check(bundle, alpha_vals, bad, n) > 0
+    evaluator.invalidate()
+
+
+def test_prefix_depth_t_planes_are_the_selection_vector(rng, ck,
+                                                        evaluator):
+    """A 9-level evaluation of a 16-level key stops mid-tree: the t
+    lane words must equal the host walk's depth-9 t column, and the
+    XOR of the parties must be one-hot at alpha's 9-bit prefix — the
+    non-byte-granular PIR contract (y planes deliberately unread)."""
+    n_key, d = 16, 9
+    prg = _prg(LAM, ck)
+    idx = [0, 411]  # 9-bit prefixes
+    bundle, _betas = _bundle(rng, prg, [i << (n_key - d) for i in idx],
+                             n_key, LAM)
+    staged_cw, fronts, parts = evaluator._staged_for(bundle, d)
+    t_both = {}
+    for b in (0, 1):
+        _y0, _y1, t = evaluator.eval_party(b, parts[b], d, staged_cw,
+                                           fronts[b])
+        _s, t_host = dpf_tree_expand_np(prg, parts[b], b, d)
+        t_dev = unpack_lanes(
+            np.asarray(t).view(np.uint32))[:, 0, :].astype(np.uint8)
+        np.testing.assert_array_equal(t_dev, t_host)
+        t_both[b] = t_host
+    onehot = t_both[0] ^ t_both[1]
+    values = _bitrev_values(d)
+    for i, a in enumerate(idx):
+        np.testing.assert_array_equal(onehot[i], (values == a)
+                                      .astype(np.uint8))
+    evaluator.invalidate()
+
+
+def test_eval_party_depth_and_restriction_contracts(rng, ck, evaluator):
+    prg = _prg(LAM, ck)
+    bundle, _ = _bundle(rng, prg, [1], 8, LAM)
+    with pytest.raises(ShapeError, match="cannot evaluate"):
+        evaluator.eval_party(0, bundle.for_party(0), 16)
+    with pytest.raises(ShapeError, match="party-restricted"):
+        evaluator.eval_party(0, bundle, 8)
+
+
+def test_sharded_evalall_2x2_mesh(rng, ck):
+    """Whole-domain reconstruction on a 2x2 (keys, points) mesh — the
+    conftest pins 8 virtual CPU devices, so a real 4-device sharding —
+    plus the host_levels floor the frontier split demands."""
+    mesh = make_mesh(shape=(2, 2))
+    ev = ShardedDpfEvalAll(LAM, ck, mesh, interpret=True)
+    prg = _prg(LAM, ck)
+    alpha_vals = [7, 200]
+    bundle, betas = _bundle(rng, prg, alpha_vals, 8, LAM)
+    assert ev.check(bundle, alpha_vals, betas, 8) == 0
+    bad = betas.copy()
+    bad[0, 5] ^= 0x01
+    ev.invalidate()
+    assert ev.check(bundle, alpha_vals, bad, 8) > 0
+    with pytest.raises(ValueError, match="need >= 7 for 4 devices"):
+        ShardedDpfEvalAll(LAM, ck, mesh, host_levels=6, interpret=True)
+
+
+@pytest.mark.slow
+def test_per_point_cross_check_full_n16_domain(rng, ck, evaluator):
+    """The serial-leg anchor: every one of the 65536 domain points,
+    walked individually by the reference evaluator, agrees with the
+    device EvalAll leaves AND the oracle."""
+    n = 16
+    prg = _prg(LAM, ck)
+    alpha_vals = [0xC0DE]
+    bundle, betas = _bundle(rng, prg, alpha_vals, n, LAM)
+    values = _bitrev_values(n)
+    xs = np.array([list(int(v).to_bytes(2, "big")) for v in values],
+                  dtype=np.uint8)
+    staged_cw, fronts, parts = evaluator._staged_for(bundle, n)
+    recon_pp = None
+    for b in (0, 1):
+        y0, y1, t = evaluator.eval_party(b, parts[b], n, staged_cw,
+                                         fronts[b])
+        y_dev, _t_dev = leaf_planes_to_bytes(y0, y1, t)
+        y_pp = dpf_eval_points(prg, parts[b], b, xs)
+        np.testing.assert_array_equal(y_dev, y_pp)
+        recon_pp = y_pp if recon_pp is None else recon_pp ^ y_pp
+    np.testing.assert_array_equal(
+        recon_pp[0], dpf_oracle(xs, alpha_vals[0], betas[0]))
+    evaluator.invalidate()

@@ -134,6 +134,72 @@ def test_truncations_and_extensions_rejected_typed(v2_frame, v3_frame,
             decode(frame + b"\x00")
 
 
+# --------------------------------------- DPF frames (ISSUE 19)
+
+
+@pytest.fixture(scope="module")
+def dpf_frame(rng):
+    from dcf_tpu.gen import random_s0s
+    from dcf_tpu.ops.prg import HirosePrgNp
+    from dcf_tpu.protocols.dpf import dpf_gen_batch
+
+    prg = HirosePrgNp(LAM, [rng.bytes(32), rng.bytes(32)])
+    alphas = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+    betas = rng.integers(0, 256, (2, LAM), dtype=np.uint8)
+    return dpf_gen_batch(prg, alphas, betas,
+                         random_s0s(2, LAM, rng)).to_bytes()
+
+
+def test_dpf_byte_flips_all_rejected_typed(dpf_frame, rng):
+    from dcf_tpu.protocols.dpf import DpfBundle
+
+    _fuzz(dpf_frame, DpfBundle.from_bytes, rng, N_FLIPS)
+
+
+def test_dpf_frame_fed_to_other_readers_rejected(dpf_frame, rng):
+    """Version gating one way (ISSUE 19): a DPF frame fed to the plain
+    or MIC readers is refused typed with a pointer at the right
+    decoder, pristine and under corruption — a plain evaluator walking
+    DPF material would read absent ``cw_v`` bytes as seed
+    corrections."""
+    with pytest.raises(KeyFormatError, match="DpfBundle"):
+        KeyBundle.from_bytes(dpf_frame)
+    with pytest.raises(KeyFormatError, match="point-function"):
+        ProtocolBundle.from_bytes(dpf_frame)
+    for _ in range(40):
+        mutated = faults.corrupt(dpf_frame,
+                                 int(rng.integers(0, len(dpf_frame))),
+                                 int(rng.integers(1, 256)))
+        with pytest.raises(KeyFormatError):
+            KeyBundle.from_bytes(mutated)
+        with pytest.raises(KeyFormatError):
+            ProtocolBundle.from_bytes(mutated)
+
+
+def test_plain_and_mic_frames_fed_to_dpf_reader_rejected(v2_frame,
+                                                         v3_frame):
+    """...and the other way: the DPF reader refuses plain (no proto
+    field at all) and MIC frames, each with a pointer at its
+    decoder."""
+    from dcf_tpu.protocols.dpf import DpfBundle
+
+    with pytest.raises(KeyFormatError, match="KeyBundle.from_bytes"):
+        DpfBundle.from_bytes(v2_frame)
+    with pytest.raises(KeyFormatError, match="ProtocolBundle"):
+        DpfBundle.from_bytes(v3_frame)
+
+
+def test_dpf_truncations_and_extensions_rejected_typed(dpf_frame, rng):
+    from dcf_tpu.protocols.dpf import DpfBundle
+
+    for cut in sorted({int(c) for c in
+                       rng.integers(0, len(dpf_frame), 25)}):
+        with pytest.raises(KeyFormatError):
+            DpfBundle.from_bytes(dpf_frame[:cut])
+    with pytest.raises(KeyFormatError):
+        DpfBundle.from_bytes(dpf_frame + b"\x00")
+
+
 # --------------------------------------- the durable store (ISSUE 8)
 
 

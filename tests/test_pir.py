@@ -1,0 +1,250 @@
+"""2-server PIR workload (ISSUE 19): retrieval, serving, faults.
+
+The workload contract end to end: a client's DPF query keys, shipped
+as DCFK v3 ``proto=2`` frames through the serving tier's registry
+plumbing, must retrieve every probed record BIT-EXACTLY from two
+servers that each saw only a pseudorandom key — at byte-granular AND
+non-byte-granular database domains (the prefix-depth contract), with
+the ``serve.eval`` fault seam honouring the same retry-then-evict
+discipline as the point-batch service.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from dcf_tpu.backends.evalall import DpfEvalAll
+from dcf_tpu.errors import ShapeError
+from dcf_tpu.gen import random_s0s
+from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.serve.metrics import Metrics
+from dcf_tpu.serve.registry import KeyRegistry
+from dcf_tpu.serve.replicate import apply_frame, sync_frames
+from dcf_tpu.testing import faults
+from dcf_tpu.workloads.pir import (
+    PirDatabase,
+    PirServer,
+    pir_answer_share,
+    pir_query_bundle,
+    pir_reconstruct,
+)
+
+pytestmark = pytest.mark.pir
+
+LAM = 32
+
+
+def _cipher_keys(rng) -> list:
+    return [bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+            for _ in range(18)]
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0x919)
+
+
+@pytest.fixture(scope="module")
+def ck(rng):
+    return _cipher_keys(rng)
+
+
+@pytest.fixture(scope="module")
+def prg(ck):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return HirosePrgNp(LAM, ck)
+
+
+@pytest.fixture(scope="module")
+def evaluator(ck):
+    return DpfEvalAll(LAM, ck, interpret=True)
+
+
+def _db(rng, n_bits, record_bytes=8):
+    records = rng.integers(0, 256, (1 << n_bits, record_bytes),
+                           dtype=np.uint8)
+    return records, PirDatabase(records, n_bits)
+
+
+def test_database_validation(rng):
+    good = rng.integers(0, 256, (256, 4), dtype=np.uint8)
+    with pytest.raises(ShapeError, match="uint8"):
+        PirDatabase(good.astype(np.int32), 8)
+    with pytest.raises(ShapeError, match="do not fill"):
+        PirDatabase(good[:100], 8)
+    with pytest.raises(ValueError, match="must be >= 5"):
+        PirDatabase(good[:16], 4)
+
+
+def test_direct_retrieval_byte_domain(rng, prg, evaluator):
+    """The bare construction, no serving tier: both parties EvalAll
+    their key share, inner-product against the packed database, and
+    the XOR of the answer shares is the record — including the first
+    and last records of the domain."""
+    n = 8
+    records, db = _db(rng, n)
+    idx = [0, 255, 77]
+    bundle = pir_query_bundle(prg, idx, n, random_s0s(len(idx), LAM, rng))
+    staged_cw, fronts, parts = evaluator._staged_for(bundle, n)
+    shares = []
+    for b in (0, 1):
+        _y0, _y1, t = evaluator.eval_party(b, parts[b], n, staged_cw,
+                                           fronts[b])
+        shares.append(pir_answer_share(t, db))
+    got = pir_reconstruct(shares[0], shares[1])
+    np.testing.assert_array_equal(got, records[idx])
+    evaluator.invalidate()
+
+
+def test_served_retrieval_non_byte_domain_via_frames(rng, prg, evaluator):
+    """The full served path at a NON-byte domain (n=9): the query key
+    is generated over the next byte-granular domain (16 bits, index in
+    the top 9), ships as a proto=2 frame through ``apply_frame``, and
+    the server's depth-9 prefix evaluation retrieves bit-exactly."""
+    n = 9
+    records, db = _db(rng, n)
+    idx = [0, 511, 300]
+    bundle = pir_query_bundle(prg, idx, n, random_s0s(len(idx), LAM, rng))
+    assert bundle.n_bits == 16  # padded to the wire's byte granularity
+    registry = KeyRegistry(None)
+    gen = apply_frame(registry, "q", bundle.to_bytes(), 7, True,
+                      lam=LAM, n_bytes=2, metrics=Metrics())
+    assert gen == 7
+    server = PirServer(evaluator, db, registry)
+    got = pir_reconstruct(server.answer("q", 0), server.answer("q", 1))
+    np.testing.assert_array_equal(got, records[idx])
+    # repeat queries under the same key ride the selection cache
+    np.testing.assert_array_equal(
+        pir_reconstruct(server.answer("q", 0), server.answer("q", 1)),
+        records[idx])
+    # and the anti-entropy half re-ships it flagged as a proto frame
+    entries = sync_frames(registry, {})
+    assert [(e[0], e[1], e[2]) for e in entries] == [("q", 7, True)]
+    assert entries[0][3] == bundle.to_bytes()
+    evaluator.invalidate()
+
+
+def test_query_index_range_refused(rng, prg):
+    with pytest.raises(ValueError, match="outside the 2\\^9-record"):
+        pir_query_bundle(prg, [1 << 9], 9, random_s0s(1, LAM, rng))
+
+
+def test_server_refuses_wrong_key_kinds(rng, prg, evaluator):
+    """A plain DCF bundle and a too-shallow DPF key both die typed at
+    the serve edge, before any kernel runs."""
+    records, db = _db(rng, 16, record_bytes=1)
+    registry = KeyRegistry(None)
+    server = PirServer(evaluator, db, registry)
+    shallow = pir_query_bundle(prg, [3], 8, random_s0s(1, LAM, rng))
+    registry.register("shallow", shallow)
+    with pytest.raises(ShapeError, match="too shallow"):
+        server.answer("shallow", 0)
+    from dcf_tpu.gen import gen_batch
+    from dcf_tpu.spec import Bound
+
+    plain = gen_batch(prg, np.zeros((1, 2), dtype=np.uint8),
+                      np.zeros((1, LAM), dtype=np.uint8),
+                      random_s0s(1, LAM, rng), Bound.LT_BETA)
+    registry.register("plain", plain)
+    with pytest.raises(ShapeError, match="not the.*DpfBundle"):
+        server.answer("plain", 0)
+    with pytest.raises(ValueError, match="party must be 0 or 1"):
+        server.answer("shallow", 2)
+    with pytest.raises(ValueError, match="retries"):
+        PirServer(evaluator, db, registry, retries=-1)
+
+
+def test_eval_fault_retry_then_evict(rng, prg, evaluator):
+    """The serve.eval discipline transplanted: a one-fault window is
+    absorbed by the bounded retry (evicting the possibly-poisoned
+    staged state first), a window wider than the retry budget re-raises
+    the typed cause, and the server recovers after the window."""
+    n = 8
+    records, db = _db(rng, n)
+    registry = KeyRegistry(None)
+    idx = [12, 200]
+    registry.register("q", pir_query_bundle(
+        prg, idx, n, random_s0s(len(idx), LAM, rng)))
+    server = PirServer(evaluator, db, registry, retries=1)
+    with faults.inject_schedule("serve.eval", window_evals=1) as sched:
+        got = pir_reconstruct(server.answer("q", 0), server.answer("q", 1))
+    np.testing.assert_array_equal(got, records[idx])
+    assert (sched.fired, sched.failed) == (3, 1)
+    assert server.eval_faults == 1
+    with faults.inject_schedule("serve.eval", window_evals=2) as sched:
+        with pytest.raises(faults.InjectedFault):
+            server.answer("q", 0)
+        # the window is spent; the same call now serves cleanly
+        got = pir_reconstruct(server.answer("q", 0), server.answer("q", 1))
+    np.testing.assert_array_equal(got, records[idx])
+    assert server.eval_faults == 3
+    evaluator.invalidate()
+
+
+def test_facade_pir_query(rng, ck, evaluator):
+    """``Dcf.pir_query`` mints a servable bundle over the facade's
+    domain with caller-reproducible randomness."""
+    from dcf_tpu.api import Dcf
+
+    dcf = Dcf(n_bytes=1, lam=LAM, cipher_keys=ck)
+    records, db = _db(rng, 8)
+    registry = KeyRegistry(None)
+    registry.register("q", dcf.pir_query([42, 0],
+                                         rng=np.random.default_rng(5)))
+    server = PirServer(evaluator, db, registry)
+    got = pir_reconstruct(server.answer("q", 0), server.answer("q", 1))
+    np.testing.assert_array_equal(got, records[[42, 0]])
+    # same rng seed -> same bundle bytes (reproducible queries)
+    again = dcf.pir_query([42, 0], rng=np.random.default_rng(5))
+    assert again.to_bytes() == registry.snapshot("q")[0].to_bytes()
+    evaluator.invalidate()
+
+
+@pytest.mark.slow
+def test_served_pir_soak_under_eval_faults(rng, prg, evaluator):
+    """The serial-leg soak: a stream of fresh queries served while
+    every third ``serve.eval`` fire faults — every reconstruction must
+    stay bit-exact and every absorbed fault must be counted."""
+    n = 9
+    records, db = _db(rng, n)
+    registry = KeyRegistry(None)
+    server = PirServer(evaluator, db, registry, retries=1)
+    fired = [0]
+
+    def every_third(*args):
+        fired[0] += 1
+        if fired[0] % 3 == 0:
+            raise faults.InjectedFault("soak fault")
+
+    with faults.inject("serve.eval", handler=every_third):
+        for q in range(10):
+            idx = [int(x) for x in rng.integers(0, 1 << n, 2)]
+            registry.register(f"q{q}", pir_query_bundle(
+                prg, idx, n, random_s0s(len(idx), LAM, rng)))
+            got = pir_reconstruct(server.answer(f"q{q}", 0),
+                                  server.answer(f"q{q}", 1))
+            np.testing.assert_array_equal(got, records[idx])
+    assert server.eval_faults > 0
+    assert server.eval_faults == fired[0] // 3
+    evaluator.invalidate()
+
+
+@pytest.mark.slow
+def test_pir_bench_cli_smoke(capsys):
+    """One single-domain pir_bench pass end to end: the gate runs, the
+    line lands with the leg, the disclosure and the pinned ratio."""
+    import json
+
+    from dcf_tpu import cli
+
+    cli.main(["pir_bench", "--n-bits=14", "--reps=1"])
+    lines = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(lines[-1])
+    assert rec["bench"] == "pir_bench"
+    assert rec["queries_per_sec"] > 0
+    assert [leg["n_bits"] for leg in rec["legs"]] == [14]
+    assert rec["legs"][0]["eval_faults"] == 0
+    assert "vs_baseline" in rec["legs"][0]
+    assert rec["repro"].startswith("python -m dcf_tpu.cli pir_bench")

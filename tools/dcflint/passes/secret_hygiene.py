@@ -45,7 +45,7 @@ from tools.dcflint import FileContext, LintPass, register
 SECRET_NAME_RE = re.compile(
     r"^(seed\w*|s0s?|cw(_\w+)?|cws|key_bundle|bundle|kb|key_material"
     r"|cipher_keys?|combine_masks?|frames?|frame_bytes|key_frame"
-    r"|repl(ica)?_frames?|shares?(_\w+)?)$")
+    r"|repl(ica)?_frames?|shares?(_\w+)?|t_words?|sel(ection)?_vecs?)$")
 # ``frame`` (ISSUE 8, dcf_tpu/serve/store.py): a serialized DCFK frame
 # is the seeds and correction words it encodes — logging one is
 # logging the key.
@@ -67,6 +67,12 @@ SECRET_NAME_RE = re.compile(
 # ``combine_masks`` (PR 5, dcf_tpu/protocols): a protocol bundle's
 # per-interval combine mask is ``pub * beta`` — beta in the clear for
 # wraparound intervals, i.e. the secret function value itself.
+# ``t_word``/``t_words``/``sel_vec``/``selection_vec`` (ISSUE 19,
+# dcf_tpu/workloads/pir.py + backends/evalall.py): one party's leaf
+# t-bit lane words are its SHARE of the PIR selection vector — logged
+# next to the other party's they reconstruct the one-hot at alpha,
+# i.e. WHICH record the client asked for.  The query privacy the whole
+# 2-server construction exists to provide dies in one log line.
 _PRINT_FUNCS = ("print", "log", "labeled")
 _LOGGING_METHODS = ("debug", "info", "warning", "error", "critical",
                     "exception", "log")
