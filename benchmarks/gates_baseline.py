@@ -1,0 +1,122 @@
+"""Measure and pin the gate_bench single-core numpy-oracle denominators.
+
+``gate_bench``'s ``vs_baseline`` compares served gate points/s against
+"what would the obviously-correct host implementation serve": the
+single-core numpy gate oracles (``protocols.fixedpoint``) computing the
+CLEAR-input gate function — unmask, look up / truncate, and encode the
+result into the same [M, lam] lane-broadcast payload the served path
+delivers (the output contract is part of the work).  Same pinning
+discipline as ``protocols_baseline.py`` / CPU_BASELINE.md: fixed
+workload, warmup passes, >= 40 timed samples, median pinned with the
+p10-p90 band and host state recorded alongside, committed once — the
+denominator must not move between bench runs, and consumers attach
+``vs_baseline`` only when the pin exists (no in-run fallback, the
+mic_m8 no-transfer rule).
+
+Fixed workloads (the gate_bench default shape — 16-bit domain, f=8
+fractional bits, lam=16, a fixed 2048-point batch):
+
+* ``gates.sigmoid_m8``: the m=8 spline table lookup
+  (``sigmoid_fixed_oracle`` on the unmasked input + payload encode);
+* ``gates.trunc``: the faithful truncation
+  (``trunc_oracle`` + payload encode).
+
+Writes the ``"gates": {...}`` entries into
+``benchmarks/cpu_baseline.json`` (other fields untouched) and prints
+the records.
+
+Usage: python benchmarks/gates_baseline.py [--samples N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+M_POINTS = 2048
+M_PIECES = 8
+LAM = 16
+N_BITS = 16
+F_BITS = 8
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--samples", type=int, default=40)
+    args = ap.parse_args()
+
+    from benchmarks.cpu_baseline import host_state
+    from dcf_tpu.protocols.fixedpoint import (
+        encode_lanes, sigmoid_fixed_oracle, sigmoid_table, trunc_oracle)
+
+    n_total = 1 << N_BITS
+    rng = np.random.default_rng(2026)
+    cuts, values = sigmoid_table(N_BITS, F_BITS, M_PIECES)
+    r_sig = int(rng.integers(0, n_total))
+    r_tr = int(rng.integers(0, n_total))
+    x_hat = rng.integers(0, n_total, size=M_POINTS, dtype=np.int64)
+
+    def run_sigmoid():
+        y = sigmoid_fixed_oracle((x_hat - r_sig) % n_total, cuts, values)
+        return encode_lanes(y, "add16", LAM)
+
+    def run_trunc():
+        y = trunc_oracle(x_hat, r_tr, F_BITS, N_BITS)
+        return encode_lanes(y, "add16", LAM)
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "cpu_baseline.json")
+    with open(path) as f:
+        pinned = json.load(f)
+    workloads = {
+        f"sigmoid_m{M_PIECES}": (
+            run_sigmoid,
+            f"numpy sigmoid_fixed_oracle (m={M_PIECES} spline table, "
+            f"f={F_BITS}) on the unmasked input + add16 lane encode, "
+            f"{N_BITS}-bit domain, lam={LAM}, single core, "
+            "reconstruction (not one party)"),
+        "trunc": (
+            run_trunc,
+            f"numpy trunc_oracle (f={F_BITS} faithful truncation) + "
+            f"add16 lane encode, {N_BITS}-bit domain, lam={LAM}, "
+            "single core, reconstruction (not one party)"),
+    }
+    for tag, (fn, desc) in workloads.items():
+        for _ in range(8):  # warmup (turbo burst / cache warm)
+            fn()
+        rates = []
+        for _ in range(max(args.samples, 8)):
+            t0 = time.perf_counter()
+            fn()
+            rates.append(M_POINTS / (time.perf_counter() - t0))
+        rates = np.array(rates)
+        entry = {
+            "points_per_sec": round(float(np.median(rates)), 1),
+            "band_points_per_sec": [
+                round(float(np.percentile(rates, 10)), 1),
+                round(float(np.percentile(rates, 90)), 1)],
+            "band": "p10-p90 of per-sample rates",
+            "samples": len(rates),
+            "batch_points": M_POINTS,
+            "workload": desc,
+            "date": datetime.date.today().isoformat(),
+            **host_state(),
+        }
+        pinned.setdefault("gates", {})[tag] = entry
+        print(json.dumps({tag: entry}, indent=1))
+    with open(path, "w") as f:
+        json.dump(pinned, f, indent=1)
+        f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

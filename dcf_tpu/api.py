@@ -645,7 +645,7 @@ class Dcf:
             s0s: np.ndarray | None = None,
             bound: Bound = Bound.LT_BETA,
             rng: np.random.Generator | None = None,
-            device: bool = False) -> KeyBundle:
+            device: bool = False, group: str = "xor") -> KeyBundle:
         """Generate K keys: alphas uint8 [K, n_bytes], betas uint8 [K, lam].
 
         s0s (uint8 [K, 2, lam]) default to fresh random seeds.  Returns the
@@ -656,6 +656,17 @@ class Dcf:
         applies) — same bytes out, throughput scaling with K instead of
         a single host core; falls back to the host walk, counted and
         warned, if the device path fails.
+
+        ``group`` selects the OUTPUT group (``spec.GROUPS``): ``"xor"``
+        (default — reconstruction is ``y0 ^ y1``) or an additive group
+        ``"add8"``/``"add16"``/``"add32"`` (the payload is little-endian
+        w-bit lanes; reconstruction is ``y0 + y1 mod 2^w`` per lane —
+        Boyle et al. Fig. 1, the algebra the fixed-point gate suite in
+        ``dcf_tpu.protocols.fixedpoint`` is built on).  The GGM tree
+        walk is group-independent; additive keygen runs the vectorized
+        host walk (the native core and the device keygen kernels are
+        XOR-only, a documented routing), and eval backends pick the
+        accumulate algebra off ``bundle.group`` at ``put_bundle``.
         """
         alphas = np.asarray(alphas, dtype=np.uint8)
         betas = np.asarray(betas, dtype=np.uint8)
@@ -669,10 +680,15 @@ class Dcf:
                 rng if rng is not None else np.random.default_rng())
         if device:
             return gen_on_device(
-                self.lam, self.cipher_keys, alphas, betas, s0s, bound)
-        if self._gen_native is not None:
+                self.lam, self.cipher_keys, alphas, betas, s0s, bound,
+                group=group)
+        if self._gen_native is not None and group == "xor":
+            # The C++ core implements the XOR value algebra only; the
+            # additive groups take the vectorized numpy walk (a documented
+            # routing, not a counted fallback — there is no native path
+            # to fall back FROM).
             return self._gen_native.gen_batch(alphas, betas, s0s, bound)
-        return gen_batch(self._prg, alphas, betas, s0s, bound)
+        return gen_batch(self._prg, alphas, betas, s0s, bound, group=group)
 
     def eval_backend(self, b: int = 0):
         """The live backend instance serving party ``b`` (the shared
@@ -842,19 +858,20 @@ class Dcf:
 
     # -- protocols (dcf_tpu.protocols: IC / MIC / piecewise) ----------------
 
-    def _protocol_gen(self, rng, device: bool = False):
+    def _protocol_gen(self, rng, device: bool = False,
+                      group: str = "xor"):
         from dcf_tpu.spec import Bound as _B
 
         def gen_fn(alphas, betas, bound: _B):
             return self.gen(alphas, betas, bound=bound, rng=rng,
-                            device=device)
+                            device=device, group=group)
 
         return gen_fn
 
     def interval(self, p: int, q: int, beta: np.ndarray,
                  bound: Bound = Bound.LT_BETA,
                  rng: np.random.Generator | None = None,
-                 device: bool = False):
+                 device: bool = False, group: str = "xor"):
         """Keys for interval containment ``1_{p <= x < q} * beta``.
 
         ``p``/``q``: ints in ``[0, 2^n_bits]`` (``q = 2^n_bits`` makes
@@ -862,24 +879,28 @@ class Dcf:
         ``[p, N) ∪ [0, q)`` and ``p == q`` is empty.  ``beta``: uint8
         [lam].  Returns a two-party ``protocols.ProtocolBundle`` packing
         the two bound keys on the K axis — ship ``pb.for_party(b)`` and
-        evaluate with :meth:`eval_interval`; XOR both parties' outputs
-        to reconstruct.  Wraparound/full-domain intervals work via the
-        public combine-mask correction (README "Protocols" derivation).
+        evaluate with :meth:`eval_interval`; group-add both parties'
+        outputs to reconstruct (XOR in the default group).
+        Wraparound/full-domain intervals work via the public
+        combine-mask correction (README "Protocols" derivation).
         ``bound`` picks which DCF bound family realizes the keys
         (LT_BETA default; GT_BETA uses the ``1_{x >= b}`` decomposition
-        — same reconstruction either way).
+        — same reconstruction either way).  ``group`` selects the
+        output group the keys and combine run in (see :meth:`gen`);
+        additive groups yield arithmetic shares of the indicator —
+        the building block of the fixed-point gates.
         """
         from dcf_tpu.protocols import gen_interval_bundle
 
         beta = np.asarray(beta, dtype=np.uint8).reshape(1, -1)
         return gen_interval_bundle(
-            self._protocol_gen(rng, device), [(p, q)], beta,
-            self.n_bytes, bound)
+            self._protocol_gen(rng, device, group), [(p, q)], beta,
+            self.n_bytes, bound, group)
 
     def mic(self, intervals, betas: np.ndarray,
             bound: Bound = Bound.LT_BETA,
             rng: np.random.Generator | None = None,
-            device: bool = False):
+            device: bool = False, group: str = "xor"):
         """Keys for multiple interval containment over ``m`` intervals.
 
         ``intervals``: sequence of ``(p, q)`` int pairs (same convention
@@ -891,20 +912,22 @@ class Dcf:
         :meth:`eval_mic` (facade path) or ``protocols.MicEvaluator``
         (staged, on-device combine), and servable online by registering
         the returned bundle in ``Dcf.serve(...)`` under a key id.
-        Reconstruction: XOR both parties' [m, M, lam] outputs.
-        ``device=True`` runs the 2m-key packed keygen on the
-        accelerator (``gen.gen_on_device`` — the K axis is exactly
-        what the device walk scales with).
+        Reconstruction: group-add both parties' [m, M, lam] outputs
+        (XOR in the default group).  ``device=True`` runs the 2m-key
+        packed keygen on the accelerator (``gen.gen_on_device`` — the
+        K axis is exactly what the device walk scales with).
+        ``group`` selects the output group (see :meth:`gen`).
         """
         from dcf_tpu.protocols import gen_interval_bundle
 
         return gen_interval_bundle(
-            self._protocol_gen(rng, device), intervals,
-            np.asarray(betas, dtype=np.uint8), self.n_bytes, bound)
+            self._protocol_gen(rng, device, group), intervals,
+            np.asarray(betas, dtype=np.uint8), self.n_bytes, bound,
+            group)
 
     def piecewise(self, cuts, values: np.ndarray,
                   rng: np.random.Generator | None = None,
-                  device: bool = False):
+                  device: bool = False, group: str = "xor"):
         """Keys for a piecewise-constant function (spline lookup table).
 
         ``cuts``: strictly increasing breakpoints in ``[0, 2^n_bits)``
@@ -912,18 +935,21 @@ class Dcf:
         ``cuts[0] == 0`` that is the standard table over [0, N));
         ``values``: uint8 [m, lam], piece i's output.  Builds the MIC
         over the induced partition; evaluate with
-        :meth:`eval_piecewise`, which XOR-reduces the per-piece rows to
-        one [M, lam] share per party (exact because the pieces
-        partition the domain and the output group is XOR).
+        :meth:`eval_piecewise`, which group-sum-reduces the per-piece
+        rows to one [M, lam] share per party (exact because the pieces
+        partition the domain, so exactly one indicator fires per
+        point).  In an additive ``group`` the result is an ARITHMETIC
+        share of the piece value — the spline-sigmoid gate
+        (``protocols.fixedpoint``) is a thin client of exactly this.
         """
         from dcf_tpu.protocols import gen_interval_bundle
         from dcf_tpu.protocols.piecewise import partition_intervals
 
         intervals = partition_intervals(list(cuts), 8 * self.n_bytes)
         return gen_interval_bundle(
-            self._protocol_gen(rng, device), intervals,
+            self._protocol_gen(rng, device, group), intervals,
             np.asarray(values, dtype=np.uint8), self.n_bytes,
-            Bound.LT_BETA)
+            Bound.LT_BETA, group)
 
     def eval_interval(self, b: int, pb, xs: np.ndarray) -> np.ndarray:
         """Party ``b``'s IC share uint8 [M, lam] (see :meth:`interval`)."""
@@ -1082,6 +1108,12 @@ class Dcf:
             return be.eval(int(b), xs)
         kb = bundle.for_party(b) if bundle.s0s.shape[1] == 2 else bundle
         if self.backend_name == "cpu":
+            if kb.group != "xor":
+                # api-edge: documented group contract — the C++ core
+                # implements the XOR value algebra only.
+                raise ShapeError(
+                    f"the cpu (native) backend is XOR-only; bundle has "
+                    f"group {kb.group!r} — use numpy/bitsliced/pallas")
             return self._gen_native.eval(b, kb, xs)
         if self.backend_name == "numpy":
             from dcf_tpu.backends.numpy_backend import eval_batch_np

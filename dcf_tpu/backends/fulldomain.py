@@ -50,8 +50,15 @@ def tree_expand_np(prg: HirosePrgNp, bundle: KeyBundle, b: int,
     bitreverse order (position = Σ dir_i 2^i over the MSB-first walk
     directions).  Doubles as the oracle the device kernel is tested
     against.
+
+    For additive bundle groups the pushed-down value accumulator is the
+    UNSIGNED per-lane sum (the party sign factors out of the whole walk;
+    consumers apply it once at their output edge).
     """
+    from dcf_tpu.utils.groups import lanes_of, bytes_of
+
     lam = bundle.lam
+    group = bundle.group
     s = bundle.s0s[:1, 0, :].copy()  # single key
     t = np.array([b], dtype=np.uint8)
     v = np.zeros((1, lam), dtype=np.uint8)
@@ -63,8 +70,15 @@ def tree_expand_np(prg: HirosePrgNp, bundle: KeyBundle, b: int,
         tc = t[:, None]
         s_l = p.s_l ^ cs * tc
         s_r = p.s_r ^ cs * tc
-        v_l = v ^ p.v_l ^ cv * tc
-        v_r = v ^ p.v_r ^ cv * tc
+        if group == "xor":
+            v_l = v ^ p.v_l ^ cv * tc
+            v_r = v ^ p.v_r ^ cv * tc
+        else:
+            lv = lanes_of(v, group)
+            cvg = lanes_of(np.ascontiguousarray(cv[None, :]), group) \
+                * tc.astype(lanes_of(v, group).dtype)
+            v_l = bytes_of(lv + lanes_of(p.v_l, group) + cvg, group)
+            v_r = bytes_of(lv + lanes_of(p.v_r, group) + cvg, group)
         t_l = p.t_l ^ (t & ctl)
         t_r = p.t_r ^ (t & ctr)
         s = np.concatenate([s_l, s_r])
@@ -156,6 +170,14 @@ class TreeFullDomain:
         party-independent; the frontier is per party)."""
         if bundle.n_bits != n_bits:
             raise ShapeError("bundle depth mismatch")
+        if bundle.group != "xor":
+            # api-edge: documented group contract — the device finalize
+            # (tree_expand_device) and the mismatch verifiers reconstruct
+            # by XOR; additive full-domain shares come from tree_expand_np
+            # / tree_expand_raw, which DO carry the group.
+            raise ShapeError(
+                f"TreeFullDomain finalize is XOR-only; bundle has group "
+                f"{bundle.group!r}")
         if bundle.s0s.shape[1] != 1:
             raise ShapeError("eval_party wants a party-restricted bundle")
         k0 = min(self.host_levels, n_bits)
@@ -176,6 +198,12 @@ class TreeFullDomain:
         checking the same bundle object (repeated checks previously paid
         ~1-2 tunnel round-trips of h2d staging EACH — the dominant cost of
         the full_domain tree bench whenever the dev tunnel degrades)."""
+        if bundle.group != "xor":
+            # api-edge: same XOR-only finalize contract as eval_party
+            # (the sharded subclass funnels through here too).
+            raise ShapeError(
+                f"TreeFullDomain finalize is XOR-only; bundle has group "
+                f"{bundle.group!r}")
         c = self._cache
         if c is not None and c[0] is bundle and c[1] == n_bits:
             return c[2], c[3], c[4]

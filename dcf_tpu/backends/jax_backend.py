@@ -26,6 +26,8 @@ from dcf_tpu.errors import ShapeError, StaleStateError
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes import expand_key_np
 from dcf_tpu.ops.aes_jax import aes256_encrypt_jax
+from dcf_tpu.ops.group_accum import (group_width, jnp_bytes_to_lanes,
+                                     jnp_lanes_to_bytes)
 from dcf_tpu.spec import hirose_used_cipher_indices
 
 __all__ = ["JaxBackend", "prg_gen_jax", "eval_core", "eval_scan"]
@@ -85,8 +87,15 @@ def eval_core(
     b: int,
     lam: int,
     prg_fn=prg_gen_jax,
+    group: str = "xor",
 ) -> jnp.ndarray:
     """Evaluate party ``b`` on all (key, point) pairs -> uint8 [K, M, lam].
+
+    ``group`` picks the value accumulation: XOR, or the additive group's
+    per-lane mod-2^w add (little-endian lanes over the payload bytes).
+    Additive shares come out signed — the party sign ``(-1)^b`` factors
+    out of the level loop, so the walk accumulates unsigned lanes and
+    party 1 negates once at the end.
 
     Unjitted core so ``dcf_tpu.parallel`` can wrap it in ``shard_map``; use
     ``eval_scan`` (the jitted wrapper) for single-device calls.  A 2D ``xs``
@@ -109,9 +118,14 @@ def eval_core(
     x_bits = ((xs[..., None] >> shifts) & jnp.uint8(1)).reshape(k_num, m, n)
     x_bits = jnp.moveaxis(x_bits, -1, 0)
 
+    w = group_width(group)  # 0 for xor
     s = jnp.broadcast_to(s0[:, None, :], (k_num, m, lam)).astype(jnp.uint8)
     t = jnp.full((k_num, m), b, dtype=jnp.uint8)
-    v = jnp.zeros((k_num, m, lam), dtype=jnp.uint8)
+    if w:
+        v = jnp.zeros((k_num, m, 8 * lam // w),
+                      dtype=jnp_bytes_to_lanes(s, w).dtype)
+    else:
+        v = jnp.zeros((k_num, m, lam), dtype=jnp.uint8)
 
     def body(carry, level):
         s, t, v = carry
@@ -124,16 +138,30 @@ def eval_core(
         t_l = t_l ^ (t & cw_t_i[:, None, 0])
         t_r = t_r ^ (t & cw_t_i[:, None, 1])
         xb = xbit[..., None].astype(bool)
-        v = v ^ jnp.where(xb, v_r, v_l) ^ cw_v_i[:, None, :] * t_mask
+        v_hat = jnp.where(xb, v_r, v_l)
+        if w:
+            v = v + jnp_bytes_to_lanes(v_hat, w) \
+                + jnp_bytes_to_lanes(cw_v_i, w)[:, None, :] \
+                * t_mask.astype(v.dtype)
+        else:
+            v = v ^ v_hat ^ cw_v_i[:, None, :] * t_mask
         s = jnp.where(xb, s_r, s_l)
         t = jnp.where(xbit.astype(bool), t_r, t_l)
         return (s, t, v), None
 
     (s, t, v), _ = jax.lax.scan(body, (s, t, v), (cw_s, cw_v, cw_t, x_bits))
-    return v ^ s ^ cw_np1[:, None, :] * t[..., None]
+    if not w:
+        return v ^ s ^ cw_np1[:, None, :] * t[..., None]
+    v = v + jnp_bytes_to_lanes(s, w) \
+        + jnp_bytes_to_lanes(cw_np1, w)[:, None, :] \
+        * t[..., None].astype(v.dtype)
+    if b:
+        v = -v
+    return jnp_lanes_to_bytes(v, w)
 
 
-eval_scan = partial(jax.jit, static_argnames=("b", "lam", "prg_fn"))(eval_core)
+eval_scan = partial(
+    jax.jit, static_argnames=("b", "lam", "prg_fn", "group"))(eval_core)
 
 
 class JaxBackend:
@@ -155,6 +183,7 @@ class JaxBackend:
         # static argument).
         self.prg_fn = prg_fn or prg_gen_jax
         self._bundle_dev = None
+        self._group = "xor"
 
     def put_bundle(self, bundle: KeyBundle) -> None:
         """Ship a (party-restricted) key bundle to device, level-major."""
@@ -163,6 +192,7 @@ class JaxBackend:
         self._bundle_dev = {
             k: jnp.asarray(v) for k, v in bundle.level_major().items()
         }
+        self._group = bundle.group
 
     def eval(self, b: int, xs: np.ndarray, bundle: KeyBundle | None = None) -> np.ndarray:
         """Evaluate party ``b``; xs uint8 [M, n_bytes] or [K, M, n_bytes].
@@ -186,5 +216,6 @@ class JaxBackend:
             b=int(b),
             lam=self.lam,
             prg_fn=self.prg_fn,
+            group=self._group,
         )
         return np.asarray(y)

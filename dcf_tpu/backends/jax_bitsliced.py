@@ -32,6 +32,8 @@ from dcf_tpu.errors import ShapeError, StaleStateError
 from dcf_tpu.backends._common import prepare_batch
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.aes_bitsliced import aes256_encrypt_planes, round_key_masks
+from dcf_tpu.ops.group_accum import (group_width, planes_add_bytemajor,
+                                     planes_neg_bytemajor)
 from dcf_tpu.spec import hirose_used_cipher_indices
 from dcf_tpu.utils.bits import byte_bits_lsb, expand_bits_to_masks, pack_lanes
 
@@ -105,9 +107,19 @@ def eval_core_bitsliced(
     x_mask: jnp.ndarray,  # uint32 [n, Kx, W] (Kx = K or 1 for shared points)
     b: int,
     lam: int,
+    group: str = "xor",
 ) -> jnp.ndarray:
-    """Party ``b`` eval, all planes; returns y planes uint32 [8*lam, K, W]."""
+    """Party ``b`` eval, all planes; returns y planes uint32 [8*lam, K, W].
+
+    ``group`` selects the value accumulation: XOR plane algebra, or the
+    additive group's per-lane mod-2^w ripple add over the byte-major
+    planes (ops.group_accum).  Additive output planes are SIGNED shares:
+    party 1's result is negated here, inside the core, so staged planes
+    already honor the signed-share contract and reconstruction is always
+    a plain lane add.
+    """
     ones = jnp.uint32(0xFFFFFFFF)
+    gw = group_width(group)  # 0 for xor
     k_num = s0_pl.shape[1]
     w = x_mask.shape[2]
     p = 8 * lam
@@ -128,7 +140,13 @@ def eval_core_bitsliced(
         t_l = t_l ^ (t & ctl[:, None])
         t_r = t_r ^ (t & ctr[:, None])
         xm_e = xm[None, :, :]  # broadcasts over planes and (if shared) keys
-        v = v ^ (v_r & xm_e) ^ (v_l & (xm_e ^ ones)) ^ (cv[:, :, None] & gate)
+        v_hat = (v_r & xm_e) | (v_l & (xm_e ^ ones))
+        cv_g = cv[:, :, None] & gate
+        if gw:
+            v = planes_add_bytemajor(
+                v, planes_add_bytemajor(v_hat, cv_g, gw), gw)
+        else:
+            v = v ^ v_hat ^ cv_g
         s = (s_r & xm_e) | (s_l & (xm_e ^ ones))
         t = (t_r & xm) | (t_l & (xm ^ ones))
         return (s, t, v), None
@@ -136,7 +154,11 @@ def eval_core_bitsliced(
     (s, t, v), _ = jax.lax.scan(
         body, (s, t, v), (cw_s_pl, cw_v_pl, cw_tl, cw_tr, x_mask)
     )
-    return v ^ s ^ (cw_np1_pl[:, :, None] & t[None, :, :])
+    tail = cw_np1_pl[:, :, None] & t[None, :, :]
+    if not gw:
+        return v ^ s ^ tail
+    y = planes_add_bytemajor(planes_add_bytemajor(v, s, gw), tail, gw)
+    return planes_neg_bytemajor(y, gw) if b else y
 
 
 def eval_core_keylanes(
@@ -151,6 +173,7 @@ def eval_core_keylanes(
     x_mask: jnp.ndarray,  # uint32 [n, M, 1] (0/~0 per point, shared by keys)
     b: int,
     lam: int,
+    group: str = "xor",
 ) -> jnp.ndarray:
     """Keys-in-lanes eval (many-keys regime): y planes uint32 [8*lam, M, Wk].
 
@@ -159,8 +182,13 @@ def eval_core_keylanes(
     while the shared evaluation points ride the explicit axis as full/zero
     masks.  This is what makes the 10^6-key secure-ReLU shape fit in HBM:
     the key image stays at its byte size (n*lam bytes per key).
+
+    ``group`` behaves as in ``eval_core_bitsliced`` (additive shares come
+    out signed; the ripple carries stay within each key's bit column, so
+    the lane packing is transparent to the add).
     """
     ones = jnp.uint32(0xFFFFFFFF)
+    gw = group_width(group)
     m = x_mask.shape[1]
     wk = s0_pl.shape[1]
     p = 8 * lam
@@ -181,7 +209,13 @@ def eval_core_keylanes(
         t_l = t_l ^ (t & ctl[None, :])
         t_r = t_r ^ (t & ctr[None, :])
         xm_e = xm[None, :, :]
-        v = v ^ (v_r & xm_e) ^ (v_l & (xm_e ^ ones)) ^ (cv[:, None, :] & gate)
+        v_hat = (v_r & xm_e) | (v_l & (xm_e ^ ones))
+        cv_g = cv[:, None, :] & gate
+        if gw:
+            v = planes_add_bytemajor(
+                v, planes_add_bytemajor(v_hat, cv_g, gw), gw)
+        else:
+            v = v ^ v_hat ^ cv_g
         s = (s_r & xm_e) | (s_l & (xm_e ^ ones))
         t = (t_r & xm) | (t_l & (xm ^ ones))
         return (s, t, v), None
@@ -189,7 +223,11 @@ def eval_core_keylanes(
     (s, t, v), _ = jax.lax.scan(
         body, (s, t, v), (cw_s_pl, cw_v_pl, cw_tl, cw_tr, x_mask)
     )
-    return v ^ s ^ (cw_np1_pl[:, None, :] & t[None, :, :])
+    tail = cw_np1_pl[:, None, :] & t[None, :, :]
+    if not gw:
+        return v ^ s ^ tail
+    y = planes_add_bytemajor(planes_add_bytemajor(v, s, gw), tail, gw)
+    return planes_neg_bytemajor(y, gw) if b else y
 
 
 # ---------------------------------------------------------------------------
@@ -307,19 +345,19 @@ def _planes_to_bytes_dev(planes, lam: int):
 
 def _eval_bytes(
     rk_masks, last_bit_mask, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
-    xs, b: int, lam: int,
+    xs, b: int, lam: int, group: str = "xor",
 ):
     """End-to-end device program: xs bytes in, y bytes out (points-in-lanes)."""
     y_planes = eval_core_bitsliced(
         rk_masks, last_bit_mask, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr,
-        cw_np1_pl, _xs_to_mask_dev(xs), b, lam,
+        cw_np1_pl, _xs_to_mask_dev(xs), b, lam, group,
     )
     return _planes_to_bytes_dev(y_planes, lam)
 
 
 def _eval_keylanes_bytes(
     rk_masks, last_bit_mask, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr, cw_np1_pl,
-    xs, b: int, lam: int,
+    xs, b: int, lam: int, group: str = "xor",
 ):
     """Device program for the keys-in-lanes layout: returns uint8 [M, K_pad, lam]."""
     m, nb = xs.shape
@@ -328,7 +366,7 @@ def _eval_keylanes_bytes(
     x_mask = (bits.T.astype(jnp.uint32) * jnp.uint32(0xFFFFFFFF))[:, :, None]
     y_planes = eval_core_keylanes(
         rk_masks, last_bit_mask, s0_pl, cw_s_pl, cw_v_pl, cw_tl, cw_tr,
-        cw_np1_pl, x_mask, b, lam,
+        cw_np1_pl, x_mask, b, lam, group,
     )
     return _planes_to_bytes_dev(y_planes, lam)
 
@@ -338,15 +376,15 @@ def _stage_range_mask_jit(start, m: int, nb: int):
     return _xs_to_mask_dev(_range_xs_dev(start, m, nb))
 
 
-_eval_jit = partial(jax.jit, static_argnames=("b", "lam"))(_eval_bytes)
-_eval_keylanes_jit = partial(jax.jit, static_argnames=("b", "lam"))(
+_eval_jit = partial(jax.jit, static_argnames=("b", "lam", "group"))(_eval_bytes)
+_eval_keylanes_jit = partial(jax.jit, static_argnames=("b", "lam", "group"))(
     _eval_keylanes_bytes
 )
 _stage_xs_jit = jax.jit(_xs_to_mask_dev)
 _planes_to_bytes_jit = partial(jax.jit, static_argnames=("lam",))(
     _planes_to_bytes_dev
 )
-_eval_core_jit = partial(jax.jit, static_argnames=("b", "lam"))(
+_eval_core_jit = partial(jax.jit, static_argnames=("b", "lam", "group"))(
     eval_core_bitsliced
 )
 
@@ -364,6 +402,7 @@ class _BitslicedBase:
         lbm[(lam - 1) * 8] = 0  # clears the PRG's 8*lam-1 masked bit plane
         self._last_bit_mask = jnp.asarray(lbm)
         self._bundle_dev = None
+        self._group = "xor"
 
 
 def bundle_plane_arrays(bundle: KeyBundle) -> dict:
@@ -405,6 +444,7 @@ class BitslicedBackend(_BitslicedBase):
         self._bundle_dev = {
             k: jnp.asarray(v) for k, v in bundle_plane_arrays(bundle).items()
         }
+        self._group = bundle.group
 
     def stage(self, xs: np.ndarray) -> dict:
         """Ship xs to device as walk-order lane masks (criterion-setup analog).
@@ -470,7 +510,7 @@ class BitslicedBackend(_BitslicedBase):
         return _eval_core_jit(
             self.rk_masks, self._last_bit_mask, dev["s0"], dev["cw_s"],
             dev["cw_v"], dev["cw_tl"], dev["cw_tr"], dev["cw_np1"],
-            staged["x_mask"], b=int(b), lam=self.lam,
+            staged["x_mask"], b=int(b), lam=self.lam, group=self._group,
         )
 
     def staged_to_bytes(self, y_planes: jax.Array, m: int) -> np.ndarray:
@@ -504,6 +544,7 @@ class BitslicedBackend(_BitslicedBase):
             jnp.asarray(xs),
             b=int(b),
             lam=self.lam,
+            group=self._group,
         )  # uint8 [K, m_pad, lam]
         return np.asarray(y[:, :m, :])
 
@@ -547,6 +588,7 @@ class KeyLanesBackend(_BitslicedBase):
             cw_tr=packed(pad_keys(bundle.cw_t[:, :, 1]).T),
             cw_np1=packed(byte_bits_lsb(pad_keys(bundle.cw_np1)).T),
         )
+        self._group = bundle.group
 
     def eval(
         self, b: int, xs: np.ndarray, bundle: KeyBundle | None = None
@@ -577,5 +619,6 @@ class KeyLanesBackend(_BitslicedBase):
             jnp.asarray(np.ascontiguousarray(xs)),
             b=int(b),
             lam=self.lam,
+            group=self._group,
         )  # uint8 [M, K_pad, lam]
         return np.asarray(y).transpose(1, 0, 2)[: self._num_keys]

@@ -514,6 +514,14 @@ class LargeLambdaBackend(FrontierConsumerMixin):
         the host bundle's wide halves."""
         if bundle.lam != self.lam:
             raise ShapeError("bundle lam mismatch")
+        if bundle.group != "xor":
+            # api-edge: documented group contract — the wide part is a
+            # GF(2) affine decomposition of the payload (XOR-linear by
+            # construction); an additive payload does not factor through
+            # it.  Additive groups use lam=16 and the point-lane backends.
+            raise ShapeError(
+                f"LargeLambdaBackend is XOR-only; bundle has group "
+                f"{bundle.group!r}")
         if bundle.s0s.shape[1] != 1:
             raise ShapeError(
                 "LargeLambdaBackend wants a party-restricted bundle")

@@ -14,6 +14,7 @@ import numpy as np
 from dcf_tpu.errors import ShapeError
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.prg import HirosePrgNp
+from dcf_tpu.utils.groups import lanes_of, np_group_neg
 
 __all__ = ["eval_batch_np"]
 
@@ -28,8 +29,15 @@ def eval_batch_np(
 
     xs: uint8 [M, n_bytes] (shared by all keys) or [K, M, n_bytes].
     Returns uint8 [K, M, lam].
+
+    The bundle's output group picks the value accumulation: XOR, or the
+    per-lane signed add of Boyle et al. Fig. 1 — the party sign
+    ``(-1)^b`` factors out of every level, so the walk accumulates
+    unsigned and party 1 negates once at the output edge (the signed
+    share contract: reconstruction is always ``group_add(y0, y1)``).
     """
     k_num, n, lam = bundle.cw_s.shape
+    group = bundle.group
     if xs.ndim == 2:
         xs = np.broadcast_to(xs, (k_num, *xs.shape))
     if xs.shape[0] != k_num or xs.shape[2] * 8 != n:
@@ -56,8 +64,21 @@ def eval_batch_np(
         t_r = p.t_r ^ (t & cw_tr)
         x_i = x_bits[:, :, i]  # [K, M], 1 -> right
         xb = x_i[..., None].astype(bool)
-        v ^= np.where(xb, p.v_r, p.v_l) ^ cw_v * t_mask
+        if group == "xor":
+            v ^= np.where(xb, p.v_r, p.v_l) ^ cw_v * t_mask
+        else:
+            v_hat = np.where(xb, p.v_r, p.v_l)
+            lv = lanes_of(v, group)
+            lv += lanes_of(v_hat, group)
+            lv += (lanes_of(np.ascontiguousarray(cw_v), group)
+                   * t_mask.astype(lv.dtype))
         s = np.where(xb, s_r, s_l)
         t = np.where(x_i.astype(bool), t_r, t_l)
 
-    return v ^ s ^ bundle.cw_np1[:, None, :] * t[..., None]
+    if group == "xor":
+        return v ^ s ^ bundle.cw_np1[:, None, :] * t[..., None]
+    lv = lanes_of(v, group)
+    lv += lanes_of(np.ascontiguousarray(s), group)
+    lv += (lanes_of(np.ascontiguousarray(bundle.cw_np1[:, None, :]), group)
+           * t[..., None].astype(lv.dtype))
+    return np_group_neg(v, group) if b else v

@@ -50,12 +50,13 @@ def _stage_xs(xs):
     )[:, :, None, :]
 
 
-@partial(jax.jit, static_argnames=("b", "tile_words", "interpret"))
+@partial(jax.jit, static_argnames=("b", "tile_words", "interpret", "group"))
 def _eval_staged(rk, s0_t, cw_s_t, cw_v_t, cw_np1_t, cw_t, x_mask,
-                 b: int, tile_words: int, interpret: bool):
+                 b: int, tile_words: int, interpret: bool,
+                 group: str = "xor"):
     return dcf_eval_pallas(
         rk, s0_t, cw_s_t, cw_v_t, cw_np1_t, cw_t, x_mask,
-        b=b, tile_words=tile_words, interpret=interpret,
+        b=b, tile_words=tile_words, interpret=interpret, group=group,
     )
 
 
@@ -132,12 +133,13 @@ def _from_planes_jit(y_planes, inv_perm):
     return _planes_to_bytes_dev(y, 16)
 
 
-@partial(jax.jit, static_argnames=("b", "tile_words", "interpret"))
+@partial(jax.jit, static_argnames=("b", "tile_words", "interpret", "group"))
 def _eval_bytes(rk, s0_t, cw_s_t, cw_v_t, cw_np1_t, cw_t, xs, inv_perm,
-                b: int, tile_words: int, interpret: bool):
+                b: int, tile_words: int, interpret: bool,
+                group: str = "xor"):
     y_bm = _eval_staged(
         rk, s0_t, cw_s_t, cw_v_t, cw_np1_t, cw_t, _stage_xs(xs),
-        b=b, tile_words=tile_words, interpret=interpret,
+        b=b, tile_words=tile_words, interpret=interpret, group=group,
     )
     return _from_planes_jit(y_bm, inv_perm)
 
@@ -163,6 +165,7 @@ class PallasBackend:
         self.rk = jnp.asarray(round_key_masks_bitmajor(cipher_keys[used[0]]))
         self._inv_perm = jnp.asarray(_INV_PERM)
         self._bundle_dev = None
+        self._group = "xor"
 
     def put_bundle(self, bundle: KeyBundle) -> None:
         """Ship a party-restricted bundle as bit-major plane masks.
@@ -190,6 +193,7 @@ class PallasBackend:
             cw_t=np.ascontiguousarray(bundle.cw_t.astype(np.int32) * -1),
         )
         self._bundle_dev = {k: self._put_plane(k, v) for k, v in host.items()}
+        self._group = bundle.group
 
     def _put_plane(self, name: str, arr: np.ndarray) -> jax.Array:
         """Placement hook for one staged bundle array (single device here)."""
@@ -317,6 +321,7 @@ class PallasBackend:
             self.rk, dev["s0"], dev["cw_s"], dev["cw_v"], dev["cw_np1"],
             dev["cw_t"], staged["x_mask"], b=int(b),
             tile_words=staged["wt"], interpret=self.interpret,
+            group=self._group,
         )
 
     def convert_staged(self, y_planes: jax.Array) -> jax.Array:
@@ -348,6 +353,6 @@ class PallasBackend:
             self.rk, dev["s0"], dev["cw_s"], dev["cw_v"], dev["cw_np1"],
             dev["cw_t"], jnp.asarray(xs),
             self._inv_perm, b=int(b), tile_words=wt,
-            interpret=self.interpret,
+            interpret=self.interpret, group=self._group,
         )
         return np.asarray(y[:, :m, :])

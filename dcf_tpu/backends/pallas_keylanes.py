@@ -162,6 +162,15 @@ class KeyLanesPallasBackend:
         KeyBundle into the device layout."""
         if bundle.lam != self.lam:
             raise ShapeError("bundle lam mismatch")
+        if bundle.group != "xor":
+            # api-edge: documented group contract — the key-lanes kernel
+            # packs 32 KEYS per lane word, so a per-key additive carry
+            # would ripple across the packed key axis; additive bundles
+            # route to the point-lane backends instead.
+            raise ShapeError(
+                f"KeyLanesPallasBackend is XOR-only; bundle has group "
+                f"{bundle.group!r} (use the pallas/bitsliced/prefix "
+                f"point-lane backends for additive groups)")
         if bundle.s0s.shape[1] != 2:
             raise ShapeError(
                 "KeyLanesPallasBackend wants the full two-party bundle")

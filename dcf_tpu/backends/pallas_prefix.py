@@ -99,7 +99,8 @@ def _stage_prefix_idx(xs, k: int):
 
 def gather_and_walk(rk, table, idx, cw_s_r, cw_v_r, cw_np1, cw_t_r,
                     x_mask_rem, *, tile_words: int, interpret: bool,
-                    k_num: int = 1, frontier_size: int = 0):
+                    k_num: int = 1, frontier_size: int = 0,
+                    group: str = "xor", negate: bool = False):
     """Gather rows, relayout, walk n-k levels — unjitted so
     ``parallel.ShardedPrefixBackend`` can wrap it in ``shard_map`` (the
     gather is a pure per-point map against the replicated frontier
@@ -124,12 +125,14 @@ def gather_and_walk(rk, table, idx, cw_s_r, cw_v_r, cw_np1, cw_t_r,
     vrows = blk[:, 4:]
     return dcf_eval_prefix_pallas(
         rk, srows, vrows, cw_s_r, cw_v_r, cw_np1, cw_t_r, x_mask_rem,
-        tile_words=tile_words, interpret=interpret)
+        tile_words=tile_words, interpret=interpret, group=group,
+        negate=negate)
 
 
 _eval_prefix_staged = partial(
     jax.jit, static_argnames=("tile_words", "interpret", "k_num",
-                              "frontier_size"))(gather_and_walk)
+                              "frontier_size", "group", "negate"))(
+    gather_and_walk)
 
 
 class PrefixPallasBackend(FrontierConsumerMixin, PallasBackend):
@@ -202,7 +205,7 @@ class PrefixPallasBackend(FrontierConsumerMixin, PallasBackend):
         per_key = KeyBundle(
             s0s=kb.s0s[key:key + 1], cw_s=kb.cw_s[key:key + 1],
             cw_v=kb.cw_v[key:key + 1], cw_t=kb.cw_t[key:key + 1],
-            cw_np1=kb.cw_np1[key:key + 1])
+            cw_np1=kb.cw_np1[key:key + 1], group=kb.group)
         s, v, t = tree_expand_np(self._prg, per_key, int(b), k0)
 
         def planes(a):  # [N, 16] -> int32 [128, N/32]
@@ -215,7 +218,7 @@ class PrefixPallasBackend(FrontierConsumerMixin, PallasBackend):
         s_p, v_p, t_p = tree_expand_raw(
             self.rk, dev["cw_s"][key], dev["cw_v"][key], dev["cw_t"][key],
             planes(s), planes(v), t_pm,
-            k0=k0, k1=k, interpret=self.interpret)
+            k0=k0, k1=k, interpret=self.interpret, group=self._group)
         # Stash t in plane 15 of s: structurally zero there (the Hirose
         # 8*lam-1 mask clears it in every PRG output, and cw_s XORs of
         # masked outputs preserve that; k >= 1 guarantees at least one
@@ -298,7 +301,9 @@ class PrefixPallasBackend(FrontierConsumerMixin, PallasBackend):
             cw_s_r, cw_v_r, self._bundle_dev["cw_np1"],
             cw_t_r, staged["x_mask_rem"],
             tile_words=staged["wt"], interpret=self.interpret,
-            k_num=self._dims()[0], frontier_size=1 << self._k())
+            k_num=self._dims()[0], frontier_size=1 << self._k(),
+            group=self._group,
+            negate=bool(b) and self._group != "xor")
 
     def eval(self, b: int, xs: np.ndarray,
              bundle: KeyBundle | None = None) -> np.ndarray:

@@ -57,6 +57,14 @@ JSONL line records served points/s, the staged ``MicEvaluator``
 equivalent, and ``vs_baseline`` against the pinned single-core
 numpy-oracle denominator (CPU_BASELINE.md).
 
+plus ``gate_bench`` — the fixed-point gate suite (ISSUE 20,
+``protocols.fixedpoint`` + ``workloads.gates``): spline sigmoid,
+faithful truncation and signed comparison served through
+``GateServer`` in the ``add16`` output group, parity-gated against
+the clear-input numpy gate oracles before timing
+(``benchmarks/RESULTS_gates.jsonl``; pinned denominators
+``gates.sigmoid_m8`` / ``gates.trunc``).
+
 plus ``chaos_bench`` — the serve resilience layer (ISSUE 6): a
 mixed-priority closed-loop load under a declarative fail-N-then-recover
 fault schedule at the ``serve.eval`` seam, with exit-code assertions on
@@ -1868,6 +1876,162 @@ def bench_mic(args) -> None:
         unit += " [no TPU this session: interpret/CPU mode, disclosed]"
     _emit("mic_bench", args.backend, "points_per_sec",
           res.throughput, unit, extra_fields=extra)
+
+
+def _gates_pinned_ratio(tag: str, rate: float,
+                        baseline_path: str | None = None) -> dict:
+    """vs_baseline for gate_bench: the pinned SINGLE-CORE NUMPY
+    GATE-ORACLE denominator (``benchmarks/cpu_baseline.json`` key
+    ``gates.<tag>``, CPU_BASELINE.md protocol) — what the
+    obviously-correct host implementation computes for the same gate on
+    the clear input.  Empty when no pin exists for this tag (no silent
+    in-run fallback); the ratio is kept for XLA-CPU runs with the
+    platform disclosed on the same JSONL line (mic_bench precedent)."""
+    pinned = _load_pinned(baseline_path)
+    if pinned is None:
+        return {}
+    entry = pinned.get("gates", {}).get(tag)
+    if not entry:
+        return {}
+    # 6 decimals: the clear-input oracle does no crypto at all, so the
+    # served-interpret ratio is honestly tiny (~1e-4) — 2 decimals
+    # would round the disclosure to a meaningless 0.0.
+    return {"vs_baseline": round(rate / entry["points_per_sec"], 6),
+            "baseline": f"pinned single-core numpy gate oracle "
+                        f"gates.{tag} "
+                        f"({entry['points_per_sec']:,.0f} points/s, "
+                        "CPU_BASELINE.md protocol)"}
+
+
+def bench_gates(args) -> None:
+    """Served fixed-point gate bench (ISSUE 20): spline sigmoid +
+    faithful truncation + signed comparison through ``GateServer``.
+
+    Dealer-side: one gate of each kind on the 16-bit fixed-point
+    domain (f=8 fractional bits, ``add16`` output group) with fresh
+    input masks; their component interval bundles register in a
+    ``DcfService`` pair (full domain + the truncation gate's low-byte
+    domain).  Parity is gated BEFORE timing: every gate reconstructs
+    bit-exactly against its clear-input numpy oracle
+    (``protocols.fixedpoint``) on a served two-party sample.  The
+    timed legs measure party 0's SERVED share rate per gate — submit,
+    service combine, client-side gate fold — on one fixed batch; the
+    sigmoid rate is the headline ``value`` (it is the deepest
+    composition: m-piece MIC + group reduce), truncation and sign ride
+    as fields on the same line, each with its ``vs_baseline`` against
+    the pinned single-core numpy gate oracle when a pin exists.
+    """
+    from dcf_tpu import Dcf
+    from dcf_tpu.protocols import (
+        gen_sigmoid_gate, gen_sign_gate, gen_trunc_gate,
+        sigmoid_fixed_oracle, sign_oracle, trunc_oracle)
+    from dcf_tpu.protocols.fixedpoint import decode_lanes
+    from dcf_tpu.workloads import GateServer
+
+    lam, nb, f_bits, group = 16, 2, 8, "add16"
+    if args.backend not in ("numpy", "jax", "bitsliced", "pallas",
+                            "prefix"):
+        raise SystemExit(
+            f"gate_bench serves lam=16 single-device facade backends "
+            f"(numpy/jax/bitsliced/pallas/prefix), got {args.backend!r}")
+    m_pieces = args.intervals or 8
+    points = args.points or 4096
+    n_total = 1 << (8 * nb)
+    rng = np.random.default_rng(args.seed)
+    ck = _cipher_keys(lam, rng)
+    dcf = Dcf(nb, lam, ck, backend=args.backend)
+    dcf_low = Dcf(1, lam, ck, backend=args.backend)
+
+    r_sig = int(rng.integers(0, n_total))
+    r_tr = int(rng.integers(0, n_total))
+    r_sgn = int(rng.integers(0, n_total))
+    log(f"gen gates: sigmoid m={m_pieces} f={f_bits}, trunc f={f_bits}, "
+        f"sign — {group} group, {8 * nb}-bit domain")
+    sig = gen_sigmoid_gate(dcf, r_sig, rng, group, f=f_bits, m=m_pieces)
+    tr = gen_trunc_gate(dcf, dcf_low, r_tr, f_bits, rng, group)
+    sgn = gen_sign_gate(dcf, r_sgn, rng, group)
+
+    max_batch = args.max_batch or (1 << 14)
+    svc = dcf.serve(max_batch=max_batch, max_delay_ms=args.max_delay_ms,
+                    device_bytes_budget=args.device_bytes_budget)
+    svc_low = dcf_low.serve(max_batch=max_batch,
+                            max_delay_ms=args.max_delay_ms)
+    gs = GateServer(svc, svc_low)
+    gs.register("sigmoid", sig)
+    gs.register("trunc", tr)
+    gs.register("sign", sgn)
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    interp = (platform != "tpu"
+              or bool(getattr(dcf.eval_backend(0), "interpret", False)))
+    with svc, svc_low:
+        # Parity gates: two-party SERVED reconstruction vs the clear
+        # oracles, before any timing.
+        x_check = rng.integers(0, n_total, size=512, dtype=np.int64)
+        got = decode_lanes(gs.reconstruct("sigmoid", x_check), group)
+        want = sigmoid_fixed_oracle((x_check - r_sig) % n_total,
+                                    sig.cuts, sig.values)
+        if not np.array_equal(got, want):
+            raise SystemExit(
+                "gate_bench sigmoid parity mismatch vs the numpy oracle")
+        got = decode_lanes(gs.reconstruct("trunc", x_check), group)
+        if not np.array_equal(got, trunc_oracle(x_check, r_tr, f_bits,
+                                                8 * nb)):
+            raise SystemExit(
+                "gate_bench trunc parity mismatch vs the numpy oracle")
+        got = decode_lanes(gs.reconstruct("sign", x_check), group)
+        if not np.array_equal(got, sign_oracle((x_check - r_sgn)
+                                               % n_total, 8 * nb)):
+            raise SystemExit(
+                "gate_bench sign parity mismatch vs the numpy oracle")
+        log("parity vs numpy gate oracles: OK (3 gates x 512 pts, "
+            "two-party, served)")
+
+        x_bench = rng.integers(0, n_total, size=points, dtype=np.int64)
+        rates = {}
+        meds = {}
+        for gate_id in ("sigmoid", "trunc", "sign"):
+            gs.eval_share(gate_id, 0, x_bench)  # warm the timed shape
+            dt, mad, ss = _timed(
+                lambda g=gate_id: gs.eval_share(g, 0, x_bench),
+                args.reps)
+            rates[gate_id] = points / dt
+            meds[gate_id] = (dt, mad, len(ss))
+            log(f"served {gate_id} gate: {rates[gate_id]:,.1f} points/s "
+                f"(median {dt * 1e3:.1f} ms +- {mad * 1e3:.1f} ms, "
+                f"{len(ss)} samples)")
+    snap = svc.metrics_snapshot()
+
+    extra = {
+        "points": points,
+        "pieces": m_pieces,
+        "frac_bits": f_bits,
+        "group": group,
+        "domain_bits": 8 * nb,
+        "max_batch": max_batch,
+        "trunc_points_per_sec": round(rates["trunc"], 1),
+        "sign_points_per_sec": round(rates["sign"], 1),
+        "platform": platform,
+        "interpreted": interp,
+        "metrics_snapshot": snap,
+        "repro": (f"python -m dcf_tpu.cli gate_bench --backend pallas "
+                  f"--points {points} --intervals {m_pieces} "
+                  f"--seed {args.seed}"),
+        **_gates_pinned_ratio(f"sigmoid_m{m_pieces}", rates["sigmoid"]),
+    }
+    tr_pin = _gates_pinned_ratio("trunc", rates["trunc"])
+    if tr_pin:
+        extra["trunc_vs_baseline"] = tr_pin["vs_baseline"]
+    unit = (f"points/s (served spline-sigmoid gate, party 0 share, "
+            f"m={m_pieces} pieces, f={f_bits}, {group})")
+    if interp:
+        unit += " [no TPU this session: interpret/CPU mode, disclosed]"
+    dt, mad, n_samples = meds["sigmoid"]
+    _emit("gate_bench", args.backend, "points_per_sec",
+          rates["sigmoid"], unit, med_s=dt, mad_s=mad,
+          samples=n_samples, extra_fields=extra)
 
 
 def bench_keygen(args) -> None:
@@ -5624,6 +5788,7 @@ BENCHES = {
     "serve_bench": bench_serve,
     "edge_bench": bench_edge,
     "mic_bench": bench_mic,
+    "gate_bench": bench_gates,
     "chaos_bench": bench_chaos,
     "keygen_bench": bench_keygen,
     "pir_bench": bench_pir,

@@ -43,13 +43,15 @@ warned via ``errors.BackendFallbackWarning``, and injectable at the
 from __future__ import annotations
 
 import warnings
+from functools import partial
 
 import numpy as np
 
 from dcf_tpu.errors import BackendFallbackWarning, ShapeError
 from dcf_tpu.keys import KeyBundle
 from dcf_tpu.ops.prg import HirosePrgNp
-from dcf_tpu.spec import Bound
+from dcf_tpu.spec import Bound, check_group
+from dcf_tpu.utils.groups import bytes_of, lanes_of
 
 __all__ = ["gen_batch", "gen_on_device", "gen_on_device_with_planes",
            "random_s0s", "device_fallback_count"]
@@ -92,16 +94,24 @@ def gen_batch(
     betas: np.ndarray,
     s0s: np.ndarray,
     bound: Bound,
+    group: str = "xor",
 ) -> KeyBundle:
     """Generate K DCF keys at once (host numpy walk).
 
     alphas: uint8 [K, n_bytes]; betas: uint8 [K, lam]; s0s: uint8 [K, 2, lam].
     Returns a two-party KeyBundle (s0s retained with P=2).
+
+    ``group`` selects the output group (spec.GROUPS).  The tree walk
+    (seeds, t-bits) is group-independent; the additive groups change only
+    the value correction-word algebra (Boyle et al. Fig. 1 — see
+    ``spec.gen``), vectorized here in the little-endian lane domain.
     """
     lam = prg.lam
+    check_group(group, lam)
     _check_gen_inputs(alphas, betas, s0s, lam)
     k_num, n_bytes = alphas.shape
     n = 8 * n_bytes
+    additive = group != "xor"
     # MSB-first bit planes of alpha: uint8 [K, n] (np.unpackbits is MSB-first,
     # matching the reference's Msb0 bit view at src/lib.rs:106).
     alpha_bits = np.unpackbits(alphas, axis=1)
@@ -111,6 +121,10 @@ def gen_batch(
     t_a = np.zeros(k_num, dtype=np.uint8)  # t^(0)_0 = 0
     t_b = np.ones(k_num, dtype=np.uint8)  # t^(0)_1 = 1
     v_alpha = np.zeros((k_num, lam), dtype=np.uint8)
+    if additive:
+        lanes = partial(lanes_of, group=group)
+        va = lanes(v_alpha)  # lane-domain V_alpha accumulator
+        betas_l = lanes(betas)
 
     cw_s = np.zeros((k_num, n, lam), dtype=np.uint8)
     cw_v = np.zeros((k_num, n, lam), dtype=np.uint8)
@@ -123,17 +137,33 @@ def gen_batch(
         # lose side: R when a_i == 0, L when a_i == 1.
         lose_is_r = (a_i ^ 1).astype(np.uint8)
         s_cw = _sel(p0.s_l, p0.s_r, lose_is_r) ^ _sel(p1.s_l, p1.s_r, lose_is_r)
-        v_cw = (
-            _sel(p0.v_l, p0.v_r, lose_is_r)
-            ^ _sel(p1.v_l, p1.v_r, lose_is_r)
-            ^ v_alpha
-        )
         # beta folds into v_cw when the lose side matches the bound
         # (src/lib.rs:114-125): LT_BETA on lose==L (a_i==1), GT_BETA on
         # lose==R (a_i==0).
         beta_gate = a_i if bound is Bound.LT_BETA else (a_i ^ 1)
-        v_cw ^= betas * beta_gate[:, None]
-        v_alpha ^= _sel(p0.v_l, p0.v_r, a_i) ^ _sel(p1.v_l, p1.v_r, a_i) ^ v_cw
+        if not additive:
+            v_cw = (
+                _sel(p0.v_l, p0.v_r, lose_is_r)
+                ^ _sel(p1.v_l, p1.v_r, lose_is_r)
+                ^ v_alpha
+            )
+            v_cw ^= betas * beta_gate[:, None]
+            v_alpha ^= (_sel(p0.v_l, p0.v_r, a_i)
+                        ^ _sel(p1.v_l, p1.v_r, a_i) ^ v_cw)
+        else:
+            # V_CW <- (-1)^{t1} * [Convert(v1_lose) - Convert(v0_lose)
+            #                      - V_alpha + beta_gate * beta]
+            sign = t_b.astype(bool)[:, None]  # party 1's t on the alpha path
+            vcw_l = (lanes(_sel(p1.v_l, p1.v_r, lose_is_r))
+                     - lanes(_sel(p0.v_l, p0.v_r, lose_is_r)) - va
+                     + betas_l * beta_gate[:, None].astype(betas_l.dtype))
+            vcw_l = np.where(sign, -vcw_l, vcw_l)
+            # V_alpha <- V_alpha - Convert(v1_keep) + Convert(v0_keep)
+            #            + (-1)^{t1} * V_CW
+            va = (va - lanes(_sel(p1.v_l, p1.v_r, a_i))
+                  + lanes(_sel(p0.v_l, p0.v_r, a_i))
+                  + np.where(sign, -vcw_l, vcw_l))
+            v_cw = bytes_of(vcw_l, group)
         tl_cw = p0.t_l ^ p1.t_l ^ a_i ^ 1
         tr_cw = p0.t_r ^ p1.t_r ^ a_i
         cw_s[:, i] = s_cw
@@ -147,9 +177,16 @@ def gen_batch(
         new_t_b = _sel(p1.t_l, p1.t_r, a_i) ^ (t_b & t_cw_keep)
         s_a, s_b, t_a, t_b = new_s_a, new_s_b, new_t_a, new_t_b
 
-    cw_np1 = s_a ^ s_b ^ v_alpha
+    if not additive:
+        cw_np1 = s_a ^ s_b ^ v_alpha
+    else:
+        # CW_{n+1} <- (-1)^{t1_n} * [Convert(s1_n) - Convert(s0_n) - V_alpha]
+        last = lanes(s_b) - lanes(s_a) - va
+        cw_np1 = bytes_of(
+            np.where(t_b.astype(bool)[:, None], -last, last), group)
     return KeyBundle(
-        s0s=s0s.copy(), cw_s=cw_s, cw_v=cw_v, cw_t=cw_t, cw_np1=cw_np1
+        s0s=s0s.copy(), cw_s=cw_s, cw_v=cw_v, cw_t=cw_t, cw_np1=cw_np1,
+        group=group,
     )
 
 
@@ -198,10 +235,17 @@ def gen_on_device(
     s0s: np.ndarray,
     bound: Bound,
     *,
+    group: str = "xor",
     interpret: bool | None = None,
     tile_words: int = 128,
 ) -> KeyBundle:
     """Generate K keys with the GGM level walk ON the accelerator.
+
+    ``group`` other than ``"xor"`` routes to the host ``gen_batch`` walk
+    directly (NOT a counted fallback): the device keygen kernels and the
+    C++ native core implement the characteristic-2 correction-word
+    algebra only, while the additive groups need the signed lane algebra
+    — a documented routing decision, not a failure.
 
     Routes lam >= 48 to the Pallas narrow keygen kernel + affine wide
     tail (``ops.pallas_keygen`` — one shared level-walk core with the
@@ -217,6 +261,13 @@ def gen_on_device(
     silent-correct, counted (``device_fallback_count``), warned once per
     call via ``BackendFallbackWarning``.
     """
+    if group != "xor":
+        check_group(group, lam)
+        _check_gen_inputs(alphas, betas, s0s, lam)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            prg = HirosePrgNp(lam, cipher_keys)
+        return gen_batch(prg, alphas, betas, s0s, bound, group)
     return _gen_on_device(lam, cipher_keys, alphas, betas, s0s, bound,
                           interpret=interpret, tile_words=tile_words,
                           want_planes=False)[0]

@@ -41,7 +41,12 @@ still read for compatibility.  Version 3 (``dcf_tpu.protocols``) adds a
 uint16 ``proto`` field after ``lam``: proto=0 frames decode here
 unchanged, proto!=0 frames carry a trailing protocol section (interval
 combine masks) and are refused with a pointer at
-``protocols.ProtocolBundle.from_bytes``.  Decoding is strict either way: the header
+``protocols.ProtocolBundle.from_bytes``.  Version 4 (PR 20) adds a
+uint16 ``group`` field after ``proto`` — the output-group code
+(``spec.GROUP_CODE``); only additive bundles write v4 (XOR stays v2,
+byte-identical to earlier releases), so pre-v4 readers refuse additive
+frames with "unsupported version" instead of silently reconstructing
+with the wrong group.  Decoding is strict either way: the header
 is bounds-checked field by field, every section must fit, the total size
 must match exactly, and any violation raises
 ``errors.KeyFormatError`` naming the offending field — a two-party FSS
@@ -75,6 +80,16 @@ _CRC_SIZE = 4
 _VERSION_PROTO = 3
 _HEADER3 = "<HHIIHH"  # version, P, K, n, lam, proto
 _HEADER3_SIZE = 4 + struct.calcsize(_HEADER3)
+# Version 4 (PR 20): the v3 header plus a uint16 ``group`` field — the
+# output-group code from ``spec.GROUP_CODE`` (0 = xor, 1/2/3 = add8/16/32
+# little-endian lanes over the lam payload bytes).  XOR bundles keep
+# writing v2 frames (byte-identical to every earlier release); only
+# additive bundles emit v4, so a v3-era reader refuses them loudly
+# ("unsupported version 4") instead of silently reconstructing with the
+# wrong group.
+_VERSION_GROUP = 4
+_HEADER4 = "<HHIIHHH"  # version, P, K, n, lam, proto, group
+_HEADER4_SIZE = 4 + struct.calcsize(_HEADER4)
 
 
 def _decode_sections(data: bytes, sections, header_size: int,
@@ -136,9 +151,15 @@ class KeyBundle:
     cw_v: np.ndarray  # uint8 [K, n, lam]
     cw_t: np.ndarray  # uint8 [K, n, 2]
     cw_np1: np.ndarray  # uint8 [K, lam]
+    group: str = "xor"  # output group (spec.GROUPS); wire v4 when additive
 
     def __post_init__(self):
         k, n, lam = self.cw_s.shape
+        try:
+            spec.check_group(self.group, lam)
+        except ValueError as e:
+            # constructor edge: group/geometry mismatch is a shape defect
+            raise ShapeError(str(e)) from None
         if self.s0s.shape[0] != k or self.s0s.shape[2] != lam:
             raise ShapeError("s0s shape mismatch")
         if self.s0s.shape[1] not in (1, 2):
@@ -169,7 +190,7 @@ class KeyBundle:
             for a in (self.s0s, self.cw_s, self.cw_v, self.cw_t,
                       self.cw_np1))
         return (f"KeyBundle(K={k}, n_bits={n}, lam={lam}, "
-                f"parties={self.s0s.shape[1]}, "
+                f"parties={self.s0s.shape[1]}, group={self.group}, "
                 f"<{secret_bytes} key-material bytes redacted>)")
 
     @property
@@ -201,6 +222,7 @@ class KeyBundle:
             cw_v=self.cw_v,
             cw_t=self.cw_t,
             cw_np1=self.cw_np1,
+            group=self.group,
         )
 
     def level_major(self) -> dict[str, np.ndarray]:
@@ -224,7 +246,9 @@ class KeyBundle:
     # -- spec interop -------------------------------------------------------
 
     @classmethod
-    def from_shares(cls, shares: list[spec.Share]) -> "KeyBundle":
+    def from_shares(
+        cls, shares: list[spec.Share], group: str = "xor"
+    ) -> "KeyBundle":
         k = len(shares)
         n = len(shares[0].cws)
         lam = len(shares[0].cw_np1)
@@ -242,7 +266,7 @@ class KeyBundle:
                 cw_v[i, j] = np.frombuffer(cw.v, dtype=np.uint8)
                 cw_t[i, j] = (cw.tl, cw.tr)
             cw_np1[i] = np.frombuffer(sh.cw_np1, dtype=np.uint8)
-        return cls(s0s, cw_s, cw_v, cw_t, cw_np1)
+        return cls(s0s, cw_s, cw_v, cw_t, cw_np1, group)
 
     def to_shares(self) -> list[spec.Share]:
         out = []
@@ -268,11 +292,23 @@ class KeyBundle:
     # -- codecs -------------------------------------------------------------
 
     def to_bytes(self) -> bytes:
-        """Flat framed binary: header + raw SoA arrays + CRC32 trailer (v2)."""
+        """Flat framed binary: header + raw SoA arrays + CRC32 trailer.
+
+        XOR bundles emit version-2 frames (byte-identical to earlier
+        releases); additive bundles emit version-4 frames whose header
+        carries the group code — old readers refuse them typed instead
+        of reconstructing with the wrong group.
+        """
         k, p = self.s0s.shape[0], self.s0s.shape[1]
-        header = _MAGIC + struct.pack(
-            _HEADER, _VERSION, p, k, self.n_bits, self.lam
-        )
+        if self.group == "xor":
+            header = _MAGIC + struct.pack(
+                _HEADER, _VERSION, p, k, self.n_bits, self.lam
+            )
+        else:
+            header = _MAGIC + struct.pack(
+                _HEADER4, _VERSION_GROUP, p, k, self.n_bits, self.lam,
+                0, spec.GROUP_CODE[self.group]
+            )
         body = b"".join(
             [
                 header,
@@ -303,7 +339,32 @@ class KeyBundle:
                 f"header needs {_HEADER_SIZE}")
         version, p, k, n, lam = struct.unpack_from(_HEADER, data, 4)
         header_size = _HEADER_SIZE
-        if version == _VERSION_PROTO:
+        group = "xor"
+        if version == _VERSION_GROUP:
+            if len(data) < _HEADER4_SIZE:
+                raise KeyFormatError(
+                    f"truncated header: frame is {len(data)} bytes, the "
+                    f"DCFK v4 header needs {_HEADER4_SIZE}")
+            version, p, k, n, lam, proto, group_code = struct.unpack_from(
+                _HEADER4, data, 4)
+            header_size = _HEADER4_SIZE
+            if proto != 0:
+                raise KeyFormatError(
+                    f"frame carries protocol section {proto}; decode with "
+                    "the dcf_tpu.protocols bundle readers — reading it as "
+                    "a plain bundle would misparse the sections")
+            if group_code not in spec.GROUP_FROM_CODE:
+                raise KeyFormatError(
+                    f"unknown output-group code {group_code} (this reader "
+                    f"handles {sorted(spec.GROUP_FROM_CODE)}); refusing to "
+                    "guess a reconstruction group for key material")
+            group = spec.GROUP_FROM_CODE[group_code]
+            if group != "xor" and (8 * lam) % spec.GROUP_WIDTH[group]:
+                raise KeyFormatError(
+                    f"group {group!r} needs lam*8={8 * lam} divisible by "
+                    f"{spec.GROUP_WIDTH[group]} — corrupt or mismatched "
+                    "header fields")
+        elif version == _VERSION_PROTO:
             if len(data) < _HEADER3_SIZE:
                 raise KeyFormatError(
                     f"truncated header: frame is {len(data)} bytes, the "
@@ -329,7 +390,7 @@ class KeyBundle:
         elif version not in (1, _VERSION):
             raise KeyFormatError(
                 f"unsupported version {version} (this reader handles "
-                f"1..{_VERSION_PROTO})")
+                f"1..{_VERSION_GROUP})")
         if p not in (1, 2):
             raise KeyFormatError(f"parties field must be 1 or 2, got {p}")
         if n == 0 or n % 8:
@@ -348,7 +409,7 @@ class KeyBundle:
             data, sections, header_size,
             _CRC_SIZE if version >= 2 else 0,
             f"K={k}, P={p}, n={n}, lam={lam}")
-        return cls(*(arrays[name] for name, _ in sections))
+        return cls(*(arrays[name] for name, _ in sections), group=group)
 
     def save(self, path: str) -> None:
         if path.endswith(".npz"):
@@ -359,6 +420,7 @@ class KeyBundle:
                 cw_v=self.cw_v,
                 cw_t=self.cw_t,
                 cw_np1=self.cw_np1,
+                group=np.uint16(spec.GROUP_CODE[self.group]),
             )
         else:
             with open(path, "wb") as fh:
@@ -368,6 +430,9 @@ class KeyBundle:
     def load(cls, path: str) -> "KeyBundle":
         if path.endswith(".npz"):
             z = np.load(path)
-            return cls(z["s0s"], z["cw_s"], z["cw_v"], z["cw_t"], z["cw_np1"])
+            group = (spec.GROUP_FROM_CODE[int(z["group"])]
+                     if "group" in z.files else "xor")
+            return cls(z["s0s"], z["cw_s"], z["cw_v"], z["cw_t"],
+                       z["cw_np1"], group)
         with open(path, "rb") as fh:
             return cls.from_bytes(fh.read())

@@ -1,0 +1,162 @@
+"""Mod-2^w lane accumulation for the device value paths.
+
+The additive output groups (``spec.GROUPS``) read the ``lam`` payload
+bytes as little-endian w-bit lanes.  The device backends never hold the
+payload as bytes — they hold bit planes — so the group add must run in
+the plane domain.  Two layouts exist:
+
+* **byte-major** (``utils.bits.byte_bits_lsb``): plane ``p = byte*8 +
+  bit``, bits LSB-first.  A little-endian lane ``l`` therefore occupies
+  the w consecutive planes ``[l*w, (l+1)*w)`` in exact carry order, so
+  the add is a ripple carry along the plane axis: ``w`` steps, each a
+  handful of word-ops on a full ``[L, ...]`` plane slab, bitwise-parallel
+  across the 32 points packed per lane word.  Used by the bitsliced /
+  keylanes XLA cores (planes ``[8*lam, K, W]``).
+
+* **bit-major** (``utils.bits.bitmajor_perm``, lam = 16 only): plane
+  ``p' = bit*16 + byte`` — rows ``[16j, 16j+16)`` hold bit ``j`` of all
+  16 byte positions.  A lane's bits are strided, so the ripple runs as
+  ``w/8`` passes over the 8 bit-layers: within a pass carries ripple bit
+  ``j -> j+1`` of every byte at once (one ``[16, W]`` slab per step), and
+  between passes the byte-boundary carry moves to the next byte position
+  by a static row shift (slice + concat — the same primitive the prefix
+  kernel's butterfly transpose uses, so it lowers in Mosaic and the
+  interpreter alike).  Entry carries converge after ``w/8`` passes; the
+  total step count equals the straight ripple's.
+
+The party sign ``(-1)^b`` of the additive eval never enters the kernels:
+it factors out of every level, so kernels accumulate unsigned and the
+backend negates party 1's result once at the output edge
+(``planes_neg_*`` — two's complement: NOT then +1 per lane, one extra
+ripple).
+
+All helpers are group-width generic (w in {8, 16, 32}), dtype-agnostic
+over int32/uint32 plane words, and pure jnp — usable inside Pallas
+kernels and plain XLA jits alike.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dcf_tpu.spec import GROUP_WIDTH
+
+__all__ = [
+    "group_width",
+    "planes_add_bytemajor",
+    "planes_sub_bytemajor",
+    "planes_neg_bytemajor",
+    "planes_add_bitmajor16",
+    "planes_neg_bitmajor16",
+    "jnp_bytes_to_lanes",
+    "jnp_lanes_to_bytes",
+]
+
+
+def group_width(group: str) -> int:
+    """Lane width in bits of an additive group (0 for xor)."""
+    return GROUP_WIDTH.get(group, 0)
+
+
+# -- byte-major layout (planes [8*lam, ...], p = byte*8 + bit) ---------------
+
+
+def planes_add_bytemajor(x, y, w: int, *, carry_in: bool = False):
+    """Per-lane ``x + y mod 2^w`` on byte-major plane slabs.
+
+    ``x``/``y``: plane words ``[8*lam, ...]`` (any trailing shape); plane
+    ``l*w + k`` is bit ``k`` of lane ``l``.  ``carry_in`` adds 1 to every
+    lane (the two's-complement tail of subtraction).
+    """
+    xk0 = x[0::w]
+    c = ~jnp.zeros_like(xk0) if carry_in else jnp.zeros_like(xk0)
+    outs = []
+    for k in range(w):
+        xk = x[k::w]
+        yk = y[k::w]
+        axb = xk ^ yk
+        outs.append(axb ^ c)
+        if k + 1 < w:
+            c = (xk & yk) | (c & axb)
+    # outs[k] holds planes l*w + k: interleave back to plane order.
+    return jnp.stack(outs, axis=1).reshape(x.shape)
+
+
+def planes_sub_bytemajor(x, y, w: int):
+    """Per-lane ``x - y mod 2^w`` (add the complement with carry-in)."""
+    return planes_add_bytemajor(x, ~y, w, carry_in=True)
+
+
+def planes_neg_bytemajor(x, w: int):
+    """Per-lane ``-x mod 2^w`` (two's complement)."""
+    return planes_add_bytemajor(~x, jnp.zeros_like(x), w, carry_in=True)
+
+
+# -- bit-major layout (lam = 16: planes [128, W], p' = bit*16 + byte) --------
+
+
+def planes_add_bitmajor16(x, y, w: int, *, carry_in: bool = False):
+    """Per-lane ``x + y mod 2^w`` on bit-major plane blocks ``[128, W]``.
+
+    Lane ``l`` spans bytes ``[l*step, (l+1)*step)`` (step = w/8); bit
+    ``j`` of byte ``B`` sits at row ``j*16 + B``.  Runs ``step`` passes
+    over the 8 bit-layers; byte-boundary carries move down one row
+    between passes (masked at lane starts, where ``carry_in`` enters
+    instead).
+    """
+    step = w // 8
+    byte_idx = jax.lax.broadcasted_iota(jnp.int32, (16, 1), 0)
+    lane_start = jnp.where(byte_idx % step == 0, jnp.int32(-1),
+                           jnp.int32(0)).astype(x.dtype)
+    cin = (lane_start if carry_in else jnp.zeros_like(lane_start))
+    xl = [x[16 * j:16 * j + 16] for j in range(8)]
+    yl = [y[16 * j:16 * j + 16] for j in range(8)]
+    entry = cin * jnp.ones_like(xl[0])
+    outs = xl
+    for _ in range(step):
+        c = entry
+        outs = []
+        for j in range(8):
+            axb = xl[j] ^ yl[j]
+            outs.append(axb ^ c)
+            c = (xl[j] & yl[j]) | (c & axb)
+        if step == 1:
+            break
+        # Carry out of bit 7 of byte B enters bit 0 of byte B+1 (static
+        # row shift), except at lane starts, which re-receive carry_in.
+        shifted = jnp.concatenate([jnp.zeros_like(c[:1]), c[:15]], axis=0)
+        entry = (shifted & ~lane_start) | cin
+    return jnp.concatenate(outs, axis=0)
+
+
+def planes_neg_bitmajor16(x, w: int):
+    """Per-lane ``-x mod 2^w`` on bit-major plane blocks ``[128, W]``."""
+    return planes_add_bitmajor16(~x, jnp.zeros_like(x), w, carry_in=True)
+
+
+# -- byte <-> lane conversion for the byte-level jnp walk --------------------
+
+
+def jnp_bytes_to_lanes(x, w: int):
+    """uint8 ``[..., lam]`` -> unsigned w-bit lanes ``[..., 8*lam/w]``.
+
+    Explicit little-endian assembly (no bitcast), so the result is
+    platform-independent and matches ``spec.bytes_to_lanes``.
+    """
+    step = w // 8
+    dt = {8: jnp.uint8, 16: jnp.uint16, 32: jnp.uint32}[w]
+    g = x.reshape(*x.shape[:-1], x.shape[-1] // step, step).astype(dt)
+    shifts = jnp.arange(step, dtype=dt) * dt(8)
+    return jnp.sum(g << shifts, axis=-1, dtype=dt) if step > 1 else g[..., 0]
+
+
+def jnp_lanes_to_bytes(lanes, w: int):
+    """Inverse of :func:`jnp_bytes_to_lanes` -> uint8 ``[..., lam]``."""
+    step = w // 8
+    if step == 1:
+        return lanes.astype(jnp.uint8)
+    shifts = jnp.arange(step, dtype=lanes.dtype) * jnp.asarray(
+        8, dtype=lanes.dtype)
+    b = (lanes[..., None] >> shifts).astype(jnp.uint8)
+    return b.reshape(*lanes.shape[:-1], lanes.shape[-1] * step)

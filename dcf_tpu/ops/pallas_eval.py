@@ -44,6 +44,8 @@ from dcf_tpu.ops.aes_bitsliced import (
     aes_walk_cipher_v3,
     prep_rk_bitmajor_v3,
 )
+from dcf_tpu.ops.group_accum import (group_width, planes_add_bitmajor16,
+                                     planes_neg_bitmajor16)
 
 __all__ = ["dcf_eval_pallas", "DEFAULT_TILE_WORDS", "make_aes", "walk_levels"]
 
@@ -74,11 +76,19 @@ def make_aes(rk, interpret: bool):
 
 
 def walk_levels(aes, lbm, s0, t0, v0, cw_s_ref, cw_v_ref, cw_t_ref, xm_ref,
-                n: int):
+                n: int, group: str = "xor"):
     """The n-level GGM walk loop on packed planes, shared by the from-root
     kernel below and the prefix-shared kernel (ops.pallas_prefix).  The
-    cw/xm refs are indexed [0, i] per level i in 0..n-1."""
+    cw/xm refs are indexed [0, i] per level i in 0..n-1.
+
+    ``group`` selects the value accumulation: XOR plane algebra, or the
+    additive group's per-lane mod-2^w add over the bit-major planes
+    (ops.group_accum.planes_add_bitmajor16 — static slice/concat only, so
+    it lowers in Mosaic).  The party sign of additive shares is applied
+    by the caller at the walk exit, not per level.
+    """
     ones = jnp.int32(-1)
+    gw = group_width(group)  # 0 for xor
     wt = s0.shape[1]
 
     def level(i, carry):
@@ -110,7 +120,12 @@ def walk_levels(aes, lbm, s0, t0, v0, cw_s_ref, cw_v_ref, cw_t_ref, xm_ref,
 
         xm = xm_ref[0, i]  # [1, wt] input-bit lane masks for this level
         nxm = xm ^ ones
-        v = v ^ (v_r & xm) ^ (v_l & nxm) ^ (cv & gate)
+        if gw:
+            v_hat = (v_r & xm) | (v_l & nxm)
+            v = planes_add_bitmajor16(
+                v, planes_add_bitmajor16(v_hat, cv & gate, gw), gw)
+        else:
+            v = v ^ (v_r & xm) ^ (v_l & nxm) ^ (cv & gate)
         s = (s_r & xm) | (s_l & nxm)
         t = (t_r & xm) | (t_l & nxm)
         return (s, t, v)
@@ -119,9 +134,10 @@ def walk_levels(aes, lbm, s0, t0, v0, cw_s_ref, cw_v_ref, cw_t_ref, xm_ref,
 
 
 def _kernel(rk_ref, s0_ref, cw_s_ref, cw_v_ref, cw_np1_ref, cw_t_ref, xm_ref,
-            y_ref, *, b: int, n: int, interpret: bool):
+            y_ref, *, b: int, n: int, interpret: bool, group: str = "xor"):
     wt = xm_ref.shape[3]
     ones = jnp.int32(-1)
+    gw = group_width(group)
     aes = make_aes(rk_ref[:], interpret)
 
     # PRG mask: output bit 8*lam-1 is cleared (reference src/prg.rs:65-68);
@@ -135,8 +151,14 @@ def _kernel(rk_ref, s0_ref, cw_s_ref, cw_v_ref, cw_np1_ref, cw_t_ref, xm_ref,
     v0 = jnp.zeros((128, wt), jnp.int32)
 
     s, t, v = walk_levels(aes, lbm, s0, t0, v0, cw_s_ref, cw_v_ref,
-                          cw_t_ref, xm_ref, n)
-    y_ref[0] = v ^ s ^ (cw_np1_ref[0] & t)
+                          cw_t_ref, xm_ref, n, group)
+    if not gw:
+        y_ref[0] = v ^ s ^ (cw_np1_ref[0] & t)
+        return
+    y = planes_add_bitmajor16(
+        v, planes_add_bitmajor16(s, cw_np1_ref[0] & t, gw), gw)
+    # Signed-share contract: party 1 negates once at the walk exit.
+    y_ref[0] = planes_neg_bitmajor16(y, gw) if b else y
 
 
 def dcf_eval_pallas(
@@ -151,8 +173,14 @@ def dcf_eval_pallas(
     b: int,
     tile_words: int = DEFAULT_TILE_WORDS,
     interpret: bool = False,
+    group: str = "xor",
 ):
-    """Party ``b`` DCF eval; returns y planes int32 [K, 128, W] (bit-major)."""
+    """Party ``b`` DCF eval; returns y planes int32 [K, 128, W] (bit-major).
+
+    Additive ``group`` planes come out as SIGNED shares (party 1 negated
+    in-kernel); reconstruction is a plain per-lane add after the
+    plane->byte conversion.
+    """
     k_num = s0_t.shape[0]
     n = cw_s_t.shape[1]
     kx, _, _, w = x_mask.shape
@@ -167,7 +195,7 @@ def dcf_eval_pallas(
     # ~256 KB (measured at K=8, n=128, wt=128), so the limit is raised
     # explicitly — same remedy as the narrow kernel.
     return pl.pallas_call(
-        partial(_kernel, b=b, n=n, interpret=interpret),
+        partial(_kernel, b=b, n=n, interpret=interpret, group=group),
         out_shape=jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
         grid=grid,
         compiler_params=_CompilerParams(

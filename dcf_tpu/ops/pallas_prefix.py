@@ -46,6 +46,8 @@ from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 from dcf_tpu.errors import ShapeError
 from dcf_tpu.ops._compat import CompilerParams as _CompilerParams
 
+from dcf_tpu.ops.group_accum import (group_width, planes_add_bitmajor16,
+                                     planes_neg_bitmajor16)
 from dcf_tpu.ops.pallas_eval import DEFAULT_TILE_WORDS, make_aes, walk_levels
 
 __all__ = ["dcf_eval_prefix_pallas", "rows_to_state_planes"]
@@ -91,9 +93,11 @@ def rows_to_state_planes(xp, rows):
 
 
 def _kernel(rk_ref, srows_ref, vrows_ref, cw_s_ref, cw_v_ref, cw_np1_ref,
-            cw_t_ref, xm_ref, y_ref, *, n_rem: int, interpret: bool):
+            cw_t_ref, xm_ref, y_ref, *, n_rem: int, interpret: bool,
+            group: str = "xor", negate: bool = False):
     wt = xm_ref.shape[3]
     ones = jnp.int32(-1)
+    gw = group_width(group)
     aes = make_aes(rk_ref[:], interpret)
 
     plane_idx = jax.lax.broadcasted_iota(jnp.int32, (128, 1), 0)
@@ -107,8 +111,15 @@ def _kernel(rk_ref, srows_ref, vrows_ref, cw_s_ref, cw_v_ref, cw_np1_ref,
     s0 = s_planes & lbm
 
     s, t, v = walk_levels(aes, lbm, s0, t0, v0, cw_s_ref, cw_v_ref,
-                          cw_t_ref, xm_ref, n_rem)
-    y_ref[0] = v ^ s ^ (cw_np1_ref[0] & t)
+                          cw_t_ref, xm_ref, n_rem, group)
+    if not gw:
+        y_ref[0] = v ^ s ^ (cw_np1_ref[0] & t)
+        return
+    y = planes_add_bitmajor16(
+        v, planes_add_bitmajor16(s, cw_np1_ref[0] & t, gw), gw)
+    # Signed-share contract: the party sign is applied at the walk exit
+    # (the frontier itself accumulates unsigned).
+    y_ref[0] = planes_neg_bitmajor16(y, gw) if negate else y
 
 
 def dcf_eval_prefix_pallas(
@@ -125,12 +136,16 @@ def dcf_eval_prefix_pallas(
     *,
     tile_words: int = DEFAULT_TILE_WORDS,
     interpret: bool = False,
+    group: str = "xor",
+    negate: bool = False,
 ):
     """Walk the remaining n-k levels from gathered frontier carries.
 
     Party is implicit: the frontier rows were expanded from the party's
-    key share (its s0 and t=b entered at level 0 of the tree).  Returns y
-    planes int32 [K, 128, W], same layout as ``dcf_eval_pallas``.
+    key share (its s0 and t=b entered at level 0 of the tree).  For an
+    additive ``group`` the caller passes ``negate=True`` for party 1 (the
+    signed-share contract; the walk itself is party-symmetric).  Returns
+    y planes int32 [K, 128, W], same layout as ``dcf_eval_pallas``.
     """
     k_num = srows.shape[0]
     n_rem = cw_s_t.shape[1]
@@ -146,7 +161,8 @@ def dcf_eval_prefix_pallas(
     # grid's block buffering exceeds the 16 MB default (measured 28 MB at
     # K=8, n_rem=110, wt=128).
     return pl.pallas_call(
-        partial(_kernel, n_rem=n_rem, interpret=interpret),
+        partial(_kernel, n_rem=n_rem, interpret=interpret, group=group,
+                negate=negate),
         out_shape=jax.ShapeDtypeStruct((k_num, 128, w), jnp.int32),
         grid=grid,
         compiler_params=_CompilerParams(

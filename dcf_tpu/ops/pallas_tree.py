@@ -37,13 +37,16 @@ from dcf_tpu.ops.aes_bitsliced import (
     aes_walk_cipher_v3,
     prep_rk_bitmajor_v3,
 )
+from dcf_tpu.ops.group_accum import group_width, planes_add_bitmajor16
 
 __all__ = ["tree_expand_device", "tree_expand_raw"]
 
 
 def _expand_kernel(rk_ref, cs_ref, cv_ref, ct_ref, s_ref, v_ref, t_ref,
-                   sl_o, vl_o, tl_o, sr_o, vr_o, tr_o, *, interpret: bool):
+                   sl_o, vl_o, tl_o, sr_o, vr_o, tr_o, *, interpret: bool,
+                   group: str = "xor"):
     ones = jnp.int32(-1)
+    gw = group_width(group)
     rk = rk_ref[:]
     if interpret:
         def aes(state):
@@ -73,11 +76,21 @@ def _expand_kernel(rk_ref, cs_ref, cv_ref, ct_ref, s_ref, v_ref, t_ref,
     sr_o[:] = (s & lbm) ^ csg
     tl_o[:] = t_l ^ (t & ct_ref[0])
     tr_o[:] = t_r ^ (t & ct_ref[1])
-    vl_o[:] = v ^ (vl_raw & lbm) ^ cvg
-    vr_o[:] = v ^ (sp & lbm) ^ cvg
+    if gw:
+        # Additive groups: the accumulator pushed down both branches is
+        # an UNSIGNED per-lane sum (the party sign factors out of the
+        # whole walk and is applied once at the consumer's output edge).
+        vl_o[:] = planes_add_bitmajor16(
+            v, planes_add_bitmajor16(vl_raw & lbm, cvg, gw), gw)
+        vr_o[:] = planes_add_bitmajor16(
+            v, planes_add_bitmajor16(sp & lbm, cvg, gw), gw)
+    else:
+        vl_o[:] = v ^ (vl_raw & lbm) ^ cvg
+        vr_o[:] = v ^ (sp & lbm) ^ cvg
 
 
-def _expand_level(rk, cs, cv, ct, s, v, t, *, interpret: bool):
+def _expand_level(rk, cs, cv, ct, s, v, t, *, interpret: bool,
+                  group: str = "xor"):
     """One tree level: [128, W] parents -> six [.., W] child halves."""
     w = s.shape[1]
     wt = min(128, w)
@@ -85,7 +98,7 @@ def _expand_level(rk, cs, cv, ct, s, v, t, *, interpret: bool):
     state_spec = pl.BlockSpec((128, wt), lambda j: (0, j))
     t_spec = pl.BlockSpec((1, wt), lambda j: (0, j))
     return pl.pallas_call(
-        partial(_expand_kernel, interpret=interpret),
+        partial(_expand_kernel, interpret=interpret, group=group),
         out_shape=(
             jax.ShapeDtypeStruct((128, w), jnp.int32),
             jax.ShapeDtypeStruct((128, w), jnp.int32),
@@ -108,9 +121,10 @@ def _expand_level(rk, cs, cv, ct, s, v, t, *, interpret: bool):
     )(rk, cs, cv, ct, s, v, t)
 
 
-@partial(jax.jit, static_argnames=("k0", "k1", "interpret"))
+@partial(jax.jit, static_argnames=("k0", "k1", "interpret", "group"))
 def tree_expand_raw(rk, cw_s_t, cw_v_t, cw_t_pm, s, v, t,
-                    k0: int, k1: int, interpret: bool = False):
+                    k0: int, k1: int, interpret: bool = False,
+                    group: str = "xor"):
     """Expand levels k0..k1-1 WITHOUT finalizing: returns the raw
     (s, v, t) node planes at level k1 (int32 [128, 2^k1 / 32] x2 +
     [1, 2^k1 / 32]), leaf order bitreverse_k1.
@@ -124,7 +138,7 @@ def tree_expand_raw(rk, cw_s_t, cw_v_t, cw_t_pm, s, v, t,
     for i in range(k0, k1):
         s_l, v_l, t_l, s_r, v_r, t_r = _expand_level(
             rk, cw_s_t[i], cw_v_t[i], cw_t_pm[i], s, v, t,
-            interpret=interpret)
+            interpret=interpret, group=group)
         s = jnp.concatenate([s_l, s_r], axis=1)
         v = jnp.concatenate([v_l, v_r], axis=1)
         t = jnp.concatenate([t_l, t_r], axis=1)

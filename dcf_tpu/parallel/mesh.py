@@ -139,7 +139,7 @@ class ShardedJaxBackend:
         self._spec_level = P(None, kaxis)  # [n, K, ...] arrays
         self._spec_xs = P(kaxis, paxis)  # per-key points [K, M, ...]
         self._spec_xs_shared = P(paxis)  # shared points [M, ...]
-        bundle_specs = (
+        self._bundle_specs = (
             P(),  # round keys replicated
             self._spec_keyed,  # s0
             self._spec_level,  # cw_s
@@ -147,25 +147,36 @@ class ShardedJaxBackend:
             self._spec_level,  # cw_t
             self._spec_keyed,  # cw_np1
         )
-        # No collectives inside the walk (pure map), so the varying-mesh-axes
-        # bookkeeping (scan carry starts key-varying, becomes (keys, points)-
-        # varying after level 1) buys nothing: check_vma=False.
-        self._fn = {
-            (b, shared): jax.jit(
+        self._group = "xor"
+        self._fn: dict = {}
+
+    def _shard_fn(self, b: int, shared: bool):
+        """Cached jit(shard_map(core)) per (party, shared, group) — the
+        group rides the bundle, so the cache key must carry it or a
+        re-put with a different group would reuse the wrong algebra.
+
+        No collectives inside the walk (pure map), so the
+        varying-mesh-axes bookkeeping (scan carry starts key-varying,
+        becomes (keys, points)-varying after level 1) buys nothing:
+        check_vma=False."""
+        key = (b, shared, self._group)
+        fn = self._fn.get(key)
+        if fn is None:
+            fn = jax.jit(
                 shard_map(
-                    partial(eval_core, b=b, lam=lam),
-                    mesh=mesh,
+                    partial(eval_core, b=b, lam=self.lam,
+                            group=self._group),
+                    mesh=self.mesh,
                     in_specs=(
-                        *bundle_specs,
+                        *self._bundle_specs,
                         self._spec_xs_shared if shared else self._spec_xs,
                     ),
                     out_specs=self._spec_xs,
                     check_vma=False,
                 )
             )
-            for b in (0, 1)
-            for shared in (False, True)
-        }
+            self._fn[key] = fn
+        return fn
 
     def _put(self, arr: np.ndarray, spec: P) -> jax.Array:
         return jax.device_put(arr, NamedSharding(self.mesh, spec))
@@ -180,6 +191,7 @@ class ShardedJaxBackend:
                 f"num_keys={bundle.num_keys} not divisible by keys-axis size {ksize}"
             )
         lm = bundle.level_major()
+        self._group = bundle.group
         self._bundle_dev = {
             k: self._put(
                 v, self._spec_keyed if k in ("s0", "cw_np1") else self._spec_level
@@ -207,7 +219,7 @@ class ShardedJaxBackend:
             np.ascontiguousarray(xs),
             self._spec_xs_shared if shared else self._spec_xs,
         )
-        y = self._fn[(int(b), shared)](
+        y = self._shard_fn(int(b), shared)(
             self.round_keys,
             dev["s0"],
             dev["cw_s"],
@@ -241,7 +253,7 @@ class ShardedBitslicedBackend(_BitslicedBase):
         self._spec_xs = P(kaxis, paxis, None)    # [K, M, nb]
         self._spec_xs_shared = P(None, paxis, None)  # [1, M, nb]
         self._spec_y = P(kaxis, paxis, None)     # [K, M, lam]
-        bundle_specs = (
+        self._bundle_specs = (
             P(),                # round keys (tuple, replicated)
             P(),                # last-bit mask
             self._spec_keyed,   # s0 planes
@@ -251,22 +263,30 @@ class ShardedBitslicedBackend(_BitslicedBase):
             self._spec_keyed,   # cw_tr
             self._spec_keyed,   # cw_np1 planes
         )
-        self._fn = {
-            (b, shared): jax.jit(
+        self._fn: dict = {}
+
+    def _shard_fn(self, b: int, shared: bool):
+        """Cached jit(shard_map(core)) per (party, shared, group); the
+        group rides the bundle (set at put_bundle), so it keys the
+        cache.  No collectives inside the walk: check_vma=False."""
+        key = (b, shared, self._group)
+        fn = self._fn.get(key)
+        if fn is None:
+            fn = jax.jit(
                 shard_map(
-                    partial(_eval_bytes, b=b, lam=lam),
-                    mesh=mesh,
+                    partial(_eval_bytes, b=b, lam=self.lam,
+                            group=self._group),
+                    mesh=self.mesh,
                     in_specs=(
-                        *bundle_specs,
+                        *self._bundle_specs,
                         self._spec_xs_shared if shared else self._spec_xs,
                     ),
                     out_specs=self._spec_y,
                     check_vma=False,
                 )
             )
-            for b in (0, 1)
-            for shared in (False, True)
-        }
+            self._fn[key] = fn
+        return fn
 
     def _put(self, arr, spec: P) -> jax.Array:
         return jax.device_put(arr, NamedSharding(self.mesh, spec))
@@ -280,6 +300,7 @@ class ShardedBitslicedBackend(_BitslicedBase):
             raise ShapeError(
                 f"num_keys={bundle.num_keys} not divisible by keys-axis "
                 f"size {ksize}")
+        self._group = bundle.group
         self._bundle_dev = {
             k: self._put(
                 v, self._spec_level if v.ndim == 3 else self._spec_keyed)
@@ -307,7 +328,7 @@ class ShardedBitslicedBackend(_BitslicedBase):
             (k_num, n), xs, lambda m: -(-m // granule) * granule)
         xs_dev = self._put(
             xs_p, self._spec_xs_shared if shared else self._spec_xs)
-        y = self._fn[(int(b), shared)](
+        y = self._shard_fn(int(b), shared)(
             self.rk_masks, self._last_bit_mask, dev["s0"], dev["cw_s"],
             dev["cw_v"], dev["cw_tl"], dev["cw_tr"], dev["cw_np1"], xs_dev,
         )

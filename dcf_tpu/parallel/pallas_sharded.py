@@ -104,14 +104,14 @@ class ShardedPallasBackend(PallasBackend):
         self._fns: dict = {}
 
     def _shard_fn(self, b: int, shared: bool, wt: int):
-        """Cached jit(shard_map(kernel)) per (party, shared, tile)."""
-        key = (b, shared, wt)
+        """Cached jit(shard_map(kernel)) per (party, shared, tile, group)."""
+        key = (b, shared, wt, self._group)
         fn = self._fns.get(key)
         if fn is None:
             fn = jax.jit(
                 shard_map(
                     partial(dcf_eval_pallas, b=b, tile_words=wt,
-                            interpret=self.interpret),
+                            interpret=self.interpret, group=self._group),
                     mesh=self.mesh,
                     in_specs=(
                         P(),                 # rk (replicated)
@@ -791,13 +791,15 @@ class ShardedPrefixBackend(PrefixPallasBackend):
         # evaluate only key 0's frontier.
         k_num = self._dims()[0]
         fsize = 1 << self._k()
-        fn = self._sfns.get((wt, k_num, fsize))
+        negate = bool(b) and self._group != "xor"
+        fn = self._sfns.get((wt, k_num, fsize, self._group, negate))
         if fn is None:
             fn = jax.jit(
                 shard_map(
                     partial(gather_and_walk, tile_words=wt,
                             interpret=self.interpret,
-                            k_num=k_num, frontier_size=fsize),
+                            k_num=k_num, frontier_size=fsize,
+                            group=self._group, negate=negate),
                     mesh=self.mesh,
                     in_specs=(
                         P(),              # rk (replicated)
@@ -810,7 +812,7 @@ class ShardedPrefixBackend(PrefixPallasBackend):
                     check_vma=False,  # pure map, no collectives
                 )
             )
-            self._sfns[(wt, k_num, fsize)] = fn
+            self._sfns[(wt, k_num, fsize, self._group, negate)] = fn
         cw_s_r, cw_v_r, cw_t_r = self._cw_rem
         return fn(self.rk, self._frontier_tables(b), staged["idx"],
                   cw_s_r, cw_v_r, self._bundle_dev["cw_np1"], cw_t_r,

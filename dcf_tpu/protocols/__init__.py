@@ -24,6 +24,11 @@ group:
   device);
 - ``protocols.piecewise`` piecewise-constant lookup as a MIC over a
   domain partition, XOR-reduced to one value per point;
+- ``protocols.fixedpoint`` fixed-point gates over the ADDITIVE output
+  groups (``group="add8"/"add16"/"add32"``): signed comparison,
+  faithful truncation and spline sigmoid, each a masked-input
+  composition of r-shifted IC/MIC bundles with a numpy golden oracle
+  (served form in ``workloads.gates``; bench: ``gate_bench``);
 - ``protocols.dpf``       distributed point functions: the GGM walk
   minus the comparison accumulation (no ``cw_v``), K-packed host and
   device keygen, the per-point reference evaluator, and the DCFK v3
@@ -53,6 +58,22 @@ from dcf_tpu.protocols.dpf import (  # noqa: F401
     dpf_gen_batch,
     dpf_gen_on_device,
 )
+from dcf_tpu.protocols.fixedpoint import (  # noqa: F401
+    SigmoidGate,
+    SignGate,
+    TruncGate,
+    eval_sigmoid_share,
+    eval_sign_share,
+    eval_trunc_share,
+    gate_reconstruct,
+    gen_sigmoid_gate,
+    gen_sign_gate,
+    gen_trunc_gate,
+    sigmoid_fixed_oracle,
+    sigmoid_table,
+    sign_oracle,
+    trunc_oracle,
+)
 from dcf_tpu.protocols.ic import eval_interval  # noqa: F401
 from dcf_tpu.protocols.keygen import (  # noqa: F401
     ProtocolBundle,
@@ -77,6 +98,9 @@ __all__ = [
     "MicEvaluator",
     "PROTO_DPF",
     "ProtocolBundle",
+    "SigmoidGate",
+    "SignGate",
+    "TruncGate",
     "combine_pair_shares",
     "decode_proto_frame",
     "dpf_device_fallback_count",
@@ -87,11 +111,22 @@ __all__ = [
     "eval_interval",
     "eval_mic",
     "eval_piecewise",
+    "eval_sigmoid_share",
+    "eval_sign_share",
+    "eval_trunc_share",
+    "gate_reconstruct",
     "gen_interval_bundle",
+    "gen_sigmoid_gate",
+    "gen_sign_gate",
+    "gen_trunc_gate",
     "ic_oracle",
     "interval_bound_alphas",
     "mic_oracle",
     "partition_intervals",
     "piecewise_oracle",
+    "sigmoid_fixed_oracle",
+    "sigmoid_table",
+    "sign_oracle",
+    "trunc_oracle",
     "xor_reconstruct_stream",
 ]

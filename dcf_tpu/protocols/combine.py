@@ -1,12 +1,18 @@
 """Share-combine algebra for the protocol layer.
 
 Every protocol in this package reduces to the same local step: party b
-evaluates the 2m K-packed bound keys, XORs adjacent key pairs
-(interval i = keys 2i ^ 2i+1) and XORs its per-interval combine mask.
-That step is pure XOR, so it runs unchanged on host uint8 bytes OR on
-device arrays — and for the staged plane layouts it runs BEFORE the
-planes->bytes conversion, halving the conversion volume (2m keys in, m
-intervals out).
+evaluates the 2m K-packed bound keys, combines adjacent key pairs
+(interval i = keys 2i ∘ 2i+1) and folds in its per-interval combine
+mask.  In the XOR output group ``∘`` is XOR; in an additive group it is
+the per-lane mod-2^w add — the keygen already folded the decomposition's
+minus sign into the key betas (``keygen.interval_session_material``), so
+the combine is the SAME uniform pairwise sum for every group and every
+bound.  The step is local and linear, so it runs unchanged on host
+uint8 bytes OR on device arrays — and for the staged plane layouts it
+runs BEFORE the planes->bytes conversion, halving the conversion volume
+(2m keys in, m intervals out); additive staged combines ride the
+``ops.group_accum`` ripple adders in the same plane domain the eval
+kernels accumulate in.
 
 ``fire("protocols.combine", m, points)`` is the fault seam: it sits at
 the exact spot where a combine-time failure (a bad mask shape, a dead
@@ -14,10 +20,11 @@ device mid-XOR) would surface, so the serving layer's retry path and
 the evaluators' error contracts are deterministically testable
 (``dcf_tpu.testing.faults``).
 
-``xor_reconstruct_stream`` is the two-party XOR reconstruction loop
+``xor_reconstruct_stream`` is the two-party reconstruction loop
 streaming over the key axis — the protocol layer's generic "both
 parties, chunked K" primitive that ``workloads.secure_relu_eval`` is a
-thin client of.
+thin client of.  The name records its XOR origin; it reconstructs in
+the bundle's group (``group_add(y0, y1)``).
 """
 
 from __future__ import annotations
@@ -26,7 +33,9 @@ import numpy as np
 
 from dcf_tpu.errors import ShapeError
 from dcf_tpu.keys import KeyBundle
+from dcf_tpu.spec import GROUP_WIDTH
 from dcf_tpu.testing.faults import fire
+from dcf_tpu.utils.groups import np_group_add
 
 __all__ = [
     "combine_pair_shares",
@@ -35,12 +44,15 @@ __all__ = [
 ]
 
 
-def combine_pair_shares(y, masks_b: np.ndarray | None):
+def combine_pair_shares(y, masks_b: np.ndarray | None, group: str = "xor"):
     """Pairwise share combine: y [2m, M, lam] -> [m, M, lam].
 
-    ``y`` may be host uint8 (numpy) or a device array (jax) — XOR and
-    strided slicing mean the combine stays wherever the shares already
-    live.  ``masks_b``: this party's uint8 [m, lam] combine mask
+    ``y`` may be host uint8 (numpy) or a device array (jax) — for XOR
+    the combine stays wherever the shares already live; additive groups
+    need the little-endian lane view, so ``y`` is materialized to host
+    bytes first (device-resident additive combines go through
+    ``staged_pair_combine`` instead, in the plane domain).  ``masks_b``:
+    this party's uint8 [m, lam] combine mask
     (``ProtocolBundle.masks_for``), or None to skip the public
     correction (an already-masked device path).
     """
@@ -48,14 +60,25 @@ def combine_pair_shares(y, masks_b: np.ndarray | None):
         raise ShapeError(
             f"expected [2m, M, lam] bound-key shares, got {y.shape}")
     fire("protocols.combine", y.shape[0] // 2, y.shape[1])
-    yc = y[0::2] ^ y[1::2]
+    if group == "xor":
+        yc = y[0::2] ^ y[1::2]
+        if masks_b is not None:
+            _check_mask(masks_b, yc)
+            yc = yc ^ masks_b[:, None, :]
+        return yc
+    y = np.asarray(y)
+    yc = np_group_add(y[0::2], y[1::2], group)
     if masks_b is not None:
-        if masks_b.shape != (yc.shape[0], yc.shape[2]):
-            raise ShapeError(
-                f"combine mask must be [{yc.shape[0]}, {yc.shape[2]}], "
-                f"got {masks_b.shape}")
-        yc = yc ^ masks_b[:, None, :]
+        _check_mask(masks_b, yc)
+        yc = np_group_add(yc, masks_b[:, None, :], group)
     return yc
+
+
+def _check_mask(masks_b: np.ndarray, yc) -> None:
+    if masks_b.shape != (yc.shape[0], yc.shape[2]):
+        raise ShapeError(
+            f"combine mask must be [{yc.shape[0]}, {yc.shape[2]}], "
+            f"got {masks_b.shape}")
 
 
 # Staged-plane key-axis table: which axis of ``eval_staged``'s output
@@ -76,29 +99,52 @@ _KEY_AXIS = {
 }
 
 
-def staged_pair_combine(be, y_dev):
+def staged_pair_combine(be, y_dev, group: str = "xor"):
     """Device-side pairwise combine of ``be.eval_staged`` output, or
     ``None`` when ``be``'s staged layout is not in the key-axis table
-    (caller then combines after ``staged_to_bytes``).  The mask XOR is
-    NOT applied here — layouts differ; apply it via
+    (caller then combines after ``staged_to_bytes``).  Additive groups
+    combine with the plane-domain ripple adders (``ops.group_accum``) —
+    bit-major [K, 128, W] blocks for the Pallas families, byte-major
+    [8*lam, K, W] slabs for the bitsliced family — and fall back to
+    ``None`` for a layout whose plane geometry doesn't match.  The mask
+    is NOT applied here — layouts differ; apply it via
     ``combine_pair_shares(..., masks_b)`` on the converted bytes or
     fold it on host."""
     axis = next((_KEY_AXIS[c.__name__] for c in type(be).__mro__
                  if c.__name__ in _KEY_AXIS), None)
     if axis is None:
         return None
-    fire("protocols.combine", y_dev.shape[axis] // 2, -1)
+    if group == "xor":
+        fire("protocols.combine", y_dev.shape[axis] // 2, -1)
+        if axis == 0:
+            return y_dev[0::2] ^ y_dev[1::2]
+        return y_dev[:, 0::2] ^ y_dev[:, 1::2]
+    w = GROUP_WIDTH[group]
     if axis == 0:
-        return y_dev[0::2] ^ y_dev[1::2]
-    return y_dev[:, 0::2] ^ y_dev[:, 1::2]
+        if y_dev.ndim != 3 or y_dev.shape[1] != 128:
+            return None  # not the bit-major [K, 128, W] block layout
+        import jax
+
+        from dcf_tpu.ops.group_accum import planes_add_bitmajor16
+
+        fire("protocols.combine", y_dev.shape[0] // 2, -1)
+        return jax.vmap(
+            lambda a, c: planes_add_bitmajor16(a, c, w)
+        )(y_dev[0::2], y_dev[1::2])
+    if y_dev.ndim != 3 or y_dev.shape[0] % 8:
+        return None  # not the byte-major [8*lam, K, W] slab layout
+    from dcf_tpu.ops.group_accum import planes_add_bytemajor
+
+    fire("protocols.combine", y_dev.shape[1] // 2, -1)
+    return planes_add_bytemajor(y_dev[:, 0::2], y_dev[:, 1::2], w)
 
 
 def xor_reconstruct_stream(
     backend0, backend1, bundle: KeyBundle, xs: np.ndarray,
     key_chunk: int = 1 << 16,
 ) -> np.ndarray:
-    """Two-party XOR reconstruction of K keys on M shared points,
-    streamed over the key axis: uint8 [K, M, lam].
+    """Two-party reconstruction of K keys on M shared points in the
+    bundle's output group, streamed over the key axis: uint8 [K, M, lam].
 
     ``backend0``/``backend1``: evaluators holding the two party roles
     (``put_bundle`` via the ``bundle=`` kwarg + ``eval``).  Keys stream
@@ -118,8 +164,10 @@ def xor_reconstruct_stream(
             cw_v=bundle.cw_v[lo:hi],
             cw_t=bundle.cw_t[lo:hi],
             cw_np1=bundle.cw_np1[lo:hi],
+            group=bundle.group,
         )
         y0 = backend0.eval(0, xs, bundle=sub.for_party(0))
         y1 = backend1.eval(1, xs, bundle=sub.for_party(1))
-        out[lo:hi] = y0 ^ y1
+        out[lo:hi] = np_group_add(np.asarray(y0), np.asarray(y1),
+                                  bundle.group)
     return out

@@ -21,9 +21,10 @@ __all__ = ["eval_interval"]
 
 def eval_interval(dcf, b: int, pb: ProtocolBundle,
                   xs: np.ndarray) -> np.ndarray:
-    """Party ``b``'s IC share: uint8 [M, lam].  XOR both parties'
-    outputs to reconstruct ``beta if x in [p, q) else 0`` (wraparound
-    intervals included — the combine mask carries the correction)."""
+    """Party ``b``'s IC share: uint8 [M, lam].  Group-add both parties'
+    outputs (XOR in the default group) to reconstruct
+    ``beta if x in [p, q) else 0`` (wraparound intervals included — the
+    combine mask carries the correction)."""
     if pb.num_intervals != 1:
         raise ShapeError(
             f"eval_interval wants a single-interval bundle, got m="

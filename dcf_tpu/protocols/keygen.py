@@ -36,11 +36,29 @@ scheme; the wire format stores a mask PER PARTY, so a dealer who wants
 beta hidden from party 0 outside the interval can XOR-share the
 correction across both masks instead — the combine is symmetric).
 
+Additive output groups (``group`` in ``spec.GROUPS``, mod-2^w lanes)
+run the SAME decomposition with signs instead of parities:
+
+    1_{(p,q)}(x) = DCF_{<q%N} - DCF_{<p%N} + pub * 1,
+    pub = [q == N] - [p == N] + [p > q]  in {-1, 0, +1}
+
+(GT: 1_{x>=p} - 1_{x>=q} with pub = [p == 0] - [q == 0] + [p > q]).
+Rather than teach the combine a per-bound sign pattern, the MINUS is
+folded into the key betas at keygen time: the subtracted bound's key
+(LT: the lower key 2i; GT: the upper key 2i+1) is generated with
+``-beta`` so the combine stays the uniform ``y[2i] + y[2i+1] + mask``
+— the exact characteristic-2 degeneration of the XOR path, where
+``-beta == beta`` and ``+`` is ``^``.  The mask is the group-encoded
+``pub * beta`` (``-beta`` bytes when pub = -1), carried by party 0.
+
 Wire format: DCFK version 3 — the v2 frame plus a ``proto`` header
 field and a trailing protocol section (bound byte + combine masks),
 version-gated: v1/v2 frames (and v3 frames with proto=0) still decode
 as plain ``KeyBundle``; ``KeyBundle.from_bytes`` on a proto!=0 frame
 refuses with a pointer here instead of silently dropping the masks.
+Additive protocol bundles write version 4 (the v3 header plus the
+``group`` code, mirroring the plain-bundle v4 gate): a v3-era reader
+refuses them loudly instead of reconstructing in the wrong group.
 """
 
 from __future__ import annotations
@@ -57,12 +75,22 @@ from dcf_tpu.keys import (
     _CRC_SIZE,
     _HEADER3,
     _HEADER3_SIZE,
+    _HEADER4,
+    _HEADER4_SIZE,
     _MAGIC,
+    _VERSION_GROUP,
     _VERSION_PROTO,
     KeyBundle,
     _decode_sections,
 )
-from dcf_tpu.spec import Bound
+from dcf_tpu.spec import (
+    GROUP_CODE,
+    GROUP_FROM_CODE,
+    GROUP_WIDTH,
+    Bound,
+    check_group,
+)
+from dcf_tpu.utils.groups import np_group_neg
 
 __all__ = [
     "PROTO_MIC",
@@ -83,20 +111,25 @@ _BOUND_FROM = {v: k for k, v in _BOUND_CODE.items()}
 
 def interval_bound_alphas(
     intervals: Sequence[tuple[int, int]], n_bytes: int,
-    bound: Bound = Bound.LT_BETA,
+    bound: Bound = Bound.LT_BETA, group: str = "xor",
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Intervals -> (alphas uint8 [2m, n_bytes], pub uint8 [m]).
+    """Intervals -> (alphas uint8 [2m, n_bytes], pub [m]).
 
     ``alphas[2i]``/``alphas[2i+1]`` are the DCF comparison points for
     interval i's lower/upper bound under ``bound``'s decomposition (see
-    the module docstring); ``pub[i]`` is the public correction bit.
-    Shared by the host keygen below and any device-keygen caller
+    the module docstring); ``pub[i]`` is the public correction — a
+    uint8 bit for the XOR group, a SIGNED int8 in {-1, 0, +1} for
+    additive groups (same three indicator terms, summed instead of
+    XORed; they never collide, so the sum stays in range and its parity
+    IS the XOR bit).  The alphas are group-independent.  Shared by the
+    host keygen below and any device-keygen caller
     (``backends.device_gen.DeviceKeyGen`` consumes these alphas as-is).
     """
     n_total = 1 << (8 * n_bytes)
     m = len(intervals)
     alphas = np.zeros((2 * m, n_bytes), dtype=np.uint8)
-    pub = np.zeros(m, dtype=np.uint8)
+    signed = group != "xor"
+    pub = np.zeros(m, dtype=np.int8 if signed else np.uint8)
     for i, (p, q) in enumerate(intervals):
         if not (0 <= p <= n_total and 0 <= q <= n_total):
             # api-edge: documented interval-bound contract (ints in
@@ -106,10 +139,12 @@ def interval_bound_alphas(
                 f"got ({p}, {q})")
         if bound is Bound.LT_BETA:
             lo, hi = p % n_total, q % n_total
-            pub[i] = (p > q) ^ (p == n_total) ^ (q == n_total)
+            pub[i] = ((q == n_total) - (p == n_total) + (p > q) if signed
+                      else (p > q) ^ (p == n_total) ^ (q == n_total))
         else:
             lo, hi = (p - 1) % n_total, (q - 1) % n_total
-            pub[i] = (p == 0) ^ (q == 0) ^ (p > q)
+            pub[i] = ((p == 0) - (q == 0) + (p > q) if signed
+                      else (p == 0) ^ (q == 0) ^ (p > q))
         alphas[2 * i] = np.frombuffer(
             lo.to_bytes(n_bytes, "big"), dtype=np.uint8)
         alphas[2 * i + 1] = np.frombuffer(
@@ -159,7 +194,13 @@ class ProtocolBundle:
         return (f"ProtocolBundle(m={self.num_intervals}, "
                 f"n_bits={self.keys.n_bits}, lam={self.lam}, "
                 f"parties={self.combine_masks.shape[0]}, "
-                f"bound={self.bound.value}, <key material redacted>)")
+                f"bound={self.bound.value}, group={self.group}, "
+                f"<key material redacted>)")
+
+    @property
+    def group(self) -> str:
+        """The output group — carried by the inner keys (one source)."""
+        return self.keys.group
 
     @property
     def num_intervals(self) -> int:
@@ -192,15 +233,23 @@ class ProtocolBundle:
             bound=self.bound,
         )
 
-    # -- codec (DCFK v3) ----------------------------------------------------
+    # -- codec (DCFK v3 / v4) -----------------------------------------------
 
     def to_bytes(self) -> bytes:
         """DCFK v3 frame: v2's sections + proto field + protocol section
-        (bound byte, combine masks) + CRC32 trailer."""
+        (bound byte, combine masks) + CRC32 trailer.  Additive bundles
+        write v4 (v3's header + the group code) — XOR frames stay
+        byte-identical to earlier releases, and a pre-v4 reader refuses
+        an additive frame typed instead of combining with XOR algebra."""
         k, p = self.keys.s0s.shape[0], self.keys.s0s.shape[1]
-        header = _MAGIC + struct.pack(
-            _HEADER3, _VERSION_PROTO, p, k, self.keys.n_bits, self.keys.lam,
-            PROTO_MIC)
+        if self.group == "xor":
+            header = _MAGIC + struct.pack(
+                _HEADER3, _VERSION_PROTO, p, k, self.keys.n_bits,
+                self.keys.lam, PROTO_MIC)
+        else:
+            header = _MAGIC + struct.pack(
+                _HEADER4, _VERSION_GROUP, p, k, self.keys.n_bits,
+                self.keys.lam, PROTO_MIC, GROUP_CODE[self.group])
         body = b"".join([
             header,
             self.keys.s0s.tobytes(),
@@ -215,11 +264,13 @@ class ProtocolBundle:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "ProtocolBundle":
-        """Strict bounds-checked decode of a v3 proto frame; the same
+        """Strict bounds-checked decode of a v3/v4 proto frame; the same
         field-naming rejection discipline as ``KeyBundle.from_bytes``.
         Plain frames (v1/v2, or v3 with proto=0) are refused with a
         pointer at ``KeyBundle.from_bytes`` — a protocol evaluator fed
-        a maskless bundle would silently skip the public correction."""
+        a maskless bundle would silently skip the public correction.
+        v4 frames carry the output-group code; an unknown code is
+        refused rather than guessed."""
         if len(data) < 4 or data[:4] != _MAGIC:
             raise KeyFormatError(
                 f"bad magic: expected {_MAGIC!r}, got {bytes(data[:4])!r} "
@@ -229,7 +280,28 @@ class ProtocolBundle:
                 f"truncated header: frame is {len(data)} bytes, the DCFK "
                 f"v3 header needs {_HEADER3_SIZE}")
         version, p, k, n, lam, proto = struct.unpack_from(_HEADER3, data, 4)
-        if version != _VERSION_PROTO:
+        header_size = _HEADER3_SIZE
+        group = "xor"
+        if version == _VERSION_GROUP:
+            if len(data) < _HEADER4_SIZE:
+                raise KeyFormatError(
+                    f"truncated header: frame is {len(data)} bytes, the "
+                    f"DCFK v4 header needs {_HEADER4_SIZE}")
+            version, p, k, n, lam, proto, group_code = struct.unpack_from(
+                _HEADER4, data, 4)
+            header_size = _HEADER4_SIZE
+            if group_code not in GROUP_FROM_CODE:
+                raise KeyFormatError(
+                    f"unknown output-group code {group_code} (this reader "
+                    f"handles {sorted(GROUP_FROM_CODE)}); refusing to "
+                    "guess a combine group for key material")
+            group = GROUP_FROM_CODE[group_code]
+            if group != "xor" and (8 * lam) % GROUP_WIDTH[group]:
+                raise KeyFormatError(
+                    f"group {group!r} needs lam*8={8 * lam} divisible by "
+                    f"{GROUP_WIDTH[group]} — corrupt or mismatched "
+                    "header fields")
+        elif version != _VERSION_PROTO:
             raise KeyFormatError(
                 f"version {version} frames carry no protocol section; "
                 "decode with KeyBundle.from_bytes")
@@ -264,7 +336,7 @@ class ProtocolBundle:
             ("combine_masks", (p, m, lam)),
         )
         arrays = _decode_sections(
-            data, sections, _HEADER3_SIZE, _CRC_SIZE,
+            data, sections, header_size, _CRC_SIZE,
             f"K={k}, P={p}, n={n}, lam={lam}")
         bound_code = int(arrays["bound"][0])
         if bound_code not in _BOUND_FROM:
@@ -274,7 +346,7 @@ class ProtocolBundle:
             keys=KeyBundle(
                 s0s=arrays["s0s"], cw_s=arrays["cw_s"],
                 cw_v=arrays["cw_v"], cw_t=arrays["cw_t"],
-                cw_np1=arrays["cw_np1"]),
+                cw_np1=arrays["cw_np1"], group=group),
             combine_masks=arrays["combine_masks"],
             bound=_BOUND_FROM[bound_code],
         )
@@ -286,6 +358,7 @@ def gen_interval_bundle(
     betas: np.ndarray,
     n_bytes: int,
     bound: Bound = Bound.LT_BETA,
+    group: str = "xor",
 ) -> ProtocolBundle:
     """Generate an m-interval protocol bundle through ``gen_fn``.
 
@@ -296,8 +369,15 @@ def gen_interval_bundle(
     bound keys are exactly the K-packed shape the device keygen kernel
     scales with — ISSUE 10).  The 2m bound keys land in ONE K-packed
     bundle: interval i's shares are keys 2i (lower) and 2i+1 (upper),
-    both carrying ``betas[i]``.  The pipelines are byte-identical, so
-    the ``ProtocolBundle`` wire frame does not record which one ran.
+    both carrying ``betas[i]`` (up to the additive sign fold — see
+    ``interval_session_material``).  The pipelines are byte-identical,
+    so the ``ProtocolBundle`` wire frame does not record which one ran.
+
+    ``group``: the output group the KEYS must be generated in — the
+    caller's ``gen_fn`` closure carries it to the keygen (the facade's
+    ``_protocol_gen`` does); the mismatch check below catches a closure
+    that dropped it, because an XOR-keyed bundle combined with additive
+    algebra reconstructs noise.
     """
     betas = np.asarray(betas, dtype=np.uint8)
     m = len(intervals)
@@ -305,9 +385,15 @@ def gen_interval_bundle(
         raise ShapeError("need at least one interval")
     if betas.ndim != 2 or betas.shape[0] != m:
         raise ShapeError(f"betas must be [{m}, lam], got {betas.shape}")
+    check_group(group, betas.shape[1])
     alphas, key_betas, masks = interval_session_material(
-        intervals, betas, n_bytes, bound)
+        intervals, betas, n_bytes, bound, group)
     keys = gen_fn(alphas, key_betas, bound)
+    if keys.group != group:
+        raise ShapeError(
+            f"gen_fn produced a {keys.group!r}-group bundle for a "
+            f"{group!r} protocol — the keygen closure must thread the "
+            "group through (Dcf._protocol_gen does)")
     return ProtocolBundle(keys=keys, combine_masks=masks, bound=bound)
 
 
@@ -316,6 +402,7 @@ def interval_session_material(
     betas: np.ndarray,
     n_bytes: int,
     bound: Bound = Bound.LT_BETA,
+    group: str = "xor",
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """The ONE per-session MIC key-material derivation: intervals ->
     ``(alphas uint8 [2m, n_bytes], key_betas uint8 [2m, lam],
@@ -323,8 +410,21 @@ def interval_session_material(
     ``gen_interval_bundle`` (host/device single-session gen) and the
     key factory's batched refill (ISSUE 11, which tiles the triple
     across a refill batch) — the combine convention must not be able
-    to fork between a pooled MIC key and the sync-mint fallback."""
-    alphas, pub = interval_bound_alphas(intervals, n_bytes, bound)
+    to fork between a pooled MIC key and the sync-mint fallback.
+
+    For additive groups the subtracted bound's key betas are NEGATED
+    (LT: lower keys ``2i``; GT: upper keys ``2i+1``) so the pairwise
+    combine stays the uniform ``y[2i] + y[2i+1] + mask`` — see the
+    module docstring.  The party-0 mask is the group-encoded
+    ``pub * beta`` with pub in {-1, 0, +1}."""
+    alphas, pub = interval_bound_alphas(intervals, n_bytes, bound, group)
     masks = np.zeros((2,) + betas.shape, dtype=np.uint8)
-    masks[0] = betas * pub[:, None]  # party-0 public correction
-    return alphas, np.repeat(betas, 2, axis=0), masks
+    if group == "xor":
+        masks[0] = betas * pub[:, None]  # party-0 public correction
+        return alphas, np.repeat(betas, 2, axis=0), masks
+    masks[0][pub > 0] = betas[pub > 0]
+    masks[0][pub < 0] = np_group_neg(betas[pub < 0], group)
+    key_betas = np.repeat(betas, 2, axis=0).copy()
+    neg_slot = 0 if bound is Bound.LT_BETA else 1
+    key_betas[neg_slot::2] = np_group_neg(betas, group)
+    return alphas, key_betas, masks

@@ -27,6 +27,7 @@ from dcf_tpu.protocols.combine import (
     staged_pair_combine,
 )
 from dcf_tpu.protocols.keygen import ProtocolBundle
+from dcf_tpu.utils.groups import np_group_add
 
 __all__ = ["MicEvaluator", "eval_mic"]
 
@@ -34,12 +35,12 @@ __all__ = ["MicEvaluator", "eval_mic"]
 def eval_mic(dcf, b: int, pb: ProtocolBundle, xs: np.ndarray) -> np.ndarray:
     """Party ``b``'s per-interval MIC shares: uint8 [m, M, lam].
 
-    XOR both parties' outputs to reconstruct
-    ``betas[i] if x in intervals[i] else 0`` per interval row.
-    ``dcf``: the facade the keys were generated for; any backend.
+    Group-add both parties' outputs (XOR for the default group) to
+    reconstruct ``betas[i] if x in intervals[i] else 0`` per interval
+    row.  ``dcf``: the facade the keys were generated for; any backend.
     """
     y = dcf.eval(b, pb.keys, xs)  # [2m, M, lam]
-    return combine_pair_shares(np.asarray(y), pb.masks_for(b))
+    return combine_pair_shares(np.asarray(y), pb.masks_for(b), pb.group)
 
 
 class MicEvaluator:
@@ -64,6 +65,7 @@ class MicEvaluator:
         self._dcf = dcf
         self._pb = pb
         self._b = int(b)
+        self._group = pb.group
         self._masks = pb.masks_for(b)
         self._be = dcf.new_eval_backend()
         if self._be is not None:
@@ -91,20 +93,22 @@ class MicEvaluator:
         if hasattr(be, "stage") and hasattr(be, "staged_to_bytes"):
             staged = be.stage(xs)
             y_dev = be.eval_staged(self._b, staged)
-            y_comb = staged_pair_combine(be, y_dev)  # fires the seam
+            y_comb = staged_pair_combine(be, y_dev, self._group)  # seam
             if y_comb is not None:
                 y = be.staged_to_bytes(y_comb, m_points)  # [m, M, lam]
-                return y ^ self._masks[:, None, :]
+                return np_group_add(y, self._masks[:, None, :],
+                                    self._group)
             y = be.staged_to_bytes(y_dev, m_points)  # [2m, M, lam]
-            return combine_pair_shares(y, self._masks)
+            return combine_pair_shares(y, self._masks, self._group)
         y = np.asarray(be.eval(self._b, xs))
-        return combine_pair_shares(y, self._masks)
+        return combine_pair_shares(y, self._masks, self._group)
 
     def reconstruct_with(self, other: "MicEvaluator",
                          xs: np.ndarray) -> np.ndarray:
-        """Two-party reconstruction convenience (tests/benches): XOR of
-        this evaluator's shares with ``other``'s (the opposite party)."""
+        """Two-party reconstruction convenience (tests/benches): the
+        group add of this evaluator's shares with ``other``'s (the
+        opposite party) — XOR in the default group."""
         if other._b == self._b:
             # api-edge: documented two-party contract
             raise ValueError("reconstruct_with wants the OPPOSITE party")
-        return self.eval(xs) ^ other.eval(xs)
+        return np_group_add(self.eval(xs), other.eval(xs), self._group)

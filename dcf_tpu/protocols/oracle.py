@@ -1,10 +1,20 @@
 """Numpy golden models for the protocol layer (the protocols "spec").
 
 Slow, obviously-correct references for interval containment, MIC and
-piecewise-constant evaluation in the repo's XOR output group.  Every
-protocol evaluator (facade path, staged device path, the serving layer)
-is validated bit-for-bit against these, exactly as the DCF backends are
-validated against ``dcf_tpu.spec``.
+piecewise-constant evaluation.  Every protocol evaluator (facade path,
+staged device path, the serving layer) is validated bit-for-bit against
+these, exactly as the DCF backends are validated against
+``dcf_tpu.spec``.
+
+The oracles are OUTPUT-GROUP INDEPENDENT: each models the plaintext
+function (``beta`` where the indicator fires, ``0`` elsewhere; the
+firing piece's value for piecewise), and that plaintext is the same
+whether the shares being checked against it reconstruct by XOR or by
+mod-2^w lane addition — the group only changes HOW the two parties'
+outputs are folded (``utils.groups.np_group_add``), not what they fold
+to.  The fixed-point gate oracles (sign, truncation, sigmoid), which DO
+have group-specific plaintext semantics, live with their gates in
+``protocols.fixedpoint``.
 
 Interval convention (shared with ``protocols.keygen`` — the single
 source of the semantics):

@@ -2,11 +2,13 @@
 
 A piecewise-constant f with m pieces is an m-interval MIC whose
 intervals PARTITION the domain: exactly one indicator fires per point,
-so the XOR over the per-interval rows collapses to the containing
-piece's value — in the XOR output group the "sum of selected values"
-and "the selected value" coincide, which is what makes the spline
-lookup a pure reduce over the MIC output (no arithmetic shares
-needed).  The last interval wraps (``[cuts[-1], N) ∪ [0, cuts[0])``),
+so the group sum over the per-interval rows collapses to the containing
+piece's value — "sum of selected values" and "the selected value"
+coincide in ANY output group when exactly one indicator fires, which is
+what makes the spline lookup a pure reduce over the MIC output.  In the
+XOR group that needs no arithmetic shares at all; in an additive group
+the same reduce yields ADDITIVE shares of the piece value — the form
+the fixed-point gates (``protocols.fixedpoint``) compose further.  The last interval wraps (``[cuts[-1], N) ∪ [0, cuts[0])``),
 so with ``cuts[0] == 0`` the table covers [0, N) in the standard way
 and the wraparound machinery costs nothing extra.
 """
@@ -19,6 +21,7 @@ import numpy as np
 
 from dcf_tpu.protocols.keygen import ProtocolBundle
 from dcf_tpu.protocols.mic import eval_mic
+from dcf_tpu.utils.groups import np_group_reduce
 
 __all__ = ["eval_piecewise", "partition_intervals"]
 
@@ -59,8 +62,11 @@ def partition_intervals(cuts: Sequence[int],
 
 def eval_piecewise(dcf, b: int, pb: ProtocolBundle,
                    xs: np.ndarray) -> np.ndarray:
-    """Party ``b``'s piecewise-lookup share: uint8 [M, lam] — the XOR
-    reduce of the MIC rows (valid because the bundle's intervals
-    partition the domain; ``Dcf.piecewise`` builds exactly that)."""
+    """Party ``b``'s piecewise-lookup share: uint8 [M, lam] — the
+    group-sum reduce of the MIC rows (XOR in the default group; mod-2^w
+    lane sums for additive bundles, where the share rows are uniform
+    and only the reduce in the RIGHT group telescopes to the containing
+    piece).  Valid because the bundle's intervals partition the domain;
+    ``Dcf.piecewise`` builds exactly that."""
     rows = eval_mic(dcf, b, pb, xs)  # [m, M, lam]
-    return np.bitwise_xor.reduce(rows, axis=0)
+    return np_group_reduce(rows, pb.group, axis=0)

@@ -128,6 +128,7 @@ from dcf_tpu.protocols.combine import (
     combine_pair_shares,
     staged_pair_combine,
 )
+from dcf_tpu.utils.groups import np_group_add
 from dcf_tpu.serve.admission import (
     AdmissionQueue,
     Priority,
@@ -364,6 +365,12 @@ class DcfService:
         # carrying an older one are refused typed (check_ring_epoch).
         self._epoch_lock = threading.Lock()
         self._ring_epoch = 0
+        # PIR answering context (ISSUE 20 satellite): None until
+        # attach_pir; guarded-by: _pir_lock (the PirServer's selection
+        # cache and evaluator residency are not themselves locked, and
+        # edge reader threads submit concurrently).
+        self._pir_lock = threading.Lock()
+        self._pir = None
         m = self.metrics
         self._c_batches = m.counter("serve_batches_total")
         self._c_retries = m.counter("serve_retries_total")
@@ -372,6 +379,7 @@ class DcfService:
             "serve_breaker_fast_fails_total")
         self._c_batch_timeouts = m.counter("serve_batch_timeouts_total")
         self._c_deadline = m.counter("serve_deadline_expired_total")
+        self._c_pir = m.counter("serve_pir_answers_total")
         self._c_epoch_fenced = m.counter("serve_epoch_fenced_total")
         self._g_ring_epoch = m.gauge("serve_ring_epoch")
         self._h_occupancy = m.histogram("serve_batch_occupancy",
@@ -686,7 +694,20 @@ class DcfService:
             raise ValueError(f"party b must be 0 or 1, got {b}")
         priority = parse_priority(priority)
         xs = ingest_points(data, self._dcf.n_bytes)
-        self.registry.bundle(key_id)  # unknown key_id fails at submit
+        from dcf_tpu.protocols.dpf import DpfBundle
+
+        bundle = self.registry.bundle(key_id)  # unknown fails at submit
+        if isinstance(bundle, DpfBundle):
+            # A DPF registration is a PIR query: the KEY is the query
+            # (full-domain EvalAll + database inner product), so the
+            # request's points are a wire-contract placeholder — the
+            # DCFE REQUEST frame needs M >= 1 — and the answer is
+            # computed here, not batched (a PIR answer has no point
+            # batch to coalesce with; PirServer carries its own
+            # serve.eval retry-then-evict discipline).  Same wire both
+            # ways: the [K, record_bytes] answer shares ride the SHARE
+            # frame as [k=K, m=1, lam=record_bytes].
+            return self._submit_pir(key_id, b)
         now = self._clock()
         self._update_brownout(now)  # the gate reflects current pressure
         deadline = None if deadline_ms is None else now + deadline_ms / 1e3
@@ -701,6 +722,63 @@ class DcfService:
         """Synchronous convenience: submit + wait."""
         return self.submit(key_id, xs, b, deadline_ms,
                            priority).result(timeout)
+
+    # -- PIR (ISSUE 20 satellite) -------------------------------------------
+
+    def attach_pir(self, db, evaluator=None, *,
+                   retries: int | None = None):
+        """Attach a 2-server-PIR answering context to this service.
+
+        ``db``: a ``workloads.pir.PirDatabase``.  ``evaluator``: a
+        ``backends.evalall.DpfEvalAll`` (defaults to one built from the
+        facade's lam/cipher keys, interpret mode off-TPU — the same
+        rule every Pallas facade path applies).  After attaching, a
+        request submitted against a registered ``DpfBundle`` — over the
+        local API or the DCFE wire, including a pod router's two-hop
+        forward — answers as a PIR query instead of a point batch.
+        ``retries`` defaults to the service's per-batch retry budget.
+        Returns the ``PirServer`` (its ``eval_faults`` counter is the
+        fault-soak observable)."""
+        from dcf_tpu.backends.evalall import DpfEvalAll
+        from dcf_tpu.workloads.pir import PirServer
+
+        if evaluator is None:
+            import jax
+
+            evaluator = DpfEvalAll(
+                self._dcf.lam, self._dcf.cipher_keys,
+                interpret=jax.devices()[0].platform != "tpu")
+        with self._pir_lock:
+            self._pir = PirServer(
+                evaluator, db, self.registry,
+                retries=self.config.retries if retries is None
+                else retries)
+        return self._pir
+
+    def _submit_pir(self, key_id: str, b: int) -> ServeFuture:
+        """One PIR answer as a completed ``ServeFuture`` (see
+        ``submit_bytes``: the key is the query, so there is nothing to
+        queue — the EvalAll + inner product run at submit, under the
+        PirServer's own retry-then-evict discipline)."""
+        if self._pir is None:
+            # api-edge: documented serving contract — a DPF key is
+            # servable only once the database context exists
+            raise ShapeError(
+                f"key {key_id!r} is a DPF (PIR) registration but no "
+                "database is attached to this service — call "
+                "attach_pir(db) first")
+        fut = ServeFuture()
+        try:
+            with self._pir_lock:
+                ans = self._pir.answer(key_id, b)
+        except Exception as e:  # fallback-ok: retries exhausted inside
+            # PirServer — the typed cause completes the future, same
+            # contract as a failed point batch
+            fut.set_exception(e)
+            return fut
+        self._c_pir.inc()
+        fut.set_result(ans[:, None, :])  # [K, 1, record_bytes] planes
+        return fut
 
     # -- serving ------------------------------------------------------------
 
@@ -1028,7 +1106,8 @@ class DcfService:
                 return fetch
             masks = proto.masks_for(b)
             return lambda: np.asarray(
-                combine_pair_shares(np.asarray(fetch()), masks))
+                combine_pair_shares(np.asarray(fetch()), masks,
+                                    proto.group))
 
         xs_batch = gather_batch(xs_list, plan, self._dcf.n_bytes)
         fire("serve.stage", key_id, plan.m)
@@ -1052,13 +1131,15 @@ class DcfService:
             self.registry.note_image_growth(key_id, b)
             self._c_batches.inc()
             if proto is not None:
-                y_comb = staged_pair_combine(be, y_dev)  # fires the seam
+                # fires the seam
+                y_comb = staged_pair_combine(be, y_dev, proto.group)
                 if y_comb is not None:
                     masks = proto.masks_for(b)
                     return _Batch(
                         plan,
-                        lambda: be.staged_to_bytes(y_comb, plan.m)
-                        ^ masks[:, None, :],
+                        lambda: np_group_add(
+                            be.staged_to_bytes(y_comb, plan.m),
+                            masks[:, None, :], proto.group),
                         t0, fam)
             return _Batch(
                 plan, wrap(lambda: be.staged_to_bytes(y_dev, plan.m)), t0,

@@ -13,8 +13,15 @@ Semantics honored here (see SURVEY.md §0, §2.1, §3):
   ``/root/reference/src/lib.rs:62`` and the test vectors at ``src/lib.rs:363-370``.
 * Comparison order is unsigned big-endian lexicographic over the ``n_bytes``
   input bytes; the GGM tree is walked MSB-first (``src/lib.rs:106, 181``).
-* The output group is XOR (byte-wise), not additive — reconstruction is
-  ``y0 ^ y1`` (``src/lib.rs:390-392``).
+* The output group is XOR (byte-wise) by default — reconstruction is
+  ``y0 ^ y1`` (``src/lib.rs:390-392``).  PR 20 adds the paper's additive
+  groups (``group`` parameter, ``add8``/``add16``/``add32`` = Z_{2^w}
+  lanes over the ``lam`` payload bytes, little-endian): the GGM tree
+  walk is untouched; only the value-accumulation and correction-word
+  algebra change, following Boyle et al. EUROCRYPT 2021 Fig. 3 — the
+  correction words carry a party sign ``(-1)^{t1}`` at gen and
+  ``(-1)^b`` at eval, and reconstruction is ``y0 + y1 mod 2^w`` per
+  lane.
 * The PRG is the Hirose double-block-length construction over AES-256 with
   its exact loop-truncation quirk (``src/prg.rs:42-73``, SURVEY.md §2.1):
   only ``min(2, lam // 16)`` block positions are ever encrypted, the t-bits
@@ -36,6 +43,10 @@ from typing import Sequence
 
 __all__ = [
     "AES_SBOX",
+    "GROUPS",
+    "GROUP_CODE",
+    "GROUP_FROM_CODE",
+    "GROUP_WIDTH",
     "SHIFT_ROWS",
     "ReferenceContractWarning",
     "aes256_expand_key",
@@ -46,11 +57,85 @@ __all__ = [
     "CmpFn",
     "Cw",
     "Share",
+    "bytes_to_lanes",
+    "check_group",
     "gen",
     "eval_point",
     "eval_batch",
+    "group_add",
+    "group_neg",
+    "group_sub",
+    "lanes_to_bytes",
     "xor_bytes",
 ]
+
+
+# ---------------------------------------------------------------------------
+# Output groups.  ``xor`` is the reference crate's byte-wise XOR group;
+# ``add{8,16,32}`` are the paper's additive groups Z_{2^w}: the lam
+# payload bytes are read as ``8 * lam / w`` little-endian w-bit lanes and
+# reconstruction is per-lane ``y0 + y1 mod 2^w``.  The name/code table is
+# the single wire + API authority (keys.py v4 frames, protocols, CLI).
+# ---------------------------------------------------------------------------
+
+GROUPS = ("xor", "add8", "add16", "add32")
+GROUP_CODE = {"xor": 0, "add8": 1, "add16": 2, "add32": 3}
+GROUP_FROM_CODE = {code: name for name, code in GROUP_CODE.items()}
+GROUP_WIDTH = {"add8": 8, "add16": 16, "add32": 32}  # lane width, bits
+
+
+def check_group(group: str, lam: int) -> None:
+    """Validate a group name against a payload width (API/wire edge)."""
+    if group not in GROUP_CODE:
+        # api-edge: documented output-group contract
+        raise ValueError(
+            f"unknown output group {group!r}; expected one of {GROUPS}")
+    if group != "xor" and (8 * lam) % GROUP_WIDTH[group] != 0:
+        # api-edge: additive lanes must tile the payload exactly
+        raise ValueError(
+            f"group {group!r} needs lam*8={8 * lam} divisible by "
+            f"{GROUP_WIDTH[group]}")
+
+
+def bytes_to_lanes(data: bytes, w: int) -> list[int]:
+    """Convert: read bytes as little-endian w-bit lanes (w in 8/16/32)."""
+    step = w // 8
+    return [int.from_bytes(data[i:i + step], "little")
+            for i in range(0, len(data), step)]
+
+
+def lanes_to_bytes(lanes: Sequence[int], w: int) -> bytes:
+    """Inverse of :func:`bytes_to_lanes`; values reduced mod 2^w."""
+    step, mask = w // 8, (1 << w) - 1
+    return b"".join((v & mask).to_bytes(step, "little") for v in lanes)
+
+
+def group_add(a: bytes, b: bytes, group: str) -> bytes:
+    """Group operation on payload bytes: XOR, or per-lane add mod 2^w."""
+    if group == "xor":
+        return xor_bytes(a, b)
+    w = GROUP_WIDTH[group]
+    return lanes_to_bytes(
+        [x + y for x, y in zip(bytes_to_lanes(a, w), bytes_to_lanes(b, w))],
+        w)
+
+
+def group_sub(a: bytes, b: bytes, group: str) -> bytes:
+    """Group inverse-apply: XOR, or per-lane ``a - b mod 2^w``."""
+    if group == "xor":
+        return xor_bytes(a, b)
+    w = GROUP_WIDTH[group]
+    return lanes_to_bytes(
+        [x - y for x, y in zip(bytes_to_lanes(a, w), bytes_to_lanes(b, w))],
+        w)
+
+
+def group_neg(a: bytes, group: str) -> bytes:
+    """Group negation: identity for XOR, per-lane ``-a mod 2^w`` else."""
+    if group == "xor":
+        return a
+    w = GROUP_WIDTH[group]
+    return lanes_to_bytes([-x for x in bytes_to_lanes(a, w)], w)
 
 
 # ---------------------------------------------------------------------------
@@ -355,9 +440,21 @@ def gen(
     f: CmpFn,
     s0s: Sequence[bytes],
     bound: Bound,
+    group: str = "xor",
 ) -> Share:
-    """GGM-tree key generation (src/lib.rs:86-161)."""
+    """GGM-tree key generation (src/lib.rs:86-161).
+
+    ``group`` selects the output group.  The tree walk (seeds, t-bits) is
+    identical for every group; only the value correction words change.
+    For the additive groups the algebra is Boyle et al. EUROCRYPT 2021
+    Fig. 1: the correction words carry the party sign ``(-1)^{t1}`` of
+    party 1's previous control bit (party 0 starts at t=0, party 1 at
+    t=1, matching the reference), and the XOR group is the exact
+    characteristic-2 degeneration of the same formulas (``-x = x``,
+    signs vanish), so one code path serves both.
+    """
     n_bytes, lam = len(f.alpha), len(f.beta)
+    check_group(group, lam)
     n = 8 * n_bytes
     zero = bytes(lam)
     v_alpha = zero
@@ -369,15 +466,28 @@ def gen(
         (s1l, v1l, t1l), (s1r, v1r, t1r) = prg.gen(ss[i - 1][1])
         alpha_i = _bit_msb(f.alpha, i - 1)
         keep, lose = (1, 0) if alpha_i else (0, 1)  # 0 = L, 1 = R
+        sign1 = ts[i - 1][1]  # party 1's t on the alpha path: (-1)^{t1}
         s_cw = xor_bytes([s0l, s0r][lose], [s1l, s1r][lose])
-        v_cw = xor_bytes([v0l, v0r][lose], [v1l, v1r][lose], v_alpha)
+        # V_CW <- (-1)^{t1} * [Convert(v1_lose) - Convert(v0_lose) - V_alpha
+        #                      (+ beta on the bound-matching lose side)]
+        v_cw = group_sub(
+            group_sub([v1l, v1r][lose], [v0l, v0r][lose], group),
+            v_alpha, group)
         if bound is Bound.LT_BETA:
             if lose == 0:
-                v_cw = xor_bytes(v_cw, f.beta)
+                v_cw = group_add(v_cw, f.beta, group)
         else:
             if lose == 1:
-                v_cw = xor_bytes(v_cw, f.beta)
-        v_alpha = xor_bytes(v_alpha, [v0l, v0r][keep], [v1l, v1r][keep], v_cw)
+                v_cw = group_add(v_cw, f.beta, group)
+        if sign1:
+            v_cw = group_neg(v_cw, group)
+        # V_alpha <- V_alpha - Convert(v1_keep) + Convert(v0_keep)
+        #            + (-1)^{t1} * V_CW
+        v_alpha = group_add(
+            group_sub(v_alpha, [v1l, v1r][keep], group),
+            group_add([v0l, v0r][keep],
+                      group_neg(v_cw, group) if sign1 else v_cw, group),
+            group)
         tl_cw = t0l ^ t1l ^ alpha_i ^ True
         tr_cw = t0r ^ t1r ^ alpha_i
         cws.append(Cw(s=s_cw, v=v_cw, tl=tl_cw, tr=tr_cw))
@@ -393,15 +503,27 @@ def gen(
                 [t1l, t1r][keep] ^ (ts[i - 1][1] & [tl_cw, tr_cw][keep]),
             )
         )
-    cw_np1 = xor_bytes(ss[n][0], ss[n][1], v_alpha)
+    # CW_{n+1} <- (-1)^{t1_n} * [Convert(s1_n) - Convert(s0_n) - V_alpha]
+    cw_np1 = group_sub(group_sub(ss[n][1], ss[n][0], group), v_alpha, group)
+    if ts[n][1]:
+        cw_np1 = group_neg(cw_np1, group)
     return Share(s0s=(bytes(s0s[0]), bytes(s0s[1])), cws=tuple(cws), cw_np1=cw_np1)
 
 
-def eval_point(prg: HirosePrgSpec, b: bool, k: Share, x: bytes) -> bytes:
-    """Single-point evaluation (src/lib.rs:163-193)."""
+def eval_point(
+    prg: HirosePrgSpec, b: bool, k: Share, x: bytes, group: str = "xor"
+) -> bytes:
+    """Single-point evaluation (src/lib.rs:163-193).
+
+    Returns the party's output-group share.  For the additive groups the
+    share carries the party sign ``(-1)^b`` (Boyle et al. Fig. 1 eval),
+    so reconstruction is always ``group_add(y0, y1, group)``; for XOR
+    the sign is the identity and this is ``y0 ^ y1``.
+    """
     n = len(k.cws)
     lam = len(k.cw_np1)
     assert n == 8 * len(x)
+    check_group(group, lam)
     zero = bytes(lam)
     s = k.s0s[0]
     t = bool(b)
@@ -414,17 +536,26 @@ def eval_point(prg: HirosePrgSpec, b: bool, k: Share, x: bytes) -> bytes:
             sr = xor_bytes(sr, cw.s)
         tl ^= t & cw.tl
         tr ^= t & cw.tr
+        # V <- V + (-1)^b * [Convert(v_hat_chosen) + t * V_CW]
         if _bit_msb(x, i - 1):
-            v = xor_bytes(v, vr_hat, cw.v if t else zero)
-            s, t = sr, tr
+            inc = group_add(vr_hat, cw.v if t else zero, group)
+            s_next, t_next = sr, tr
         else:
-            v = xor_bytes(v, vl_hat, cw.v if t else zero)
-            s, t = sl, tl
-    return xor_bytes(v, s, k.cw_np1 if t else zero)
+            inc = group_add(vl_hat, cw.v if t else zero, group)
+            s_next, t_next = sl, tl
+        if b:
+            inc = group_neg(inc, group)
+        v = group_add(v, inc, group)
+        s, t = s_next, t_next
+    inc = group_add(s, k.cw_np1 if t else zero, group)
+    if b:
+        inc = group_neg(inc, group)
+    return group_add(v, inc, group)
 
 
 def eval_batch(
-    prg: HirosePrgSpec, b: bool, k: Share, xs: Sequence[bytes]
+    prg: HirosePrgSpec, b: bool, k: Share, xs: Sequence[bytes],
+    group: str = "xor",
 ) -> list[bytes]:
     """Batch evaluation: a pure map over points (src/lib.rs:194-203)."""
-    return [eval_point(prg, b, k, x) for x in xs]
+    return [eval_point(prg, b, k, x, group) for x in xs]

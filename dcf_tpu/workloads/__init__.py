@@ -3,7 +3,9 @@
 ``workloads.core`` holds the BASELINE.json reference configs (config 3
 full-domain check, config 5 secure-ReLU); ``workloads.pir`` holds the
 2-server PIR workload built on the DPF EvalAll subsystem (the served
-selection-vector inner product).  Everything re-exports here, so
+selection-vector inner product); ``workloads.gates`` serves the
+fixed-point gate suite (``protocols.fixedpoint``) through
+``DcfService``.  Everything re-exports here, so
 ``from dcf_tpu.workloads import full_domain_check`` keeps working from
 the flat-module days.
 """
@@ -15,6 +17,7 @@ from dcf_tpu.workloads.core import (  # noqa: F401
     secure_relu_check_device,
     secure_relu_eval,
 )
+from dcf_tpu.workloads.gates import GateServer  # noqa: F401
 from dcf_tpu.workloads.pir import (  # noqa: F401
     PirDatabase,
     PirServer,
@@ -24,6 +27,7 @@ from dcf_tpu.workloads.pir import (  # noqa: F401
 )
 
 __all__ = [
+    "GateServer",
     "PirDatabase",
     "PirServer",
     "domain_points",

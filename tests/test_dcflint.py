@@ -252,6 +252,45 @@ def test_frontier_cache_layer_lint_clean():
     assert run_path(REPO / "dcf_tpu" / "backends" / "frontier.py") == []
 
 
+def test_fixedpoint_layer_lint_clean(tmp_path):
+    """The ISSUE-20 CI satellite: the fixed-point gate pair —
+    ``protocols/fixedpoint.py`` (gate keygen/eval/oracles) and
+    ``workloads/gates.py`` (the served form) — sweeps clean under ALL
+    passes.  Crypto-dtype is the load-bearing one: its scope now
+    includes both files, so a float dtype creeping into an arithmetic
+    share path (the classic probabilistic-truncation shortcut) is
+    caught, exactly as it would be under ops/ or backends/."""
+    assert run_path(REPO / "dcf_tpu" / "protocols"
+                    / "fixedpoint.py") == []
+    assert run_path(REPO / "dcf_tpu" / "workloads" / "gates.py") == []
+    # Detection power for the scope extension: a fixedpoint-shaped
+    # module quantizing through a float dtype IS caught...
+    write(tmp_path, "protocols/fixedpoint.py", (
+        "import numpy as np\n"
+        "def quantize(x, f):\n"
+        "    return (x * np.float32(2.0 ** f)).astype(np.int32)\n"))
+    got = [v for v in run_path(tmp_path, ["crypto-dtype"])
+           if v.path.endswith("fixedpoint.py")]
+    assert [v.line for v in got] == [3]
+    # ...and the same code OUTSIDE the scoped pair is not (the pass
+    # stays a key/CW/value-path rule, not a repo-wide float ban).
+    write(tmp_path, "protocols/other.py", (
+        "import numpy as np\n"
+        "def quantize(x, f):\n"
+        "    return (x * np.float32(2.0 ** f)).astype(np.int32)\n"))
+    assert [v for v in run_path(tmp_path, ["crypto-dtype"])
+            if v.path.endswith("other.py")] == []
+    # secret-hygiene learned the gate names: the truncation gate's
+    # additive scalar shares and the signed per-key payloads.
+    write(tmp_path, "protocols/gatey.py", (
+        "def f(const_share, key_betas):\n"
+        "    log(f'shares: {const_share}')\n"
+        "    print('payloads', key_betas)\n"))
+    got = [v for v in run_path(tmp_path, ["secret-hygiene"])
+           if v.path.endswith("gatey.py")]
+    assert [v.line for v in got] == [2, 3]
+
+
 def test_secret_hygiene_covers_store_layer(tmp_path):
     """ISSUE 8 rule 4: the durable store layer.  ``frame`` joined the
     key-material name set (a serialized DCFK frame IS the key), and a

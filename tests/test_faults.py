@@ -364,7 +364,7 @@ def test_key_class_reprs_redact(bundle):
     traceback that formats a bundle."""
     r = repr(bundle)
     assert r == ("KeyBundle(K=2, n_bits=16, lam=16, parties=2, "
-                 "<1184 key-material bytes redacted>)")
+                 "group=xor, <1184 key-material bytes redacted>)")
     # no array/bytes content: every byte value of the actual key material
     # is absent from the repr
     assert bundle.s0s.tobytes() not in r.encode()

@@ -1,0 +1,427 @@
+"""Fixed-point gate suite over the additive output group (ISSUE 20).
+
+Three layers, matching the subsystem's own:
+
+* the GATE ALGEBRA (``protocols.fixedpoint``): signed comparison via
+  the DCF offset trick, faithful truncation from two prefix ICs plus an
+  additive constant share, and spline sigmoid as an r-shifted MIC —
+  each reconstructed bit-exactly against its numpy golden oracle across
+  groups, masks (including r=0, N-1 and the sign boundary) and domain
+  widths;
+* the ADDITIVE PROTOCOL layer underneath (``group="add*"`` threaded
+  through keygen/combine): backend-family parity — host/bitsliced/
+  prefix facades and the sharded 2x2-mesh backends — both parties, both
+  bounds, x exactly on a cut, against the same oracles that pin the XOR
+  path;
+* the SERVED form (``workloads.gates.GateServer``): component bundles
+  registered through ``DcfService`` under derived ids, shares folded
+  client-side, hot-swap by re-registration, and (slow leg) a soak under
+  injected ``protocols.combine`` faults riding the service's
+  retry-then-evict discipline.
+
+Unit tests run in tier-1 on the threaded legs; the fault soak
+(``gates and slow``) rides the serial CI leg.
+"""
+
+import numpy as np
+import pytest
+
+from dcf_tpu import Dcf
+from dcf_tpu.errors import ShapeError
+from dcf_tpu.protocols import (
+    eval_sigmoid_share,
+    eval_sign_share,
+    eval_trunc_share,
+    gate_reconstruct,
+    gen_sigmoid_gate,
+    gen_sign_gate,
+    gen_trunc_gate,
+    mic_oracle,
+    sigmoid_fixed_oracle,
+    sigmoid_table,
+    sign_oracle,
+    trunc_oracle,
+)
+from dcf_tpu.protocols.fixedpoint import decode_lanes, encode_lanes
+from dcf_tpu.spec import Bound
+from dcf_tpu.testing import faults
+from dcf_tpu.utils.groups import np_group_add
+from dcf_tpu.workloads import GateServer
+
+pytestmark = pytest.mark.gates
+
+NB, LAM = 2, 16
+W = 8 * NB
+N = 1 << W
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0xF1BED)
+
+
+@pytest.fixture
+def ck(rng):
+    return [rng.bytes(32), rng.bytes(32)]
+
+
+@pytest.fixture
+def dcf(ck):
+    return Dcf(NB, LAM, ck, backend="bitsliced")
+
+
+@pytest.fixture
+def dcf_low(ck):
+    return Dcf(1, LAM, ck, backend="bitsliced")
+
+
+def gate_points(rng, n=128):
+    """Random masked inputs plus every boundary the gates care about:
+    0, N-1, the sign boundary, and the f=8 truncation carry edges."""
+    return np.concatenate([
+        rng.integers(0, N, size=n, dtype=np.int64),
+        np.array([0, 1, N - 1, N // 2, N // 2 - 1, 255, 256, 257],
+                 dtype=np.int64)])
+
+
+# ------------------------------------------------------------ lane codec
+
+
+def test_lane_codec_roundtrip():
+    got = decode_lanes(
+        encode_lanes(np.array([5, -3, 70000]), "add16", LAM), "add16")
+    assert got.tolist() == [5, (N - 3) % N, 70000 % N]
+
+
+def test_lane_codec_refuses_floats():
+    with pytest.raises(ShapeError):
+        encode_lanes(np.array([1.5]), "add16", LAM)
+
+
+# ------------------------------------------------------------- sign gate
+
+
+@pytest.mark.parametrize("group", ["add16", "add32"])
+def test_sign_gate_bit_exact(dcf, rng, group):
+    """sign(x) = IC over [2^{w-1}+r, r) on the masked input: both
+    parties' shares group-add to the oracle for every mask class."""
+    x_hat = gate_points(rng)
+    for r in (0, 1, 12345, N // 2, N - 1, 0x1200, 0x00FF):
+        g = gen_sign_gate(dcf, r, rng, group)
+        y0 = eval_sign_share(dcf, 0, g.for_party(0), x_hat)
+        y1 = eval_sign_share(dcf, 1, g.for_party(1), x_hat)
+        got = gate_reconstruct(y0, y1, group)
+        want = sign_oracle((x_hat - r) % N, W)
+        assert np.array_equal(got, want), (group, r)
+
+
+# ------------------------------------------------------------ truncation
+
+
+def test_trunc_gate_bit_exact(dcf, dcf_low, rng):
+    """Faithful truncation (not probabilistic): the borrow IC on the
+    f-bit low half and the wraparound IC on the full domain make the
+    identity exact for EVERY input, including the carry edges."""
+    x_hat = gate_points(rng)
+    for r in (0, 1, 0x1200, 0x00FF, 0xFF00, N - 1, 54321):
+        g = gen_trunc_gate(dcf, dcf_low, r, 8, rng, "add16")
+        y0 = eval_trunc_share(dcf, dcf_low, 0, g.for_party(0), x_hat)
+        y1 = eval_trunc_share(dcf, dcf_low, 1, g.for_party(1), x_hat)
+        got = gate_reconstruct(y0, y1, "add16")
+        assert np.array_equal(got, trunc_oracle(x_hat, r, 8, W)), r
+
+
+def test_trunc_gate_wide_domain(ck, rng):
+    """Same identity on the 4-byte domain with a 2-byte fraction —
+    the low-half service really is a different-width Dcf facade."""
+    d4 = Dcf(4, LAM, ck, backend="bitsliced")
+    d4_low = Dcf(2, LAM, ck, backend="bitsliced")
+    n4 = 1 << 32
+    xh = np.concatenate([
+        rng.integers(0, n4, size=48, dtype=np.int64),
+        np.array([0, 1, n4 - 1, n4 // 2], dtype=np.int64)])
+    for r in (0, 0xDEADBEEF, 0x0000FFFF, n4 - 1):
+        g = gen_trunc_gate(d4, d4_low, r, 16, rng, "add32")
+        y0 = eval_trunc_share(d4, d4_low, 0, g.for_party(0), xh)
+        y1 = eval_trunc_share(d4, d4_low, 1, g.for_party(1), xh)
+        assert np.array_equal(gate_reconstruct(y0, y1, "add32"),
+                              trunc_oracle(xh, r, 16, 32)), r
+
+
+def test_trunc_const_share_party_restricted(dcf, dcf_low, rng):
+    g = gen_trunc_gate(dcf, dcf_low, 77, 8, rng, "add16")
+    g0 = g.for_party(0)
+    assert g0.const_for(0).shape == (LAM,)
+    with pytest.raises(ShapeError):
+        g0.const_for(1)
+
+
+def test_trunc_repr_redacts_const_share(dcf, dcf_low, rng):
+    """secret-hygiene rule 3 in action: the repr shows geometry, never
+    the additive scalar shares (the pair reveals the mask's high bits)."""
+    g = gen_trunc_gate(dcf, dcf_low, 0x1234, 8, rng, "add16")
+    text = repr(g)
+    assert "const_share" not in text or "redacted" in text
+    for b in (0, 1):
+        assert g.const_for(b).tobytes().hex() not in text
+
+
+# --------------------------------------------------------------- sigmoid
+
+
+def test_sigmoid_table_contract():
+    f = 8
+    cuts, vals = sigmoid_table(W, f, 16)
+    assert len(cuts) == 16 and cuts[0] == 0
+    assert vals.min() == 0 and vals.max() <= (1 << f)  # saturates
+    # value at x=0 ~ sigma(0)=0.5; pieces anchor at cut boundaries so
+    # the piece containing 0 carries its MIDPOINT's sigma — allow the
+    # half-piece-width slack, not exact 2^{f-1}.
+    mid = sigmoid_fixed_oracle(np.array([0]), cuts, vals)[0]
+    assert abs(int(mid) - (1 << (f - 1))) <= 40, mid
+    with pytest.raises(ShapeError):
+        sigmoid_table(W, f, 15)  # odd m: pieces come in +/- pairs
+    with pytest.raises(ShapeError):
+        sigmoid_table(W, f, 2)  # below the minimum partition
+    with pytest.raises(ShapeError):
+        sigmoid_table(W, W, 16)  # f must leave integer bits
+
+
+@pytest.mark.parametrize("group", ["add16", "add32"])
+def test_sigmoid_gate_bit_exact(dcf, rng, group):
+    """The r-shifted partition is still a partition: served spline
+    output equals the table oracle on the unmasked input, bit-exact."""
+    x_hat = gate_points(rng)
+    for r in (0, 7, 0x8000, 0x1234, N - 1):
+        g = gen_sigmoid_gate(dcf, r, rng, group, f=8, m=16)
+        y0 = eval_sigmoid_share(dcf, 0, g.for_party(0), x_hat)
+        y1 = eval_sigmoid_share(dcf, 1, g.for_party(1), x_hat)
+        got = gate_reconstruct(y0, y1, group)
+        want = sigmoid_fixed_oracle((x_hat - r) % N, g.cuts, g.values)
+        assert np.array_equal(got, want), (group, r)
+
+
+def test_sigmoid_accuracy_pin():
+    """m=32 table max abs error vs the real sigmoid is bounded by
+    slope x piece half-width: 0.25 * (8/15) ~ 0.07.  Pin at 0.08 so a
+    regression in cut placement (not float noise) trips it."""
+    f = 8
+    cuts, vals = sigmoid_table(W, f, 32)
+    xs = np.arange(0, N, 37, dtype=np.int64)
+    tab = sigmoid_fixed_oracle(xs, cuts, vals) / (1 << f)
+    signed = np.where(xs >= N // 2, xs - N, xs)
+    true = 1.0 / (1.0 + np.exp(-signed / (1 << f)))
+    assert np.abs(tab - true).max() < 0.08
+
+
+def test_gates_refuse_xor_group(dcf, rng):
+    with pytest.raises(ShapeError):
+        gen_sign_gate(dcf, 5, rng, "xor")
+
+
+# ------------------------------------ additive backend-family parity
+
+
+IV = [(10, 60), (60, 300), (300, 4096), (40000, 40001), (60000, N),
+      (5000, 5000), (0, N), (50000, 2000)]
+# plain, adjacent, big, singleton, suffix, empty, full-domain, wrap
+
+
+def edge_points(rng, n=48):
+    """Random points plus every IV endpoint (x exactly on a cut)."""
+    return np.vstack([
+        rng.integers(0, 256, size=(n, NB), dtype=np.uint8),
+        np.array([[0, 10], [0, 59], [0, 60], [19, 136], [234, 96],
+                  [255, 255], [0, 0], [195, 80]], dtype=np.uint8)])
+
+
+@pytest.mark.parametrize("backend", ["auto", "bitsliced", "prefix"])
+def test_additive_mic_facade_backend_parity(ck, rng, backend):
+    """Every facade backend family reconstructs the additive MIC
+    bit-exactly: both parties, both bounds, points on the cuts."""
+    d = Dcf(NB, LAM, ck, backend=backend)
+    xs = edge_points(rng)
+    for group in ("add16", "add32", "add8"):
+        for bound in (Bound.LT_BETA, Bound.GT_BETA):
+            betas = rng.integers(0, 256, size=(len(IV), LAM),
+                                 dtype=np.uint8)
+            pb = d.mic(IV, betas, bound=bound, rng=rng, group=group)
+            assert pb.group == group
+            y0 = d.eval_mic(0, pb.for_party(0), xs)
+            y1 = d.eval_mic(1, pb.for_party(1), xs)
+            got = np_group_add(y0, y1, group)
+            assert np.array_equal(got, mic_oracle(xs, IV, betas)), \
+                (backend, group, bound)
+
+
+def test_additive_sharded_mesh_parity(rng):
+    """The sharded 2x2-mesh backends (Pallas walk + prefix frontier,
+    interpret mode) match the host oracle per-party for additive
+    bundles: both parties, both bounds, x=alpha and the domain edges."""
+    import jax
+    from jax.sharding import Mesh
+
+    from dcf_tpu.backends.numpy_backend import eval_batch_np
+    from dcf_tpu.gen import gen_batch
+    from dcf_tpu.ops.prg import HirosePrgNp
+    from dcf_tpu.parallel.pallas_sharded import (
+        ShardedPallasBackend,
+        ShardedPrefixBackend,
+    )
+
+    cks = [bytes(range(32)), bytes(range(1, 33))]
+    prg = HirosePrgNp(LAM, cks)
+    n_bits, nb = 24, 3
+    n_tot = 1 << n_bits
+    mesh22 = Mesh(np.array(jax.devices())[:4].reshape(2, 2),
+                  ("keys", "points"))
+    mesh14 = Mesh(np.array(jax.devices())[:4].reshape(1, 4),
+                  ("keys", "points"))
+
+    def to_bytes(vals):
+        out = np.zeros((len(vals), nb), dtype=np.uint8)
+        for j in range(nb):
+            out[:, j] = (vals >> (8 * (nb - 1 - j))) & 0xFF
+        return out
+
+    for group, bound in (("add32", Bound.LT_BETA),
+                         ("add8", Bound.GT_BETA)):
+        k_num = 2
+        alphas = rng.integers(0, n_tot, size=k_num, dtype=np.uint64)
+        betas = rng.integers(0, 256, size=(k_num, LAM), dtype=np.uint8)
+        s0s = rng.integers(0, 256, size=(k_num, 2, LAM), dtype=np.uint8)
+        bundle = gen_batch(prg, to_bytes(alphas), betas, s0s, bound,
+                           group=group)
+        m = 48
+        xs = rng.integers(0, n_tot, size=m, dtype=np.uint64)
+        xs[:k_num] = alphas  # x exactly on alpha
+        xs[k_num], xs[k_num + 1] = 0, n_tot - 1
+        xb = to_bytes(xs)
+        want = [eval_batch_np(prg, b, bundle.for_party(b), xb)
+                for b in (0, 1)]
+
+        for b in (0, 1):
+            be = ShardedPallasBackend(LAM, cks, mesh22, interpret=True)
+            be.put_bundle(bundle.for_party(b))
+            st = be.stage(xb[None].repeat(k_num, axis=0))
+            out = be.staged_to_bytes(be.eval_staged(b, st), m)
+            assert np.array_equal(out, want[b]), \
+                ("sharded-pallas", group, bound, b)
+
+            bp = ShardedPrefixBackend(LAM, cks, mesh14, prefix_levels=6,
+                                      interpret=True, host_levels=6)
+            bp.put_bundle(bundle.for_party(b))
+            stp = bp.stage(xb)
+            out = bp.staged_to_bytes(bp.eval_staged(b, stp), m)
+            assert np.array_equal(out, want[b]), \
+                ("sharded-prefix", group, bound, b)
+
+
+# ----------------------------------------------------------- served path
+
+
+def make_gate_server(d, d_low, **knobs):
+    knobs.setdefault("max_batch", 64)
+    svc = d.serve(**knobs).start()
+    svc_low = d_low.serve(**knobs).start()
+    return svc, svc_low, GateServer(svc, svc_low)
+
+
+def test_served_gates_bit_exact(dcf, dcf_low, rng):
+    """All three gates through the SERVED path (started services,
+    registry snapshots, client-side fold) vs the same oracles, plus
+    hot-swap by re-registration."""
+    svc, svc_low, gs = make_gate_server(dcf, dcf_low)
+    try:
+        x_hat = gate_points(rng)
+        r1, r2, r3 = 0x1234, 0xBEEF, 0x00FF
+        gs.register("cmp", gen_sign_gate(dcf, r1, rng, "add16"))
+        gs.register("trunc",
+                    gen_trunc_gate(dcf, dcf_low, r2, 8, rng, "add16"))
+        sg = gen_sigmoid_gate(dcf, r3, rng, "add16", f=8, m=16)
+        gs.register("sig", sg)
+
+        got = decode_lanes(gs.reconstruct("cmp", x_hat), "add16")
+        assert np.array_equal(got, sign_oracle((x_hat - r1) % N, W))
+        got = decode_lanes(gs.reconstruct("trunc", x_hat), "add16")
+        assert np.array_equal(got, trunc_oracle(x_hat, r2, 8, W))
+        got = decode_lanes(gs.reconstruct("sig", x_hat), "add16")
+        assert np.array_equal(
+            got, sigmoid_fixed_oracle((x_hat - r3) % N, sg.cuts,
+                                      sg.values))
+
+        # hot-swap: a fresh mask under the same gate id is a new dealer
+        # generation — the swapped components must all be the new ones.
+        gs.register("sig",
+                    gen_sigmoid_gate(dcf, 777, rng, "add16", f=8, m=16))
+        sg2 = gs.gate("sig")
+        got = decode_lanes(gs.reconstruct("sig", x_hat), "add16")
+        assert np.array_equal(
+            got, sigmoid_fixed_oracle((x_hat - 777) % N, sg2.cuts,
+                                      sg2.values))
+    finally:
+        svc.close()
+        svc_low.close()
+
+
+def test_gate_server_typed_refusals(dcf, dcf_low, rng):
+    svc = dcf.serve()
+    try:
+        gs = GateServer(svc)  # no low-domain service
+        with pytest.raises(ShapeError):
+            gs.register("t", gen_trunc_gate(dcf, dcf_low, 1, 8, rng,
+                                            "add16"))
+        with pytest.raises(ShapeError):
+            gs.register("x", object())
+        with pytest.raises(ShapeError):
+            gs.eval_share("missing", 0, np.array([1]))
+    finally:
+        svc.close()
+
+
+@pytest.mark.slow
+def test_served_gate_soak_under_combine_faults(dcf, dcf_low, rng):
+    """The acceptance fault clause, served form: a deterministic
+    every-5th-fire ``protocols.combine`` fault under many rounds of all
+    three gates; the service's retry machinery absorbs every injected
+    failure (never two consecutive on one key, so the breaker stays
+    closed) and each round reconstructs bit-exactly.  Serial CI leg
+    only (gates and slow)."""
+    svc, svc_low, gs = make_gate_server(dcf, dcf_low, retries=3)
+    try:
+        r1, r2, r3 = 0x0100, 0xFFFE, 0x8421
+        gs.register("cmp", gen_sign_gate(dcf, r1, rng, "add16"))
+        gs.register("trunc",
+                    gen_trunc_gate(dcf, dcf_low, r2, 8, rng, "add16"))
+        sg = gen_sigmoid_gate(dcf, r3, rng, "add16", f=8, m=16)
+        gs.register("sig", sg)
+
+        fired = {"n": 0}
+
+        def every_fifth(*args):
+            fired["n"] += 1
+            if fired["n"] % 5 == 0:
+                raise faults.InjectedFault(
+                    f"injected combine fault #{fired['n']}")
+
+        with faults.inject("protocols.combine", handler=every_fifth):
+            for round_i in range(25):
+                x_hat = rng.integers(0, N, size=96, dtype=np.int64)
+                got = decode_lanes(gs.reconstruct("cmp", x_hat),
+                                   "add16")
+                assert np.array_equal(
+                    got, sign_oracle((x_hat - r1) % N, W)), round_i
+                got = decode_lanes(gs.reconstruct("trunc", x_hat),
+                                   "add16")
+                assert np.array_equal(
+                    got, trunc_oracle(x_hat, r2, 8, W)), round_i
+                got = decode_lanes(gs.reconstruct("sig", x_hat),
+                                   "add16")
+                assert np.array_equal(
+                    got, sigmoid_fixed_oracle((x_hat - r3) % N,
+                                              sg.cuts, sg.values)), \
+                    round_i
+        assert fired["n"] >= 100  # the seam really rode every batch
+    finally:
+        svc.close()
+        svc_low.close()

@@ -17,6 +17,13 @@ a wider header), which the strict exact-size section decode rejects.
 The fuzz pins exactly that reasoning against regressions in either
 reader (they share ``keys._decode_sections`` by design).
 
+ISSUE 20 extends the sweep to the v4 ADDITIVE-GROUP frames (plain and
+protocol, with the widened header carrying the output-group code):
+the same seeded flips, truncation/extension sweeps, the group-code
+mutation, and the cross-reader gates in both directions — plus the
+version-pinning check that XOR frames stay on v2/v3, byte-compatible
+with pre-v4 readers.
+
 ISSUE 8 extends the sweep to the DURABLE STORE: the same seeded flips
 and truncations applied to the on-disk frame files and to the CRC'd
 manifest.  A mutated frame read back through ``KeyStore.load`` must die
@@ -198,6 +205,102 @@ def test_dpf_truncations_and_extensions_rejected_typed(dpf_frame, rng):
             DpfBundle.from_bytes(dpf_frame[:cut])
     with pytest.raises(KeyFormatError):
         DpfBundle.from_bytes(dpf_frame + b"\x00")
+
+
+# ----------------------------- v4 additive-group frames (ISSUE 20)
+
+
+@pytest.fixture(scope="module")
+def v4_plain_frame(rng):
+    from dcf_tpu.gen import gen_batch, random_s0s
+    from dcf_tpu.ops.prg import HirosePrgNp
+
+    prg = HirosePrgNp(LAM, [rng.bytes(32), rng.bytes(32)])
+    alphas = rng.integers(0, 256, (2, NB), dtype=np.uint8)
+    betas = rng.integers(0, 256, (2, LAM), dtype=np.uint8)
+    return gen_batch(prg, alphas, betas, random_s0s(2, LAM, rng),
+                     Bound.LT_BETA, group="add16").to_bytes()
+
+
+@pytest.fixture(scope="module")
+def v4_proto_frame(rng):
+    from dcf_tpu.gen import gen_batch, random_s0s
+    from dcf_tpu.ops.prg import HirosePrgNp
+
+    prg = HirosePrgNp(LAM, [rng.bytes(32), rng.bytes(32)])
+
+    def gen_fn(alphas, key_betas, bound):
+        return gen_batch(prg, alphas, key_betas,
+                         random_s0s(alphas.shape[0], LAM, rng), bound,
+                         group="add16")
+
+    betas = rng.integers(0, 256, (2, LAM), dtype=np.uint8)
+    pb = gen_interval_bundle(gen_fn, [(10, 60), (100, 200)], betas, NB,
+                             group="add16")
+    return pb.to_bytes()
+
+
+def test_version_pinning_xor_stays_pre_v4(v2_frame, v3_frame,
+                                          v4_plain_frame,
+                                          v4_proto_frame):
+    """Only additive bundles write v4: XOR frames stay byte-compatible
+    with pre-v4 readers (v2 plain / v3 protocol), so old key stores
+    keep loading, while every additive frame announces the wider
+    header — a v3-era reader refuses it loudly ("unsupported version
+    4") instead of silently reconstructing with the wrong algebra."""
+    assert v2_frame[4] == 2 and v3_frame[4] == 3
+    assert v4_plain_frame[4] == 4 and v4_proto_frame[4] == 4
+
+
+def test_v4_plain_byte_flips_all_rejected_typed(v4_plain_frame, rng):
+    _fuzz(v4_plain_frame, KeyBundle.from_bytes, rng, N_FLIPS)
+
+
+def test_v4_proto_byte_flips_all_rejected_typed(v4_proto_frame, rng):
+    _fuzz(v4_proto_frame, ProtocolBundle.from_bytes, rng, N_FLIPS)
+
+
+def test_v4_cross_reader_gates(v4_plain_frame, v4_proto_frame, rng):
+    """Cross-reader gating for the additive frames: a v4 protocol frame
+    fed to the plain reader (dropping the combine masks) and a v4 plain
+    frame fed to the protocol reader are refused typed, pristine and
+    under corruption."""
+    with pytest.raises(KeyFormatError, match="protocol"):
+        KeyBundle.from_bytes(v4_proto_frame)
+    with pytest.raises(KeyFormatError):
+        ProtocolBundle.from_bytes(v4_plain_frame)
+    for frame, decode in ((v4_proto_frame, KeyBundle.from_bytes),
+                          (v4_plain_frame, ProtocolBundle.from_bytes)):
+        for _ in range(40):
+            mutated = faults.corrupt(frame,
+                                     int(rng.integers(0, len(frame))),
+                                     int(rng.integers(1, 256)))
+            with pytest.raises(KeyFormatError):
+                decode(mutated)
+
+
+def test_v4_unknown_group_code_rejected_typed(v4_proto_frame):
+    """The group field itself (v4 header, low byte at offset 16) is
+    validated before any section decode: an unknown code names itself
+    in the error (or dies at the CRC, depending on the flip) — never a
+    KeyError out of the code table."""
+    bad = bytearray(v4_proto_frame)
+    bad[16] = 99
+    with pytest.raises(KeyFormatError):
+        ProtocolBundle.from_bytes(bytes(bad))
+
+
+def test_v4_truncations_and_extensions_rejected_typed(v4_plain_frame,
+                                                      v4_proto_frame,
+                                                      rng):
+    for frame, decode in ((v4_plain_frame, KeyBundle.from_bytes),
+                          (v4_proto_frame, ProtocolBundle.from_bytes)):
+        for cut in sorted({int(c) for c in
+                           rng.integers(0, len(frame), 25)}):
+            with pytest.raises(KeyFormatError):
+                decode(frame[:cut])
+        with pytest.raises(KeyFormatError):
+            decode(frame + b"\x00")
 
 
 # --------------------------------------- the durable store (ISSUE 8)

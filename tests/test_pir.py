@@ -202,6 +202,84 @@ def test_facade_pir_query(rng, ck, evaluator):
     evaluator.invalidate()
 
 
+def test_pir_answers_through_pod_router_door(rng, prg, evaluator):
+    """ISSUE 20 satellite: PIR answers over the DCFE wire end to end —
+    an EdgeClient at the POD DOOR, the router relaying the request to
+    the owning shard's EdgeServer, the shard a real ``DcfService`` with
+    an attached PIR context.  The DPF query key fans out through
+    ``DcfRouter.register_key`` (proto=2 frames, owner + replica), the
+    query itself is a one-placeholder-point REQUEST frame (the key IS
+    the query), and the [K, record_bytes] answer shares ride the SHARE
+    frame as [K, 1, record_bytes] — two hops, bit-exact."""
+    from dcf_tpu.api import Dcf
+    from dcf_tpu.serve import (
+        DcfRouter,
+        EdgeClient,
+        EdgeServer,
+        ShardMap,
+        ShardSpec,
+    )
+
+    n = 9
+    records, db = _db(rng, n)
+    ck2 = _cipher_keys(rng)
+    d = Dcf(2, LAM, ck2, backend="bitsliced")  # 16-bit wire domain
+    prg2 = HirosePrgNp(LAM, ck2)
+    ev = DpfEvalAll(LAM, ck2, interpret=True)
+    svcs, servers, specs = [], [], []
+    try:
+        for i in range(2):
+            svc = d.serve(max_batch=32, max_delay_ms=1.0).start()
+            svc.attach_pir(db, ev)
+            srv = EdgeServer(svc).start()
+            svcs.append(svc)
+            servers.append(srv)
+            specs.append(ShardSpec(f"shard-{i}", *srv.address))
+        router = DcfRouter(ShardMap(specs), n_bytes=2)
+        router.start()
+        try:
+            idx = [0, 511, 300]
+            bundle = pir_query_bundle(prg2, idx, n,
+                                      random_s0s(len(idx), LAM, rng))
+            router.register_key("q", bundle)
+            placeholder = np.zeros((1, 2), dtype=np.uint8)
+            with EdgeClient(*router.address, n_bytes=2) as c:
+                a0 = c.evaluate("q", placeholder, b=0, timeout=120)
+                a1 = c.evaluate("q", placeholder, b=1, timeout=120)
+            assert a0.shape == (len(idx), 1, db.record_bytes)
+            got = pir_reconstruct(a0[:, 0, :], a1[:, 0, :])
+            np.testing.assert_array_equal(got, records[idx])
+            answered = sum(
+                svc.metrics.snapshot()["serve_pir_answers_total"]
+                for svc in svcs)
+            assert answered == 2  # both parties served THROUGH a shard
+        finally:
+            router.close()
+    finally:
+        for srv in servers:
+            srv.close()
+        for svc in svcs:
+            svc.close(drain=False)
+
+
+def test_service_pir_requires_attached_db(rng, prg):
+    """A DPF registration without a database context refuses typed at
+    submit — never a point batch against selection-vector material."""
+    from dcf_tpu.api import Dcf
+
+    ck2 = _cipher_keys(rng)
+    d = Dcf(2, LAM, ck2, backend="bitsliced")
+    svc = d.serve()
+    try:
+        bundle = pir_query_bundle(HirosePrgNp(LAM, ck2), [3], 9,
+                                  random_s0s(1, LAM, rng))
+        svc.register_key("q", bundle)
+        with pytest.raises(ShapeError, match="attach_pir"):
+            svc.submit("q", np.zeros((1, 2), dtype=np.uint8), b=0)
+    finally:
+        svc.close()
+
+
 @pytest.mark.slow
 def test_served_pir_soak_under_eval_faults(rng, prg, evaluator):
     """The serial-leg soak: a stream of fresh queries served while

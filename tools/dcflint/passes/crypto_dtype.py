@@ -1,7 +1,12 @@
 """crypto-dtype: integer-only math on the key/CW/value paths.
 
 Scope: files under ``ops/`` and ``backends/`` — the modules that touch
-seeds, correction words and value shares.  Two rules:
+seeds, correction words and value shares — plus the fixed-point gate
+pair (ISSUE 20): ``protocols/fixedpoint.py`` and
+``workloads/gates.py``, where additive shares are ARITHMETIC and a
+float is the likeliest way for a rounding step to corrupt one (the
+dealer's sigma table is scalar ``math`` rounded to int before any
+ndarray exists, so the rule holds there too).  Two rules:
 
 1. No float dtypes.  The GGM walk, the PRG and the CW algebra are
    GF(2)/integer math; a float anywhere on those paths means a rounding
@@ -21,6 +26,8 @@ from typing import Iterator
 from tools.dcflint import FileContext, LintPass, register
 
 _SCOPE_DIRS = ("ops", "backends")
+# The fixed-point gate pair (ISSUE 20): (containing dir, file name).
+_SCOPE_FILES = (("protocols", "fixedpoint.py"), ("workloads", "gates.py"))
 _JNP_NAMES = ("jnp", "jax.numpy")
 _FLOAT_ATTRS = ("float16", "float32", "float64", "bfloat16", "float_",
                 "double", "half")
@@ -48,7 +55,10 @@ class CryptoDtypePass(LintPass):
                    "ops/ and backends/")
 
     def check(self, ctx: FileContext) -> Iterator[tuple[int, str]]:
-        if not any(d in ctx.parts[:-1] for d in _SCOPE_DIRS):
+        in_scope = any(d in ctx.parts[:-1] for d in _SCOPE_DIRS) \
+            or any(d in ctx.parts[:-1] and ctx.parts[-1] == f
+                   for d, f in _SCOPE_FILES)
+        if not in_scope:
             return
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.Attribute) \

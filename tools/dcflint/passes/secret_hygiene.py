@@ -45,7 +45,8 @@ from tools.dcflint import FileContext, LintPass, register
 SECRET_NAME_RE = re.compile(
     r"^(seed\w*|s0s?|cw(_\w+)?|cws|key_bundle|bundle|kb|key_material"
     r"|cipher_keys?|combine_masks?|frames?|frame_bytes|key_frame"
-    r"|repl(ica)?_frames?|shares?(_\w+)?|t_words?|sel(ection)?_vecs?)$")
+    r"|repl(ica)?_frames?|shares?(_\w+)?|t_words?|sel(ection)?_vecs?"
+    r"|key_betas?|const_shares?)$")
 # ``frame`` (ISSUE 8, dcf_tpu/serve/store.py): a serialized DCFK frame
 # is the seeds and correction words it encodes — logging one is
 # logging the key.
@@ -73,6 +74,13 @@ SECRET_NAME_RE = re.compile(
 # next to the other party's they reconstruct the one-hot at alpha,
 # i.e. WHICH record the client asked for.  The query privacy the whole
 # 2-server construction exists to provide dies in one log line.
+# ``key_betas`` (ISSUE 20, dcf_tpu/protocols/keygen.py): the per-key
+# signed payloads of an additive interval bundle — beta up to sign,
+# the secret function value.  ``const_share``/``const_shares`` (ISSUE
+# 20, dcf_tpu/protocols/fixedpoint.py): the truncation gate's additive
+# scalar shares of ``-(r >> f)`` — one share is uniform noise, but the
+# PAIR reveals the input mask's high bits, so the sink rule and the
+# redacted-repr rule both apply.
 _PRINT_FUNCS = ("print", "log", "labeled")
 _LOGGING_METHODS = ("debug", "info", "warning", "error", "critical",
                     "exception", "log")
